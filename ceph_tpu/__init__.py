@@ -1,0 +1,11 @@
+"""ceph_tpu — a TPU-native storage-compute framework with the capabilities of Ceph.
+
+Re-expresses Ceph's embarrassingly-parallel inner loops (CRUSH placement and
+erasure-code stripe encode/decode) as jitted JAX/XLA/Pallas array programs, and
+rebuilds the surrounding control plane (cluster map, EC profiles/registry,
+placement pipeline, cluster simulator, CLI tools) TPU-first.
+
+Reference under survey: fzakaria/ceph (Quincy), see SURVEY.md.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,1 @@
+"""Low-level array ops: hashes, GF arithmetic, device kernels."""

@@ -1,0 +1,181 @@
+"""rjenkins1 32-bit mixing hash — CRUSH's only randomness source.
+
+Three implementations sharing one spec (reference: src/crush/hash.c:12-90):
+
+  * python-int scalars (`hash1`..`hash5`)   — used by the scalar reference mapper
+  * numpy vectorized  (`np_hash2/np_hash3`) — host-side batch utilities
+  * jax vectorized    (`jx_hash2/jx_hash3`) — traced into the TPU placement kernels
+
+All arithmetic is modulo 2^32; the seed constant is 1315423911 (hash.c:24).
+The mix schedule (which operands feed each 9-op mixing round) differs per arity
+and is part of the wire-compatible spec.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+M32 = 0xFFFFFFFF
+SEED = 1315423911
+MIX_X = 231232
+MIX_Y = 1232
+
+
+# ---------------------------------------------------------------- scalar ----
+
+def _mix(a: int, b: int, c: int):
+    a = (a - b) & M32; a = (a - c) & M32; a = a ^ (c >> 13)
+    b = (b - c) & M32; b = (b - a) & M32; b = (b ^ (a << 8)) & M32
+    c = (c - a) & M32; c = (c - b) & M32; c = c ^ (b >> 13)
+    a = (a - b) & M32; a = (a - c) & M32; a = a ^ (c >> 12)
+    b = (b - c) & M32; b = (b - a) & M32; b = (b ^ (a << 16)) & M32
+    c = (c - a) & M32; c = (c - b) & M32; c = c ^ (b >> 5)
+    a = (a - b) & M32; a = (a - c) & M32; a = a ^ (c >> 3)
+    b = (b - c) & M32; b = (b - a) & M32; b = (b ^ (a << 10)) & M32
+    c = (c - a) & M32; c = (c - b) & M32; c = c ^ (b >> 15)
+    return a, b, c
+
+
+def hash1(a: int) -> int:
+    a &= M32
+    h = (SEED ^ a) & M32
+    b, x, y = a, MIX_X, MIX_Y
+    b, x, h = _mix(b, x, h)
+    y, a, h = _mix(y, a, h)
+    return h
+
+
+def hash2(a: int, b: int) -> int:
+    a &= M32; b &= M32
+    h = (SEED ^ a ^ b) & M32
+    x, y = MIX_X, MIX_Y
+    a, b, h = _mix(a, b, h)
+    x, a, h = _mix(x, a, h)
+    b, y, h = _mix(b, y, h)
+    return h
+
+
+def hash3(a: int, b: int, c: int) -> int:
+    a &= M32; b &= M32; c &= M32
+    h = (SEED ^ a ^ b ^ c) & M32
+    x, y = MIX_X, MIX_Y
+    a, b, h = _mix(a, b, h)
+    c, x, h = _mix(c, x, h)
+    y, a, h = _mix(y, a, h)
+    b, x, h = _mix(b, x, h)
+    y, c, h = _mix(y, c, h)
+    return h
+
+
+def hash4(a: int, b: int, c: int, d: int) -> int:
+    a &= M32; b &= M32; c &= M32; d &= M32
+    h = (SEED ^ a ^ b ^ c ^ d) & M32
+    x, y = MIX_X, MIX_Y
+    a, b, h = _mix(a, b, h)
+    c, d, h = _mix(c, d, h)
+    a, x, h = _mix(a, x, h)
+    y, b, h = _mix(y, b, h)
+    c, x, h = _mix(c, x, h)
+    y, d, h = _mix(y, d, h)
+    return h
+
+
+def hash5(a: int, b: int, c: int, d: int, e: int) -> int:
+    a &= M32; b &= M32; c &= M32; d &= M32; e &= M32
+    h = (SEED ^ a ^ b ^ c ^ d ^ e) & M32
+    x, y = MIX_X, MIX_Y
+    a, b, h = _mix(a, b, h)
+    c, d, h = _mix(c, d, h)
+    e, x, h = _mix(e, x, h)
+    y, a, h = _mix(y, a, h)
+    b, x, h = _mix(b, x, h)
+    y, c, h = _mix(y, c, h)
+    d, x, h = _mix(d, x, h)
+    y, e, h = _mix(y, e, h)
+    return h
+
+
+# ----------------------------------------------------------------- numpy ----
+
+def _np_mix(a, b, c):
+    a = a - b; a = a - c; a = a ^ (c >> np.uint32(13))
+    b = b - c; b = b - a; b = b ^ (a << np.uint32(8))
+    c = c - a; c = c - b; c = c ^ (b >> np.uint32(13))
+    a = a - b; a = a - c; a = a ^ (c >> np.uint32(12))
+    b = b - c; b = b - a; b = b ^ (a << np.uint32(16))
+    c = c - a; c = c - b; c = c ^ (b >> np.uint32(5))
+    a = a - b; a = a - c; a = a ^ (c >> np.uint32(3))
+    b = b - c; b = b - a; b = b ^ (a << np.uint32(10))
+    c = c - a; c = c - b; c = c ^ (b >> np.uint32(15))
+    return a, b, c
+
+
+def np_hash2(a, b):
+    a = np.asarray(a, np.uint32); b = np.asarray(b, np.uint32)
+    h = np.uint32(SEED) ^ a ^ b
+    x = np.broadcast_to(np.uint32(MIX_X), h.shape).copy()
+    y = np.broadcast_to(np.uint32(MIX_Y), h.shape).copy()
+    a, b, h = _np_mix(a, b, h)
+    x, a, h = _np_mix(x, a, h)
+    b, y, h = _np_mix(b, y, h)
+    return h
+
+
+def np_hash3(a, b, c):
+    a = np.asarray(a, np.uint32); b = np.asarray(b, np.uint32)
+    c = np.asarray(c, np.uint32)
+    h = np.uint32(SEED) ^ a ^ b ^ c
+    x = np.broadcast_to(np.uint32(MIX_X), h.shape).copy()
+    y = np.broadcast_to(np.uint32(MIX_Y), h.shape).copy()
+    a, b, h = _np_mix(a, b, h)
+    c, x, h = _np_mix(c, x, h)
+    y, a, h = _np_mix(y, a, h)
+    b, x, h = _np_mix(b, x, h)
+    y, c, h = _np_mix(y, c, h)
+    return h
+
+
+# ------------------------------------------------------------------- jax ----
+# imported lazily so host-only users never pay for jax import
+
+def _jx():
+    import jax.numpy as jnp
+    return jnp
+
+
+def _jx_mix(a, b, c):
+    jnp = _jx()
+    u = lambda n: jnp.uint32(n)
+    a = a - b; a = a - c; a = a ^ (c >> u(13))
+    b = b - c; b = b - a; b = b ^ (a << u(8))
+    c = c - a; c = c - b; c = c ^ (b >> u(13))
+    a = a - b; a = a - c; a = a ^ (c >> u(12))
+    b = b - c; b = b - a; b = b ^ (a << u(16))
+    c = c - a; c = c - b; c = c ^ (b >> u(5))
+    a = a - b; a = a - c; a = a ^ (c >> u(3))
+    b = b - c; b = b - a; b = b ^ (a << u(10))
+    c = c - a; c = c - b; c = c ^ (b >> u(15))
+    return a, b, c
+
+
+def jx_hash2(a, b):
+    jnp = _jx()
+    a = a.astype(jnp.uint32); b = b.astype(jnp.uint32)
+    h = jnp.uint32(SEED) ^ a ^ b
+    x = jnp.full_like(h, MIX_X); y = jnp.full_like(h, MIX_Y)
+    a, b, h = _jx_mix(a, b, h)
+    x, a, h = _jx_mix(x, a, h)
+    b, y, h = _jx_mix(b, y, h)
+    return h
+
+
+def jx_hash3(a, b, c):
+    jnp = _jx()
+    a = a.astype(jnp.uint32); b = b.astype(jnp.uint32); c = c.astype(jnp.uint32)
+    h = jnp.uint32(SEED) ^ a ^ b ^ c
+    x = jnp.full_like(h, MIX_X); y = jnp.full_like(h, MIX_Y)
+    a, b, h = _jx_mix(a, b, h)
+    c, x, h = _jx_mix(c, x, h)
+    y, a, h = _jx_mix(y, a, h)
+    b, x, h = _jx_mix(b, x, h)
+    y, c, h = _jx_mix(y, c, h)
+    return h

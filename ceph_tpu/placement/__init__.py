@@ -1,0 +1,1 @@
+"""CRUSH placement: map model, scalar reference mapper, batched TPU mapper."""

@@ -1,0 +1,375 @@
+#!/usr/bin/env python3
+"""Generate golden test vectors from the reference CRUSH C implementation.
+
+Dev-time-only script: compiles the reference C core (mounted read-only at
+/root/reference) into a scratch shared library under /tmp, drives it through
+ctypes, and writes:
+
+  - tests/golden/hash_vectors.json    rjenkins1 hash outputs
+  - tests/golden/crush_vectors.json   crush_do_rule results over a family of maps
+  - ceph_tpu/placement/data/crush_ln_u16.npy
+        the 65536-entry crush_ln LUT (int64).  straw2 only ever evaluates
+        crush_ln(u) for u in [0, 0xffff] (reference: src/crush/mapper.c:334-359),
+        so the whole 2^44*log2(x+1) fixed-point pipeline collapses to this LUT.
+        NOTE: the reference's __LL_tbl deviates from its stated generating
+        formula in 235/256 entries (a long-standing upstream quirk kept for
+        compatibility); the LUT is therefore extracted, not regenerated.
+
+The committed artifacts are pure interoperability data (golden outputs and
+fixed-point constants), not code.
+"""
+import ctypes
+import json
+import os
+import re
+import subprocess
+import sys
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+REF = os.environ.get("CEPH_REFERENCE", "/root/reference")
+BUILD = "/tmp/refcrush_golden"
+
+
+def build_oracle():
+    os.makedirs(BUILD, exist_ok=True)
+    open(os.path.join(BUILD, "acconfig.h"), "w").close()
+    so = os.path.join(BUILD, "librefcrush.so")
+    srcs = [os.path.join(REF, "src/crush", f)
+            for f in ("hash.c", "mapper.c", "crush.c", "builder.c")]
+    subprocess.check_call(
+        ["gcc", "-O2", "-shared", "-fPIC", "-I" + BUILD, "-I" + os.path.join(REF, "src"),
+         "-o", so] + srcs)
+    return ctypes.CDLL(so)
+
+
+# ---------------------------------------------------------------- ln LUT ----
+
+def parse_ln_tables():
+    src = open(os.path.join(REF, "src/crush/crush_ln_table.h")).read()
+
+    def parse(name):
+        m = re.search(name + r"\[[^]]*\] = \{(.*?)\};", src, re.S)
+        return [int(v, 16) for v in re.findall(r"0x([0-9a-fA-F]+)[ul]*l*", m.group(1))]
+
+    return parse("__RH_LH_tbl"), parse("__LL_tbl")
+
+
+def crush_ln(xin, rh_lh, ll):
+    """Fixed-point 2^44*log2(x+1); semantics of reference src/crush/mapper.c:248-290."""
+    x = (xin + 1) & 0xFFFFFFFF
+    iexpon = 15
+    if not (x & 0x18000):
+        bits = 0
+        v = x & 0x1FFFF
+        while not (v & 0x18000):
+            v = (v << 1) & 0x1FFFF
+            bits += 1
+        x = (x << bits) & 0xFFFFFFFF
+        iexpon = 15 - bits
+    index1 = (x >> 8) << 1
+    RH = rh_lh[index1 - 256]
+    LH = rh_lh[index1 + 1 - 256]
+    xl64 = (x * RH) >> 48
+    result = iexpon << 44
+    index2 = xl64 & 0xFF
+    LL = ll[index2]
+    return result + ((LH + LL) >> 4)
+
+
+def gen_ln_lut():
+    rh_lh, ll = parse_ln_tables()
+    lut = np.array([crush_ln(u, rh_lh, ll) for u in range(0x10000)], dtype=np.int64)
+    out = os.path.join(REPO, "ceph_tpu/placement/data/crush_ln_u16.npy")
+    np.save(out, lut)
+    print(f"wrote {out}: [{lut[0]}, {lut[1]}, ..., {lut[-1]}]")
+    return lut
+
+
+# ------------------------------------------------------------ hash golden ----
+
+def gen_hash_vectors(lib):
+    lib.crush_hash32.restype = ctypes.c_uint32
+    lib.crush_hash32_2.restype = ctypes.c_uint32
+    lib.crush_hash32_3.restype = ctypes.c_uint32
+    lib.crush_hash32_4.restype = ctypes.c_uint32
+    lib.crush_hash32_5.restype = ctypes.c_uint32
+    rng = np.random.RandomState(1234)
+    vals = [0, 1, 2, 0xFFFF, 0x10000, 0x7FFFFFFF, 0x80000000, 0xFFFFFFFF]
+    vals += [int(v) for v in rng.randint(0, 2**32, size=24, dtype=np.uint64)]
+    out = {"inputs": vals, "h1": [], "h2": [], "h3": [], "h4": [], "h5": []}
+    u = ctypes.c_uint32
+    for i, a in enumerate(vals):
+        b = vals[(i + 7) % len(vals)]
+        c = vals[(i + 13) % len(vals)]
+        d = vals[(i + 19) % len(vals)]
+        e = vals[(i + 23) % len(vals)]
+        out["h1"].append(lib.crush_hash32(0, u(a)))
+        out["h2"].append(lib.crush_hash32_2(0, u(a), u(b)))
+        out["h3"].append(lib.crush_hash32_3(0, u(a), u(b), u(c)))
+        out["h4"].append(lib.crush_hash32_4(0, u(a), u(b), u(c), u(d)))
+        out["h5"].append(lib.crush_hash32_5(0, u(a), u(b), u(c), u(d), u(e)))
+    path = os.path.join(REPO, "tests/golden/hash_vectors.json")
+    json.dump(out, open(path, "w"))
+    print(f"wrote {path} ({len(vals)} inputs)")
+
+
+# ----------------------------------------------------------- crush golden ----
+
+class CrushMapStruct(ctypes.Structure):
+    _fields_ = [
+        ("buckets", ctypes.c_void_p),
+        ("rules", ctypes.c_void_p),
+        ("max_buckets", ctypes.c_int32),
+        ("max_rules", ctypes.c_uint32),
+        ("max_devices", ctypes.c_int32),
+        ("choose_local_tries", ctypes.c_uint32),
+        ("choose_local_fallback_tries", ctypes.c_uint32),
+        ("choose_total_tries", ctypes.c_uint32),
+        ("chooseleaf_descend_once", ctypes.c_uint32),
+        ("chooseleaf_vary_r", ctypes.c_uint8),
+        ("chooseleaf_stable", ctypes.c_uint8),
+        ("working_size", ctypes.c_size_t),
+        ("straw_calc_version", ctypes.c_uint8),
+        ("allowed_bucket_algs", ctypes.c_uint32),
+        ("choose_tries", ctypes.c_void_p),
+    ]
+
+
+TUNABLE_PROFILES = {
+    # CrushWrapper.h:144-210
+    "argonaut": dict(choose_local_tries=2, choose_local_fallback_tries=5,
+                     choose_total_tries=19, chooseleaf_descend_once=0,
+                     chooseleaf_vary_r=0, chooseleaf_stable=0),
+    "bobtail": dict(choose_local_tries=0, choose_local_fallback_tries=0,
+                    choose_total_tries=50, chooseleaf_descend_once=1,
+                    chooseleaf_vary_r=0, chooseleaf_stable=0),
+    "firefly": dict(choose_local_tries=0, choose_local_fallback_tries=0,
+                    choose_total_tries=50, chooseleaf_descend_once=1,
+                    chooseleaf_vary_r=1, chooseleaf_stable=0),
+    "jewel": dict(choose_local_tries=0, choose_local_fallback_tries=0,
+                  choose_total_tries=50, chooseleaf_descend_once=1,
+                  chooseleaf_vary_r=1, chooseleaf_stable=1),
+}
+
+
+class Oracle:
+    def __init__(self, lib):
+        self.lib = lib
+        lib.crush_create.restype = ctypes.POINTER(CrushMapStruct)
+        lib.crush_make_bucket.restype = ctypes.c_void_p
+        lib.crush_make_rule.restype = ctypes.c_void_p
+        lib.crush_do_rule.restype = ctypes.c_int
+
+    def build(self, spec):
+        lib = self.lib
+        m = lib.crush_create()
+        mp = m.contents
+        for k, v in spec["tunables"].items():
+            setattr(mp, k, v)
+        for b in spec["buckets"]:
+            n = len(b["items"])
+            items = (ctypes.c_int * n)(*b["items"])
+            weights = (ctypes.c_int * n)(*b["weights"])
+            bkt = lib.crush_make_bucket(m, b["alg"], 0, b["type"], n, items, weights)
+            assert bkt, f"make_bucket failed for {b}"
+            idout = ctypes.c_int()
+            r = lib.crush_add_bucket(m, b["id"], ctypes.c_void_p(bkt),
+                                     ctypes.byref(idout))
+            assert r == 0 and idout.value == b["id"], (r, idout.value, b["id"])
+        for ri, rule in enumerate(spec["rules"]):
+            steps = rule["steps"]
+            rr = lib.crush_make_rule(len(steps), 0, 1, 1, 10)
+            for i, (op, a1, a2) in enumerate(steps):
+                lib.crush_rule_set_step(ctypes.c_void_p(rr), i, op, a1, a2)
+            rno = lib.crush_add_rule(m, ctypes.c_void_p(rr), ri)
+            assert rno == ri, (rno, ri)
+        lib.crush_finalize(m)
+        return m
+
+    def do_rule(self, m, ruleno, x, result_max, weights):
+        mp = m.contents
+        ws = ctypes.create_string_buffer(mp.working_size + 3 * result_max * 4 + 64)
+        self.lib.crush_init_workspace(m, ws)
+        result = (ctypes.c_int * result_max)()
+        n = len(weights)
+        warr = (ctypes.c_uint32 * n)(*weights)
+        rl = self.lib.crush_do_rule(m, ruleno, ctypes.c_int(x), result,
+                                    ctypes.c_int(result_max), warr,
+                                    ctypes.c_int(n), ws, None)
+        return [result[i] for i in range(rl)]
+
+
+OP = dict(take=1, choose_firstn=2, choose_indep=3, emit=4,
+          chooseleaf_firstn=6, chooseleaf_indep=7,
+          set_choose_tries=8, set_chooseleaf_tries=9,
+          set_choose_local_tries=10, set_choose_local_fallback_tries=11,
+          set_chooseleaf_vary_r=12, set_chooseleaf_stable=13)
+
+UNIFORM, LIST, TREE, STRAW, STRAW2 = 1, 2, 3, 4, 5
+
+
+def make_specs():
+    specs = []
+    W = 0x10000  # 1.0 in 16.16 fixed point
+
+    # --- 1. flat straw2, 12 osds, mixed weights
+    flat = {
+        "name": "flat_straw2",
+        "tunables": TUNABLE_PROFILES["jewel"],
+        "buckets": [
+            {"id": -1, "alg": STRAW2, "type": 1,
+             "items": list(range(12)),
+             "weights": [W, W, 2 * W, W // 2, W, 3 * W, W, W, W // 4, W, W, 5 * W]},
+        ],
+        "rules": [
+            {"steps": [(OP["take"], -1, 0), (OP["choose_firstn"], 0, 0), (OP["emit"], 0, 0)]},
+            {"steps": [(OP["take"], -1, 0), (OP["choose_indep"], 0, 0), (OP["emit"], 0, 0)]},
+        ],
+        "num_devices": 12,
+    }
+    specs.append(flat)
+
+    # --- 2. two-level host/osd tree: 6 hosts x 4 osds, chooseleaf
+    hosts = []
+    root_items, root_w = [], []
+    for h in range(6):
+        osds = list(range(h * 4, h * 4 + 4))
+        w = [W, 2 * W, W, W]
+        hosts.append({"id": -(2 + h), "alg": STRAW2, "type": 1,
+                      "items": osds, "weights": w})
+        root_items.append(-(2 + h))
+        root_w.append(sum(w))
+    two = {
+        "name": "two_level",
+        "tunables": TUNABLE_PROFILES["jewel"],
+        "buckets": [{"id": -1, "alg": STRAW2, "type": 2,
+                     "items": root_items, "weights": root_w}] + hosts,
+        "rules": [
+            {"steps": [(OP["take"], -1, 0), (OP["chooseleaf_firstn"], 0, 1), (OP["emit"], 0, 0)]},
+            {"steps": [(OP["take"], -1, 0), (OP["chooseleaf_indep"], 0, 1), (OP["emit"], 0, 0)]},
+            {"steps": [(OP["take"], -1, 0), (OP["choose_firstn"], 0, 1),
+                       (OP["choose_firstn"], 1, 0), (OP["emit"], 0, 0)]},
+            {"steps": [(OP["take"], -1, 0), (OP["set_chooseleaf_tries"], 5, 0),
+                       (OP["chooseleaf_firstn"], 0, 1), (OP["emit"], 0, 0)]},
+        ],
+        "num_devices": 24,
+    }
+    specs.append(two)
+
+    # --- 3. same two-level shape, legacy tunables (exercises local retries)
+    legacy = dict(two)
+    legacy = json.loads(json.dumps(two))
+    legacy["name"] = "two_level_argonaut"
+    legacy["tunables"] = TUNABLE_PROFILES["argonaut"]
+    specs.append(legacy)
+
+    bobtail = json.loads(json.dumps(two))
+    bobtail["name"] = "two_level_bobtail"
+    bobtail["tunables"] = TUNABLE_PROFILES["bobtail"]
+    specs.append(bobtail)
+
+    # --- 4. three-level rack/host/osd with firstn over racks
+    racks, all_hosts = [], []
+    hid = 0
+    for r in range(3):
+        rk_items, rk_w = [], []
+        for hh in range(3):
+            osds = [hid * 3 + i for i in range(3)]
+            w = [W] * 3
+            all_hosts.append({"id": -(10 + hid), "alg": STRAW2, "type": 1,
+                              "items": osds, "weights": w})
+            rk_items.append(-(10 + hid))
+            rk_w.append(sum(w))
+            hid += 1
+        racks.append({"id": -(2 + r), "alg": STRAW2, "type": 2,
+                      "items": rk_items, "weights": rk_w})
+    three = {
+        "name": "three_level",
+        "tunables": TUNABLE_PROFILES["jewel"],
+        "buckets": [{"id": -1, "alg": STRAW2, "type": 3,
+                     "items": [-2, -3, -4], "weights": [9 * W] * 3}] + racks + all_hosts,
+        "rules": [
+            # replicated across racks
+            {"steps": [(OP["take"], -1, 0), (OP["chooseleaf_firstn"], 0, 2), (OP["emit"], 0, 0)]},
+            # EC-style: 2 racks, 2 osds each? -> choose 3 racks indep, chooseleaf 1
+            {"steps": [(OP["take"], -1, 0), (OP["choose_indep"], 3, 2),
+                       (OP["chooseleaf_indep"], 2, 1), (OP["emit"], 0, 0)]},
+            # choose firstn hosts then osds
+            {"steps": [(OP["take"], -1, 0), (OP["choose_firstn"], 2, 2),
+                       (OP["choose_firstn"], 2, 1), (OP["choose_firstn"], 1, 0),
+                       (OP["emit"], 0, 0)]},
+        ],
+        "num_devices": 27,
+    }
+    specs.append(three)
+
+    # --- 5. other bucket algs (uniform / list / tree / straw) flat maps
+    for alg, name in ((UNIFORM, "uniform"), (LIST, "list"), (TREE, "tree"), (STRAW, "straw")):
+        specs.append({
+            "name": f"flat_{name}",
+            "tunables": TUNABLE_PROFILES["jewel"],
+            "buckets": [{"id": -1, "alg": alg, "type": 1,
+                         "items": list(range(8)),
+                         "weights": [W] * 8 if alg == UNIFORM else
+                         [W, W, 2 * W, W, W // 2, W, W, 3 * W]}],
+            "rules": [
+                {"steps": [(OP["take"], -1, 0), (OP["choose_firstn"], 0, 0), (OP["emit"], 0, 0)]},
+                {"steps": [(OP["take"], -1, 0), (OP["choose_indep"], 0, 0), (OP["emit"], 0, 0)]},
+            ],
+            "num_devices": 8,
+        })
+
+    # --- 6. big flat straw2 bucket (exercises the whole ln LUT range)
+    rng = np.random.RandomState(7)
+    nbig = 100
+    specs.append({
+        "name": "big_flat_straw2",
+        "tunables": TUNABLE_PROFILES["jewel"],
+        "buckets": [{"id": -1, "alg": STRAW2, "type": 1,
+                     "items": list(range(nbig)),
+                     "weights": [int(w) for w in rng.randint(W // 8, 8 * W, size=nbig)]}],
+        "rules": [
+            {"steps": [(OP["take"], -1, 0), (OP["choose_firstn"], 0, 0), (OP["emit"], 0, 0)]},
+            {"steps": [(OP["take"], -1, 0), (OP["choose_indep"], 0, 0), (OP["emit"], 0, 0)]},
+        ],
+        "num_devices": nbig,
+    })
+    return specs
+
+
+def gen_crush_vectors(lib):
+    oracle = Oracle(lib)
+    specs = make_specs()
+    cases = []
+    rng = np.random.RandomState(42)
+    for si, spec in enumerate(specs):
+        m = oracle.build(spec)
+        nd = spec["num_devices"]
+        weight_sets = {
+            "all_in": [0x10000] * nd,
+            "some_out": [0 if i % 5 == 0 else 0x10000 for i in range(nd)],
+            "reweighted": [int(w) for w in rng.randint(0, 0x10001, size=nd)],
+        }
+        xs = list(range(64)) + [int(v) for v in rng.randint(0, 2**31 - 1, size=64)]
+        for ruleno in range(len(spec["rules"])):
+            for wname, wv in weight_sets.items():
+                for result_max in (3, 5):
+                    for x in xs:
+                        res = oracle.do_rule(m, ruleno, x, result_max, wv)
+                        cases.append({"map": si, "rule": ruleno, "x": x,
+                                      "result_max": result_max, "weights": wname,
+                                      "result": res})
+    out = {"specs": specs, "weight_set_names": ["all_in", "some_out", "reweighted"],
+           "cases": cases}
+    path = os.path.join(REPO, "tests/golden/crush_vectors.json")
+    json.dump(out, open(path, "w"))
+    print(f"wrote {path}: {len(specs)} maps, {len(cases)} cases")
+
+
+if __name__ == "__main__":
+    lib = build_oracle()
+    gen_ln_lut()
+    gen_hash_vectors(lib)
+    gen_crush_vectors(lib)
