@@ -1,0 +1,125 @@
+"""GF(2^w) arithmetic property tests — the EC math foundation."""
+import itertools
+
+import numpy as np
+import pytest
+
+from ceph_tpu.ops import gf
+
+
+def test_gf8_field_axioms_exhaustive():
+    a = np.arange(256).repeat(256)
+    b = np.tile(np.arange(256), 256)
+    ab = gf.gf_mul(a, b)
+    ba = gf.gf_mul(b, a)
+    assert np.array_equal(ab, ba)
+    # 1 is identity; 0 annihilates
+    assert np.array_equal(gf.gf_mul(np.arange(256), 1), np.arange(256))
+    assert np.all(gf.gf_mul(np.arange(256), 0) == 0)
+    # every nonzero element has an inverse
+    nz = np.arange(1, 256)
+    assert np.all(gf.gf_mul(nz, gf.gf_inv(nz)) == 1)
+
+
+def test_gf8_associative_distributive_random():
+    rng = np.random.default_rng(0)
+    a, b, c = rng.integers(0, 256, size=(3, 4096))
+    assert np.array_equal(gf.gf_mul(gf.gf_mul(a, b), c),
+                          gf.gf_mul(a, gf.gf_mul(b, c)))
+    assert np.array_equal(gf.gf_mul(a, b ^ c),
+                          gf.gf_mul(a, b) ^ gf.gf_mul(a, c))
+
+
+def test_gf8_mul_matches_slow_carryless():
+    rng = np.random.default_rng(1)
+    for _ in range(200):
+        a, b = map(int, rng.integers(0, 256, size=2))
+        assert int(gf.gf_mul(a, b)) == gf.gf_mul_slow(a, b, 8, gf.POLY8)
+
+
+def test_gf16_tables():
+    rng = np.random.default_rng(2)
+    for _ in range(100):
+        a, b = map(int, rng.integers(0, 1 << 16, size=2))
+        assert int(gf.gf_mul(a, b, w=16)) == gf.gf_mul_slow(a, b, 16, gf.POLY16)
+    nz = rng.integers(1, 1 << 16, size=1000)
+    assert np.all(gf.gf_mul(nz, gf.gf_inv(nz, 16), 16) == 1)
+
+
+def test_gaussian_inverse_roundtrip():
+    rng = np.random.default_rng(3)
+    for n in (1, 2, 4, 8, 11):
+        while True:
+            M = rng.integers(0, 256, size=(n, n))
+            try:
+                Minv = gf.gf_gaussian_inverse(M)
+                break
+            except ValueError:
+                continue
+        assert np.array_equal(gf.gf_matmul(M, Minv),
+                              np.eye(n, dtype=np.uint8))
+        assert np.array_equal(gf.gf_matmul(Minv, M),
+                              np.eye(n, dtype=np.uint8))
+
+
+def test_singular_matrix_raises():
+    M = np.array([[1, 2], [1, 2]])
+    with pytest.raises(ValueError):
+        gf.gf_gaussian_inverse(M)
+
+
+@pytest.mark.parametrize("gen,km", [
+    (gf.vandermonde_parity, (4, 2)),
+    (gf.vandermonde_parity, (8, 3)),
+    (gf.vandermonde_parity, (8, 4)),
+    (gf.cauchy_orig_parity, (8, 3)),
+    (gf.cauchy_good_parity, (8, 3)),
+    (gf.isa_cauchy_parity, (8, 4)),
+])
+def test_parity_matrices_are_mds(gen, km):
+    """Every k-subset of [I;P] rows must be invertible (erasure-decodable)."""
+    k, m = km
+    P = gen(k, m)
+    G = gf.generator_matrix(P)
+    for rows in itertools.combinations(range(k + m), k):
+        sub = G[list(rows)]
+        gf.gf_gaussian_inverse(sub)  # raises if singular
+
+
+def test_cauchy_good_normalization():
+    P = gf.cauchy_good_parity(8, 3).astype(int)
+    assert np.all(P[0] == 1)
+    assert np.all(P[:, 0] == 1)
+
+
+def test_isa_rs_row0_all_ones():
+    P = gf.isa_rs_parity(10, 4)
+    assert np.all(P[0] == 1)
+
+
+def test_matmul_vs_scalar():
+    rng = np.random.default_rng(4)
+    A = rng.integers(0, 256, size=(3, 5))
+    B = rng.integers(0, 256, size=(5, 7))
+    C = gf.gf_matmul(A, B)
+    for i in range(3):
+        for j in range(7):
+            acc = 0
+            for t in range(5):
+                acc ^= int(gf.gf_mul(int(A[i, t]), int(B[t, j])))
+            assert acc == C[i, j]
+
+
+def test_bitmatrix_formulation_equals_gf_matmul():
+    """The MXU formulation: bit-expanded GF(2) matmul == GF(2^8) matmul."""
+    rng = np.random.default_rng(5)
+    for k, m, n in [(4, 2, 64), (8, 3, 128), (5, 5, 33)]:
+        M = rng.integers(0, 256, size=(m, k)).astype(np.uint8)
+        D = rng.integers(0, 256, size=(k, n)).astype(np.uint8)
+        assert np.array_equal(gf.gf8_bitmatmul(M, D), gf.gf_matmul(M, D))
+
+
+def test_bits_roundtrip():
+    rng = np.random.default_rng(6)
+    D = rng.integers(0, 256, size=(6, 50)).astype(np.uint8)
+    assert np.array_equal(gf.bits_to_bytes(gf.bytes_to_bits(D)), D)
