@@ -1,0 +1,9 @@
+"""Erasure-code subsystem — codecs, plugin registry, and TPU data path.
+
+Mirrors the capability surface of the reference's src/erasure-code/ tree
+(ErasureCodeInterface.h, ErasureCodePlugin.cc, jerasure/isa/shec/clay/lrc
+plugins) re-designed for batched array execution: profiles and matrix
+preparation on host, stripe math as jitted bit-plane matmuls on TPU.
+"""
+from .interface import ErasureCodeInterface, ErasureCodeProfile  # noqa: F401
+from .registry import ErasureCodePluginRegistry, instance  # noqa: F401

@@ -1,0 +1,106 @@
+"""The 'jax' codec — TPU-batched erasure coding (north-star loop #2).
+
+Same profile surface as the jerasure/isa RS techniques, but the data path
+is the XLA bit-plane matmul (ceph_tpu.ops.gf_jax): encode and decode run
+as single compiled calls batched over stripes, with matrix preparation and
+the erasure-signature cache on host.  Single-stripe calls reuse the same
+kernel with batch 1, so every ErasureCodeInterface entry point is served
+by the device path.
+
+Matches the BASELINE north star: ErasureCodeInterface::encode_chunks /
+decode_chunks as batched GF(2^8) matrix multiplies compiled by XLA, behind
+the registry seam (reference: src/erasure-code/ErasureCodeInterface.h:370,
+:411; src/erasure-code/ErasureCodePlugin.cc:86).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..ops import gf, gf_jax
+from .interface import ErasureCodeError, ErasureCodeProfile
+from .matrix_codec import MatrixCodec
+
+DEFAULT_K = 8
+DEFAULT_M = 3
+
+TECHNIQUES = ("reed_sol_van", "cauchy", "cauchy_good", "isa_rs")
+
+
+class ErasureCodeJax(MatrixCodec):
+    """RS/Cauchy codec whose stripe math executes on the accelerator."""
+
+    def init(self, profile: ErasureCodeProfile) -> None:
+        technique = profile.get("technique", "reed_sol_van")
+        k = self.profile_int(profile, "k", DEFAULT_K, minimum=1)
+        m = self.profile_int(profile, "m", DEFAULT_M, minimum=1)
+        w = self.profile_int(profile, "w", 8)
+        if w != 8:
+            raise ErasureCodeError("jax codec runs in GF(2^8); w must be 8")
+        if k + m > 256:
+            raise ErasureCodeError("k+m must be <= 256 for w=8")
+        if technique == "reed_sol_van":
+            parity = gf.vandermonde_parity(k, m)
+        elif technique == "cauchy":
+            parity = gf.isa_cauchy_parity(k, m)
+        elif technique == "cauchy_good":
+            parity = gf.cauchy_good_parity(k, m)
+        elif technique == "isa_rs":
+            parity = gf.isa_rs_parity(k, m)
+        else:
+            raise ErasureCodeError(
+                f"technique={technique!r} not in {TECHNIQUES}")
+        self.set_matrix(parity, 8)
+        self._profile = dict(profile)
+        self._profile.setdefault("plugin", "jax")
+        self._profile["technique"] = technique
+        self._profile.update(k=str(k), m=str(m))
+
+    # ----------------------------------------------------------- encode ---
+    def encode_chunks(self, data_chunks: np.ndarray) -> np.ndarray:
+        out = self.encode_chunks_device(data_chunks)
+        return np.asarray(out)
+
+    def encode_chunks_batch(self, data: np.ndarray) -> np.ndarray:
+        return np.asarray(self.encode_chunks_device(data))
+
+    def encode_chunks_device(self, data):
+        """[..., k, L] -> [..., m, L]; stays on device (jax.Array out)."""
+        if data.shape[-2] != self.k:
+            raise ErasureCodeError(
+                f"expected {self.k} data chunks, got {data.shape[-2]}")
+        return gf_jax.gf8_matmul(self.parity, data)
+
+    # ----------------------------------------------------------- decode ---
+    def decode_chunks(self, available_ids, chunks, erased_ids):
+        return np.asarray(
+            self.decode_chunks_device(available_ids, chunks, erased_ids))
+
+    def decode_chunks_batch(self, available_ids, chunks, erased_ids):
+        return np.asarray(
+            self.decode_chunks_device(available_ids, chunks, erased_ids))
+
+    def decode_chunks_device(self, available_ids, chunks, erased_ids):
+        """chunks [..., n_avail, L] for one erasure signature shared by the
+        whole batch -> [..., n_erased, L] on device.  The recovery matrix
+        is a dynamic operand, so new signatures do NOT recompile."""
+        erased = sorted(erased_ids)
+        if not erased:
+            return np.zeros(
+                tuple(chunks.shape[:-2]) + (0, chunks.shape[-1]),
+                dtype=np.uint8)
+        R, used = self.decode_matrix(available_ids, erased)
+        order = list(available_ids)
+        sel = [order.index(c) for c in used]
+        import jax.numpy as jnp
+        rows = jnp.asarray(chunks)[..., sel, :]
+        return gf_jax.gf8_matmul(R, rows)
+
+
+def _factory(profile: ErasureCodeProfile):
+    codec = ErasureCodeJax()
+    codec.init(profile)
+    return codec
+
+
+def register(registry) -> None:
+    registry.add("jax", _factory)

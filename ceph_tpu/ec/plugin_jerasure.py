@@ -1,0 +1,93 @@
+"""The 'jerasure' codec family — baseline RS/Cauchy techniques.
+
+Re-creates the technique surface of the reference jerasure plugin
+(src/erasure-code/jerasure/ErasureCodeJerasure.h:81-240) from first
+principles (the GF libraries are empty submodules in the reference
+checkout; ceph_tpu.ops.gf re-derives the math):
+
+  * reed_sol_van    — systematic Vandermonde RS, w in {8, 16}
+  * reed_sol_r6_op  — RAID-6 P/Q (m == 2; rows [1..1], [1,2,4,...])
+  * cauchy_orig     — Cauchy generator 1/(i ^ (m+j))
+  * cauchy_good     — normalized Cauchy
+
+The bitmatrix-only techniques (liberation, blaum_roth, liber8tion) are
+CPU XOR-schedule optimizations of the same code space; they are not yet
+implemented here and fail loudly at init.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..ops import gf
+from .interface import ErasureCodeError, ErasureCodeProfile
+from .matrix_codec import MatrixCodec
+
+TECHNIQUES = ("reed_sol_van", "reed_sol_r6_op", "cauchy_orig", "cauchy_good",
+              "liberation", "blaum_roth", "liber8tion")
+
+DEFAULT_K = 2
+DEFAULT_M = 1
+DEFAULT_W = 8
+
+
+class ErasureCodeJerasure(MatrixCodec):
+    def init(self, profile: ErasureCodeProfile) -> None:
+        technique = profile.get("technique", "reed_sol_van")
+        if technique not in TECHNIQUES:
+            raise ErasureCodeError(
+                f"technique={technique!r} not in {TECHNIQUES}")
+        k = self.profile_int(profile, "k", DEFAULT_K, minimum=1)
+        m = self.profile_int(profile, "m", DEFAULT_M, minimum=1)
+        w = self.profile_int(profile, "w", DEFAULT_W)
+
+        if technique == "reed_sol_van":
+            if w not in (8, 16):
+                raise ErasureCodeError(
+                    f"reed_sol_van supports w in (8, 16), got {w}")
+            try:
+                parity = gf.vandermonde_parity(k, m, w)
+            except ValueError as e:
+                raise ErasureCodeError(str(e)) from e
+        elif technique == "reed_sol_r6_op":
+            if m != 2:
+                raise ErasureCodeError("reed_sol_r6_op requires m=2")
+            if w not in (8, 16):
+                raise ErasureCodeError("reed_sol_r6_op supports w in (8,16)")
+            parity = np.zeros((2, k), dtype=np.int64)
+            parity[0] = 1
+            for j in range(k):
+                parity[1, j] = gf.gf_pow(2, j, w)
+            parity = parity.astype(np.uint8 if w == 8 else np.uint16)
+        elif technique == "cauchy_orig":
+            if w != 8:
+                raise ErasureCodeError("cauchy_orig implemented for w=8")
+            try:
+                parity = gf.cauchy_orig_parity(k, m, w)
+            except ValueError as e:
+                raise ErasureCodeError(str(e)) from e
+        elif technique == "cauchy_good":
+            if w != 8:
+                raise ErasureCodeError("cauchy_good implemented for w=8")
+            try:
+                parity = gf.cauchy_good_parity(k, m, w)
+            except ValueError as e:
+                raise ErasureCodeError(str(e)) from e
+        else:
+            raise ErasureCodeError(
+                f"technique {technique!r} is a CPU bitmatrix XOR-schedule "
+                "variant not yet provided by this backend")
+        self.set_matrix(parity, w)
+        self._profile = dict(profile)
+        self._profile.setdefault("plugin", "jerasure")
+        self._profile["technique"] = technique
+        self._profile.update(k=str(k), m=str(m), w=str(w))
+
+
+def _factory(profile: ErasureCodeProfile):
+    codec = ErasureCodeJerasure()
+    codec.init(profile)
+    return codec
+
+
+def register(registry) -> None:
+    registry.add("jerasure", _factory)

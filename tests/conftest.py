@@ -6,7 +6,11 @@ anywhere in the test session.
 """
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# unconditional: the ambient environment may preset JAX_PLATFORMS to the
+# real accelerator, but the suite must be deterministic and exercise the
+# 8-device sharding paths; run bench.py / CEPH_TPU_TEST_DEVICE=1 for
+# on-hardware checks
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
