@@ -1,0 +1,3 @@
+"""Cluster map layer: pools, OSD states, the pg→osd pipeline, and the
+batched full-cluster mapper (the ParallelPGMapper replacement)."""
+from .osdmap import OSDMap, PGPool, PGId  # noqa: F401

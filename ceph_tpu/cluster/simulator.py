@@ -1,0 +1,303 @@
+"""Single-process cluster simulator — the end-to-end slice.
+
+A memstore-backed fake cluster (the role of src/os/memstore/ + vstart.sh
+in the reference's test strategy, SURVEY.md §4): N simulated OSDs hold
+shard payloads in dicts; placement runs through the real OSDMap pipeline
+(batched CRUSH on device); EC pools stripe/encode through the real codec
+registry (batched bit-plane matmuls on device).
+
+put(object) → ps hash → PG → up set → store shards on OSDs
+get(object) → gather surviving shards → minimum_to_decode → decode
+kill/out OSDs → remap diff (old vs new batched mapping) → recover_all
+rebuilds lost shards via batched decode and re-places them — the
+ECBackend recovery flow (src/osd/ECBackend.cc:757,433,462) collapsed
+into array programs (BASELINE config #5).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+import numpy as np
+
+from ..ec import instance as ec_registry
+from ..ec.interface import ErasureCodeError
+from ..ops import hashing
+from ..placement.crush_map import ITEM_NONE
+from .osdmap import OSDMap, PGPool, POOL_ERASURE, POOL_REPLICATED
+
+ShardKey = Tuple[int, int, str, int]   # (pool, pg, object, shard)
+
+
+class SimOSD:
+    """A fake OSD: a dict object store (memstore) plus liveness."""
+
+    def __init__(self, osd_id: int):
+        self.id = osd_id
+        self.store: Dict[ShardKey, np.ndarray] = {}
+        self.alive = True
+
+    def put(self, key: ShardKey, data: np.ndarray) -> None:
+        if not self.alive:
+            raise IOError(f"osd.{self.id} is dead")
+        self.store[key] = np.asarray(data, dtype=np.uint8).copy()
+
+    def get(self, key: ShardKey) -> Optional[np.ndarray]:
+        if not self.alive:
+            return None
+        return self.store.get(key)
+
+    def delete(self, key: ShardKey) -> None:
+        self.store.pop(key, None)
+
+
+@dataclass
+class ObjectInfo:
+    """Client-side record of a written object (size for unpad)."""
+    size: int
+    chunk_size: int
+
+
+class ClusterSim:
+    """OSDMap + memstore OSDs + codec data path, in one process."""
+
+    def __init__(self, osdmap: OSDMap):
+        self.osdmap = osdmap
+        self.osds = [SimOSD(i) for i in range(osdmap.max_osd)]
+        self.codecs: Dict[int, object] = {}
+        self.objects: Dict[Tuple[int, str], ObjectInfo] = {}
+        self.ec_profiles: Dict[str, Dict[str, str]] = {}
+
+    # ------------------------------------------------------------- pools --
+    def create_ec_profile(self, name: str, profile: Dict[str, str]) -> None:
+        """Validates by instantiating the plugin, like the mon
+        (src/mon/OSDMonitor.cc:7349-7444)."""
+        ec_registry().factory(profile.get("plugin", "jax"), profile)
+        self.ec_profiles[name] = dict(profile)
+
+    def codec_for(self, pool: PGPool):
+        codec = self.codecs.get(pool.id)
+        if codec is None:
+            prof = self.ec_profiles[pool.erasure_code_profile]
+            codec = ec_registry().factory(prof.get("plugin", "jax"), prof)
+            self.codecs[pool.id] = codec
+        return codec
+
+    # ---------------------------------------------------------- placement --
+    def object_pg(self, pool: PGPool, name: str) -> int:
+        ps = hashing.str_hash_rjenkins(name.encode())
+        return pool.raw_pg_to_pg(ps)
+
+    def pg_up(self, pool: PGPool, pg: int) -> List[int]:
+        up, _, acting, _ = self.osdmap.pg_to_up_acting_osds(pool.id, pg)
+        return acting or up
+
+    # --------------------------------------------------------------- I/O --
+    def put(self, pool_id: int, name: str, data: bytes) -> List[int]:
+        pool = self.osdmap.pools[pool_id]
+        pg = self.object_pg(pool, name)
+        up = self.pg_up(pool, pg)
+        if pool.type == POOL_REPLICATED:
+            payload = np.frombuffer(data, dtype=np.uint8)
+            placed = []
+            for o in up:
+                if o == ITEM_NONE:
+                    continue
+                self.osds[o].put((pool_id, pg, name, 0), payload)
+                placed.append(o)
+            self.objects[(pool_id, name)] = ObjectInfo(len(data), len(data))
+            return placed
+        codec = self.codec_for(pool)
+        k, mm = codec.get_data_chunk_count(), codec.get_coding_chunk_count()
+        chunks = codec.encode(set(range(k + mm)), data)
+        placed = []
+        for shard, payload in chunks.items():
+            tgt = up[shard] if shard < len(up) else ITEM_NONE
+            if tgt == ITEM_NONE:
+                continue   # degraded write: shard currently homeless
+            self.osds[tgt].put((pool_id, pg, name, shard), payload)
+            placed.append(tgt)
+        self.objects[(pool_id, name)] = ObjectInfo(
+            len(data), codec.get_chunk_size(len(data)))
+        return placed
+
+    def get(self, pool_id: int, name: str) -> bytes:
+        pool = self.osdmap.pools[pool_id]
+        info = self.objects[(pool_id, name)]
+        pg = self.object_pg(pool, name)
+        up = self.pg_up(pool, pg)
+        if pool.type == POOL_REPLICATED:
+            # up set first, then any live OSD (stale-map / pre-recovery
+            # reads, same as the EC branch below)
+            sources = [o for o in up if o != ITEM_NONE] + \
+                [o.id for o in self.osds]
+            for o in sources:
+                payload = self.osds[o].get((pool_id, pg, name, 0))
+                if payload is not None:
+                    return payload.tobytes()[:info.size]
+            raise IOError(f"object {name}: no replica available")
+        codec = self.codec_for(pool)
+        k, mm = codec.get_data_chunk_count(), codec.get_coding_chunk_count()
+        avail: Dict[int, np.ndarray] = {}
+        # shards may live on osds outside the current up set (stale map);
+        # search up first, then everywhere (the real system would backfill)
+        for shard in range(k + mm):
+            tgt = up[shard] if shard < len(up) else ITEM_NONE
+            sources = ([tgt] if tgt != ITEM_NONE else []) + \
+                [o.id for o in self.osds]
+            for o in sources:
+                payload = self.osds[o].get((pool_id, pg, name, shard))
+                if payload is not None:
+                    avail[shard] = payload
+                    break
+        plan = codec.minimum_to_decode(set(range(k)), set(avail))
+        out = codec.decode_concat({c: avail[c] for c in plan})
+        return out.tobytes()[:info.size]
+
+    # ----------------------------------------------------------- failure --
+    def kill_osd(self, osd: int) -> None:
+        """Thrasher-style kill (qa/tasks/ceph_manager.py kill_osd): process
+        death — store contents are lost to the cluster."""
+        self.osds[osd].alive = False
+        self.osdmap.mark_down(osd)
+
+    def out_osd(self, osd: int) -> None:
+        self.osdmap.mark_out(osd)
+
+    def revive_osd(self, osd: int) -> None:
+        self.osds[osd].alive = True
+        self.osdmap.osd_up[osd] = True
+        self.osdmap.osd_weight[osd] = 0x10000
+        self.osdmap.bump_epoch()
+
+    # ---------------------------------------------------------- recovery --
+    def remap_diff(self, pool_id: int, old_up: np.ndarray
+                   ) -> Dict[int, List[int]]:
+        """Batched old-vs-new mapping diff: {pg: shards whose home moved}."""
+        new_up, _ = self.osdmap.map_pgs_batch(pool_id)
+        diffs: Dict[int, List[int]] = {}
+        n = min(len(old_up), len(new_up))
+        for pg in range(n):
+            moved = [s for s in range(new_up.shape[1])
+                     if old_up[pg][s] != new_up[pg][s]]
+            if moved:
+                diffs[pg] = moved
+        return diffs
+
+    def recover_all(self, pool_id: int) -> Dict[str, int]:
+        """Rebuild every unreadable/misplaced shard onto the current up set.
+
+        The batched analog of ECBackend::recover_object: group damaged
+        stripes by erasure signature, decode each group in one batched
+        device call, write rebuilt shards to their new homes.
+        """
+        pool = self.osdmap.pools[pool_id]
+        stats = {"objects_scanned": 0, "shards_rebuilt": 0,
+                 "shards_copied": 0, "batches": 0}
+        if pool.type == POOL_REPLICATED:
+            for (pid, name), info in self.objects.items():
+                if pid != pool_id:
+                    continue
+                stats["objects_scanned"] += 1
+                pg = self.object_pg(pool, name)
+                up = self.pg_up(pool, pg)
+                payload = None
+                for o in range(len(self.osds)):
+                    p = self.osds[o].get((pool_id, pg, name, 0))
+                    if p is not None:
+                        payload = p
+                        break
+                if payload is None:
+                    continue
+                for o in up:
+                    if o != ITEM_NONE and \
+                            self.osds[o].get((pool_id, pg, name, 0)) is None:
+                        self.osds[o].put((pool_id, pg, name, 0), payload)
+                        stats["shards_copied"] += 1
+            return stats
+
+        codec = self.codec_for(pool)
+        k, mm = codec.get_data_chunk_count(), codec.get_coding_chunk_count()
+        n_shards = k + mm
+        # signature -> list of (pg, name, up, avail_chunks dict)
+        groups: Dict[Tuple[Tuple[int, ...], Tuple[int, ...]], List] = {}
+        for (pid, name), info in self.objects.items():
+            if pid != pool_id:
+                continue
+            stats["objects_scanned"] += 1
+            pg = self.object_pg(pool, name)
+            up = self.pg_up(pool, pg)
+            avail: Dict[int, np.ndarray] = {}
+            missing: List[int] = []
+            for shard in range(n_shards):
+                found = None
+                for o in range(len(self.osds)):
+                    p = self.osds[o].get((pool_id, pg, name, shard))
+                    if p is not None:
+                        found = p
+                        break
+                if found is None:
+                    missing.append(shard)
+                else:
+                    avail[shard] = found
+            if missing:
+                # chunk size is part of the key: stripes only batch with
+                # shape-identical peers
+                chunk_len = len(next(iter(avail.values()))) if avail else 0
+                key = (tuple(sorted(avail)[:k]), tuple(missing), chunk_len)
+                groups.setdefault(key, []).append((pg, name, up, avail))
+            # re-place surviving shards that are off their new home
+            for shard, payload in avail.items():
+                tgt = up[shard] if shard < len(up) else ITEM_NONE
+                if tgt != ITEM_NONE and \
+                        self.osds[tgt].get((pool_id, pg, name, shard)) is None:
+                    self.osds[tgt].put((pool_id, pg, name, shard), payload)
+                    stats["shards_copied"] += 1
+        for (use, missing, _chunk_len), members in groups.items():
+            if len(use) < k:
+                continue   # unrecoverable group
+            stats["batches"] += 1
+            batch = np.stack([
+                np.stack([avail[c] for c in use]) for _, _, _, avail
+                in members])
+            rebuilt = codec.decode_chunks_batch(list(use), batch,
+                                                list(missing))
+            for i, (pg, name, up, _avail) in enumerate(members):
+                for j, shard in enumerate(missing):
+                    tgt = up[shard] if shard < len(up) else ITEM_NONE
+                    if tgt == ITEM_NONE:
+                        continue
+                    self.osds[tgt].put((pool_id, pg, name, shard),
+                                       rebuilt[i, j])
+                    stats["shards_rebuilt"] += 1
+        return stats
+
+    # -------------------------------------------------------------- scrub --
+    def scrub(self, pool_id: int) -> List[Tuple[str, int]]:
+        """Deep-scrub analog: re-encode data shards and compare parity
+        (the checksum-compare role of src/osd/pg_scrubber.cc)."""
+        pool = self.osdmap.pools[pool_id]
+        if pool.type != POOL_ERASURE:
+            return []
+        codec = self.codec_for(pool)
+        k, mm = codec.get_data_chunk_count(), codec.get_coding_chunk_count()
+        bad: List[Tuple[str, int]] = []
+        for (pid, name), info in self.objects.items():
+            if pid != pool_id:
+                continue
+            pg = self.object_pg(pool, name)
+            shards: Dict[int, np.ndarray] = {}
+            for shard in range(k + mm):
+                for o in range(len(self.osds)):
+                    p = self.osds[o].get((pool_id, pg, name, shard))
+                    if p is not None:
+                        shards[shard] = p
+                        break
+            if set(range(k)) <= set(shards):
+                parity = codec.encode_chunks(
+                    np.stack([shards[i] for i in range(k)]))
+                for j in range(mm):
+                    if k + j in shards and \
+                            not np.array_equal(parity[j], shards[k + j]):
+                        bad.append((name, k + j))
+        return bad

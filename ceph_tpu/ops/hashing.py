@@ -94,6 +94,49 @@ def hash5(a: int, b: int, c: int, d: int, e: int) -> int:
     return h
 
 
+def str_hash_rjenkins(data: bytes) -> int:
+    """Object-name hash (reference: src/common/ceph_hash.cc
+    ceph_str_hash_rjenkins) — the object→ps step of placement."""
+    a = 0x9E3779B9
+    b = a
+    c = 0
+    i, length = 0, len(data)
+    left = length
+    while left >= 12:
+        a = (a + int.from_bytes(data[i:i + 4], "little")) & M32
+        b = (b + int.from_bytes(data[i + 4:i + 8], "little")) & M32
+        c = (c + int.from_bytes(data[i + 8:i + 12], "little")) & M32
+        a, b, c = _mix(a, b, c)
+        i += 12
+        left -= 12
+    c = (c + length) & M32
+    tail = data[i:]
+    if left >= 11:
+        c = (c + (tail[10] << 24)) & M32
+    if left >= 10:
+        c = (c + (tail[9] << 16)) & M32
+    if left >= 9:
+        c = (c + (tail[8] << 8)) & M32
+    if left >= 8:
+        b = (b + (tail[7] << 24)) & M32
+    if left >= 7:
+        b = (b + (tail[6] << 16)) & M32
+    if left >= 6:
+        b = (b + (tail[5] << 8)) & M32
+    if left >= 5:
+        b = (b + tail[4]) & M32
+    if left >= 4:
+        a = (a + (tail[3] << 24)) & M32
+    if left >= 3:
+        a = (a + (tail[2] << 16)) & M32
+    if left >= 2:
+        a = (a + (tail[1] << 8)) & M32
+    if left >= 1:
+        a = (a + tail[0]) & M32
+    a, b, c = _mix(a, b, c)
+    return c
+
+
 # ----------------------------------------------------------------- numpy ----
 
 def _np_mix(a, b, c):

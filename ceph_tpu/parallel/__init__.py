@@ -1,0 +1,8 @@
+"""Multi-chip scale-out: mesh construction and sharded data-path steps.
+
+The reference scales out with one messenger connection per OSD peer
+(SURVEY.md §2.4); the TPU framework scales the batch axes (stripes, PGs)
+across a jax.sharding.Mesh, with XLA inserting ICI/DCN collectives.
+"""
+from .mesh import (batch_sharding, distributed_encode_step,  # noqa: F401
+                   make_mesh, replicated_sharding)

@@ -1,0 +1,91 @@
+"""Device-mesh utilities and the sharded cluster step.
+
+Design per the scaling-book recipe: pick a mesh, annotate shardings on the
+batch axes, let XLA insert collectives.  The framework's data plane is
+embarrassingly parallel over stripes/PGs, so the shard axis carries
+encode/decode/mapping work with zero cross-chip traffic; collectives
+appear only in cluster-wide reductions (utilization stats, recovery
+accounting) where a psum rides the ICI ring.
+
+This replaces the reference's messenger fan-out/gather across OSD
+processes (src/msg/async/, SURVEY.md §2.4) for the compute tier.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+SHARD_AXIS = "shard"
+
+
+def make_mesh(n_devices: Optional[int] = None,
+              devices: Optional[Sequence] = None) -> Mesh:
+    """1-D mesh over the stripe/PG batch axis.
+
+    Falls back to the CPU backend's virtual devices when the default
+    backend has fewer than n_devices (the dry-run path on a 1-chip host
+    with --xla_force_host_platform_device_count set).
+    """
+    if devices is None:
+        devices = jax.devices()
+        if n_devices is not None and len(devices) < n_devices:
+            try:
+                cpus = jax.devices("cpu")
+                if len(cpus) >= n_devices:
+                    devices = cpus
+            except RuntimeError:
+                pass
+        if n_devices is not None:
+            if len(devices) < n_devices:
+                raise ValueError(
+                    f"need {n_devices} devices, have {len(devices)}")
+            devices = devices[:n_devices]
+    return Mesh(np.asarray(devices), (SHARD_AXIS,))
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    """Shard the leading (stripe/PG) axis; replicate the rest."""
+    return NamedSharding(mesh, P(SHARD_AXIS))
+
+
+def replicated_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+_STEP_CACHE: dict = {}
+
+
+def _encode_step_fn(mesh: Mesh):
+    """Jitted sharded step, cached per mesh so repeated steps reuse the
+    compiled executable (jit caches by function identity)."""
+    key = id(mesh)
+    if key not in _STEP_CACHE:
+        from ..ops.gf_jax import bitplane_matmul
+
+        def step(bitmat, d):
+            parity = bitplane_matmul(bitmat, d)
+            # genuine cross-shard reduction: XLA lowers it to an ICI psum
+            total = jnp.sum(d.astype(jnp.int64))
+            return parity, total
+
+        _STEP_CACHE[key] = jax.jit(
+            step,
+            in_shardings=(replicated_sharding(mesh), batch_sharding(mesh)),
+            out_shardings=(batch_sharding(mesh), None))
+    return _STEP_CACHE[key]
+
+
+def distributed_encode_step(mesh: Mesh, bitmat: jax.Array,
+                            data: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """One sharded encode step: stripes split across the mesh, parity
+    computed locally per chip, plus a cluster-wide psum byte counter
+    (the collective the perf-counter aggregation rides).
+
+    data: [B, k, L] uint8 sharded on B → (parity [B, m, L], total_bytes).
+    """
+    sharded = jax.device_put(data, batch_sharding(mesh))
+    return _encode_step_fn(mesh)(bitmat, sharded)

@@ -1,0 +1,67 @@
+"""Map-construction helpers (the builder.c role for common topologies).
+
+One canonical straw2 hierarchy builder shared by benchmarks, the driver
+dry-run, and tests — root → [racks →] hosts → osds.
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+
+from .crush_map import (BUCKET_STRAW2, Bucket, CrushMap, Tunables,
+                        WEIGHT_ONE)
+
+TYPE_OSD, TYPE_HOST, TYPE_RACK, TYPE_ROOT = 0, 1, 2, 3
+
+
+def build_flat_cluster(n_hosts: int = 6, osds_per_host: int = 4,
+                       n_racks: int = 0, seed: int = 0,
+                       tunables: Optional[Tunables] = None,
+                       weight_jitter: bool = False
+                       ) -> Tuple[CrushMap, int]:
+    """Build root → [racks →] hosts → osds, all straw2.
+
+    Returns (map, root_bucket_id).  With weight_jitter, per-osd weights
+    are randomized in [0.5, 1.5) to exercise weighted selection.
+    """
+    rng = np.random.default_rng(seed)
+    m = CrushMap(tunables=tunables or Tunables.profile("jewel"))
+    m.type_names = {TYPE_OSD: "osd", TYPE_HOST: "host", TYPE_RACK: "rack",
+                    TYPE_ROOT: "root"}
+    osd = 0
+    host_ids = []
+    for h in range(n_hosts):
+        items, weights = [], []
+        for _ in range(osds_per_host):
+            items.append(osd)
+            w = WEIGHT_ONE
+            if weight_jitter:
+                w = int(WEIGHT_ONE * (0.5 + rng.random()))
+            weights.append(w)
+            osd += 1
+        hid = -1 - len(m.buckets)
+        m.add_bucket(Bucket(id=hid, alg=BUCKET_STRAW2, type=TYPE_HOST,
+                            items=items, weights=weights))
+        m.bucket_names[hid] = f"host{h}"
+        host_ids.append(hid)
+    group_ids = host_ids
+    if n_racks:
+        racks = []
+        per = max(1, len(host_ids) // n_racks)
+        for r in range(n_racks):
+            hs = host_ids[r * per:(r + 1) * per] or host_ids[-1:]
+            rid = -1 - len(m.buckets)
+            m.add_bucket(Bucket(
+                id=rid, alg=BUCKET_STRAW2, type=TYPE_RACK, items=list(hs),
+                weights=[sum(m.bucket(h).weights) for h in hs]))
+            m.bucket_names[rid] = f"rack{r}"
+            racks.append(rid)
+        group_ids = racks
+    root_id = -1 - len(m.buckets)
+    m.add_bucket(Bucket(
+        id=root_id, alg=BUCKET_STRAW2, type=TYPE_ROOT, items=list(group_ids),
+        weights=[sum(m.bucket(g).weights) for g in group_ids]))
+    m.bucket_names[root_id] = "default"
+    m.finalize()
+    return m, root_id

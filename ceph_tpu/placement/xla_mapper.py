@@ -1,0 +1,614 @@
+"""Batched CRUSH mapping as one jitted XLA program — north-star loop #1.
+
+Replaces the reference's per-x interpreter stack (crush_do_rule,
+src/crush/mapper.c:900-1105; CrushTester's triple loop,
+src/crush/CrushTester.cc:612-623; the ParallelPGMapper thread-pool batcher,
+src/osd/OSDMapMapping.h:18) with a single compiled call that maps millions
+of PG ids at once:
+
+  * The CrushMap compiles to dense padded arrays (items, weights, types,
+    sizes, per-position weight-sets) — pure data, no pointers.
+  * straw2 selection (mapper.c:361-384) is a vectorized hash → 64-bit
+    fixed-point log LUT → truncating divide → argmax over the padded item
+    axis.  argmax's first-max tie-break reproduces the scalar strict-'>'
+    scan exactly.
+  * The rule program is unrolled at trace time (steps are static); the
+    data-dependent retry loops of crush_choose_firstn (mapper.c:460-648)
+    and crush_choose_indep (mapper.c:655-843) become bounded
+    lax.while_loops with masked state, vmapped over x.
+
+Bit-exactness contract: for supported maps (straw2 buckets, modern
+tunables with choose_local_tries == choose_local_fallback_tries == 0 —
+the 'bobtail'+ profiles every real cluster runs) the batch output equals
+scalar_mapper.do_rule element-for-element; tests/test_xla_mapper.py
+enforces this on randomized hierarchies.  Unsupported maps raise
+UnsupportedMapError so callers can fall back to the scalar path.
+
+straw2 draws need 64-bit integers: importing this module enables
+jax_enable_x64 (all other ceph_tpu kernels pin their dtypes explicitly).
+"""
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+
+jax.config.update("jax_enable_x64", True)
+
+import jax.numpy as jnp  # noqa: E402
+from jax import lax  # noqa: E402
+
+from ..ops import hashing  # noqa: E402
+from . import lntable  # noqa: E402
+from .crush_map import (  # noqa: E402
+    BUCKET_STRAW2, ITEM_NONE, ITEM_UNDEF,
+    RULE_CHOOSELEAF_FIRSTN, RULE_CHOOSELEAF_INDEP, RULE_CHOOSE_FIRSTN,
+    RULE_CHOOSE_INDEP, RULE_EMIT, RULE_SET_CHOOSELEAF_STABLE,
+    RULE_SET_CHOOSELEAF_TRIES, RULE_SET_CHOOSELEAF_VARY_R,
+    RULE_SET_CHOOSE_LOCAL_FALLBACK_TRIES, RULE_SET_CHOOSE_LOCAL_TRIES,
+    RULE_SET_CHOOSE_TRIES, RULE_TAKE, CrushMap,
+)
+
+S64_MIN = lntable.S64_MIN
+
+
+class UnsupportedMapError(Exception):
+    """Map/rule uses features outside the vectorized subset."""
+
+
+# ---------------------------------------------------------------- compile --
+
+@dataclass(frozen=True)
+class CompiledMap:
+    """Dense, device-ready view of a CrushMap (straw2-only subset)."""
+    items: np.ndarray        # i32 [B, S] child ids (pad 0)
+    hash_ids: np.ndarray     # i32 [B, S] ids hashed by straw2 (choose_args)
+    weight_sets: np.ndarray  # i32 [B, P, S] per-position weights
+    sizes: np.ndarray        # i32 [B]
+    types: np.ndarray        # i32 [B]
+    n_buckets: int
+    max_size: int
+    n_positions: int
+    max_devices: int
+    max_depth: int
+
+    @functools.cached_property
+    def device_arrays(self):
+        # must be materialized OUTSIDE any jit trace (XlaMapper.__init__
+        # touches this eagerly) or the cached constants leak as tracers.
+        # The ln LUT is stored as the POSITIVE draw numerator in float64:
+        # values < 2^48 are exactly representable, which lets straw2 run
+        # its truncating division in f64 (with an exactness correction)
+        # instead of TPU-emulated int64 — see _straw2_choose.
+        numer = (-lntable.straw2_ln_lut()).astype(np.float64)
+        return (jnp.asarray(self.items), jnp.asarray(self.hash_ids),
+                jnp.asarray(self.weight_sets), jnp.asarray(self.sizes),
+                jnp.asarray(self.types), jnp.asarray(numer))
+
+
+def compile_map(cmap: CrushMap, choose_args_key: object = None,
+                n_positions: int = 1) -> CompiledMap:
+    """Flatten the bucket hierarchy to padded arrays.
+
+    Raises UnsupportedMapError for non-straw2 buckets or legacy local-retry
+    tunables (the scalar mapper covers those).
+    """
+    t = cmap.tunables
+    if t.choose_local_tries or t.choose_local_fallback_tries:
+        raise UnsupportedMapError(
+            "legacy local-retry tunables not vectorized (argonaut profile)")
+    B = cmap.max_buckets
+    if B == 0:
+        raise UnsupportedMapError("map has no buckets")
+    S = 1
+    for b in cmap.buckets:
+        if b is None:
+            continue
+        if b.alg != BUCKET_STRAW2:
+            raise UnsupportedMapError(
+                f"bucket {b.id} alg {b.alg} != straw2; scalar fallback")
+        S = max(S, b.size)
+    choose_args = cmap.choose_args.get(choose_args_key) \
+        if choose_args_key is not None else None
+    P = 1
+    if choose_args is not None:
+        for a in choose_args:
+            if a is not None and a.weight_set is not None:
+                P = max(P, len(a.weight_set))
+    P = max(P, n_positions if choose_args is not None else 1)
+
+    items = np.zeros((B, S), dtype=np.int32)
+    hash_ids = np.zeros((B, S), dtype=np.int32)
+    ws = np.zeros((B, P, S), dtype=np.int32)
+    sizes = np.zeros(B, dtype=np.int32)
+    types = np.zeros(B, dtype=np.int32)
+    for idx, b in enumerate(cmap.buckets):
+        if b is None:
+            continue
+        n = b.size
+        sizes[idx] = n
+        types[idx] = b.type
+        items[idx, :n] = b.items
+        hash_ids[idx, :n] = b.items
+        for p in range(P):
+            ws[idx, p, :n] = b.weights
+        if choose_args is not None:
+            arg = choose_args[idx] if idx < len(choose_args) else None
+            if arg is not None:
+                if arg.ids is not None:
+                    hash_ids[idx, :n] = arg.ids
+                if arg.weight_set is not None:
+                    for p in range(P):
+                        src = arg.weight_set[min(p, len(arg.weight_set) - 1)]
+                        ws[idx, p, :n] = src
+
+    # max descent depth: longest bucket→bucket chain + 1
+    depth = np.ones(B, dtype=np.int64)
+    # iterate to fixed point (hierarchies are DAG-ish and shallow)
+    for _ in range(B):
+        changed = False
+        for idx, b in enumerate(cmap.buckets):
+            if b is None:
+                continue
+            for it in b.items:
+                if it < 0:
+                    child = -1 - it
+                    if child < B and depth[child] + 1 > depth[idx]:
+                        depth[idx] = depth[child] + 1
+                        changed = True
+        if not changed:
+            break
+    return CompiledMap(
+        items=items, hash_ids=hash_ids, weight_sets=ws, sizes=sizes,
+        types=types, n_buckets=B, max_size=S, n_positions=P,
+        max_devices=max(cmap.max_devices, 1), max_depth=int(depth.max()))
+
+
+# ------------------------------------------------------------- primitives --
+
+def _u32(v):
+    return jnp.asarray(v).astype(jnp.uint32)
+
+
+def _straw2_choose(arrs, bidx, x, r, pos):
+    """One straw2 selection (mapper.c:361-384): returns chosen child id.
+
+    The reference draw is trunc_div(crush_ln(u) - 2^48, weight) maximized
+    with first-index tie-break.  Negating, that is q = (-ln) // w
+    MINIMIZED with first-index tie-break.  q is computed in float64:
+    the dividend is < 2^48 (exact), the quotient is corrected by one ulp
+    step each way, and products stay < 2^53, so q is the exact integer
+    quotient — bit-identical to the reference's div64_s64 — without any
+    TPU-emulated 64-bit integer ops.
+    """
+    items, hash_ids, weight_sets, sizes, types, numer_lut = arrs
+    S = items.shape[1]
+    ids = hash_ids[bidx]                               # [S]
+    pos_c = jnp.minimum(pos, weight_sets.shape[1] - 1)
+    w = weight_sets[bidx, pos_c].astype(jnp.float64)   # [S]
+    u = hashing.jx_hash3(
+        jnp.broadcast_to(_u32(x), (S,)), ids.astype(jnp.uint32),
+        jnp.broadcast_to(_u32(r), (S,))) & jnp.uint32(0xFFFF)
+    a = numer_lut[u.astype(jnp.int32)]                 # [S] f64, 0..2^48
+    q = jnp.floor(a / jnp.maximum(w, 1.0))
+    q = q - (q * w > a)                                # exactness corrections
+    q = q + ((q + 1.0) * w <= a)
+    inf = jnp.float64(jnp.inf)
+    q = jnp.where(w > 0, q, inf)
+    q = jnp.where(jnp.arange(S) < sizes[bidx], q, inf)
+    return items[bidx, jnp.argmin(q)]
+
+
+def _is_out(weights, item, x):
+    """Device overload rejection (mapper.c:424-438); item must be >= 0."""
+    n = weights.shape[0]
+    w = weights[jnp.clip(item, 0, n - 1)].astype(jnp.int64)
+    oob = item >= n
+    hashed = (hashing.jx_hash2(_u32(x), _u32(item)) &
+              jnp.uint32(0xFFFF)).astype(jnp.int64) >= w
+    return oob | jnp.where(w >= 0x10000, False,
+                           jnp.where(w == 0, True, hashed))
+
+
+# descend outcome codes
+_OK, _REJECT, _SKIP = 0, 1, 2
+
+
+def _descend(cm: CompiledMap, arrs, start_bidx, target_type: int, x, r, pos):
+    """Walk from bucket index down to an item of target_type.
+
+    Mirrors the inner retry_bucket walk of mapper.c:495-546 for straw2:
+    returns (item, status) with status OK (item has target type), REJECT
+    (empty bucket on the path → costs a retry), or SKIP (escaped the map →
+    abandon this replica slot).
+    """
+    items, hash_ids, weight_sets, sizes, types, _ = arrs
+
+    def body(carry, _):
+        cur, done, status, result = carry
+        empty = sizes[cur] == 0
+        item = _straw2_choose(arrs, cur, x, r, pos)
+        is_dev = item >= 0
+        bad_dev = is_dev & (item >= cm.max_devices)
+        bidx = jnp.where(is_dev, 0, -1 - item)
+        bad_bucket = (~is_dev) & (bidx >= cm.n_buckets)
+        itype = jnp.where(is_dev, 0,
+                          types[jnp.clip(bidx, 0, cm.n_buckets - 1)])
+        match = itype == target_type
+        # classify this level's outcome (only if not already done)
+        lvl_reject = empty
+        lvl_skip = (~empty) & (bad_dev |
+                               ((~match) & (is_dev | bad_bucket)))
+        lvl_done = lvl_reject | lvl_skip | ((~empty) & match)
+        new_status = jnp.where(
+            done, status,
+            jnp.where(lvl_reject, _REJECT,
+                      jnp.where(lvl_skip, _SKIP, _OK)))
+        new_result = jnp.where(done | ~match | empty, result, item)
+        new_done = done | lvl_done
+        new_cur = jnp.where(new_done, cur, bidx)
+        return (new_cur, new_done, new_status, new_result), None
+
+    init = (start_bidx, jnp.asarray(False), jnp.int32(_REJECT),
+            jnp.int32(ITEM_NONE))
+    (cur, done, status, result), _ = lax.scan(
+        body, init, None, length=cm.max_depth)
+    # not terminating within max_depth == malformed map → treat as SKIP
+    status = jnp.where(done, status, _SKIP)
+    return result, status
+
+
+# --------------------------------------------------------------- firstn ----
+
+def _leaf_firstn(cm, arrs, bucket_item, weights, x, sub_r, recurse_tries,
+                 stable, out2, outpos, pos):
+    """The chooseleaf recursion (mapper.c:564-581 → recursive
+    crush_choose_firstn with numrep=1): pick one device inside
+    ``bucket_item``'s subtree, with collision checks against out2[:outpos].
+    Returns (device, ok)."""
+    rep_base = jnp.int32(0) if stable else outpos
+    R = out2.shape[0]
+
+    def cond(s):
+        ftotal, done, ok, dev = s
+        return (~done) & (ftotal < recurse_tries)
+
+    def body(s):
+        ftotal, done, ok, dev = s
+        r = rep_base + sub_r + ftotal
+        item, status = _descend(cm, arrs, -1 - bucket_item, 0, x, r, pos)
+        collide = jnp.any((jnp.arange(R) < outpos) & (out2 == item))
+        out_dev = jnp.where(status == _OK, _is_out(weights, item, x), False)
+        success = (status == _OK) & (~collide) & (~out_dev)
+        hard_fail = status == _SKIP
+        return (ftotal + 1, success | hard_fail, success,
+                jnp.where(success, item, dev))
+
+    init = (jnp.int32(0), jnp.asarray(False), jnp.asarray(False),
+            jnp.int32(ITEM_NONE))
+    _, _, ok, dev = lax.while_loop(cond, body, init)
+    return dev, ok
+
+
+def _choose_firstn(cm, arrs, root_item, target_type: int, numrep: int,
+                   recurse_to_leaf: bool, tries: int, recurse_tries: int,
+                   vary_r: int, stable: bool, weights, x, count_limit):
+    """crush_choose_firstn (mapper.c:460-648) for one x, modern tunables.
+
+    root_item: bucket id (negative, traced).  Returns (out, out2, outpos):
+    out/out2 are [numrep] i32 padded with ITEM_NONE.
+    """
+    R = numrep
+    out = jnp.full((R,), ITEM_NONE, dtype=jnp.int32)
+    out2 = jnp.full((R,), ITEM_NONE, dtype=jnp.int32)
+    outpos = jnp.int32(0)
+
+    for rep in range(numrep):  # static unroll; mapper.c:478 rep loop
+        def cond(s):
+            ftotal, placed, skipped, item, leaf = s
+            return (~placed) & (~skipped) & (ftotal < tries)
+
+        def body(s, rep=rep):
+            ftotal, placed, skipped, item_prev, leaf_prev = s
+            r = rep + ftotal  # parent_r == 0 at rule level
+            item, status = _descend(
+                cm, arrs, -1 - root_item, target_type, x, r, outpos)
+            collide = jnp.any((jnp.arange(R) < outpos) & (out == item))
+            reject = status == _REJECT
+            skip = status == _SKIP
+            leaf = jnp.int32(ITEM_NONE)
+            if recurse_to_leaf:
+                sub_r = (r >> (vary_r - 1)) if vary_r else jnp.int32(0)
+                is_bucket = item < 0
+                leaf_dev, leaf_ok = _leaf_firstn(
+                    cm, arrs, jnp.where(is_bucket, item, -1), weights, x,
+                    sub_r, recurse_tries, stable, out2, outpos, outpos)
+                # device-typed direct hit keeps itself as leaf
+                leaf = jnp.where(is_bucket, leaf_dev, item)
+                reject = reject | (
+                    (status == _OK) & (~collide) & is_bucket & (~leaf_ok))
+            if target_type == 0:
+                reject = reject | jnp.where(
+                    (status == _OK) & (~collide),
+                    _is_out(weights, item, x), False)
+            ok = (status == _OK) & (~collide) & (~reject)
+            fail = (~ok) & (~skip)
+            return (ftotal + jnp.where(fail, 1, 0),
+                    placed | ok, skipped | skip,
+                    jnp.where(ok, item, item_prev),
+                    jnp.where(ok, leaf, leaf_prev))
+
+        init = (jnp.int32(0), jnp.asarray(False), jnp.asarray(False),
+                jnp.int32(ITEM_NONE), jnp.int32(ITEM_NONE))
+        ftotal, placed, skipped, item, leaf = lax.while_loop(
+            cond, body, init)
+        placed = placed & (outpos < count_limit)
+        out = jnp.where(placed, out.at[outpos].set(item), out)
+        if recurse_to_leaf:
+            out2 = jnp.where(placed, out2.at[outpos].set(leaf), out2)
+        outpos = outpos + jnp.where(placed, 1, 0)
+    return out, out2, outpos
+
+
+# ---------------------------------------------------------------- indep ----
+
+def _leaf_indep(cm, arrs, bucket_item, weights, x, parent_r, rep,
+                numrep: int, recurse_tries: int, pos):
+    """Leaf recursion of crush_choose_indep (mapper.c:777-792): one device
+    in the subtree, positionally stable; no collision window (the recursion
+    window is a single slot).  Returns device or ITEM_NONE."""
+    def cond(s):
+        ftotal, done, dev = s
+        return (~done) & (ftotal < recurse_tries)
+
+    def body(s):
+        ftotal, done, dev = s
+        r = rep + parent_r + numrep * ftotal
+        item, status = _descend(cm, arrs, -1 - bucket_item, 0, x, r, pos)
+        out_dev = jnp.where(status == _OK, _is_out(weights, item, x), False)
+        success = (status == _OK) & (~out_dev)
+        hard_fail = status == _SKIP
+        return (ftotal + 1, success | hard_fail,
+                jnp.where(success, item, dev))
+
+    init = (jnp.int32(0), jnp.asarray(False), jnp.int32(ITEM_NONE))
+    _, _, dev = lax.while_loop(cond, body, init)
+    return dev
+
+
+def _choose_indep(cm, arrs, root_item, target_type: int, numrep: int,
+                  recurse_to_leaf: bool, tries: int, recurse_tries: int,
+                  weights, x, out_size_limit):
+    """crush_choose_indep (mapper.c:655-843) for one x: breadth-first,
+    positionally stable; failed slots become ITEM_NONE."""
+    R = numrep
+    UNDEF = jnp.int32(ITEM_UNDEF)
+    NONE = jnp.int32(ITEM_NONE)
+    active = jnp.arange(R) < out_size_limit
+    out = jnp.where(active, UNDEF, NONE)
+    out2 = jnp.where(active, UNDEF, NONE)
+
+    def round_body(s):
+        ftotal, out, out2 = s
+        for rep in range(R):  # static; collision sees earlier same-round reps
+            pending = active[rep] & (out[rep] == UNDEF)
+            r = rep + numrep * ftotal
+            item, status = _descend(
+                cm, arrs, -1 - root_item, target_type, x, r, rep)
+            collide = jnp.any(out == item)
+            hard = status == _SKIP
+            leaf = NONE
+            if recurse_to_leaf:
+                is_bucket = item < 0
+                leaf_dev = _leaf_indep(
+                    cm, arrs, jnp.where(is_bucket, item, -1), weights, x,
+                    r, rep, numrep, recurse_tries, rep)
+                leaf = jnp.where(is_bucket, leaf_dev, item)
+                leaf_fail = is_bucket & (leaf_dev == NONE)
+            else:
+                leaf_fail = jnp.asarray(False)
+            out_dev = jnp.where(
+                (status == _OK) & (target_type == 0),
+                _is_out(weights, item, x), False)
+            ok = (status == _OK) & ~collide & ~leaf_fail & ~out_dev
+            place = pending & ok
+            out = jnp.where(place, out.at[rep].set(item), out)
+            if recurse_to_leaf:
+                out2 = jnp.where(place, out2.at[rep].set(leaf), out2)
+            # hard failure pins the slot to NONE permanently
+            pin = pending & hard & ~ok
+            out = jnp.where(pin, out.at[rep].set(NONE), out)
+            out2 = jnp.where(pin & recurse_to_leaf,
+                             out2.at[rep].set(NONE), out2)
+        return (ftotal + 1, out, out2)
+
+    def round_cond(s):
+        ftotal, out, out2 = s
+        return (ftotal < tries) & jnp.any(out == UNDEF)
+
+    _, out, out2 = lax.while_loop(
+        round_cond, round_body, (jnp.int32(0), out, out2))
+    out = jnp.where(out == UNDEF, NONE, out)
+    out2 = jnp.where(out2 == UNDEF, NONE, out2)
+    return out, out2
+
+
+# ------------------------------------------------------------- rule driver --
+
+class XlaMapper:
+    """Compiled batched do_rule for one CrushMap.
+
+    Usage::
+
+        mapper = XlaMapper(cmap)
+        osds = mapper.map_batch(ruleno, xs, result_max, weights)  # [N, R]
+
+    ``weights`` is the device in/out vector ([max_devices] 16.16 fixed,
+    like the reference's __u32 *weight argument); results are padded with
+    ITEM_NONE.  One XLA compilation per (ruleno, result_max).
+    """
+
+    def __init__(self, cmap: CrushMap, choose_args_key: object = None,
+                 n_positions: int = 8):
+        self.cmap = cmap
+        self.compiled = compile_map(cmap, choose_args_key, n_positions)
+        self.compiled.device_arrays  # materialize outside any jit trace
+        self._jitted = {}
+
+    # -- trace-time rule interpretation (steps are static data) ------------
+    def _trace_rule(self, ruleno: int, result_max: int, xs, weights):
+        cmap, cm = self.cmap, self.compiled
+        rule = cmap.rules[ruleno]
+        t = cmap.tunables
+        arrs = cm.device_arrays
+
+        choose_tries = t.choose_total_tries + 1
+        choose_leaf_tries = 0
+        vary_r = t.chooseleaf_vary_r
+        stable = bool(t.chooseleaf_stable)
+
+        def per_x(x, weights):
+            result = jnp.full((result_max,), ITEM_NONE, dtype=jnp.int32)
+            rpos = jnp.int32(0)
+            # working vector: static list of (kind, payload) sources
+            sources: List = []   # each: dict(items=array [n] per-x, count)
+            nonlocal choose_tries, choose_leaf_tries, vary_r, stable
+            for op, arg1, arg2 in rule.steps:
+                if op == RULE_TAKE:
+                    ok = (0 <= arg1 < cmap.max_devices) or \
+                        (cmap.bucket(arg1) is not None)
+                    if ok:
+                        sources = [dict(
+                            items=jnp.full((1,), arg1, dtype=jnp.int32),
+                            count=jnp.int32(1))]
+                    else:
+                        sources = []
+                elif op == RULE_SET_CHOOSE_TRIES:
+                    if arg1 > 0:
+                        choose_tries = arg1
+                elif op == RULE_SET_CHOOSELEAF_TRIES:
+                    if arg1 > 0:
+                        choose_leaf_tries = arg1
+                elif op == RULE_SET_CHOOSE_LOCAL_TRIES:
+                    if arg1 > 0:
+                        raise UnsupportedMapError("local_tries rule step")
+                elif op == RULE_SET_CHOOSE_LOCAL_FALLBACK_TRIES:
+                    if arg1 > 0:
+                        raise UnsupportedMapError("local_fallback rule step")
+                elif op == RULE_SET_CHOOSELEAF_VARY_R:
+                    if arg1 >= 0:
+                        vary_r = arg1
+                elif op == RULE_SET_CHOOSELEAF_STABLE:
+                    if arg1 >= 0:
+                        stable = bool(arg1)
+                elif op in (RULE_CHOOSE_FIRSTN, RULE_CHOOSELEAF_FIRSTN,
+                            RULE_CHOOSE_INDEP, RULE_CHOOSELEAF_INDEP):
+                    firstn = op in (RULE_CHOOSE_FIRSTN, RULE_CHOOSELEAF_FIRSTN)
+                    leaf = op in (RULE_CHOOSELEAF_FIRSTN, RULE_CHOOSELEAF_INDEP)
+                    numrep = arg1
+                    if numrep <= 0:
+                        numrep += result_max
+                        if numrep <= 0:
+                            continue
+                    if firstn:
+                        if choose_leaf_tries:
+                            recurse_tries = choose_leaf_tries
+                        elif t.chooseleaf_descend_once:
+                            recurse_tries = 1
+                        else:
+                            recurse_tries = choose_tries
+                    else:
+                        recurse_tries = choose_leaf_tries or 1
+                    new_items = jnp.full((result_max,), ITEM_NONE,
+                                         dtype=jnp.int32)
+                    osize = jnp.int32(0)
+                    for src in sources:
+                        n_src = src["items"].shape[0]
+                        for i in range(n_src):
+                            live = (i < src["count"])
+                            bid = src["items"][i]
+                            is_bucket = bid < 0
+                            root = jnp.where(is_bucket, bid, -1)
+                            live = live & is_bucket
+                            if firstn:
+                                o, o2, got = _choose_firstn(
+                                    cm, arrs, root, arg2, numrep, leaf,
+                                    choose_tries, recurse_tries, vary_r,
+                                    stable, weights, x,
+                                    count_limit=result_max - osize)
+                            else:
+                                o, o2 = _choose_indep(
+                                    cm, arrs, root, arg2, numrep, leaf,
+                                    choose_tries, recurse_tries, weights, x,
+                                    out_size_limit=jnp.minimum(
+                                        numrep, result_max - osize))
+                                got = jnp.minimum(numrep,
+                                                  result_max - osize)
+                            vals = o2 if leaf else o
+                            idx = osize + jnp.arange(numrep)
+                            valid = live & (jnp.arange(numrep) < got)
+                            idx = jnp.where(valid, idx, result_max)
+                            new_items = new_items.at[idx].set(
+                                jnp.where(valid, vals, ITEM_NONE),
+                                mode="drop")
+                            osize = osize + jnp.where(live, got, 0)
+                    sources = [dict(items=new_items, count=osize)]
+                elif op == RULE_EMIT:
+                    for src in sources:
+                        n_src = src["items"].shape[0]
+                        take = jnp.minimum(src["count"], result_max - rpos)
+                        idx = rpos + jnp.arange(n_src)
+                        valid = jnp.arange(n_src) < take
+                        idx = jnp.where(valid, idx, result_max)
+                        result = result.at[idx].set(
+                            jnp.where(valid, src["items"][:n_src],
+                                      ITEM_NONE), mode="drop")
+                        rpos = rpos + take
+                    sources = []
+            return result
+
+        return jax.vmap(per_x, in_axes=(0, None))(xs, weights)
+
+    # ----------------------------------------------------------- public ---
+    def _get_jitted(self, ruleno: int, result_max: int, mesh=None):
+        key = (ruleno, result_max, id(mesh) if mesh is not None else None)
+        if key not in self._jitted:
+            fn = functools.partial(self._trace_rule, ruleno, result_max)
+            if mesh is None:
+                self._jitted[key] = jax.jit(fn)
+            else:
+                from jax.sharding import NamedSharding, PartitionSpec as P
+                axis = mesh.axis_names[0]
+                batch = NamedSharding(mesh, P(axis))
+                repl = NamedSharding(mesh, P())
+                self._jitted[key] = jax.jit(
+                    fn, in_shardings=(batch, repl), out_shardings=batch)
+        return self._jitted[key]
+
+    def map_batch(self, ruleno: int, xs, result_max: int,
+                  weights: Sequence[int], mesh=None) -> np.ndarray:
+        """[N] x values -> [N, result_max] i32 osd ids (ITEM_NONE padded).
+
+        With ``mesh``, the x axis is sharded across the device mesh (the
+        multi-chip ParallelPGMapper); N is padded to the mesh size.
+        """
+        if ruleno < 0 or ruleno >= self.cmap.max_rules or \
+                self.cmap.rules[ruleno] is None:
+            raise ValueError(f"no rule {ruleno}")
+        jitted = self._get_jitted(ruleno, result_max, mesh)
+        w = np.zeros(self.compiled.max_devices, dtype=np.int32)
+        w_in = np.asarray(weights, dtype=np.int64)
+        w[:min(len(w_in), len(w))] = w_in[:len(w)]
+        xs_np = np.asarray(xs, dtype=np.int64).astype(np.uint32) \
+            .astype(np.int32)
+        n = len(xs_np)
+        if mesh is not None:
+            pad = (-n) % mesh.size
+            if pad:
+                xs_np = np.concatenate([xs_np, xs_np[:1].repeat(pad)])
+        out = np.asarray(jitted(jnp.asarray(xs_np), jnp.asarray(w)))
+        return out[:n]

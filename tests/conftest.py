@@ -7,11 +7,20 @@ anywhere in the test session.
 import os
 
 # unconditional: the ambient environment may preset JAX_PLATFORMS to the
-# real accelerator, but the suite must be deterministic and exercise the
-# 8-device sharding paths; run bench.py / CEPH_TPU_TEST_DEVICE=1 for
-# on-hardware checks
+# real accelerator (and site hooks may override the env var at interpreter
+# start), but the suite must be deterministic and exercise the 8-device
+# sharding paths; run bench.py for on-hardware checks
 os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
+
+try:
+    import jax  # noqa: E402
+except ImportError:     # jax-less env: non-device tests still collect/run
+    pass
+else:
+    # site hooks may pin jax_platforms at interpreter start; override at
+    # the config level too (env alone is not sufficient there)
+    jax.config.update("jax_platforms", "cpu")

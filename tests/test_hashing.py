@@ -56,3 +56,19 @@ def test_jax_hashes_match_golden(vectors):
     h3 = hashing.jx_hash3(jnp.asarray(vals), jnp.asarray(b), jnp.asarray(c))
     assert np.asarray(h2).tolist() == vectors["h2"]
     assert np.asarray(h3).tolist() == vectors["h3"]
+
+
+def test_str_hash_rjenkins_golden():
+    """Pinned to vectors from the compiled reference ceph_str_hash_rjenkins
+    (src/common/ceph_hash.cc) — guards object->ps wire compatibility."""
+    golden = {
+        b"": 3175731469,
+        b"a": 703514648,
+        b"rbd_data.1234": 1649385036,
+        b"obj-000017": 1304429757,
+        b"benchmark_data_object_12345": 2206846135,
+        b"0123456789ab": 2465405648,
+        b"x": 3604590387,
+    }
+    for name, want in golden.items():
+        assert hashing.str_hash_rjenkins(name) == want, name

@@ -1,0 +1,176 @@
+"""OSDMap pipeline tests: scalar-vs-batched consistency, upmap semantics,
+pg_temp overlays, primary affinity, pool masks (models TestOSDMap.cc)."""
+import numpy as np
+import pytest
+
+from ceph_tpu.cluster.osdmap import (
+    FLAG_HASHPSPOOL, MAX_PRIMARY_AFFINITY, OSDMap, PGPool, POOL_ERASURE,
+    POOL_REPLICATED, WEIGHT_IN, pg_num_mask, stable_mod,
+)
+from ceph_tpu.placement.crush_map import (
+    ITEM_NONE, RULE_CHOOSELEAF_FIRSTN, RULE_CHOOSELEAF_INDEP, RULE_EMIT,
+    RULE_TAKE, Rule,
+)
+from tests.test_xla_mapper import TYPE_HOST, build_cluster
+
+
+def make_osdmap(n_hosts=6, osds_per_host=4, seed=0):
+    cmap, root = build_cluster(n_hosts=n_hosts, osds_per_host=osds_per_host,
+                               seed=seed)
+    cmap.add_rule(Rule(steps=[(RULE_TAKE, root, 0),
+                              (RULE_CHOOSELEAF_FIRSTN, 0, TYPE_HOST),
+                              (RULE_EMIT, 0, 0)]))
+    cmap.add_rule(Rule(steps=[(RULE_TAKE, root, 0),
+                              (RULE_CHOOSELEAF_INDEP, 0, TYPE_HOST),
+                              (RULE_EMIT, 0, 0)]))
+    m = OSDMap(cmap)
+    m.mark_all_in_up()
+    m.add_pool(PGPool(id=1, name="rbd", type=POOL_REPLICATED, size=3,
+                      pg_num=64, crush_rule=0))
+    m.add_pool(PGPool(id=2, name="ecpool", type=POOL_ERASURE, size=5,
+                      pg_num=32, crush_rule=1))
+    return m
+
+
+def test_stable_mod_and_masks():
+    assert pg_num_mask(8) == 7
+    assert pg_num_mask(12) == 15
+    for x in range(64):
+        b, bmask = 12, 15
+        want = x & bmask if (x & bmask) < b else x & (bmask >> 1)
+        assert stable_mod(x, b, bmask) == want
+        assert stable_mod(x, b, bmask) < b
+
+
+def test_scalar_batch_consistency_replicated():
+    m = make_osdmap()
+    up_b, prim_b = m.map_pgs_batch(1)
+    for ps in range(m.pools[1].pg_num):
+        up, upp, acting, actp = m.pg_to_up_acting_osds(1, ps)
+        row = [o for o in up_b[ps] if o != ITEM_NONE]
+        assert row == up, f"ps={ps}"
+        assert prim_b[ps] == upp
+        assert acting == up and actp == upp  # no temp overlays
+
+
+def test_scalar_batch_consistency_erasure():
+    m = make_osdmap()
+    up_b, prim_b = m.map_pgs_batch(2)
+    for ps in range(m.pools[2].pg_num):
+        up, upp, _, _ = m.pg_to_up_acting_osds(2, ps)
+        assert list(up_b[ps]) == up, f"ps={ps}"
+        assert prim_b[ps] == upp
+
+
+def test_down_and_out_osds():
+    m = make_osdmap()
+    m.mark_down(3)
+    m.mark_out(7)
+    up_b, _ = m.map_pgs_batch(1)
+    assert not np.any(up_b == 3)       # down filtered from up
+    assert not np.any(up_b == 7)       # out rejected by crush is_out
+    up_e, _ = m.map_pgs_batch(2)
+    assert not np.any(up_e == 3)
+    # EC keeps positional holes
+    for ps in range(m.pools[2].pg_num):
+        up, _, _, _ = m.pg_to_up_acting_osds(2, ps)
+        assert len(up) == 5
+        assert list(up_e[ps]) == up
+
+
+def test_pg_upmap_full_replacement():
+    m = make_osdmap()
+    up0, _, _, _ = m.pg_to_up_acting_osds(1, 5)
+    target = [0, 4, 8]
+    m.pg_upmap[(1, 5)] = target
+    up, upp, _, _ = m.pg_to_up_acting_osds(1, 5)
+    assert up == target
+    up_b, _ = m.map_pgs_batch(1)
+    assert [o for o in up_b[5] if o != ITEM_NONE] == target
+    # upmap to an out osd is ignored
+    m.mark_out(4)
+    up, _, _, _ = m.pg_to_up_acting_osds(1, 5)
+    assert up != target
+
+
+def test_pg_upmap_items_swap():
+    m = make_osdmap()
+    up0, _, _, _ = m.pg_to_up_acting_osds(1, 9)
+    frm = up0[1]
+    # pick a target on an unused host
+    used_hosts = {o // 4 for o in up0}
+    to = next(o for o in range(m.max_osd) if o // 4 not in used_hosts)
+    m.pg_upmap_items[(1, 9)] = [(frm, to)]
+    up, _, _, _ = m.pg_to_up_acting_osds(1, 9)
+    want = list(up0)
+    want[1] = to
+    assert up == want
+    up_b, _ = m.map_pgs_batch(1)
+    assert [o for o in up_b[9] if o != ITEM_NONE] == want
+    # replacement already present -> no-op
+    m.pg_upmap_items[(1, 9)] = [(frm, up0[0])]
+    up, _, _, _ = m.pg_to_up_acting_osds(1, 9)
+    assert up == up0
+
+
+def test_pg_temp_overlay():
+    m = make_osdmap()
+    up0, upp0, _, _ = m.pg_to_up_acting_osds(1, 3)
+    m.pg_temp[(1, 3)] = [9, 10, 11]
+    up, upp, acting, actp = m.pg_to_up_acting_osds(1, 3)
+    assert up == up0 and upp == upp0          # up unchanged
+    assert acting == [9, 10, 11] and actp == 9
+    m.primary_temp[(1, 3)] = 11
+    _, _, _, actp = m.pg_to_up_acting_osds(1, 3)
+    assert actp == 11
+    # down temp member drops out (replicated shifts)
+    m.osd_up[10] = False
+    _, _, acting, _ = m.pg_to_up_acting_osds(1, 3)
+    assert acting == [9, 11]
+
+
+def test_primary_affinity():
+    m = make_osdmap()
+    m.osd_primary_affinity[:] = 0     # nobody wants to be primary
+    m.osd_primary_affinity[2] = MAX_PRIMARY_AFFINITY
+    ups = []
+    for ps in range(m.pools[1].pg_num):
+        up, upp, _, _ = m.pg_to_up_acting_osds(1, ps)
+        ups.append((up, upp))
+        if 2 in up:
+            assert upp == 2           # the only full-affinity osd wins
+        else:
+            assert upp == up[0]       # fallback: first (all zero affinity)
+    up_b, prim_b = m.map_pgs_batch(1)
+    for ps, (up, upp) in enumerate(ups):
+        assert prim_b[ps] == upp
+        assert [o for o in up_b[ps] if o != ITEM_NONE] == up
+
+
+def test_pps_batch_matches_scalar():
+    pool = PGPool(id=7, pg_num=48, flags=FLAG_HASHPSPOOL)
+    pss = np.arange(48)
+    batch = pool.raw_pg_to_pps_batch(pss)
+    for ps in range(48):
+        assert batch[ps] == pool.raw_pg_to_pps(ps)
+    legacy = PGPool(id=7, pg_num=48, flags=0)
+    batch = legacy.raw_pg_to_pps_batch(pss)
+    for ps in range(48):
+        assert batch[ps] == legacy.raw_pg_to_pps(ps)
+
+
+def test_unknown_pool_and_oob_ps():
+    m = make_osdmap()
+    assert m.pg_to_up_acting_osds(99, 0) == ([], -1, [], -1)
+    assert m.pg_to_up_acting_osds(1, 10**6) == ([], -1, [], -1)
+    with pytest.raises(KeyError):
+        m.map_pgs_batch(99)
+
+
+def test_pg_counts_balance():
+    m = make_osdmap(n_hosts=8, osds_per_host=4, seed=2)
+    m.pools[1].pg_num = 256
+    m.pools[1].pgp_num = 256
+    counts = m.pg_counts_per_osd([1])
+    assert counts.sum() == 256 * 3
+    assert counts.min() > 0

@@ -1,0 +1,224 @@
+"""End-to-end cluster simulator tests: EC/replicated put/get, failures,
+recovery, thrashing, scrub — the memstore+vstart tier of the reference
+test strategy (SURVEY.md §4) plus the thrasher fault loop."""
+import numpy as np
+import pytest
+
+from ceph_tpu.cluster.osdmap import OSDMap, PGPool, POOL_ERASURE, \
+    POOL_REPLICATED
+from ceph_tpu.cluster.simulator import ClusterSim
+from ceph_tpu.cluster.striper import (FileLayout, extents_to_objects,
+                                      file_to_extents, read_from_objects)
+from ceph_tpu.placement.crush_map import (RULE_CHOOSELEAF_FIRSTN,
+                                          RULE_CHOOSELEAF_INDEP, RULE_EMIT,
+                                          RULE_TAKE, Rule)
+from tests.test_xla_mapper import TYPE_HOST, build_cluster
+
+
+def make_sim(n_hosts=8, osds_per_host=3, k=4, m=2, seed=0):
+    cmap, root = build_cluster(n_hosts=n_hosts, osds_per_host=osds_per_host,
+                               seed=seed)
+    cmap.add_rule(Rule(steps=[(RULE_TAKE, root, 0),
+                              (RULE_CHOOSELEAF_FIRSTN, 0, TYPE_HOST),
+                              (RULE_EMIT, 0, 0)]))
+    cmap.add_rule(Rule(steps=[(RULE_TAKE, root, 0),
+                              (RULE_CHOOSELEAF_INDEP, 0, TYPE_HOST),
+                              (RULE_EMIT, 0, 0)]))
+    om = OSDMap(cmap)
+    om.mark_all_in_up()
+    om.add_pool(PGPool(id=1, name="rep", type=POOL_REPLICATED, size=3,
+                       pg_num=32, crush_rule=0))
+    om.add_pool(PGPool(id=2, name="ec", type=POOL_ERASURE, size=k + m,
+                       pg_num=32, crush_rule=1,
+                       erasure_code_profile="default"))
+    sim = ClusterSim(om)
+    sim.create_ec_profile("default", {"plugin": "jax", "k": str(k),
+                                      "m": str(m)})
+    return sim
+
+
+def test_replicated_put_get():
+    sim = make_sim()
+    data = bytes(range(256)) * 17
+    placed = sim.put(1, "obj-a", data)
+    assert len(placed) == 3
+    assert sim.get(1, "obj-a") == data
+
+
+def test_ec_put_get_roundtrip():
+    sim = make_sim()
+    rng = np.random.default_rng(0)
+    blobs = {f"o{i}": rng.integers(0, 256, size=rng.integers(1, 100_000))
+             .astype(np.uint8).tobytes() for i in range(10)}
+    for name, data in blobs.items():
+        placed = sim.put(2, name, data)
+        assert len(placed) == 6      # k+m shards all placed
+    for name, data in blobs.items():
+        assert sim.get(2, name) == data
+
+
+def test_ec_degraded_read():
+    sim = make_sim()
+    data = b"x" * 50000
+    sim.put(2, "victim", data)
+    sim.kill_osd(0)
+    sim.kill_osd(5)
+    assert sim.get(2, "victim") == data   # <= m failures decode fine
+
+
+def test_ec_recovery_after_kill():
+    sim = make_sim()
+    rng = np.random.default_rng(1)
+    blobs = {f"o{i}": rng.integers(0, 256, size=20000).astype(np.uint8)
+             .tobytes() for i in range(12)}
+    for name, data in blobs.items():
+        sim.put(2, name, data)
+    old_up, _ = sim.osdmap.map_pgs_batch(2)
+    sim.kill_osd(2)
+    sim.out_osd(2)
+    sim.kill_osd(9)
+    sim.out_osd(9)
+    diffs = sim.remap_diff(2, old_up)
+    assert diffs                        # remap happened
+    stats = sim.recover_all(2)
+    assert stats["shards_rebuilt"] + stats["shards_copied"] > 0
+    # after recovery, every object readable from the new up set only
+    for name, data in blobs.items():
+        assert sim.get(2, name) == data
+    # every shard has a live home on the current up set
+    pool = sim.osdmap.pools[2]
+    for name in blobs:
+        pg = sim.object_pg(pool, name)
+        up = sim.pg_up(pool, pg)
+        for shard in range(6):
+            tgt = up[shard]
+            if tgt == -1 or tgt == 0x7FFFFFFF:
+                continue
+            assert sim.osds[tgt].get((2, pg, name, shard)) is not None
+
+
+def test_thrasher_loop():
+    """Randomized kill/revive while data stays readable (ceph_manager.py
+    Thrasher semantics, bounded to m simultaneous failures)."""
+    sim = make_sim(n_hosts=9, osds_per_host=3, k=4, m=2, seed=3)
+    rng = np.random.default_rng(42)
+    blobs = {f"t{i}": rng.integers(0, 256, size=8192).astype(np.uint8)
+             .tobytes() for i in range(8)}
+    for name, data in blobs.items():
+        sim.put(2, name, data)
+    dead = []
+    for round_ in range(6):
+        if len(dead) >= 2 or (dead and rng.random() < 0.5):
+            osd = dead.pop(rng.integers(0, len(dead)))
+            sim.revive_osd(osd)
+        else:
+            alive = [o.id for o in sim.osds if o.alive]
+            osd = int(rng.choice(alive))
+            sim.kill_osd(osd)
+            dead.append(osd)
+        sim.recover_all(2)
+        for name, data in blobs.items():
+            assert sim.get(2, name) == data, f"round {round_} lost {name}"
+
+
+def test_scrub_detects_corruption():
+    sim = make_sim()
+    data = b"scrubme" * 1000
+    sim.put(2, "s1", data)
+    assert sim.scrub(2) == []
+    pool = sim.osdmap.pools[2]
+    pg = sim.object_pg(pool, "s1")
+    up = sim.pg_up(pool, pg)
+    # flip a byte in parity shard 4
+    victim = sim.osds[up[4]]
+    key = (2, pg, "s1", 4)
+    payload = victim.store[key].copy()
+    payload[0] ^= 0xFF
+    victim.store[key] = payload
+    assert ("s1", 4) in sim.scrub(2)
+
+
+def test_unrecoverable_raises():
+    sim = make_sim(k=2, m=1)
+    sim.osdmap.pools[2].size = 3
+    data = b"fragile" * 100
+    sim.put(2, "f", data)
+    pool = sim.osdmap.pools[2]
+    pg = sim.object_pg(pool, "f")
+    up = sim.pg_up(pool, pg)
+    for o in up[:2]:
+        sim.kill_osd(o)
+    with pytest.raises(Exception):
+        sim.get(2, "f")
+
+
+# ------------------------------------------------------------- striper ----
+
+def test_striper_extent_math():
+    lay = FileLayout(stripe_unit=4, stripe_count=3, object_size=8)
+    # 30 bytes: blocks of 4 round-robin over 3 objects, 2 blocks per object
+    ext = file_to_extents(lay, 0, 30)
+    assert sum(e[2] for e in ext) == 30
+    # object numbers roll to the second object set (ids 3..5) after 24 bytes
+    assert {e[0] for e in ext} == {0, 1, 2, 3, 4}
+    total = {}
+    for objno, off, ln in ext:
+        total.setdefault(objno, 0)
+        total[objno] += ln
+    assert total[0] == 8 and total[1] == 8 and total[2] == 8
+
+
+def test_striper_roundtrip():
+    rng = np.random.default_rng(5)
+    lay = FileLayout(stripe_unit=1024, stripe_count=4, object_size=4096)
+    data = rng.integers(0, 256, size=50000).astype(np.uint8).tobytes()
+    frags = extents_to_objects(lay, data)
+    objects = {}
+    for objno, pieces in frags.items():
+        size = max(off + len(b) for off, b in pieces.items())
+        buf = bytearray(size)
+        for off, b in pieces.items():
+            buf[off:off + len(b)] = b
+        objects[objno] = bytes(buf)
+    assert read_from_objects(lay, objects, 0, len(data)) == data
+    # partial mid-stream read
+    assert read_from_objects(lay, objects, 12345, 6789) == \
+        data[12345:12345 + 6789]
+
+
+def test_striper_validation():
+    with pytest.raises(ValueError):
+        FileLayout(stripe_unit=3, stripe_count=1, object_size=8)
+    with pytest.raises(ValueError):
+        FileLayout(stripe_unit=0, stripe_count=1, object_size=0)
+
+
+def test_recovery_mixed_object_sizes():
+    """Stripes batch only with shape-identical peers (regression: a shared
+    erasure signature across different chunk sizes must not abort)."""
+    sim = make_sim()
+    a = b"a" * 1000
+    b = b"b" * 100000
+    sim.put(2, "small", a)
+    sim.put(2, "big", b)
+    # drop shard 1 of both objects everywhere
+    for osd in sim.osds:
+        for key in [k for k in osd.store if k[3] == 1 and k[0] == 2]:
+            osd.delete(key)
+    stats = sim.recover_all(2)
+    assert stats["shards_rebuilt"] >= 1
+    assert sim.get(2, "small") == a
+    assert sim.get(2, "big") == b
+
+
+def test_replicated_stale_map_read():
+    """Out-but-alive replicas remain readable before recovery runs."""
+    sim = make_sim()
+    data = b"sticky" * 500
+    sim.put(1, "r1", data)
+    pool = sim.osdmap.pools[1]
+    pg = sim.object_pg(pool, "r1")
+    holders = sim.pg_up(pool, pg)
+    for o in holders:
+        sim.out_osd(o)          # remap away; OSDs stay alive with data
+    assert sim.get(1, "r1") == data

@@ -1,0 +1,123 @@
+"""CLI tools + multi-chip sharding tests (models the reference's cram-style
+CLI transcripts, src/test/cli/crushtool/*.t, and the mesh scale-out)."""
+import json
+
+import numpy as np
+import pytest
+
+from ceph_tpu.placement.crush_map import (
+    RULE_CHOOSELEAF_FIRSTN, RULE_EMIT, RULE_TAKE, Rule, WEIGHT_ONE)
+from tests.test_xla_mapper import TYPE_HOST, build_cluster
+
+
+@pytest.fixture(scope="module")
+def map_spec(tmp_path_factory):
+    cmap, root = build_cluster(n_hosts=4, osds_per_host=3)
+    cmap.add_rule(Rule(steps=[(RULE_TAKE, root, 0),
+                              (RULE_CHOOSELEAF_FIRSTN, 0, TYPE_HOST),
+                              (RULE_EMIT, 0, 0)], name="replicated_rule"))
+    p = tmp_path_factory.mktemp("maps") / "map.json"
+    p.write_text(json.dumps(cmap.to_spec()))
+    return str(p), cmap
+
+
+def test_crushtool_test_mode(map_spec, capsys):
+    from ceph_tpu.tools import crushtool
+    path, cmap = map_spec
+    rc = crushtool.main(["--infn", path, "--test", "--min-x", "0",
+                         "--max-x", "63", "--num-rep", "3",
+                         "--show-utilization", "--show-statistics",
+                         "--show-bad-mappings"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "num_osds_mapped 12" in out
+    assert "size 3:\t64/64" in out
+
+
+def test_crushtool_scalar_matches_batched(map_spec, capsys):
+    from ceph_tpu.tools import crushtool
+    path, _ = map_spec
+    crushtool.main(["--infn", path, "--test", "--max-x", "31",
+                    "--num-rep", "3", "--show-mappings"])
+    batched = capsys.readouterr().out
+    crushtool.main(["--infn", path, "--test", "--max-x", "31",
+                    "--num-rep", "3", "--show-mappings", "--scalar"])
+    scalar = capsys.readouterr().out
+    assert batched == scalar
+
+
+def test_crushtool_roundtrip_spec(map_spec, capsys):
+    from ceph_tpu.tools import crushtool
+    path, cmap = map_spec
+    rc = crushtool.main(["--infn", path, "--dump"])
+    assert rc == 0
+    spec = json.loads(capsys.readouterr().out)
+    assert spec == cmap.to_spec()
+
+
+def test_osdmaptool_test_map_pgs(map_spec, tmp_path, capsys):
+    from ceph_tpu.tools import osdmaptool
+    path, cmap = map_spec
+    cluster = {
+        "crush": cmap.to_spec(),
+        "pools": [{"id": 1, "type": 1, "size": 3, "pg_num": 64,
+                   "crush_rule": 0},
+                  {"id": 2, "type": 3, "size": 4, "pg_num": 32,
+                   "crush_rule": 0}],
+        "osds": {"down": [], "out": []},
+    }
+    p = tmp_path / "cluster.json"
+    p.write_text(json.dumps(cluster))
+    rc = osdmaptool.main([str(p), "--test-map-pgs"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "96 pgs" in out
+    assert "total replicas 320" in out
+
+
+def test_ec_bench_json(capsys):
+    from ceph_tpu.tools import ec_bench
+    rc = ec_bench.main(["--plugin", "jax", "--workload", "encode",
+                        "-k", "4", "-m", "2", "--size", "65536",
+                        "--iterations", "2", "--batch", "4", "--json"])
+    assert rc == 0
+    result = json.loads(capsys.readouterr().out)
+    assert result["KB"] == 2 * 4 * 4 * result["chunk_size"] // 1024
+    assert result["GBps"] > 0
+    rc = ec_bench.main(["--plugin", "jerasure", "--workload", "decode",
+                        "-k", "4", "-m", "2", "--size", "16384",
+                        "--iterations", "1", "--erasures", "2", "--json"])
+    assert rc == 0
+    result = json.loads(capsys.readouterr().out)
+    assert len(result["erased"]) == 2
+
+
+def test_sharded_map_batch_matches_single():
+    from ceph_tpu.parallel.mesh import make_mesh
+    from ceph_tpu.placement.xla_mapper import XlaMapper
+    cmap, root = build_cluster(n_hosts=4, osds_per_host=3)
+    cmap.add_rule(Rule(steps=[(RULE_TAKE, root, 0),
+                              (RULE_CHOOSELEAF_FIRSTN, 0, TYPE_HOST),
+                              (RULE_EMIT, 0, 0)]))
+    weights = [WEIGHT_ONE] * cmap.max_devices
+    mapper = XlaMapper(cmap)
+    xs = np.arange(101)   # deliberately not divisible by 8
+    plain = mapper.map_batch(0, xs, 3, weights)
+    mesh = make_mesh(8)
+    sharded = mapper.map_batch(0, xs, 3, weights, mesh=mesh)
+    assert np.array_equal(plain, sharded)
+
+
+def test_distributed_encode_step_matches_host():
+    import jax.numpy as jnp
+    from ceph_tpu.ops import gf
+    from ceph_tpu.parallel.mesh import distributed_encode_step, make_mesh
+    mesh = make_mesh(8)
+    parity = gf.vandermonde_parity(4, 2)
+    bitmat = jnp.asarray(gf.gf8_bitmatrix(parity))
+    rng = np.random.default_rng(0)
+    data = rng.integers(0, 256, size=(16, 4, 256), dtype=np.uint8)
+    out, total = distributed_encode_step(mesh, bitmat, jnp.asarray(data))
+    want = np.stack([gf.gf_matmul(parity, d) for d in data])
+    assert np.array_equal(np.asarray(out), want)
+    assert int(total) == int(data.astype(np.int64).sum())

@@ -1,0 +1,206 @@
+"""XLA batched mapper vs scalar reference mapper — bit-exactness suite.
+
+Every test builds a straw2 hierarchy, runs the same rule through
+scalar_mapper.do_rule (the oracle validated against the reference C core
+by tests/test_scalar_mapper.py golden vectors) and XlaMapper.map_batch,
+and requires element-for-element equality including ITEM_NONE padding.
+"""
+import numpy as np
+import pytest
+
+from ceph_tpu.placement import scalar_mapper
+from ceph_tpu.placement.crush_map import (
+    BUCKET_STRAW2, BUCKET_UNIFORM, ITEM_NONE, RULE_CHOOSELEAF_FIRSTN,
+    RULE_CHOOSELEAF_INDEP, RULE_CHOOSE_FIRSTN, RULE_CHOOSE_INDEP, RULE_EMIT,
+    RULE_SET_CHOOSELEAF_STABLE, RULE_SET_CHOOSELEAF_VARY_R, RULE_TAKE,
+    Bucket, ChooseArg, CrushMap, Rule, Tunables, WEIGHT_ONE,
+)
+from ceph_tpu.placement.builder import (TYPE_HOST, TYPE_OSD, TYPE_RACK,
+                                        TYPE_ROOT, build_flat_cluster)
+from ceph_tpu.placement.xla_mapper import UnsupportedMapError, XlaMapper
+
+
+def build_cluster(n_racks=0, n_hosts=6, osds_per_host=4, seed=0,
+                  tunables=None, weight_jitter=True):
+    return build_flat_cluster(n_hosts=n_hosts, osds_per_host=osds_per_host,
+                              n_racks=n_racks, seed=seed, tunables=tunables,
+                              weight_jitter=weight_jitter)
+
+
+def assert_bit_exact(cmap, ruleno, result_max, weights, xs,
+                     choose_args_key=None):
+    choose_args = cmap.choose_args.get(choose_args_key) \
+        if choose_args_key is not None else None
+    mapper = XlaMapper(cmap, choose_args_key=choose_args_key)
+    got = mapper.map_batch(ruleno, xs, result_max, weights)
+    for i, x in enumerate(xs):
+        want = scalar_mapper.do_rule(cmap, ruleno, int(x), result_max,
+                                     weights, choose_args)
+        want = want + [ITEM_NONE] * (result_max - len(want))
+        assert list(got[i]) == want, \
+            f"x={x}: xla={list(got[i])} scalar={want}"
+
+
+XS = list(range(257)) + [2**31 - 1, 2**31, 2**32 - 1, 12345678]
+
+
+def test_chooseleaf_firstn_replicated():
+    cmap, root = build_cluster()
+    cmap.add_rule(Rule(steps=[(RULE_TAKE, root, 0),
+                              (RULE_CHOOSELEAF_FIRSTN, 0, TYPE_HOST),
+                              (RULE_EMIT, 0, 0)]))
+    weights = [WEIGHT_ONE] * cmap.max_devices
+    assert_bit_exact(cmap, 0, 3, weights, XS)
+
+
+def test_choose_firstn_direct_osd():
+    cmap, root = build_cluster(n_hosts=4, osds_per_host=6)
+    cmap.add_rule(Rule(steps=[(RULE_TAKE, root, 0),
+                              (RULE_CHOOSE_FIRSTN, 0, TYPE_OSD),
+                              (RULE_EMIT, 0, 0)]))
+    weights = [WEIGHT_ONE] * cmap.max_devices
+    assert_bit_exact(cmap, 0, 3, weights, XS)
+
+
+def test_chooseleaf_indep_ec():
+    cmap, root = build_cluster(n_hosts=8, osds_per_host=3)
+    cmap.add_rule(Rule(steps=[(RULE_TAKE, root, 0),
+                              (RULE_CHOOSELEAF_INDEP, 0, TYPE_HOST),
+                              (RULE_EMIT, 0, 0)]))
+    weights = [WEIGHT_ONE] * cmap.max_devices
+    assert_bit_exact(cmap, 0, 6, weights, XS)
+
+
+def test_choose_indep_direct_osd():
+    cmap, root = build_cluster(n_hosts=5, osds_per_host=5)
+    cmap.add_rule(Rule(steps=[(RULE_TAKE, root, 0),
+                              (RULE_CHOOSE_INDEP, 4, TYPE_OSD),
+                              (RULE_EMIT, 0, 0)]))
+    weights = [WEIGHT_ONE] * cmap.max_devices
+    assert_bit_exact(cmap, 0, 4, weights, XS)
+
+
+def test_two_step_rack_then_host():
+    cmap, root = build_cluster(n_racks=3, n_hosts=9, osds_per_host=3)
+    cmap.add_rule(Rule(steps=[(RULE_TAKE, root, 0),
+                              (RULE_CHOOSE_FIRSTN, 2, TYPE_RACK),
+                              (RULE_CHOOSELEAF_FIRSTN, 2, TYPE_HOST),
+                              (RULE_EMIT, 0, 0)]))
+    weights = [WEIGHT_ONE] * cmap.max_devices
+    assert_bit_exact(cmap, 0, 4, weights, XS[:128])
+
+
+def test_out_devices_reweight():
+    """Zero, fractional and full weights exercise is_out + retries."""
+    cmap, root = build_cluster(n_hosts=6, osds_per_host=4, seed=3)
+    cmap.add_rule(Rule(steps=[(RULE_TAKE, root, 0),
+                              (RULE_CHOOSELEAF_FIRSTN, 0, TYPE_HOST),
+                              (RULE_EMIT, 0, 0)]))
+    rng = np.random.default_rng(7)
+    weights = []
+    for i in range(cmap.max_devices):
+        roll = rng.random()
+        if roll < 0.2:
+            weights.append(0)              # marked out
+        elif roll < 0.5:
+            weights.append(int(WEIGHT_ONE * rng.random()))  # overloaded
+        else:
+            weights.append(WEIGHT_ONE)
+    assert_bit_exact(cmap, 0, 3, weights, XS)
+
+
+def test_all_devices_out():
+    cmap, root = build_cluster(n_hosts=3, osds_per_host=2)
+    cmap.add_rule(Rule(steps=[(RULE_TAKE, root, 0),
+                              (RULE_CHOOSELEAF_FIRSTN, 0, TYPE_HOST),
+                              (RULE_EMIT, 0, 0)]))
+    weights = [0] * cmap.max_devices
+    assert_bit_exact(cmap, 0, 3, weights, XS[:64])
+
+
+def test_numrep_exceeds_hosts():
+    """More replicas than failure domains -> short results, NONE padding."""
+    cmap, root = build_cluster(n_hosts=3, osds_per_host=4)
+    cmap.add_rule(Rule(steps=[(RULE_TAKE, root, 0),
+                              (RULE_CHOOSELEAF_FIRSTN, 0, TYPE_HOST),
+                              (RULE_EMIT, 0, 0)]))
+    weights = [WEIGHT_ONE] * cmap.max_devices
+    assert_bit_exact(cmap, 0, 5, weights, XS[:64])
+
+
+def test_vary_r_and_stable_steps():
+    cmap, root = build_cluster(n_hosts=6, osds_per_host=4, seed=11)
+    cmap.add_rule(Rule(steps=[(RULE_SET_CHOOSELEAF_VARY_R, 0, 0),
+                              (RULE_SET_CHOOSELEAF_STABLE, 0, 0),
+                              (RULE_TAKE, root, 0),
+                              (RULE_CHOOSELEAF_FIRSTN, 0, TYPE_HOST),
+                              (RULE_EMIT, 0, 0)]))
+    weights = [WEIGHT_ONE] * cmap.max_devices
+    assert_bit_exact(cmap, 0, 3, weights, XS[:128])
+
+
+def test_firefly_tunables():
+    cmap, root = build_cluster(tunables=Tunables.profile("firefly"), seed=5)
+    cmap.add_rule(Rule(steps=[(RULE_TAKE, root, 0),
+                              (RULE_CHOOSELEAF_FIRSTN, 0, TYPE_HOST),
+                              (RULE_EMIT, 0, 0)]))
+    weights = [WEIGHT_ONE] * cmap.max_devices
+    assert_bit_exact(cmap, 0, 3, weights, XS[:128])
+
+
+def test_multiple_takes_multiple_emits():
+    cmap, root = build_cluster(n_hosts=4, osds_per_host=3, seed=13)
+    h0 = -1  # first host bucket
+    cmap.add_rule(Rule(steps=[(RULE_TAKE, h0, 0),
+                              (RULE_CHOOSE_FIRSTN, 1, TYPE_OSD),
+                              (RULE_EMIT, 0, 0),
+                              (RULE_TAKE, root, 0),
+                              (RULE_CHOOSELEAF_FIRSTN, 2, TYPE_HOST),
+                              (RULE_EMIT, 0, 0)]))
+    weights = [WEIGHT_ONE] * cmap.max_devices
+    assert_bit_exact(cmap, 0, 3, weights, XS[:128])
+
+
+def test_choose_args_weight_set():
+    """Per-position weight-set overrides (the upmap/balancer mechanism)."""
+    cmap, root = build_cluster(n_hosts=4, osds_per_host=4, seed=17)
+    rng = np.random.default_rng(23)
+    args = []
+    for b in cmap.buckets:
+        if b is None:
+            args.append(None)
+            continue
+        ws = [[max(1, int(w * (0.5 + rng.random()))) for w in b.weights]
+              for _ in range(2)]
+        args.append(ChooseArg(ids=None, weight_set=ws))
+    cmap.choose_args["pool1"] = args
+    cmap.add_rule(Rule(steps=[(RULE_TAKE, root, 0),
+                              (RULE_CHOOSELEAF_FIRSTN, 0, TYPE_HOST),
+                              (RULE_EMIT, 0, 0)]))
+    weights = [WEIGHT_ONE] * cmap.max_devices
+    assert_bit_exact(cmap, 0, 3, weights, XS[:128],
+                     choose_args_key="pool1")
+
+
+def test_unsupported_map_raises():
+    m = CrushMap(tunables=Tunables.profile("jewel"))
+    m.add_bucket(Bucket(id=-1, alg=BUCKET_UNIFORM, type=TYPE_HOST,
+                        items=[0, 1], weights=[WEIGHT_ONE]))
+    m.finalize()
+    with pytest.raises(UnsupportedMapError):
+        XlaMapper(m)
+    m2, _ = build_cluster(tunables=Tunables.profile("argonaut"))
+    with pytest.raises(UnsupportedMapError):
+        XlaMapper(m2)
+
+
+def test_large_batch_shape():
+    cmap, root = build_cluster()
+    cmap.add_rule(Rule(steps=[(RULE_TAKE, root, 0),
+                              (RULE_CHOOSELEAF_FIRSTN, 0, TYPE_HOST),
+                              (RULE_EMIT, 0, 0)]))
+    weights = [WEIGHT_ONE] * cmap.max_devices
+    mapper = XlaMapper(cmap)
+    out = mapper.map_batch(0, np.arange(10000), 3, weights)
+    assert out.shape == (10000, 3)
+    assert np.all(out != ITEM_NONE)
