@@ -113,11 +113,12 @@ class ErasureCodeBase(ErasureCodeInterface):
         if want_to_read <= have:
             return {c: np.asarray(chunks[c], dtype=np.uint8)
                     for c in want_to_read}
-        if len(available) < self.k:
-            raise ErasureCodeError(
-                f"need {self.k} chunks to decode, have {len(available)}")
+        # sufficiency is codec-specific (layered codecs decode locally
+        # from fewer than k chunks); decode_chunks raises if impossible.
+        # Rebuild only what was asked for — a local read plan deliberately
+        # leaves unrelated chunks unread.
         use = available[:self.k + self.m]
-        erased = sorted(set(range(self.get_chunk_count())) - have)
+        erased = sorted(want_to_read - have)
         stack = np.stack([np.asarray(chunks[c], dtype=np.uint8)
                           for c in use])
         rebuilt = self.decode_chunks(use, stack, erased)

@@ -1,0 +1,252 @@
+"""The 'lrc' codec — layered locally-repairable erasure coding.
+
+Re-creates the behavior of the reference LRC plugin
+(src/erasure-code/lrc/ErasureCodeLrc.{h,cc}): a global ``mapping`` string
+assigns each chunk position a role ('D' data, 'c' coding, '_' padding
+hole), and ``layers`` — a JSON list of [chunks_map, profile] pairs — each
+run an inner codec over their own 'D'/'c' positions (layers_init,
+ErasureCodeLrc.cc:213-244).  Single-chunk failures repair from the
+smallest covering layer instead of reading k chunks: _minimum_to_decode
+walks layers in reverse preferring local groups (ErasureCodeLrc.cc:590+).
+
+The k/m/l shorthand (DEFAULT_KML generation, ErasureCodeLrc.cc:347-367)
+builds the canonical mapping: k data + m global parities followed by one
+local parity per group of (k+m)/... — matching the reference's generated
+layout.
+
+Profiles:
+  plugin=lrc mapping=__DD__DD layers=[["_cDD_cDD",""],["cDDD____",""],...]
+  plugin=lrc k=4 m=2 l=3     (generated layout)
+"""
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Sequence, Set
+
+import numpy as np
+
+from .base import ErasureCodeBase
+from .interface import ErasureCodeError, ErasureCodeProfile, SubChunkPlan
+
+
+class _Layer:
+    def __init__(self, chunks_map: str, profile: Dict[str, str]):
+        self.chunks_map = chunks_map
+        self.data = [i for i, c in enumerate(chunks_map) if c == "D"]
+        self.coding = [i for i, c in enumerate(chunks_map) if c == "c"]
+        self.chunks = self.data + self.coding
+        self.chunks_as_set = set(self.chunks)
+        self.profile = dict(profile)
+        self.profile.setdefault("k", str(len(self.data)))
+        self.profile.setdefault("m", str(len(self.coding)))
+        self.profile.setdefault("plugin", "jax")
+        self.profile.setdefault("technique", "reed_sol_van")
+        from .registry import ErasureCodePluginRegistry
+        self.codec = ErasureCodePluginRegistry.instance().factory(
+            self.profile["plugin"], self.profile)
+
+
+def _generate_kml(k: int, m: int, l: int) -> Dict[str, str]:
+    """The k/m/l layout generator (ErasureCodeLrc.cc:293-375 semantics):
+    groups of l data-or-global-coding chunks each get one local parity."""
+    if l <= 0 or (k + m) % l:
+        raise ErasureCodeError(
+            f"lrc k+m={k + m} must be a multiple of l={l}")
+    local_group_count = (k + m) // l
+    if k % local_group_count or m % local_group_count:
+        raise ErasureCodeError(
+            f"lrc k={k} and m={m} must be multiples of the group count "
+            f"{local_group_count}")
+    kg = k // local_group_count
+    mg = m // local_group_count
+    mapping = ("D" * kg + "_" * mg + "_") * local_group_count
+    # global layer: all data positions, coding in the per-group m slots
+    glob = ""
+    for g in range(local_group_count):
+        glob += "D" * kg + "c" * mg + "_"
+    layers: List[List[str]] = [[glob, ""]]
+    # one local parity layer per group covering its k+m slots
+    for g in range(local_group_count):
+        pre = "_" * (g * (kg + mg + 1))
+        post = "_" * ((local_group_count - g - 1) * (kg + mg + 1))
+        layers.append([pre + "D" * (kg + mg) + "c" + post, ""])
+    return {"mapping": mapping, "layers": json.dumps(layers)}
+
+
+class ErasureCodeLrc(ErasureCodeBase):
+    def __init__(self) -> None:
+        super().__init__()
+        self.layers: List[_Layer] = []
+        self.mapping = ""
+
+    def init(self, profile: ErasureCodeProfile) -> None:
+        prof = dict(profile)
+        if "mapping" not in prof:
+            k = self.profile_int(prof, "k", 4, minimum=1)
+            m = self.profile_int(prof, "m", 2, minimum=1)
+            l = self.profile_int(prof, "l", 3, minimum=1)
+            prof.update(_generate_kml(k, m, l))
+        self.mapping = prof["mapping"]
+        try:
+            layer_desc = json.loads(prof["layers"])
+        except (KeyError, json.JSONDecodeError) as e:
+            raise ErasureCodeError(f"lrc layers JSON invalid: {e}") from e
+        if not isinstance(layer_desc, list) or not layer_desc:
+            raise ErasureCodeError("lrc layers must be a non-empty list")
+        n = len(self.mapping)
+        self.layers = []
+        for entry in layer_desc:
+            cmap = entry[0] if isinstance(entry, list) else entry
+            lprof: Dict[str, str] = {}
+            if isinstance(entry, list) and len(entry) > 1 and entry[1]:
+                if isinstance(entry[1], str):
+                    for kv in entry[1].split():
+                        key, _, val = kv.partition("=")
+                        lprof[key] = val
+                elif isinstance(entry[1], dict):
+                    lprof = {k: str(v) for k, v in entry[1].items()}
+            if len(cmap) != n:
+                raise ErasureCodeError(
+                    f"layer map {cmap!r} length != mapping length {n}")
+            self.layers.append(_Layer(cmap, lprof))
+        covered = set()
+        for lay in self.layers:
+            covered |= lay.chunks_as_set
+        if covered != set(range(n)):
+            raise ErasureCodeError(
+                f"layers cover {sorted(covered)} != all {n} positions")
+        self.k = sum(1 for c in self.mapping if c == "D")
+        self.m = n - self.k
+        # logical chunk ids: 0..k-1 data, k.. the rest; physical = the
+        # position in the mapping string (what placement distributes)
+        self._l2p = [i for i, c in enumerate(self.mapping) if c == "D"] + \
+            [i for i, c in enumerate(self.mapping) if c != "D"]
+        self._p2l = {p: i for i, p in enumerate(self._l2p)}
+        self._profile = dict(profile)
+        self._profile.setdefault("plugin", "lrc")
+
+    def get_chunk_mapping(self) -> List[int]:
+        return list(self._l2p)
+
+    # ------------------------------------------------------------ encode --
+    def encode_chunks(self, data_chunks: np.ndarray) -> np.ndarray:
+        data = np.asarray(data_chunks, dtype=np.uint8)
+        if data.shape[0] != self.k:
+            raise ErasureCodeError(
+                f"expected {self.k} data chunks, got {data.shape[0]}")
+        n = len(self.mapping)
+        chunk = data.shape[1]
+        full = np.zeros((n, chunk), dtype=np.uint8)
+        data_pos = [i for i, c in enumerate(self.mapping) if c == "D"]
+        for i, pos in enumerate(data_pos):
+            full[pos] = data[i]
+        # layers run in order; later layers may consume earlier codings
+        for lay in self.layers:
+            sub = full[lay.data]
+            parity = lay.codec.encode_chunks(sub)
+            for j, pos in enumerate(lay.coding):
+                full[pos] = parity[j]
+        non_data = [i for i in range(n) if i not in data_pos]
+        return full[non_data]
+
+    # ------------------------------------------------------------ decode --
+    def minimum_to_decode(self, want_to_read: Set[int],
+                          available: Set[int]) -> SubChunkPlan:
+        """Smallest covering layer first (ErasureCodeLrc.cc Case 1-3).
+        Ids are logical; layers work in physical positions."""
+        erasures_want = want_to_read - available
+        if not erasures_want:
+            return {c: [(0, 1)] for c in want_to_read}
+        want_p = {self._l2p[c] for c in want_to_read}
+        avail_p = {self._l2p[c] for c in available}
+        # accumulate per-layer reads, most-local layers first, removing
+        # erasures as a layer promises to recover them (Case 2,
+        # ErasureCodeLrc.cc); wanted-and-available chunks always read
+        minimum_p = want_p & avail_p
+        era_not_recovered = set(range(len(self.mapping))) - avail_p
+        era_want = {self._l2p[c] for c in erasures_want}
+        for lay in reversed(self.layers):
+            if not era_want:
+                break
+            layer_erasures = era_want & lay.chunks_as_set
+            if not layer_erasures:
+                continue
+            unrecovered_in_layer = lay.chunks_as_set & era_not_recovered
+            if len(unrecovered_in_layer) > len(lay.coding):
+                continue            # too many for this layer; try a wider one
+            minimum_p |= lay.chunks_as_set & avail_p
+            era_not_recovered -= unrecovered_in_layer
+            era_want -= layer_erasures
+        if not era_want:
+            return {self._p2l[c]: [(0, 1)] for c in minimum_p}
+        # fall back: any combination across layers that can cascade-recover
+        if self._can_recover(avail_p):
+            return {self._p2l[c]: [(0, 1)] for c in avail_p}
+        raise ErasureCodeError(
+            f"lrc cannot recover {sorted(erasures_want)} from "
+            f"{sorted(available)}")
+
+    def _can_recover(self, available: Set[int]) -> bool:
+        have = set(available)
+        progress = True
+        while progress:
+            progress = False
+            for lay in self.layers:
+                missing = lay.chunks_as_set - have
+                if missing and len(missing) <= len(lay.coding) and \
+                        len(lay.chunks_as_set & have) >= len(lay.data):
+                    have |= lay.chunks_as_set
+                    progress = True
+        return have >= set(range(len(self.mapping)))
+
+    def decode_chunks(self, available_ids: Sequence[int],
+                      chunks: np.ndarray, erased_ids: Sequence[int]
+                      ) -> np.ndarray:
+        """Cascading layer repair: repeatedly fix any layer with few
+        enough erasures until targets are rebuilt.  Ids logical."""
+        chunk = chunks.shape[-1]
+        have: Dict[int, np.ndarray] = {
+            self._l2p[c]: np.asarray(chunks[i], dtype=np.uint8)
+            for i, c in enumerate(available_ids)}
+        targets = [self._l2p[c] for c in sorted(erased_ids)]
+        progress = True
+        while progress and not all(t in have for t in targets):
+            progress = False
+            for lay in self.layers:
+                missing = [c for c in lay.chunks if c not in have]
+                if not missing:
+                    continue
+                avail_in = [c for c in lay.chunks if c in have]
+                if len(avail_in) < len(lay.data) or \
+                        len(missing) > len(lay.coding):
+                    continue
+                # express in layer-local indices
+                local = {g: i for i, g in enumerate(lay.chunks)}
+                try:
+                    rebuilt = lay.codec.decode_chunks(
+                        [local[c] for c in avail_in],
+                        np.stack([have[c] for c in avail_in]),
+                        [local[c] for c in missing])
+                except ErasureCodeError:
+                    continue
+                for i, c in enumerate(sorted(missing,
+                                             key=lambda g: local[g])):
+                    have[c] = rebuilt[i]
+                progress = True
+        try:
+            return np.stack([have[t] for t in targets]) if targets else \
+                np.zeros((0, chunk), dtype=np.uint8)
+        except KeyError as e:
+            raise ErasureCodeError(
+                f"lrc unrecoverable chunk {e} from {sorted(available_ids)}"
+            ) from e
+
+
+def _factory(profile: ErasureCodeProfile):
+    codec = ErasureCodeLrc()
+    codec.init(profile)
+    return codec
+
+
+def register(registry) -> None:
+    registry.add("lrc", _factory)

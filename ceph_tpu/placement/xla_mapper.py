@@ -30,6 +30,7 @@ jax_enable_x64 (all other ceph_tpu kernels pin their dtypes explicitly).
 from __future__ import annotations
 
 import functools
+import os
 from dataclasses import dataclass
 from typing import List, Optional, Sequence, Tuple
 
@@ -76,18 +77,8 @@ class CompiledMap:
     max_devices: int
     max_depth: int
 
-    @functools.cached_property
-    def device_arrays(self):
-        # must be materialized OUTSIDE any jit trace (XlaMapper.__init__
-        # touches this eagerly) or the cached constants leak as tracers.
-        # The ln LUT is stored as the POSITIVE draw numerator in float64:
-        # values < 2^48 are exactly representable, which lets straw2 run
-        # its truncating division in f64 (with an exactness correction)
-        # instead of TPU-emulated int64 — see _straw2_choose.
-        numer = (-lntable.straw2_ln_lut()).astype(np.float64)
-        return (jnp.asarray(self.items), jnp.asarray(self.hash_ids),
-                jnp.asarray(self.weight_sets), jnp.asarray(self.sizes),
-                jnp.asarray(self.types), jnp.asarray(numer))
+    def tables(self, strategy: str) -> "DeviceTables":
+        return DeviceTables(self, strategy)
 
 
 def compile_map(cmap: CrushMap, choose_args_key: object = None,
@@ -170,11 +161,157 @@ def compile_map(cmap: CrushMap, choose_args_key: object = None,
 
 # ------------------------------------------------------------- primitives --
 
+_LN_TABLES = os.path.join(os.path.dirname(__file__), "data",
+                          "crush_ln_tables.npz")
+LN_SHIFT_F = float(lntable.LN_SHIFT)            # 2^48
+_2P24 = 16777216.0
+_2P44 = 17592186044416.0
+
+
+class DeviceTables:
+    """Trace-time table-access layer for the vectorized mapper.
+
+    Two bit-identical lookup strategies, chosen per backend:
+
+      * 'gather' — direct row indexing.  Fast on CPU; on TPU XLA lowers
+        these gathers to serial per-element loops (~0.1 G elem/s measured
+        on v5e), which caps the whole mapper.
+      * 'onehot' — every table row/LUT read becomes a one-hot matmul that
+        rides the MXU.  crush_ln is re-derived EXACTLY from the two small
+        reference tables (__RH_LH_tbl/__LL_tbl, src/crush/crush_ln_table.h)
+        with 8-bit-limb integer arithmetic: one-hot(bf16) @ limb tables →
+        int32 carry chains → f64 combine; verified equal to the 65536-entry
+        LUT for every u.  Weights split into 16-bit halves so f32 one-hot
+        products stay exact.
+    """
+
+    def __init__(self, cm: CompiledMap, strategy: str):
+        self.cm = cm
+        self.strategy = strategy
+        self.B, self.S, self.P = cm.n_buckets, cm.max_size, cm.n_positions
+        self.items = jnp.asarray(cm.items)
+        self.sizes = jnp.asarray(cm.sizes)
+        self.types = jnp.asarray(cm.types)
+        if strategy == "gather":
+            self.hash_ids = jnp.asarray(cm.hash_ids)
+            self.weight_sets = jnp.asarray(cm.weight_sets)
+            self.numer_lut = jnp.asarray(
+                (-lntable.straw2_ln_lut()).astype(np.float64))
+            return
+        if cm.max_devices >= (1 << 24):
+            raise UnsupportedMapError(
+                "onehot strategy requires device ids < 2^24 (f32-exact)")
+        # every value that round-trips through an f32 one-hot matmul must
+        # be f32-exact, including choose_args id overrides and child ids
+        for name, arr in (("hash_ids", cm.hash_ids), ("items", cm.items)):
+            if np.abs(arr.astype(np.int64)).max(initial=0) >= (1 << 24):
+                raise UnsupportedMapError(
+                    f"onehot strategy requires |{name}| < 2^24 (f32-exact)")
+        self.items_f = jnp.asarray(cm.items.astype(np.float32))
+        self.ids_f = jnp.asarray(cm.hash_ids.astype(np.float32))
+        self.ws_hi = jnp.asarray(
+            (cm.weight_sets >> 16).astype(np.float32))          # [B,P,S]
+        self.ws_lo = jnp.asarray(
+            (cm.weight_sets & 0xFFFF).astype(np.float32))
+        self.sizes_f = jnp.asarray(cm.sizes.astype(np.float32))
+        self.types_f = jnp.asarray(cm.types.astype(np.float32))
+        d = np.load(_LN_TABLES)
+        rh_lh = d["rh_lh"].astype(np.int64)
+        ll = d["ll"].astype(np.int64)
+        rh, lh = rh_lh[0:258:2], rh_lh[1:258:2]     # 129 entries each
+
+        def limbs(v, n):
+            return np.stack([(v >> (8 * j)) & 0xFF for j in range(n)], 1)
+
+        # RH needs 7 limbs: RH[0] == 2^48 exactly
+        self.t129 = jnp.asarray(np.concatenate(
+            [limbs(rh, 7), limbs(lh, 6)], 1).astype(jnp.bfloat16))
+        self.t256 = jnp.asarray(limbs(ll, 6).astype(jnp.bfloat16))
+
+    # ---- per-lane accessors (called under vmap; bidx is a scalar) -------
+    def bucket_onehot(self, bidx):
+        return (jnp.arange(self.B) == bidx).astype(jnp.float32)
+
+    def bucket_row(self, bidx, pos):
+        """(items [S] i32, hash_ids [S] u32, weights [S] f64, size i32)."""
+        if self.strategy == "gather":
+            pos_c = jnp.minimum(pos, self.P - 1)
+            return (self.items[bidx],
+                    self.hash_ids[bidx].astype(jnp.uint32),
+                    self.weight_sets[bidx, pos_c].astype(jnp.float64),
+                    self.sizes[bidx])
+        ohb = self.bucket_onehot(bidx)                          # [B]
+        items = (ohb @ self.items_f).astype(jnp.int32)          # [S]
+        ids = (ohb @ self.ids_f).astype(jnp.int32).astype(jnp.uint32)
+        w_hi = jnp.einsum("b,bps->ps", ohb, self.ws_hi)         # [P,S]
+        w_lo = jnp.einsum("b,bps->ps", ohb, self.ws_lo)
+        pos_c = jnp.minimum(pos, self.P - 1)
+        psel = (jnp.arange(self.P) == pos_c).astype(jnp.float64)
+        w = psel @ (w_hi.astype(jnp.float64) * 65536.0 +
+                    w_lo.astype(jnp.float64))                   # [S]
+        size = (ohb @ self.sizes_f).astype(jnp.int32)
+        return items, ids, w, size
+
+    def bucket_type(self, bidx):
+        if self.strategy == "gather":
+            return self.types[jnp.clip(bidx, 0, self.B - 1)]
+        ohb = self.bucket_onehot(jnp.clip(bidx, 0, self.B - 1))
+        return (ohb @ self.types_f).astype(jnp.int32)
+
+    def bucket_size(self, bidx):
+        if self.strategy == "gather":
+            return self.sizes[bidx]
+        return (self.bucket_onehot(bidx) @ self.sizes_f).astype(jnp.int32)
+
+    def item_at(self, items_row, idx):
+        """items_row[idx] without a gather."""
+        if self.strategy == "gather":
+            return items_row[idx]
+        sel = (jnp.arange(self.S) == idx)
+        return jnp.where(sel, items_row, 0).sum(dtype=jnp.int32)
+
+    # ---- exact draw numerator: 2^48 - crush_ln(u) -----------------------
+    def ln_numer(self, u):
+        """u [S] u16 → positive f64 numerator, bit-exact vs the LUT."""
+        if self.strategy == "gather":
+            return self.numer_lut[u.astype(jnp.int32)]
+        x = u.astype(jnp.int32) + 1
+        e = (jax.lax.bitcast_convert_type(
+            x.astype(jnp.float32), jnp.int32) >> 23) - 127
+        bits = jnp.where((x & 0x18000) == 0, 15 - e, 0)
+        xs = x << bits
+        iexpon = 15 - bits
+        k = (xs >> 8) - 128                                    # 0..128
+        oh1 = (k[..., None] == jnp.arange(129, dtype=jnp.int32)
+               ).astype(jnp.bfloat16)
+        L1 = jnp.einsum("...k,kc->...c", oh1, self.t129,
+                        preferred_element_type=jnp.float32).astype(jnp.int32)
+        t = xs * L1[..., 0]
+        for j in range(1, 7):                                  # carry chain
+            t = xs * L1[..., j] + (t >> 8)
+        idx2 = t & 0xFF
+        oh2 = (idx2[..., None] == jnp.arange(256, dtype=jnp.int32)
+               ).astype(jnp.bfloat16)
+        L2 = jnp.einsum("...k,kc->...c", oh2, self.t256,
+                        preferred_element_type=jnp.float32).astype(jnp.int32)
+        c = 0
+        out = []
+        for j in range(6):                                     # LH + LL
+            s = L1[..., 7 + j] + L2[..., j] + c
+            out.append(s & 0xFF)
+            c = s >> 8
+        v_lo = out[0] | (out[1] << 8) | (out[2] << 16)
+        v_hi = out[3] | (out[4] << 8) | (out[5] << 16)
+        v = v_hi.astype(jnp.float64) * _2P24 + v_lo.astype(jnp.float64)
+        result = iexpon.astype(jnp.float64) * _2P44 + jnp.floor(v / 16.0)
+        return LN_SHIFT_F - result
+
+
 def _u32(v):
     return jnp.asarray(v).astype(jnp.uint32)
 
 
-def _straw2_choose(arrs, bidx, x, r, pos):
+def _straw2_choose(dt: DeviceTables, bidx, x, r, pos):
     """One straw2 selection (mapper.c:361-384): returns chosen child id.
 
     The reference draw is trunc_div(crush_ln(u) - 2^48, weight) maximized
@@ -185,22 +322,19 @@ def _straw2_choose(arrs, bidx, x, r, pos):
     quotient — bit-identical to the reference's div64_s64 — without any
     TPU-emulated 64-bit integer ops.
     """
-    items, hash_ids, weight_sets, sizes, types, numer_lut = arrs
-    S = items.shape[1]
-    ids = hash_ids[bidx]                               # [S]
-    pos_c = jnp.minimum(pos, weight_sets.shape[1] - 1)
-    w = weight_sets[bidx, pos_c].astype(jnp.float64)   # [S]
+    S = dt.S
+    items_row, ids, w, size = dt.bucket_row(bidx, pos)
     u = hashing.jx_hash3(
-        jnp.broadcast_to(_u32(x), (S,)), ids.astype(jnp.uint32),
+        jnp.broadcast_to(_u32(x), (S,)), ids,
         jnp.broadcast_to(_u32(r), (S,))) & jnp.uint32(0xFFFF)
-    a = numer_lut[u.astype(jnp.int32)]                 # [S] f64, 0..2^48
+    a = dt.ln_numer(u)                                 # [S] f64, 0..2^48
     q = jnp.floor(a / jnp.maximum(w, 1.0))
     q = q - (q * w > a)                                # exactness corrections
     q = q + ((q + 1.0) * w <= a)
     inf = jnp.float64(jnp.inf)
     q = jnp.where(w > 0, q, inf)
-    q = jnp.where(jnp.arange(S) < sizes[bidx], q, inf)
-    return items[bidx, jnp.argmin(q)]
+    q = jnp.where(jnp.arange(S) < size, q, inf)
+    return dt.item_at(items_row, jnp.argmin(q))
 
 
 def _is_out(weights, item, x):
@@ -218,7 +352,8 @@ def _is_out(weights, item, x):
 _OK, _REJECT, _SKIP = 0, 1, 2
 
 
-def _descend(cm: CompiledMap, arrs, start_bidx, target_type: int, x, r, pos):
+def _descend(cm: CompiledMap, dt: DeviceTables, start_bidx,
+             target_type: int, x, r, pos):
     """Walk from bucket index down to an item of target_type.
 
     Mirrors the inner retry_bucket walk of mapper.c:495-546 for straw2:
@@ -226,18 +361,16 @@ def _descend(cm: CompiledMap, arrs, start_bidx, target_type: int, x, r, pos):
     (empty bucket on the path → costs a retry), or SKIP (escaped the map →
     abandon this replica slot).
     """
-    items, hash_ids, weight_sets, sizes, types, _ = arrs
 
     def body(carry, _):
         cur, done, status, result = carry
-        empty = sizes[cur] == 0
-        item = _straw2_choose(arrs, cur, x, r, pos)
+        empty = dt.bucket_size(cur) == 0
+        item = _straw2_choose(dt, cur, x, r, pos)
         is_dev = item >= 0
         bad_dev = is_dev & (item >= cm.max_devices)
         bidx = jnp.where(is_dev, 0, -1 - item)
         bad_bucket = (~is_dev) & (bidx >= cm.n_buckets)
-        itype = jnp.where(is_dev, 0,
-                          types[jnp.clip(bidx, 0, cm.n_buckets - 1)])
+        itype = jnp.where(is_dev, 0, dt.bucket_type(bidx))
         match = itype == target_type
         # classify this level's outcome (only if not already done)
         lvl_reject = empty
@@ -264,7 +397,7 @@ def _descend(cm: CompiledMap, arrs, start_bidx, target_type: int, x, r, pos):
 
 # --------------------------------------------------------------- firstn ----
 
-def _leaf_firstn(cm, arrs, bucket_item, weights, x, sub_r, recurse_tries,
+def _leaf_firstn(cm, dt, bucket_item, weights, x, sub_r, recurse_tries,
                  stable, out2, outpos, pos):
     """The chooseleaf recursion (mapper.c:564-581 → recursive
     crush_choose_firstn with numrep=1): pick one device inside
@@ -280,7 +413,7 @@ def _leaf_firstn(cm, arrs, bucket_item, weights, x, sub_r, recurse_tries,
     def body(s):
         ftotal, done, ok, dev = s
         r = rep_base + sub_r + ftotal
-        item, status = _descend(cm, arrs, -1 - bucket_item, 0, x, r, pos)
+        item, status = _descend(cm, dt, -1 - bucket_item, 0, x, r, pos)
         collide = jnp.any((jnp.arange(R) < outpos) & (out2 == item))
         out_dev = jnp.where(status == _OK, _is_out(weights, item, x), False)
         success = (status == _OK) & (~collide) & (~out_dev)
@@ -294,7 +427,7 @@ def _leaf_firstn(cm, arrs, bucket_item, weights, x, sub_r, recurse_tries,
     return dev, ok
 
 
-def _choose_firstn(cm, arrs, root_item, target_type: int, numrep: int,
+def _choose_firstn(cm, dt, root_item, target_type: int, numrep: int,
                    recurse_to_leaf: bool, tries: int, recurse_tries: int,
                    vary_r: int, stable: bool, weights, x, count_limit):
     """crush_choose_firstn (mapper.c:460-648) for one x, modern tunables.
@@ -316,7 +449,7 @@ def _choose_firstn(cm, arrs, root_item, target_type: int, numrep: int,
             ftotal, placed, skipped, item_prev, leaf_prev = s
             r = rep + ftotal  # parent_r == 0 at rule level
             item, status = _descend(
-                cm, arrs, -1 - root_item, target_type, x, r, outpos)
+                cm, dt, -1 - root_item, target_type, x, r, outpos)
             collide = jnp.any((jnp.arange(R) < outpos) & (out == item))
             reject = status == _REJECT
             skip = status == _SKIP
@@ -325,7 +458,7 @@ def _choose_firstn(cm, arrs, root_item, target_type: int, numrep: int,
                 sub_r = (r >> (vary_r - 1)) if vary_r else jnp.int32(0)
                 is_bucket = item < 0
                 leaf_dev, leaf_ok = _leaf_firstn(
-                    cm, arrs, jnp.where(is_bucket, item, -1), weights, x,
+                    cm, dt, jnp.where(is_bucket, item, -1), weights, x,
                     sub_r, recurse_tries, stable, out2, outpos, outpos)
                 # device-typed direct hit keeps itself as leaf
                 leaf = jnp.where(is_bucket, leaf_dev, item)
@@ -356,7 +489,7 @@ def _choose_firstn(cm, arrs, root_item, target_type: int, numrep: int,
 
 # ---------------------------------------------------------------- indep ----
 
-def _leaf_indep(cm, arrs, bucket_item, weights, x, parent_r, rep,
+def _leaf_indep(cm, dt, bucket_item, weights, x, parent_r, rep,
                 numrep: int, recurse_tries: int, pos):
     """Leaf recursion of crush_choose_indep (mapper.c:777-792): one device
     in the subtree, positionally stable; no collision window (the recursion
@@ -368,7 +501,7 @@ def _leaf_indep(cm, arrs, bucket_item, weights, x, parent_r, rep,
     def body(s):
         ftotal, done, dev = s
         r = rep + parent_r + numrep * ftotal
-        item, status = _descend(cm, arrs, -1 - bucket_item, 0, x, r, pos)
+        item, status = _descend(cm, dt, -1 - bucket_item, 0, x, r, pos)
         out_dev = jnp.where(status == _OK, _is_out(weights, item, x), False)
         success = (status == _OK) & (~out_dev)
         hard_fail = status == _SKIP
@@ -380,7 +513,7 @@ def _leaf_indep(cm, arrs, bucket_item, weights, x, parent_r, rep,
     return dev
 
 
-def _choose_indep(cm, arrs, root_item, target_type: int, numrep: int,
+def _choose_indep(cm, dt, root_item, target_type: int, numrep: int,
                   recurse_to_leaf: bool, tries: int, recurse_tries: int,
                   weights, x, out_size_limit):
     """crush_choose_indep (mapper.c:655-843) for one x: breadth-first,
@@ -398,14 +531,14 @@ def _choose_indep(cm, arrs, root_item, target_type: int, numrep: int,
             pending = active[rep] & (out[rep] == UNDEF)
             r = rep + numrep * ftotal
             item, status = _descend(
-                cm, arrs, -1 - root_item, target_type, x, r, rep)
+                cm, dt, -1 - root_item, target_type, x, r, rep)
             collide = jnp.any(out == item)
             hard = status == _SKIP
             leaf = NONE
             if recurse_to_leaf:
                 is_bucket = item < 0
                 leaf_dev = _leaf_indep(
-                    cm, arrs, jnp.where(is_bucket, item, -1), weights, x,
+                    cm, dt, jnp.where(is_bucket, item, -1), weights, x,
                     r, rep, numrep, recurse_tries, rep)
                 leaf = jnp.where(is_bucket, leaf_dev, item)
                 leaf_fail = is_bucket & (leaf_dev == NONE)
@@ -453,10 +586,30 @@ class XlaMapper:
     """
 
     def __init__(self, cmap: CrushMap, choose_args_key: object = None,
-                 n_positions: int = 8):
+                 n_positions: int = 8, strategy: Optional[str] = None):
         self.cmap = cmap
         self.compiled = compile_map(cmap, choose_args_key, n_positions)
-        self.compiled.device_arrays  # materialize outside any jit trace
+        auto = False
+        if strategy is None:
+            strategy = os.environ.get("CEPH_TPU_LOOKUP")
+        if strategy is None:
+            # one-hot matmul lookups on real accelerators; row gathers on
+            # CPU where XLA lowers them efficiently
+            auto = True
+            platform = jax.devices()[0].platform
+            strategy = "gather" if platform == "cpu" else "onehot"
+        if strategy not in ("gather", "onehot"):
+            raise ValueError(
+                f"lookup strategy must be gather|onehot, got {strategy!r}")
+        # tables materialized OUTSIDE any jit trace (constants created
+        # inside a trace leak as tracers through the cache)
+        try:
+            self.tables = self.compiled.tables(strategy)
+        except UnsupportedMapError:
+            if not auto:
+                raise
+            # auto-selected onehot but ids exceed f32-exact range
+            self.tables = self.compiled.tables("gather")
         self._jitted = {}
 
     # -- trace-time rule interpretation (steps are static data) ------------
@@ -464,7 +617,7 @@ class XlaMapper:
         cmap, cm = self.cmap, self.compiled
         rule = cmap.rules[ruleno]
         t = cmap.tunables
-        arrs = cm.device_arrays
+        dt = self.tables
 
         choose_tries = t.choose_total_tries + 1
         choose_leaf_tries = 0
@@ -536,13 +689,13 @@ class XlaMapper:
                             live = live & is_bucket
                             if firstn:
                                 o, o2, got = _choose_firstn(
-                                    cm, arrs, root, arg2, numrep, leaf,
+                                    cm, dt, root, arg2, numrep, leaf,
                                     choose_tries, recurse_tries, vary_r,
                                     stable, weights, x,
                                     count_limit=result_max - osize)
                             else:
                                 o, o2 = _choose_indep(
-                                    cm, arrs, root, arg2, numrep, leaf,
+                                    cm, dt, root, arg2, numrep, leaf,
                                     choose_tries, recurse_tries, weights, x,
                                     out_size_limit=jnp.minimum(
                                         numrep, result_max - osize))
@@ -589,6 +742,11 @@ class XlaMapper:
                     fn, in_shardings=(batch, repl), out_shardings=batch)
         return self._jitted[key]
 
+    # one-hot intermediates are ~S*385 bytes per lane-level; cap the lanes
+    # per device dispatch so working set stays well inside HBM (the full
+    # sweep streams chunks through one compiled executable)
+    MAX_LANES_PER_CALL = 1 << 17
+
     def map_batch(self, ruleno: int, xs, result_max: int,
                   weights: Sequence[int], mesh=None) -> np.ndarray:
         """[N] x values -> [N, result_max] i32 osd ids (ITEM_NONE padded).
@@ -606,6 +764,16 @@ class XlaMapper:
         xs_np = np.asarray(xs, dtype=np.int64).astype(np.uint32) \
             .astype(np.int32)
         n = len(xs_np)
+        cap = self.MAX_LANES_PER_CALL * (mesh.size if mesh is not None else 1)
+        if n > cap:
+            # pad to a multiple of cap so every chunk reuses one executable
+            pad = (-n) % cap
+            xs_pad = np.concatenate([xs_np, xs_np[:1].repeat(pad)]) \
+                if pad else xs_np
+            parts = [self.map_batch(ruleno, xs_pad[i:i + cap], result_max,
+                                    weights, mesh)
+                     for i in range(0, len(xs_pad), cap)]
+            return np.concatenate(parts)[:n]
         if mesh is not None:
             pad = (-n) % mesh.size
             if pad:

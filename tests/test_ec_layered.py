@@ -1,0 +1,180 @@
+"""LRC and SHEC layered-codec tests (models TestErasureCodeLrc.cc /
+TestErasureCodeShec*.cc: roundtrips, local-repair read amplification,
+profile validation)."""
+import itertools
+
+import numpy as np
+import pytest
+
+from ceph_tpu import ec
+from ceph_tpu.ec.interface import ErasureCodeError
+
+
+def _codec(plugin, **profile):
+    return ec.instance().factory(
+        plugin, {k: str(v) for k, v in profile.items()})
+
+
+# ----------------------------------------------------------------- LRC ----
+
+def test_lrc_kml_roundtrip_all_single_and_double():
+    codec = _codec("lrc", k=4, m=2, l=3)
+    n = codec.get_chunk_count()
+    assert codec.get_data_chunk_count() == 4
+    rng = np.random.default_rng(0)
+    data = rng.integers(0, 256, size=(4, 256)).astype(np.uint8)
+    parity = codec.encode_chunks(data)
+    full = np.concatenate([data, parity])
+    for nerase in (1, 2):
+        for lost in itertools.combinations(range(n), nerase):
+            avail = [i for i in range(n) if i not in lost]
+            try:
+                rebuilt = codec.decode_chunks(avail, full[avail], list(lost))
+            except ErasureCodeError:
+                continue  # some double losses exceed lrc capability
+            assert np.array_equal(rebuilt, full[list(lost)]), lost
+
+
+def test_lrc_local_repair_reads_fewer_chunks():
+    """The selling point: single failure repairs within its local group."""
+    codec = _codec("lrc", k=4, m=2, l=3)
+    n = codec.get_chunk_count()
+    avail = set(range(n))
+    plan_full = codec.minimum_to_decode({0, 1, 2, 3}, avail)
+    assert set(plan_full) == {0, 1, 2, 3}
+    # lose one data chunk: local layer (l chunks) beats reading k chunks
+    plan = codec.minimum_to_decode({0}, avail - {0})
+    assert len(plan) <= 3            # l = 3 -> read l-1 data + local parity
+    assert 0 not in plan
+
+
+def test_lrc_explicit_mapping_layers():
+    import json
+    layers = json.dumps([["_cDD_cDD", ""], ["cDDD____", ""],
+                         ["____cDDD", ""]])
+    codec = _codec("lrc", mapping="__DD__DD", layers=layers)
+    assert codec.get_data_chunk_count() == 4
+    assert codec.get_chunk_count() == 8
+    rng = np.random.default_rng(1)
+    data = rng.integers(0, 256, size=(4, 128)).astype(np.uint8)
+    parity = codec.encode_chunks(data)
+    full = np.concatenate([data, parity])
+    for lost in range(8):
+        avail = [i for i in range(8) if i != lost]
+        rebuilt = codec.decode_chunks(avail, full[avail], [lost])
+        assert np.array_equal(rebuilt[0], full[lost]), lost
+
+
+def test_lrc_profile_validation():
+    with pytest.raises(ErasureCodeError):
+        _codec("lrc", k=4, m=2, l=5)       # k+m not multiple of l
+    with pytest.raises(ErasureCodeError):
+        _codec("lrc", mapping="DD", layers="not json")
+    with pytest.raises(ErasureCodeError):
+        _codec("lrc", mapping="DD", layers="[]")
+    with pytest.raises(ErasureCodeError):
+        # layer map length mismatch
+        _codec("lrc", mapping="DDDD", layers='[["Dc", ""]]')
+
+
+# ---------------------------------------------------------------- SHEC ----
+
+@pytest.mark.parametrize("profile", [
+    dict(k=4, m=3, c=2),
+    dict(k=6, m=3, c=2),
+    dict(k=4, m=3, c=2, technique="single"),
+    dict(k=8, m=4, c=3),
+])
+def test_shec_roundtrip_recoverable_patterns(profile):
+    codec = _codec("shec", **profile)
+    k, m = codec.k, codec.m
+    rng = np.random.default_rng(2)
+    data = rng.integers(0, 256, size=(k, 128)).astype(np.uint8)
+    parity = codec.encode_chunks(data)
+    full = np.concatenate([data, parity])
+    c = profile["c"]
+    # any c-chunk loss must be recoverable (the durability guarantee)
+    for lost in itertools.combinations(range(k + m), c):
+        avail = [i for i in range(k + m) if i not in lost]
+        rebuilt = codec.decode_chunks(avail, full[avail], list(lost))
+        assert np.array_equal(rebuilt, full[list(lost)]), lost
+
+
+def test_shec_local_repair_width():
+    """Single failure reads fewer than k chunks (the shec selling point)."""
+    codec = _codec("shec", k=6, m=3, c=2)
+    n = codec.get_chunk_count()
+    plan = codec.minimum_to_decode({0}, set(range(n)) - {0})
+    assert len(plan) < 6
+
+
+def test_shec_parity_is_shingled():
+    codec = _codec("shec", k=6, m=3, c=2)
+    P = np.asarray(codec.parity)
+    # at least one local (windowed) parity row; every column covered
+    assert any((P[j] == 0).any() for j in range(3))
+    assert all((P[:, i] != 0).any() for i in range(6))
+    # the 'single' technique windows every row
+    Ps = np.asarray(_codec("shec", k=6, m=3, c=2,
+                           technique="single").parity)
+    assert all((Ps[j] == 0).any() for j in range(3))
+
+
+def test_shec_bounds():
+    for bad in [dict(k=13, m=3, c=2), dict(k=12, m=12, c=2),
+                dict(k=4, m=5, c=2), dict(k=4, m=3, c=4),
+                dict(k=4, m=3, c=2, technique="nope")]:
+        with pytest.raises(ErasureCodeError):
+            _codec("shec", **bad)
+
+
+def test_registry_lists_layered_plugins():
+    names = ec.instance().names()
+    assert "lrc" in names and "shec" in names
+
+
+def test_shec_decode_from_its_own_plan():
+    """decode_chunks must work from exactly the chunks minimum_to_decode
+    asked for (regression: local window < k rows)."""
+    codec = _codec("shec", k=6, m=3, c=2)
+    n = codec.get_chunk_count()
+    rng = np.random.default_rng(7)
+    data = rng.integers(0, 256, size=(6, 64)).astype(np.uint8)
+    full = np.concatenate([data, codec.encode_chunks(data)])
+    for lost in range(n):
+        plan = codec.minimum_to_decode({lost}, set(range(n)) - {lost})
+        avail = sorted(plan)
+        rebuilt = codec.decode_chunks(avail, full[avail], [lost])
+        assert np.array_equal(rebuilt[0], full[lost]), lost
+
+
+def test_lrc_plan_includes_wanted_available():
+    """Wanted chunks that are available must appear in the plan
+    (regression: plan {2,6,7} omitted available chunk 0)."""
+    codec = _codec("lrc", k=4, m=2, l=3)
+    n = codec.get_chunk_count()
+    plan = codec.minimum_to_decode({0, 3}, set(range(n)) - {3})
+    assert 0 in plan
+    avail = sorted(plan)
+    rng = np.random.default_rng(8)
+    data = rng.integers(0, 256, size=(4, 64)).astype(np.uint8)
+    full = np.concatenate([data, codec.encode_chunks(data)])
+    out = codec.decode({0, 3}, {c: full[c] for c in avail}, 64)
+    assert np.array_equal(out[0], full[0])
+    assert np.array_equal(out[3], full[3])
+
+
+def test_lrc_multi_group_erasures_accumulate_layers():
+    """One erasure per local group: the plan should combine the two local
+    layers, not fall back to reading everything."""
+    codec = _codec("lrc", k=4, m=2, l=3)
+    n = codec.get_chunk_count()
+    # find two data chunks in different local groups
+    lost = {0, 2}
+    plan = codec.minimum_to_decode(lost, set(range(n)) - lost)
+    avail = sorted(plan)
+    rng = np.random.default_rng(9)
+    data = rng.integers(0, 256, size=(4, 64)).astype(np.uint8)
+    full = np.concatenate([data, codec.encode_chunks(data)])
+    rebuilt = codec.decode_chunks(avail, full[avail], sorted(lost))
+    assert np.array_equal(rebuilt, full[sorted(lost)])
