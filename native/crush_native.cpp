@@ -1,0 +1,586 @@
+// ceph_tpu native runtime — C++ CRUSH mapper.
+//
+// A from-scratch C++17 implementation of the CRUSH placement semantics
+// (reference behavior: src/crush/mapper.c — rule machine, five bucket
+// algorithms, collision/out/retry handling), exposed through a flat-array
+// C ABI so the Python control plane drives it via ctypes.  This is the
+// fast host-side mapper: the per-x scalar oracle for the XLA batch path
+// and the low-latency fallback for maps outside the vectorized subset.
+//
+// The map is passed as dense arrays (the same CompiledMap layout the XLA
+// path uses) plus per-bucket auxiliary tables for the legacy algorithms.
+// Everything is reentrant: all mutable state lives in a caller-owned
+// workspace.
+
+#include <cstdint>
+#include <cstring>
+#include <vector>
+
+namespace {
+
+constexpr uint32_t kHashSeed = 1315423911u;
+constexpr int32_t kItemUndef = 0x7FFFFFFE;
+constexpr int32_t kItemNone = 0x7FFFFFFF;
+constexpr int64_t kS64Min = INT64_MIN;
+
+// bucket algorithms
+enum Alg { UNIFORM = 1, LIST = 2, TREE = 3, STRAW = 4, STRAW2 = 5 };
+// rule opcodes
+enum Op {
+  TAKE = 1, CHOOSE_FIRSTN = 2, CHOOSE_INDEP = 3, EMIT = 4,
+  CHOOSELEAF_FIRSTN = 6, CHOOSELEAF_INDEP = 7,
+  SET_CHOOSE_TRIES = 8, SET_CHOOSELEAF_TRIES = 9,
+  SET_CHOOSE_LOCAL_TRIES = 10, SET_CHOOSE_LOCAL_FALLBACK_TRIES = 11,
+  SET_CHOOSELEAF_VARY_R = 12, SET_CHOOSELEAF_STABLE = 13,
+};
+
+#define MIX(a, b, c)                      \
+  do {                                    \
+    a -= b; a -= c; a ^= (c >> 13);       \
+    b -= c; b -= a; b ^= (a << 8);        \
+    c -= a; c -= b; c ^= (b >> 13);       \
+    a -= b; a -= c; a ^= (c >> 12);       \
+    b -= c; b -= a; b ^= (a << 16);       \
+    c -= a; c -= b; c ^= (b >> 5);        \
+    a -= b; a -= c; a ^= (c >> 3);        \
+    b -= c; b -= a; b ^= (a << 10);       \
+    c -= a; c -= b; c ^= (b >> 15);       \
+  } while (0)
+
+uint32_t hash2(uint32_t a, uint32_t b) {
+  uint32_t hash = kHashSeed ^ a ^ b;
+  uint32_t x = 231232u, y = 1232u;
+  MIX(a, b, hash);
+  MIX(x, a, hash);
+  MIX(b, y, hash);
+  return hash;
+}
+
+uint32_t hash3(uint32_t a, uint32_t b, uint32_t c) {
+  uint32_t hash = kHashSeed ^ a ^ b ^ c;
+  uint32_t x = 231232u, y = 1232u;
+  MIX(a, b, hash);
+  MIX(c, x, hash);
+  MIX(y, a, hash);
+  MIX(b, x, hash);
+  MIX(y, c, hash);
+  return hash;
+}
+
+uint32_t hash4(uint32_t a, uint32_t b, uint32_t c, uint32_t d) {
+  uint32_t hash = kHashSeed ^ a ^ b ^ c ^ d;
+  uint32_t x = 231232u, y = 1232u;
+  MIX(a, b, hash);
+  MIX(c, d, hash);
+  MIX(a, x, hash);
+  MIX(y, b, hash);
+  MIX(c, x, hash);
+  MIX(y, d, hash);
+  return hash;
+}
+
+struct MapView {
+  int32_t n_buckets = 0;
+  int32_t max_size = 0;
+  const int32_t* items = nullptr;        // [B, S]
+  const int32_t* weights = nullptr;      // [B, S] straw2/list weights
+  const int32_t* sizes = nullptr;        // [B]
+  const int32_t* types = nullptr;        // [B]
+  const int32_t* algs = nullptr;         // [B]
+  // legacy-algorithm aux tables (same padding; may be null if unused)
+  const int32_t* sum_weights = nullptr;  // [B, S] LIST prefix sums
+  const int32_t* straws = nullptr;       // [B, S] STRAW scalers
+  const int32_t* node_weights = nullptr; // [B, 2S] TREE interior weights
+  const int32_t* num_nodes = nullptr;    // [B]
+  const int64_t* ln_table = nullptr;     // [65536]
+  int32_t max_devices = 0;
+  // tunables
+  int32_t choose_local_tries = 0;
+  int32_t choose_local_fallback_tries = 0;
+  int32_t choose_total_tries = 50;
+  int32_t chooseleaf_descend_once = 1;
+  int32_t chooseleaf_vary_r = 1;
+  int32_t chooseleaf_stable = 1;
+};
+
+// per-bucket lazily built permutation (UNIFORM buckets)
+struct PermState {
+  uint32_t perm_x = 0;
+  uint32_t perm_n = 0;
+  std::vector<int32_t> perm;
+};
+
+struct Workspace {
+  std::vector<PermState> perm;  // one per bucket index
+  explicit Workspace(const MapView& m) : perm(m.n_buckets) {
+    for (int32_t i = 0; i < m.n_buckets; ++i)
+      perm[i].perm.assign(m.sizes[i], 0);
+  }
+};
+
+struct Row {
+  const MapView& m;
+  int32_t b;  // bucket index
+  int32_t id() const { return -1 - b; }
+  int32_t size() const { return m.sizes[b]; }
+  int32_t alg() const { return m.algs[b]; }
+  int32_t type() const { return m.types[b]; }
+  int32_t item(int32_t i) const { return m.items[b * m.max_size + i]; }
+  int32_t weight(int32_t i) const { return m.weights[b * m.max_size + i]; }
+};
+
+int32_t perm_choose(const Row& bk, PermState& w, uint32_t x, uint32_t r) {
+  uint32_t pr = r % bk.size();
+  if (w.perm_x != x || w.perm_n == 0) {
+    w.perm_x = x;
+    if (pr == 0) {
+      int32_t s = hash3(x, (uint32_t)bk.id(), 0) % bk.size();
+      w.perm[0] = s;
+      w.perm_n = 0xFFFF;  // marker: only slot 0 valid
+      return bk.item(s);
+    }
+    for (int32_t i = 0; i < bk.size(); ++i) w.perm[i] = i;
+    w.perm_n = 0;
+  } else if (w.perm_n == 0xFFFF) {
+    for (int32_t i = 1; i < bk.size(); ++i) w.perm[i] = i;
+    w.perm[w.perm[0]] = 0;
+    w.perm_n = 1;
+  }
+  while (w.perm_n <= pr) {
+    uint32_t p = w.perm_n;
+    if ((int32_t)p < bk.size() - 1) {
+      uint32_t i = hash3(x, (uint32_t)bk.id(), p) % (bk.size() - p);
+      if (i) std::swap(w.perm[p + i], w.perm[p]);
+    }
+    w.perm_n++;
+  }
+  return bk.item(w.perm[pr]);
+}
+
+int32_t list_choose(const Row& bk, uint32_t x, uint32_t r) {
+  const int32_t* sums = bk.m.sum_weights + bk.b * bk.m.max_size;
+  for (int32_t i = bk.size() - 1; i >= 0; --i) {
+    uint64_t w = hash4(x, (uint32_t)bk.item(i), r, (uint32_t)bk.id());
+    w &= 0xFFFF;
+    w = (w * (uint64_t)sums[i]) >> 16;
+    if ((int64_t)w < (int64_t)bk.weight(i)) return bk.item(i);
+  }
+  return bk.item(0);
+}
+
+int32_t tree_choose(const Row& bk, uint32_t x, uint32_t r) {
+  const int32_t* nw = bk.m.node_weights + bk.b * 2 * bk.m.max_size;
+  int32_t n = bk.m.num_nodes[bk.b] >> 1;
+  while (!(n & 1)) {
+    uint64_t t =
+        ((uint64_t)hash4(x, (uint32_t)n, r, (uint32_t)bk.id()) *
+         (uint64_t)nw[n]) >> 32;
+    int32_t h = 0, tn = n;
+    while ((tn & 1) == 0) { h++; tn >>= 1; }
+    int32_t left = n - (1 << (h - 1));
+    n = ((int64_t)t < (int64_t)nw[left]) ? left : (n + (1 << (h - 1)));
+  }
+  return bk.item(n >> 1);
+}
+
+int32_t straw_choose(const Row& bk, uint32_t x, uint32_t r) {
+  const int32_t* straws = bk.m.straws + bk.b * bk.m.max_size;
+  int32_t high = 0;
+  uint64_t high_draw = 0;
+  for (int32_t i = 0; i < bk.size(); ++i) {
+    uint64_t draw = (hash3(x, (uint32_t)bk.item(i), r) & 0xFFFF) *
+                    (uint64_t)straws[i];
+    if (i == 0 || draw > high_draw) { high = i; high_draw = draw; }
+  }
+  return bk.item(high);
+}
+
+int32_t straw2_choose(const Row& bk, uint32_t x, uint32_t r,
+                      const int32_t* arg_ids, const int32_t* arg_weights) {
+  int32_t high = 0;
+  int64_t high_draw = 0;
+  for (int32_t i = 0; i < bk.size(); ++i) {
+    int32_t w = arg_weights ? arg_weights[i] : bk.weight(i);
+    int32_t id = arg_ids ? arg_ids[i] : bk.item(i);
+    int64_t draw;
+    if (w) {
+      uint32_t u = hash3(x, (uint32_t)id, r) & 0xFFFF;
+      int64_t ln = bk.m.ln_table[u] - 0x1000000000000LL;
+      // ln <= 0, w > 0: truncating division toward zero
+      draw = -((-ln) / w);
+    } else {
+      draw = kS64Min;
+    }
+    if (i == 0 || draw > high_draw) { high = i; high_draw = draw; }
+  }
+  return bk.item(high);
+}
+
+struct ChooseArgs {
+  // optional per-bucket overrides, flattened [B, P, S] / [B, S]
+  const int32_t* weight_sets = nullptr;
+  const int32_t* ids = nullptr;
+  int32_t n_positions = 0;
+};
+
+int32_t bucket_choose(const Row& bk, Workspace& ws, uint32_t x, uint32_t r,
+                      const ChooseArgs* args, int32_t position) {
+  switch (bk.alg()) {
+    case UNIFORM: return perm_choose(bk, ws.perm[bk.b], x, r);
+    case LIST: return list_choose(bk, x, r);
+    case TREE: return tree_choose(bk, x, r);
+    case STRAW: return straw_choose(bk, x, r);
+    case STRAW2: {
+      const int32_t* aw = nullptr;
+      const int32_t* ai = nullptr;
+      if (args && args->weight_sets) {
+        int32_t p = position < args->n_positions ? position
+                                                 : args->n_positions - 1;
+        aw = args->weight_sets +
+             ((int64_t)bk.b * args->n_positions + p) * bk.m.max_size;
+      }
+      if (args && args->ids) ai = args->ids + (int64_t)bk.b * bk.m.max_size;
+      return straw2_choose(bk, x, r, ai, aw);
+    }
+  }
+  return bk.item(0);
+}
+
+bool is_out(const MapView& m, const int32_t* weight, int32_t item,
+            uint32_t x) {
+  if (item >= m.max_devices) return true;
+  int32_t w = weight[item];
+  if (w >= 0x10000) return false;
+  if (w == 0) return true;
+  return (hash2(x, (uint32_t)item) & 0xFFFF) >= (uint32_t)w;
+}
+
+struct RuleCtx {
+  const MapView& m;
+  Workspace& ws;
+  const int32_t* weight;
+  const ChooseArgs* args;
+  uint32_t x;
+};
+
+int choose_firstn(RuleCtx& c, Row bucket, int32_t numrep, int32_t type,
+                  int32_t* out, int32_t outpos, int32_t out_size,
+                  int32_t tries, int32_t recurse_tries,
+                  int32_t local_retries, int32_t local_fallback_retries,
+                  bool recurse_to_leaf, int32_t vary_r, int32_t stable,
+                  int32_t* out2, int32_t parent_r) {
+  int32_t count = out_size;
+  for (int32_t rep = stable ? 0 : outpos; rep < numrep && count > 0;
+       ++rep) {
+    int32_t ftotal = 0;
+    bool skip_rep = false;
+    int32_t item = 0;
+    bool retry_descent = true;
+    while (retry_descent) {
+      retry_descent = false;
+      Row in = bucket;
+      int32_t flocal = 0;
+      bool retry_bucket = true;
+      while (retry_bucket) {
+        retry_bucket = false;
+        bool collide = false, reject = false;
+        uint32_t r = rep + parent_r + ftotal;
+        if (in.size() == 0) {
+          reject = true;
+        } else {
+          if (local_fallback_retries > 0 &&
+              flocal >= (in.size() >> 1) &&
+              flocal > local_fallback_retries) {
+            item = perm_choose(in, c.ws.perm[in.b], c.x, r);
+          } else {
+            item = bucket_choose(in, c.ws, c.x, r, c.args, outpos);
+          }
+          if (item >= c.m.max_devices) { skip_rep = true; break; }
+          int32_t itemtype = item < 0 ? c.m.types[-1 - item] : 0;
+          if (itemtype != type) {
+            if (item >= 0 || (-1 - item) >= c.m.n_buckets) {
+              skip_rep = true;
+              break;
+            }
+            in = Row{c.m, -1 - item};
+            retry_bucket = true;
+            continue;
+          }
+          for (int32_t i = 0; i < outpos; ++i)
+            if (out[i] == item) { collide = true; break; }
+          if (!collide && recurse_to_leaf) {
+            if (item < 0) {
+              int32_t sub_r = vary_r ? (int32_t)(r >> (vary_r - 1)) : 0;
+              if (choose_firstn(c, Row{c.m, -1 - item},
+                                stable ? 1 : outpos + 1, 0, out2, outpos,
+                                count, recurse_tries, 0, local_retries,
+                                local_fallback_retries, false, vary_r,
+                                stable, nullptr, sub_r) <= outpos)
+                reject = true;
+            } else {
+              out2[outpos] = item;
+            }
+          }
+          if (!reject && !collide && type == 0)
+            reject = is_out(c.m, c.weight, item, c.x);
+        }
+        if (reject || collide) {
+          ftotal++;
+          flocal++;
+          if (collide && flocal <= local_retries) {
+            retry_bucket = true;
+          } else if (local_fallback_retries > 0 &&
+                     flocal <= in.size() + local_fallback_retries) {
+            retry_bucket = true;
+          } else if (ftotal < tries) {
+            retry_descent = true;
+          } else {
+            skip_rep = true;
+          }
+        }
+      }
+      if (skip_rep) break;
+    }
+    if (!skip_rep) {
+      out[outpos] = item;
+      outpos++;
+      count--;
+    }
+  }
+  return outpos;
+}
+
+void choose_indep(RuleCtx& c, Row bucket, int32_t left, int32_t numrep,
+                  int32_t type, int32_t* out, int32_t outpos,
+                  int32_t tries, int32_t recurse_tries,
+                  bool recurse_to_leaf, int32_t* out2, int32_t parent_r) {
+  const int32_t endpos = outpos + left;
+  for (int32_t rep = outpos; rep < endpos; ++rep) {
+    out[rep] = kItemUndef;
+    if (out2) out2[rep] = kItemUndef;
+  }
+  for (int32_t ftotal = 0; left > 0 && ftotal < tries; ++ftotal) {
+    for (int32_t rep = outpos; rep < endpos; ++rep) {
+      if (out[rep] != kItemUndef) continue;
+      Row in = bucket;
+      for (;;) {
+        uint32_t r = rep + parent_r;
+        if (in.alg() == UNIFORM && in.size() % numrep == 0)
+          r += (numrep + 1) * ftotal;
+        else
+          r += numrep * ftotal;
+        if (in.size() == 0) break;
+        int32_t item = bucket_choose(in, c.ws, c.x, r, c.args, outpos);
+        if (item >= c.m.max_devices) {
+          out[rep] = kItemNone;
+          if (out2) out2[rep] = kItemNone;
+          left--;
+          break;
+        }
+        int32_t itemtype = item < 0 ? c.m.types[-1 - item] : 0;
+        if (itemtype != type) {
+          if (item >= 0 || (-1 - item) >= c.m.n_buckets) {
+            out[rep] = kItemNone;
+            if (out2) out2[rep] = kItemNone;
+            left--;
+            break;
+          }
+          in = Row{c.m, -1 - item};
+          continue;
+        }
+        bool collide = false;
+        for (int32_t i = outpos; i < endpos; ++i)
+          if (out[i] == item) { collide = true; break; }
+        if (collide) break;
+        if (recurse_to_leaf) {
+          if (item < 0) {
+            choose_indep(c, Row{c.m, -1 - item}, 1, numrep, 0, out2, rep,
+                         recurse_tries, 0, false, nullptr, r);
+            if (out2 && out2[rep] == kItemNone) break;
+          } else if (out2) {
+            out2[rep] = item;
+          }
+        }
+        if (itemtype == 0 && is_out(c.m, c.weight, item, c.x)) break;
+        out[rep] = item;
+        left--;
+        break;
+      }
+    }
+  }
+  for (int32_t rep = outpos; rep < endpos; ++rep) {
+    if (out[rep] == kItemUndef) out[rep] = kItemNone;
+    if (out2 && out2[rep] == kItemUndef) out2[rep] = kItemNone;
+  }
+}
+
+int do_rule(const MapView& m, Workspace& ws, const int32_t* steps,
+            int32_t n_steps, uint32_t x, int32_t result_max,
+            const int32_t* weight, const ChooseArgs* args,
+            int32_t* result) {
+  std::vector<int32_t> w(result_max + 1), o(result_max + 1),
+      co(result_max + 1);
+  int32_t wsize = 0;
+  int32_t result_len = 0;
+
+  int32_t choose_tries = m.choose_total_tries + 1;
+  int32_t choose_leaf_tries = 0;
+  int32_t local_retries = m.choose_local_tries;
+  int32_t local_fallback = m.choose_local_fallback_tries;
+  int32_t vary_r = m.chooseleaf_vary_r;
+  int32_t stable = m.chooseleaf_stable;
+
+  RuleCtx ctx{m, ws, weight, args, x};
+
+  for (int32_t s = 0; s < n_steps; ++s) {
+    const int32_t op = steps[s * 3], arg1 = steps[s * 3 + 1],
+                  arg2 = steps[s * 3 + 2];
+    bool firstn = false;
+    switch (op) {
+      case TAKE:
+        if ((arg1 >= 0 && arg1 < m.max_devices) ||
+            (-1 - arg1 >= 0 && -1 - arg1 < m.n_buckets)) {
+          w[0] = arg1;
+          wsize = 1;
+        }
+        break;
+      case SET_CHOOSE_TRIES:
+        if (arg1 > 0) choose_tries = arg1;
+        break;
+      case SET_CHOOSELEAF_TRIES:
+        if (arg1 > 0) choose_leaf_tries = arg1;
+        break;
+      case SET_CHOOSE_LOCAL_TRIES:
+        if (arg1 >= 0) local_retries = arg1;
+        break;
+      case SET_CHOOSE_LOCAL_FALLBACK_TRIES:
+        if (arg1 >= 0) local_fallback = arg1;
+        break;
+      case SET_CHOOSELEAF_VARY_R:
+        if (arg1 >= 0) vary_r = arg1;
+        break;
+      case SET_CHOOSELEAF_STABLE:
+        if (arg1 >= 0) stable = arg1;
+        break;
+      case CHOOSE_FIRSTN:
+      case CHOOSELEAF_FIRSTN:
+      case CHOOSE_INDEP:
+      case CHOOSELEAF_INDEP: {
+        if (wsize == 0) break;
+        firstn = (op == CHOOSE_FIRSTN || op == CHOOSELEAF_FIRSTN);
+        const bool leaf =
+            (op == CHOOSELEAF_FIRSTN || op == CHOOSELEAF_INDEP);
+        int32_t osize = 0;
+        for (int32_t i = 0; i < wsize; ++i) {
+          int32_t numrep = arg1;
+          if (numrep <= 0) {
+            numrep += result_max;
+            if (numrep <= 0) continue;
+          }
+          int32_t bno = -1 - w[i];
+          if (bno < 0 || bno >= m.n_buckets) continue;
+          Row bucket{m, bno};
+          if (firstn) {
+            int32_t recurse_tries =
+                choose_leaf_tries ? choose_leaf_tries
+                : (m.chooseleaf_descend_once ? 1 : choose_tries);
+            osize = choose_firstn(
+                ctx, bucket, numrep, arg2, o.data() + osize, 0,
+                result_max - osize, choose_tries, recurse_tries,
+                local_retries, local_fallback, leaf, vary_r, stable,
+                co.data() + osize, 0) + osize;
+          } else {
+            int32_t out_size = std::min(numrep, result_max - osize);
+            choose_indep(ctx, bucket, out_size, numrep, arg2,
+                         o.data() + osize, 0, choose_tries,
+                         choose_leaf_tries ? choose_leaf_tries : 1, leaf,
+                         co.data() + osize, 0);
+            osize += out_size;
+          }
+        }
+        if (leaf)
+          for (int32_t i = 0; i < osize; ++i) o[i] = co[i];
+        std::swap(w, o);
+        wsize = osize;
+        break;
+      }
+      case EMIT:
+        for (int32_t i = 0; i < wsize && result_len < result_max; ++i)
+          result[result_len++] = w[i];
+        wsize = 0;
+        break;
+    }
+    (void)firstn;
+  }
+  return result_len;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Batched do_rule over xs: results [n_xs, result_max] filled with
+// ITEM_NONE padding; returns 0 on success.
+int ceph_tpu_do_rule_batch(
+    // map arrays
+    int32_t n_buckets, int32_t max_size, const int32_t* items,
+    const int32_t* weights, const int32_t* sizes, const int32_t* types,
+    const int32_t* algs, const int32_t* sum_weights, const int32_t* straws,
+    const int32_t* node_weights, const int32_t* num_nodes,
+    const int64_t* ln_table, int32_t max_devices,
+    // tunables
+    int32_t choose_local_tries, int32_t choose_local_fallback_tries,
+    int32_t choose_total_tries, int32_t chooseleaf_descend_once,
+    int32_t chooseleaf_vary_r, int32_t chooseleaf_stable,
+    // rule
+    const int32_t* steps, int32_t n_steps,
+    // choose args (nullable)
+    const int32_t* arg_weight_sets, const int32_t* arg_ids,
+    int32_t n_positions,
+    // query
+    const uint32_t* xs, int64_t n_xs, int32_t result_max,
+    const int32_t* device_weights, int32_t* results) {
+  MapView m;
+  m.n_buckets = n_buckets;
+  m.max_size = max_size;
+  m.items = items;
+  m.weights = weights;
+  m.sizes = sizes;
+  m.types = types;
+  m.algs = algs;
+  m.sum_weights = sum_weights;
+  m.straws = straws;
+  m.node_weights = node_weights;
+  m.num_nodes = num_nodes;
+  m.ln_table = ln_table;
+  m.max_devices = max_devices;
+  m.choose_local_tries = choose_local_tries;
+  m.choose_local_fallback_tries = choose_local_fallback_tries;
+  m.choose_total_tries = choose_total_tries;
+  m.chooseleaf_descend_once = chooseleaf_descend_once;
+  m.chooseleaf_vary_r = chooseleaf_vary_r;
+  m.chooseleaf_stable = chooseleaf_stable;
+
+  ChooseArgs args;
+  args.weight_sets = arg_weight_sets;
+  args.ids = arg_ids;
+  args.n_positions = n_positions;
+  const ChooseArgs* argp =
+      (arg_weight_sets || arg_ids) ? &args : nullptr;
+
+  Workspace ws(m);
+  for (int64_t i = 0; i < n_xs; ++i) {
+    int32_t* res = results + i * result_max;
+    for (int32_t j = 0; j < result_max; ++j) res[j] = kItemNone;
+    do_rule(m, ws, steps, n_steps, xs[i], result_max, device_weights,
+            argp, res);
+  }
+  return 0;
+}
+
+uint32_t ceph_tpu_hash2(uint32_t a, uint32_t b) { return hash2(a, b); }
+uint32_t ceph_tpu_hash3(uint32_t a, uint32_t b, uint32_t c) {
+  return hash3(a, b, c);
+}
+
+}  // extern "C"

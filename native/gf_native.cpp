@@ -1,0 +1,126 @@
+// ceph_tpu native runtime — GF(2^8) region coding.
+//
+// SIMD erasure-encode/decode over byte regions: the role ISA-L's
+// ec_encode_data plays in the reference (src/erasure-code/isa/
+// ErasureCodeIsa.cc:129).  Each constant multiply is two 16-entry nibble
+// table lookups; with AVX2 the lookups are _mm256_shuffle_epi8 over 32
+// bytes per instruction, otherwise a portable scalar path runs.
+//
+// This is the honest local CPU baseline for the TPU plugin's throughput
+// comparison (BASELINE.md) and the host-side fallback codec.
+
+#include <cstdint>
+#include <cstring>
+
+#if defined(__AVX2__)
+#include <immintrin.h>
+#endif
+
+namespace {
+
+constexpr uint16_t kPoly = 0x11D;
+
+struct Tables {
+  uint8_t mul[256][256];
+  bool ready = false;
+};
+
+Tables& tables() {
+  static Tables t;
+  if (!t.ready) {
+    for (int a = 0; a < 256; ++a) {
+      for (int b = 0; b < 256; ++b) {
+        uint16_t r = 0, x = a, y = b;
+        while (y) {
+          if (y & 1) r ^= x;
+          y >>= 1;
+          x <<= 1;
+          if (x & 0x100) x ^= kPoly;
+        }
+        t.mul[a][b] = (uint8_t)r;
+      }
+    }
+    t.ready = true;
+  }
+  return t;
+}
+
+// nibble tables for constant c: prod = lo[x & 0xF] ^ hi[x >> 4]
+void nibble_tables(uint8_t c, uint8_t lo[16], uint8_t hi[16]) {
+  Tables& t = tables();
+  for (int i = 0; i < 16; ++i) {
+    lo[i] = t.mul[c][i];
+    hi[i] = t.mul[c][i << 4];
+  }
+}
+
+// dst ^= c * src over len bytes
+void region_mul_xor(uint8_t* dst, const uint8_t* src, uint8_t c,
+                    int64_t len) {
+  if (c == 0) return;
+  if (c == 1) {
+    int64_t i = 0;
+#if defined(__AVX2__)
+    for (; i + 32 <= len; i += 32) {
+      __m256i d = _mm256_loadu_si256((const __m256i*)(dst + i));
+      __m256i s = _mm256_loadu_si256((const __m256i*)(src + i));
+      _mm256_storeu_si256((__m256i*)(dst + i), _mm256_xor_si256(d, s));
+    }
+#endif
+    for (; i < len; ++i) dst[i] ^= src[i];
+    return;
+  }
+  uint8_t lo[16], hi[16];
+  nibble_tables(c, lo, hi);
+  int64_t i = 0;
+#if defined(__AVX2__)
+  const __m256i vlo = _mm256_broadcastsi128_si256(
+      _mm_loadu_si128((const __m128i*)lo));
+  const __m256i vhi = _mm256_broadcastsi128_si256(
+      _mm_loadu_si128((const __m128i*)hi));
+  const __m256i mask = _mm256_set1_epi8(0x0F);
+  for (; i + 32 <= len; i += 32) {
+    __m256i s = _mm256_loadu_si256((const __m256i*)(src + i));
+    __m256i l = _mm256_shuffle_epi8(vlo, _mm256_and_si256(s, mask));
+    __m256i h = _mm256_shuffle_epi8(
+        vhi, _mm256_and_si256(_mm256_srli_epi64(s, 4), mask));
+    __m256i p = _mm256_xor_si256(l, h);
+    __m256i d = _mm256_loadu_si256((const __m256i*)(dst + i));
+    _mm256_storeu_si256((__m256i*)(dst + i), _mm256_xor_si256(d, p));
+  }
+#endif
+  const Tables& t = tables();
+  for (; i < len; ++i) dst[i] ^= t.mul[c][src[i]];
+}
+
+}  // namespace
+
+extern "C" {
+
+// out[m][chunk] = matrix[m][k] (GF) x data[k][chunk]; out zeroed here.
+int ceph_tpu_gf_matmul_regions(const uint8_t* matrix, int32_t rows,
+                               int32_t k, const uint8_t* data,
+                               uint8_t* out, int64_t chunk) {
+  std::memset(out, 0, (size_t)rows * chunk);
+  for (int32_t r = 0; r < rows; ++r)
+    for (int32_t c = 0; c < k; ++c)
+      region_mul_xor(out + (int64_t)r * chunk, data + (int64_t)c * chunk,
+                     matrix[r * k + c], chunk);
+  return 0;
+}
+
+// dst ^= c * src (exposed for tests / XOR fast paths)
+void ceph_tpu_gf_region_mul_xor(uint8_t* dst, const uint8_t* src,
+                                uint8_t c, int64_t len) {
+  region_mul_xor(dst, src, c, len);
+}
+
+int ceph_tpu_has_avx2(void) {
+#if defined(__AVX2__)
+  return 1;
+#else
+  return 0;
+#endif
+}
+
+}  // extern "C"
