@@ -204,9 +204,12 @@ class OSDMap:
         """(OSDMap.cc:2465-2510)"""
         p = self.pg_upmap.get(pgid)
         if p is not None:
-            if not any(o != ITEM_NONE and 0 <= o < self.max_osd and
-                       self.osd_weight[o] == 0 for o in p):
-                raw = list(p)
+            if any(o != ITEM_NONE and 0 <= o < self.max_osd and
+                   self.osd_weight[o] == 0 for o in p):
+                # any out target rejects the whole exception — including
+                # pg_upmap_items (OSDMap.cc:2475 returns, not falls through)
+                return raw
+            raw = list(p)
         q = self.pg_upmap_items.get(pgid)
         if q is not None:
             for frm, to in q:

@@ -59,10 +59,20 @@ def replicated_sharding(mesh: Mesh) -> NamedSharding:
 _STEP_CACHE: dict = {}
 
 
+def mesh_cache_key(mesh: Mesh):
+    """Stable cache key for a mesh: the device objects (live per-platform
+    singletons — hashable, never id-reused) + axis names.  Never use
+    id(mesh): a freed mesh's id can be reused by a new mesh with different
+    devices, yielding a stale executable with wrong shardings.  Raw
+    integer device ids are also insufficient — they repeat across
+    platforms (cpu:0 vs tpu:0)."""
+    return (tuple(mesh.devices.flat), mesh.devices.shape, mesh.axis_names)
+
+
 def _encode_step_fn(mesh: Mesh):
     """Jitted sharded step, cached per mesh so repeated steps reuse the
     compiled executable (jit caches by function identity)."""
-    key = id(mesh)
+    key = mesh_cache_key(mesh)
     if key not in _STEP_CACHE:
         from ..ops.gf_jax import bitplane_matmul
 

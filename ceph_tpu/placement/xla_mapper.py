@@ -530,8 +530,12 @@ def _choose_indep(cm, dt, root_item, target_type: int, numrep: int,
         for rep in range(R):  # static; collision sees earlier same-round reps
             pending = active[rep] & (out[rep] == UNDEF)
             r = rep + numrep * ftotal
+            # choose_args weight-set position is outpos (0 at rule level),
+            # NOT rep: crush_choose_indep passes outpos down to
+            # bucket_choose (mapper.c:655-843); only the leaf recursion
+            # uses rep as its outpos.
             item, status = _descend(
-                cm, dt, -1 - root_item, target_type, x, r, rep)
+                cm, dt, -1 - root_item, target_type, x, r, jnp.int32(0))
             collide = jnp.any(out == item)
             hard = status == _SKIP
             leaf = NONE
@@ -728,7 +732,9 @@ class XlaMapper:
 
     # ----------------------------------------------------------- public ---
     def _get_jitted(self, ruleno: int, result_max: int, mesh=None):
-        key = (ruleno, result_max, id(mesh) if mesh is not None else None)
+        from ..parallel.mesh import mesh_cache_key
+        key = (ruleno, result_max,
+               mesh_cache_key(mesh) if mesh is not None else None)
         if key not in self._jitted:
             fn = functools.partial(self._trace_rule, ruleno, result_max)
             if mesh is None:

@@ -1,0 +1,45 @@
+"""Driver-contract tests: entry() compiles; dryrun_multichip survives a
+hostile ambient environment (the round-1 failure mode: a poisoned
+accelerator runtime inherited by the dry run)."""
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import __graft_entry__  # noqa: E402
+
+
+def test_entry_compiles_and_runs():
+    import jax
+    fn, args = __graft_entry__.entry()
+    out = jax.jit(fn)(*args)
+    jax.block_until_ready(out)
+    assert out.shape[0] == args[1].shape[0]
+
+
+def test_dryrun_multichip_8():
+    __graft_entry__.dryrun_multichip(8)
+
+
+def test_dryrun_multichip_survives_poisoned_env():
+    """Even with JAX_PLATFORMS pointing at a nonexistent backend in the
+    caller's env, the subprocess re-exec must pin CPU and pass."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "tpu"          # no TPU in the test sandbox
+    env["TPU_LIBRARY_PATH"] = "/nonexistent/libtpu.so"
+    code = ("import sys; sys.path.insert(0, %r); "
+            "import __graft_entry__; "
+            "__graft_entry__.dryrun_multichip(4); print('OK')" % REPO)
+    proc = subprocess.run([sys.executable, "-c", code], env=env,
+                          capture_output=True, text=True, timeout=1200)
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    assert "OK" in proc.stdout
+
+
+def test_dryrun_bad_args_cli():
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "__graft_entry__.py")],
+        capture_output=True, text=True)
+    assert proc.returncode == 2

@@ -93,6 +93,23 @@ def test_pg_upmap_full_replacement():
     assert up != target
 
 
+def test_pg_upmap_out_target_rejects_items_too():
+    """A pg_upmap with any out target rejects the WHOLE exception: the
+    reference returns before even looking at pg_upmap_items
+    (OSDMap.cc:2475)."""
+    m = make_osdmap()
+    up0, _, _, _ = m.pg_to_up_acting_osds(1, 5)
+    m.pg_upmap[(1, 5)] = [0, 4, 8]
+    frm = up0[0]
+    used_hosts = {o // 4 for o in up0} | {0, 1, 2}
+    to = next(o for o in range(m.max_osd) if o // 4 not in used_hosts)
+    m.pg_upmap_items[(1, 5)] = [(frm, to)]
+    m.mark_out(4)     # poisons the pg_upmap exception
+    up, _, _, _ = m.pg_to_up_acting_osds(1, 5)
+    assert to not in up            # items must NOT have been applied
+    assert up == [o for o in up0 if m.osd_weight[o] != 0] or up == up0
+
+
 def test_pg_upmap_items_swap():
     m = make_osdmap()
     up0, _, _, _ = m.pg_to_up_acting_osds(1, 9)

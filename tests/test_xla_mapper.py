@@ -182,6 +182,29 @@ def test_choose_args_weight_set():
                      choose_args_key="pool1")
 
 
+def test_choose_args_weight_set_indep():
+    """INDEP variant with a 4-position weight set: the top-level descend
+    must use position outpos (0), not rep — regression for the
+    crush_choose_indep position bug (mapper.c passes outpos down)."""
+    cmap, root = build_cluster(n_hosts=6, osds_per_host=4, seed=29)
+    rng = np.random.default_rng(31)
+    args = []
+    for b in cmap.buckets:
+        if b is None:
+            args.append(None)
+            continue
+        ws = [[max(1, int(w * (0.5 + rng.random()))) for w in b.weights]
+              for _ in range(4)]
+        args.append(ChooseArg(ids=None, weight_set=ws))
+    cmap.choose_args["ecpool"] = args
+    cmap.add_rule(Rule(steps=[(RULE_TAKE, root, 0),
+                              (RULE_CHOOSELEAF_INDEP, 0, TYPE_HOST),
+                              (RULE_EMIT, 0, 0)]))
+    weights = [WEIGHT_ONE] * cmap.max_devices
+    assert_bit_exact(cmap, 0, 4, weights, XS[:256],
+                     choose_args_key="ecpool")
+
+
 def test_unsupported_map_raises():
     m = CrushMap(tunables=Tunables.profile("jewel"))
     m.add_bucket(Bucket(id=-1, alg=BUCKET_UNIFORM, type=TYPE_HOST,
