@@ -1,0 +1,265 @@
+"""ctypes bridge to the native C++ runtime (native/libceph_tpu_native.so).
+
+Two surfaces:
+
+  * ``NativeMapper`` — the compiled C++ CRUSH interpreter
+    (native/crush_native.cpp), the fast host-side mapper.  It is the
+    honest scalar-CPU baseline for the batched TPU mapper and the
+    low-latency fallback for maps outside the vectorized subset (the
+    role of crush_do_rule behind CrushWrapper::do_rule,
+    src/crush/CrushWrapper.h:1581).
+  * ``gf_matmul_regions`` — the SIMD GF(2^8) region codec
+    (native/gf_native.cpp), the role ISA-L's ec_encode_data plays in the
+    reference (src/erasure-code/isa/ErasureCodeIsa.cc:129) and the
+    honest local CPU throughput baseline for the TPU EC kernels.
+
+The shared object is (re)built on demand with `make -C native`; loading
+is lazy so pure-Python paths never require a toolchain.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional, Sequence
+
+import numpy as np
+
+from .placement import lntable
+from .placement.crush_map import (
+    BUCKET_LIST, BUCKET_STRAW, BUCKET_TREE, ITEM_NONE, CrushMap)
+
+_NATIVE_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "native")
+_SO = os.path.join(_NATIVE_DIR, "libceph_tpu_native.so")
+_LOCK = threading.Lock()
+_LIB: Optional[ctypes.CDLL] = None
+
+_I32P = ctypes.POINTER(ctypes.c_int32)
+_I64P = ctypes.POINTER(ctypes.c_int64)
+_U8P = ctypes.POINTER(ctypes.c_uint8)
+_U32P = ctypes.POINTER(ctypes.c_uint32)
+
+
+class NativeUnavailable(RuntimeError):
+    """The native library could not be built or loaded."""
+
+
+def ensure_built(force: bool = False) -> str:
+    """Build the shared object if missing or stale; returns its path."""
+    srcs = [os.path.join(_NATIVE_DIR, f)
+            for f in ("crush_native.cpp", "gf_native.cpp", "Makefile")]
+    stale = (not os.path.exists(_SO) or
+             any(os.path.getmtime(s) > os.path.getmtime(_SO)
+                 for s in srcs if os.path.exists(s)))
+    if force or stale:
+        proc = subprocess.run(["make", "-C", _NATIVE_DIR],
+                              capture_output=True, text=True, timeout=300)
+        if proc.returncode != 0:
+            raise NativeUnavailable(
+                f"native build failed:\n{proc.stdout}\n{proc.stderr}")
+    return _SO
+
+
+def _i32p(a: Optional[np.ndarray]):
+    if a is None:
+        return None
+    return a.ctypes.data_as(_I32P)
+
+
+def lib() -> ctypes.CDLL:
+    global _LIB
+    with _LOCK:
+        if _LIB is None:
+            try:
+                so = ensure_built()
+                _LIB = ctypes.CDLL(so)
+            except OSError as e:
+                raise NativeUnavailable(str(e)) from e
+            _LIB.ceph_tpu_do_rule_batch.restype = ctypes.c_int
+            _LIB.ceph_tpu_do_rule_batch.argtypes = [
+                ctypes.c_int32, ctypes.c_int32,          # n_buckets, max_size
+                _I32P, _I32P, _I32P, _I32P, _I32P,       # items..algs
+                _I32P, _I32P, _I32P, _I32P,              # aux tables
+                _I64P, ctypes.c_int32,                   # ln_table, max_dev
+                ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,  # tunables
+                ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
+                _I32P, ctypes.c_int32,                   # steps, n_steps
+                _I32P, _I32P, ctypes.c_int32,            # choose_args
+                _U32P, ctypes.c_int64, ctypes.c_int32,   # xs, n, result_max
+                _I32P, _I32P]                            # weights, results
+            _LIB.ceph_tpu_gf_matmul_regions.restype = ctypes.c_int
+            _LIB.ceph_tpu_gf_matmul_regions.argtypes = [
+                _U8P, ctypes.c_int32, ctypes.c_int32, _U8P, _U8P,
+                ctypes.c_int64]
+            _LIB.ceph_tpu_gf_region_mul_xor.restype = None
+            _LIB.ceph_tpu_gf_region_mul_xor.argtypes = [
+                _U8P, _U8P, ctypes.c_uint8, ctypes.c_int64]
+            _LIB.ceph_tpu_has_avx2.restype = ctypes.c_int
+            _LIB.ceph_tpu_hash2.restype = ctypes.c_uint32
+            _LIB.ceph_tpu_hash2.argtypes = [ctypes.c_uint32, ctypes.c_uint32]
+            _LIB.ceph_tpu_hash3.restype = ctypes.c_uint32
+            _LIB.ceph_tpu_hash3.argtypes = [ctypes.c_uint32, ctypes.c_uint32,
+                                            ctypes.c_uint32]
+        return _LIB
+
+
+def has_avx2() -> bool:
+    return bool(lib().ceph_tpu_has_avx2())
+
+
+# ------------------------------------------------------------------ CRUSH ---
+
+class NativeMapper:
+    """Flatten a CrushMap into the dense MapView arrays once, then run
+    batched do_rule sweeps through the C++ interpreter."""
+
+    def __init__(self, cmap: CrushMap, choose_args_key: object = None):
+        lib()   # fail fast if unbuildable
+        self.cmap = cmap
+        B = cmap.max_buckets
+        # node_weights stride in the C ABI is 2*max_size: widen max_size so
+        # every TREE bucket's num_nodes (which can exceed 2*size for
+        # non-power-of-two sizes) still fits.
+        S = max((b.size for b in cmap.buckets if b is not None), default=1)
+        for b in cmap.buckets:
+            if b is not None and b.alg == BUCKET_TREE and b.num_nodes:
+                S = max(S, (b.num_nodes + 1) // 2)
+        S = max(S, 1)
+        self.items = np.zeros((B, S), dtype=np.int32)
+        self.weights = np.zeros((B, S), dtype=np.int32)
+        self.sizes = np.zeros(B, dtype=np.int32)
+        self.types = np.zeros(B, dtype=np.int32)
+        self.algs = np.zeros(B, dtype=np.int32)
+        self.sum_weights = np.zeros((B, S), dtype=np.int32)
+        self.straws = np.zeros((B, S), dtype=np.int32)
+        self.node_weights = np.zeros((B, 2 * S), dtype=np.int32)
+        self.num_nodes = np.zeros(B, dtype=np.int32)
+        for i, b in enumerate(cmap.buckets):
+            if b is None:
+                continue
+            n = b.size
+            self.items[i, :n] = b.items
+            if b.weights:
+                w = ([b.weights[0]] * n if len(b.weights) == 1 and n > 1
+                     else b.weights[:n])
+                self.weights[i, :len(w)] = w
+            self.sizes[i] = n
+            self.types[i] = b.type
+            self.algs[i] = b.alg
+            if b.alg == BUCKET_LIST and b.sum_weights:
+                self.sum_weights[i, :n] = b.sum_weights
+            if b.alg == BUCKET_STRAW and b.straws:
+                self.straws[i, :n] = b.straws
+            if b.alg == BUCKET_TREE and b.node_weights:
+                self.node_weights[i, :len(b.node_weights)] = b.node_weights
+                self.num_nodes[i] = b.num_nodes
+        self.max_size = S
+        self.ln_table = np.ascontiguousarray(
+            lntable.crush_ln_lut(), dtype=np.int64)
+        # choose_args → flattened [B, P, S] weight sets / [B, S] ids
+        self.arg_weight_sets: Optional[np.ndarray] = None
+        self.arg_ids: Optional[np.ndarray] = None
+        self.n_positions = 0
+        if choose_args_key is not None:
+            args = cmap.choose_args.get(choose_args_key)
+            if args:
+                P = max((len(a.weight_set) for a in args
+                         if a is not None and a.weight_set), default=0)
+                if P:
+                    ws = np.zeros((B, P, S), dtype=np.int32)
+                    for i, a in enumerate(args[:B]):
+                        src = (a.weight_set if a is not None and a.weight_set
+                               else None)
+                        for p in range(P):
+                            row = (src[min(p, len(src) - 1)] if src
+                                   else (cmap.buckets[i].weights
+                                         if cmap.buckets[i] else []))
+                            ws[i, p, :len(row)] = row
+                    self.arg_weight_sets = ws
+                    self.n_positions = P
+                if any(a is not None and a.ids for a in args):
+                    ids = np.array(self.items, copy=True)
+                    for i, a in enumerate(args[:B]):
+                        if a is not None and a.ids:
+                            ids[i, :len(a.ids)] = a.ids
+                    self.arg_ids = ids
+
+    def map_batch(self, ruleno: int, xs, result_max: int,
+                  weights: Sequence[int]) -> np.ndarray:
+        rule = self.cmap.rules[ruleno]
+        if rule is None:
+            raise ValueError(f"no rule {ruleno}")
+        steps = np.asarray([list(s) for s in rule.steps],
+                           dtype=np.int32).reshape(-1)
+        xs = np.ascontiguousarray(np.asarray(xs, dtype=np.uint32))
+        dev_w = np.zeros(self.cmap.max_devices, dtype=np.int32)
+        w_in = np.asarray(list(weights), dtype=np.int64)
+        dev_w[:len(w_in)] = np.clip(w_in, 0, 0x10000)
+        results = np.empty((len(xs), result_max), dtype=np.int32)
+        t = self.cmap.tunables
+        rc = lib().ceph_tpu_do_rule_batch(
+            np.int32(self.cmap.max_buckets), np.int32(self.max_size),
+            _i32p(self.items), _i32p(self.weights), _i32p(self.sizes),
+            _i32p(self.types), _i32p(self.algs), _i32p(self.sum_weights),
+            _i32p(self.straws), _i32p(self.node_weights),
+            _i32p(self.num_nodes), self.ln_table.ctypes.data_as(_I64P),
+            np.int32(self.cmap.max_devices),
+            np.int32(t.choose_local_tries),
+            np.int32(t.choose_local_fallback_tries),
+            np.int32(t.choose_total_tries),
+            np.int32(t.chooseleaf_descend_once),
+            np.int32(t.chooseleaf_vary_r),
+            np.int32(t.chooseleaf_stable),
+            _i32p(steps), np.int32(len(rule.steps)),
+            _i32p(self.arg_weight_sets), _i32p(self.arg_ids),
+            np.int32(self.n_positions),
+            xs.ctypes.data_as(_U32P), np.int64(len(xs)),
+            np.int32(result_max), _i32p(dev_w),
+            results.ctypes.data_as(_I32P))
+        if rc != 0:
+            raise RuntimeError(f"native do_rule_batch rc={rc}")
+        return results
+
+
+# --------------------------------------------------------------------- GF ---
+
+def gf_matmul_regions(matrix: np.ndarray, data: np.ndarray) -> np.ndarray:
+    """out[m, chunk] = matrix[m, k] ∘ data[k, chunk] over GF(2^8)."""
+    matrix = np.ascontiguousarray(matrix, dtype=np.uint8)
+    data = np.ascontiguousarray(data, dtype=np.uint8)
+    m, k = matrix.shape
+    assert data.shape[0] == k, (matrix.shape, data.shape)
+    chunk = data.shape[1]
+    out = np.empty((m, chunk), dtype=np.uint8)
+    lib().ceph_tpu_gf_matmul_regions(
+        matrix.ctypes.data_as(_U8P), np.int32(m), np.int32(k),
+        data.ctypes.data_as(_U8P), out.ctypes.data_as(_U8P),
+        np.int64(chunk))
+    return out
+
+
+def gf_matmul_regions_batch(matrix: np.ndarray,
+                            data: np.ndarray) -> np.ndarray:
+    """Batched: data [B, k, chunk] → [B, m, chunk]."""
+    matrix = np.ascontiguousarray(matrix, dtype=np.uint8)
+    data = np.ascontiguousarray(data, dtype=np.uint8)
+    B, k, chunk = data.shape
+    m = matrix.shape[0]
+    out = np.empty((B, m, chunk), dtype=np.uint8)
+    fn = lib().ceph_tpu_gf_matmul_regions
+    mp = matrix.ctypes.data_as(_U8P)
+    for i in range(B):
+        fn(mp, np.int32(m), np.int32(k), data[i].ctypes.data_as(_U8P),
+           out[i].ctypes.data_as(_U8P), np.int64(chunk))
+    return out
+
+
+def region_mul_xor(dst: np.ndarray, src: np.ndarray, c: int) -> None:
+    """dst ^= c * src in place (GF(2^8))."""
+    assert dst.dtype == np.uint8 and src.dtype == np.uint8
+    assert dst.flags.c_contiguous and src.flags.c_contiguous
+    lib().ceph_tpu_gf_region_mul_xor(
+        dst.ctypes.data_as(_U8P), src.ctypes.data_as(_U8P),
+        np.uint8(c), np.int64(dst.size))

@@ -119,14 +119,16 @@ struct Workspace {
 };
 
 struct Row {
-  const MapView& m;
+  // pointer, not reference: Row must stay copy-assignable (the descent
+  // loops reassign `in` as they walk down the hierarchy)
+  const MapView* m;
   int32_t b;  // bucket index
   int32_t id() const { return -1 - b; }
-  int32_t size() const { return m.sizes[b]; }
-  int32_t alg() const { return m.algs[b]; }
-  int32_t type() const { return m.types[b]; }
-  int32_t item(int32_t i) const { return m.items[b * m.max_size + i]; }
-  int32_t weight(int32_t i) const { return m.weights[b * m.max_size + i]; }
+  int32_t size() const { return m->sizes[b]; }
+  int32_t alg() const { return m->algs[b]; }
+  int32_t type() const { return m->types[b]; }
+  int32_t item(int32_t i) const { return m->items[b * m->max_size + i]; }
+  int32_t weight(int32_t i) const { return m->weights[b * m->max_size + i]; }
 };
 
 int32_t perm_choose(const Row& bk, PermState& w, uint32_t x, uint32_t r) {
@@ -158,7 +160,7 @@ int32_t perm_choose(const Row& bk, PermState& w, uint32_t x, uint32_t r) {
 }
 
 int32_t list_choose(const Row& bk, uint32_t x, uint32_t r) {
-  const int32_t* sums = bk.m.sum_weights + bk.b * bk.m.max_size;
+  const int32_t* sums = bk.m->sum_weights + bk.b * bk.m->max_size;
   for (int32_t i = bk.size() - 1; i >= 0; --i) {
     uint64_t w = hash4(x, (uint32_t)bk.item(i), r, (uint32_t)bk.id());
     w &= 0xFFFF;
@@ -169,8 +171,8 @@ int32_t list_choose(const Row& bk, uint32_t x, uint32_t r) {
 }
 
 int32_t tree_choose(const Row& bk, uint32_t x, uint32_t r) {
-  const int32_t* nw = bk.m.node_weights + bk.b * 2 * bk.m.max_size;
-  int32_t n = bk.m.num_nodes[bk.b] >> 1;
+  const int32_t* nw = bk.m->node_weights + bk.b * 2 * bk.m->max_size;
+  int32_t n = bk.m->num_nodes[bk.b] >> 1;
   while (!(n & 1)) {
     uint64_t t =
         ((uint64_t)hash4(x, (uint32_t)n, r, (uint32_t)bk.id()) *
@@ -184,7 +186,7 @@ int32_t tree_choose(const Row& bk, uint32_t x, uint32_t r) {
 }
 
 int32_t straw_choose(const Row& bk, uint32_t x, uint32_t r) {
-  const int32_t* straws = bk.m.straws + bk.b * bk.m.max_size;
+  const int32_t* straws = bk.m->straws + bk.b * bk.m->max_size;
   int32_t high = 0;
   uint64_t high_draw = 0;
   for (int32_t i = 0; i < bk.size(); ++i) {
@@ -205,7 +207,7 @@ int32_t straw2_choose(const Row& bk, uint32_t x, uint32_t r,
     int64_t draw;
     if (w) {
       uint32_t u = hash3(x, (uint32_t)id, r) & 0xFFFF;
-      int64_t ln = bk.m.ln_table[u] - 0x1000000000000LL;
+      int64_t ln = bk.m->ln_table[u] - 0x1000000000000LL;
       // ln <= 0, w > 0: truncating division toward zero
       draw = -((-ln) / w);
     } else {
@@ -237,9 +239,9 @@ int32_t bucket_choose(const Row& bk, Workspace& ws, uint32_t x, uint32_t r,
         int32_t p = position < args->n_positions ? position
                                                  : args->n_positions - 1;
         aw = args->weight_sets +
-             ((int64_t)bk.b * args->n_positions + p) * bk.m.max_size;
+             ((int64_t)bk.b * args->n_positions + p) * bk.m->max_size;
       }
-      if (args && args->ids) ai = args->ids + (int64_t)bk.b * bk.m.max_size;
+      if (args && args->ids) ai = args->ids + (int64_t)bk.b * bk.m->max_size;
       return straw2_choose(bk, x, r, ai, aw);
     }
   }
@@ -302,7 +304,7 @@ int choose_firstn(RuleCtx& c, Row bucket, int32_t numrep, int32_t type,
               skip_rep = true;
               break;
             }
-            in = Row{c.m, -1 - item};
+            in = Row{&c.m, -1 - item};
             retry_bucket = true;
             continue;
           }
@@ -311,7 +313,7 @@ int choose_firstn(RuleCtx& c, Row bucket, int32_t numrep, int32_t type,
           if (!collide && recurse_to_leaf) {
             if (item < 0) {
               int32_t sub_r = vary_r ? (int32_t)(r >> (vary_r - 1)) : 0;
-              if (choose_firstn(c, Row{c.m, -1 - item},
+              if (choose_firstn(c, Row{&c.m, -1 - item},
                                 stable ? 1 : outpos + 1, 0, out2, outpos,
                                 count, recurse_tries, 0, local_retries,
                                 local_fallback_retries, false, vary_r,
@@ -385,7 +387,7 @@ void choose_indep(RuleCtx& c, Row bucket, int32_t left, int32_t numrep,
             left--;
             break;
           }
-          in = Row{c.m, -1 - item};
+          in = Row{&c.m, -1 - item};
           continue;
         }
         bool collide = false;
@@ -394,7 +396,7 @@ void choose_indep(RuleCtx& c, Row bucket, int32_t left, int32_t numrep,
         if (collide) break;
         if (recurse_to_leaf) {
           if (item < 0) {
-            choose_indep(c, Row{c.m, -1 - item}, 1, numrep, 0, out2, rep,
+            choose_indep(c, Row{&c.m, -1 - item}, 1, numrep, 0, out2, rep,
                          recurse_tries, 0, false, nullptr, r);
             if (out2 && out2[rep] == kItemNone) break;
           } else if (out2) {
@@ -479,7 +481,7 @@ int do_rule(const MapView& m, Workspace& ws, const int32_t* steps,
           }
           int32_t bno = -1 - w[i];
           if (bno < 0 || bno >= m.n_buckets) continue;
-          Row bucket{m, bno};
+          Row bucket{&m, bno};
           if (firstn) {
             int32_t recurse_tries =
                 choose_leaf_tries ? choose_leaf_tries
