@@ -1,0 +1,1022 @@
+"""Level-synchronous batched CRUSH mapping — the fast TPU path.
+
+The round-1 mapper vectorized crush_do_rule by vmapping a per-x rule
+machine whose retry loops were lax.while_loops: every iteration re-ran
+the full batch width, the whole batch spun until its WORST lane
+converged, and every bucket row was padded to the global max bucket
+size.  This module restructures the computation around two facts about
+the algorithm (reference: src/crush/mapper.c:460-843):
+
+  1. A descent's value depends only on (map, x, r) — collision/out
+     rejections affect which descents are *kept*, never what they
+     *return*.  So all retry candidates r ∈ [0, numrep+extra) are
+     computed at once as one extra parallel axis, and the sequential
+     accept/reject bookkeeping (crush_choose_firstn's ftotal loop,
+     crush_choose_indep's rounds) collapses to a statically unrolled
+     chain of cheap [N]-wide integer selects.  Within one replica slot,
+     try number f always uses r = rep + f (firstn) or r = rep +
+     numrep·f (indep), so the candidate grid is static.
+  2. The hierarchy is layered: a descent from one root can only visit
+     buckets reachable at that depth.  Tables are therefore built per
+     level (root row alone at level 0, its bucket children at level 1,
+     ...), so a 1000-host root costs S=1000-wide straw2 draws only at
+     level 0 while the host level pays S=10 — not the global max.
+
+Lanes that exhaust the candidate budget (or hit the rare
+position-dependent cases the grid cannot represent, e.g. a skip under
+chooseleaf_stable=0 or multi-position choose_args weight sets) are
+flagged incomplete and recomputed bit-exactly by the caller through the
+native C++ interpreter (ceph_tpu.native_bridge) or the scalar oracle —
+same semantics, so the combined result is bit-exact for every lane.
+
+Supported rules: sequences of TAKE/SET_*/CHOOSE*/EMIT where each TAKE
+names a static bucket and each take block contains at most one choose
+step (chains where a choose feeds another choose fall back to the
+general XlaMapper trace).  Map subset: straw2 + modern tunables, as
+compile_map enforces.
+"""
+from __future__ import annotations
+
+import functools
+import os
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..ops import hashing
+from .crush_map import (
+    ITEM_NONE, ITEM_UNDEF,
+    RULE_CHOOSELEAF_FIRSTN, RULE_CHOOSELEAF_INDEP, RULE_CHOOSE_FIRSTN,
+    RULE_CHOOSE_INDEP, RULE_EMIT, RULE_SET_CHOOSELEAF_STABLE,
+    RULE_SET_CHOOSELEAF_TRIES, RULE_SET_CHOOSELEAF_VARY_R,
+    RULE_SET_CHOOSE_LOCAL_FALLBACK_TRIES, RULE_SET_CHOOSE_LOCAL_TRIES,
+    RULE_SET_CHOOSE_TRIES, RULE_TAKE, CrushMap,
+)
+from . import lntable
+from .xla_mapper import (
+    CompiledMap, DeviceTables, UnsupportedMapError, compile_map)
+
+_INF = jnp.inf
+_OK, _REJECT, _SKIP = 0, 1, 2
+
+# ------------------------------------------------- approximate straw2 draw --
+#
+# The exact straw2 draw needs the quirky 2^48-fixed-point crush_ln LUT
+# (ln_numer's one-hot limb matmuls — ~6.4k MXU flops and ~50 bytes of
+# HBM traffic per item).  The selection, however, only needs the ARGMIN
+# of the draws.  So: compute a cheap f32 approximation of the draw for
+# every item (polynomial log2 — pure VPU arithmetic, no tables), then
+# evaluate the EXACT draw only for the (at most two) items whose
+# approximate draw lies within a conservative error margin of the
+# minimum.  The margin is derived from the measured worst-case gap D
+# between the f32 polynomial and the real LUT over all 65536 inputs, so
+# the exact winner is provably inside the candidate set; lanes where
+# more than two items fall inside the margin (probability ~ margin /
+# draw-scale ≈ 2e-5 per selection) are flagged for exact fallback.
+
+# minimax-ish fit of log2(m), m ∈ [1, 2), ascending coefficients
+_LOG2_POLY = (-2.7868055642996064, 5.046852935530284, -3.4924660425578216,
+              1.5938845482693522, -0.40486230941613244,
+              0.04342836333164342)
+_2P44_F = float(2.0 ** 44)
+
+
+def _approx_numer_f32(u):
+    """f32 approximation of ln_numer(u) = 2^48 - crush_ln(u)."""
+    v = (u.astype(jnp.int32) + 1).astype(jnp.float32)
+    bits = jax.lax.bitcast_convert_type(v, jnp.int32)
+    e = (bits >> 23) - 127
+    mant = jax.lax.bitcast_convert_type(
+        (bits & 0x7FFFFF) | 0x3F800000, jnp.float32)
+    p = jnp.float32(_LOG2_POLY[-1])
+    for c in _LOG2_POLY[-2::-1]:
+        p = p * mant + jnp.float32(c)
+    log2v = e.astype(jnp.float32) + p
+    return jnp.float32(_2P44_F) * (jnp.float32(16.0) - log2v)
+
+
+@functools.lru_cache(maxsize=None)
+def _approx_error_bound() -> float:
+    """Measured max |approx - exact| over every u, with 4x slack for
+    platform fma/reassociation differences, plus the f32 division and
+    weight-rounding error terms (each ≤ 2^24-scale on a 2^48 value)."""
+    n_exact = (-lntable.straw2_ln_lut()).astype(np.float64)
+    u = np.arange(65536, dtype=np.int64)
+    v = (u + 1).astype(np.float32)
+    bits = v.view(np.int32)
+    e = (bits >> 23) - 127
+    mant = ((bits & 0x7FFFFF) | 0x3F800000).view(np.float32)
+    p = np.float32(_LOG2_POLY[-1])
+    for c in _LOG2_POLY[-2::-1]:
+        p = (p * mant + np.float32(c)).astype(np.float32)
+    log2v = e.astype(np.float32) + p
+    na = (np.float32(_2P44_F) * (np.float32(16.0) - log2v)).astype(np.float64)
+    d = float(np.abs(na - n_exact).max())
+    return 4.0 * d + float(2 ** 26)
+
+
+class UnsupportedRuleError(UnsupportedMapError):
+    """Rule shape outside the fast subset (caller should fall back)."""
+
+
+# ------------------------------------------------------------ level tables --
+
+@dataclass
+class _HostLevel:
+    """One descent level, host-side (rows = buckets reachable here)."""
+    bucket_ids: List[int]            # global bucket ids at this level
+    items: np.ndarray                # i32 [Bl, Sl] child ids
+    hash_ids: np.ndarray             # i32 [Bl, Sl]
+    weights: np.ndarray              # i32 [Bl, P, Sl]
+    sizes: np.ndarray                # i32 [Bl]
+    child_row: np.ndarray            # i32 [Bl, Sl] row in next level (-1)
+    child_type: np.ndarray           # i32 [Bl, Sl] (0 for devices)
+    child_escape: np.ndarray         # bool [Bl, Sl] invalid child
+    child_leafrow: np.ndarray        # i32 [Bl, Sl] row in leaf class (-1)
+
+
+def _build_levels(cmap: CrushMap, cm: CompiledMap, roots: List[int],
+                  target_type: int) -> Tuple[List[_HostLevel], List[int]]:
+    """BFS the hierarchy from `roots` down to `target_type`.
+
+    Returns (levels, leaf_class): leaf_class is the ordered list of
+    target-type bucket ids encountered (the chooseleaf recursion roots).
+    """
+    levels: List[_HostLevel] = []
+    leaf_class: List[int] = []
+    leaf_index: Dict[int, int] = {}
+    cur = list(dict.fromkeys(roots))
+    for _ in range(cm.max_depth + 1):
+        if not cur:
+            break
+        next_ids: List[int] = []
+        next_index: Dict[int, int] = {}
+        rows = [cmap.bucket(b) for b in cur]
+        Sl = max((b.size for b in rows if b is not None), default=1)
+        Sl = max(Sl, 1)
+        Bl = len(cur)
+        items = np.zeros((Bl, Sl), dtype=np.int32)
+        hash_ids = np.zeros((Bl, Sl), dtype=np.int32)
+        ws = np.zeros((Bl, cm.n_positions, Sl), dtype=np.int32)
+        sizes = np.zeros(Bl, dtype=np.int32)
+        child_row = np.full((Bl, Sl), -1, dtype=np.int32)
+        child_type = np.zeros((Bl, Sl), dtype=np.int32)
+        child_escape = np.zeros((Bl, Sl), dtype=bool)
+        child_leafrow = np.full((Bl, Sl), -1, dtype=np.int32)
+        for li, (bid, b) in enumerate(zip(cur, rows)):
+            if b is None:
+                continue
+            gidx = -1 - bid
+            n = b.size
+            sizes[li] = n
+            items[li, :n] = cm.items[gidx, :n]
+            hash_ids[li, :n] = cm.hash_ids[gidx, :n]
+            ws[li, :, :n] = cm.weight_sets[gidx, :, :n]
+            for s, c in enumerate(b.items):
+                if c >= 0:
+                    if c >= cm.max_devices:
+                        child_escape[li, s] = True
+                    continue
+                cb = cmap.bucket(c)
+                if cb is None:
+                    child_escape[li, s] = True
+                    continue
+                child_type[li, s] = cb.type
+                if cb.type == target_type:
+                    if c not in leaf_index:
+                        leaf_index[c] = len(leaf_class)
+                        leaf_class.append(c)
+                    child_leafrow[li, s] = leaf_index[c]
+                else:
+                    if c not in next_index:
+                        next_index[c] = len(next_ids)
+                        next_ids.append(c)
+                    child_row[li, s] = next_index[c]
+        levels.append(_HostLevel(
+            bucket_ids=list(cur), items=items, hash_ids=hash_ids,
+            weights=ws, sizes=sizes, child_row=child_row,
+            child_type=child_type, child_escape=child_escape,
+            child_leafrow=child_leafrow))
+        cur = next_ids
+    if cur:
+        raise UnsupportedMapError(
+            "hierarchy deeper than max_depth (cycle?)")
+    return levels, leaf_class
+
+
+class _DevLevel:
+    """Device-resident level tables for one static choose_args position.
+
+    Strategy mirror of DeviceTables: 'gather' (CPU) row-indexes;
+    'onehot' (TPU) turns every row select into a one-hot matmul so no
+    serial gather is emitted.
+    """
+
+    def __init__(self, hl: _HostLevel, pos: int, strategy: str):
+        self.strategy = strategy
+        self.Bl, self.Sl = hl.items.shape
+        pos_c = min(pos, hl.weights.shape[1] - 1)
+        w = hl.weights[:, pos_c, :].astype(np.int64)
+        # per-row conservative margin: |q_approx - q_exact| ≤ bound/w + 2
+        # for every valid item; doubled so it bounds a PAIR gap
+        bound = _approx_error_bound()
+        valid = (w > 0) & (np.arange(self.Sl)[None, :] < hl.sizes[:, None])
+        wmin = np.where(valid, w, np.int64(1) << 40).min(
+            axis=1, initial=np.int64(1) << 40)
+        margin = (2.0 * bound / np.maximum(wmin, 1) + 4.0).astype(
+            np.float32)
+        self.margin = jnp.asarray(margin)
+        if strategy == "gather":
+            self.items = jnp.asarray(hl.items)
+            self.hash_ids = jnp.asarray(hl.hash_ids.astype(np.uint32))
+            self.w_hi = jnp.asarray((w >> 16).astype(np.float32))
+            self.w_lo = jnp.asarray((w & 0xFFFF).astype(np.float32))
+            self.sizes = jnp.asarray(hl.sizes)
+            self.child_row = jnp.asarray(hl.child_row)
+            self.child_type = jnp.asarray(hl.child_type)
+            self.child_escape = jnp.asarray(hl.child_escape)
+            self.child_leafrow = jnp.asarray(hl.child_leafrow)
+            return
+        for name, arr in (("items", hl.items), ("hash_ids", hl.hash_ids)):
+            if np.abs(arr.astype(np.int64)).max(initial=0) >= (1 << 24):
+                raise UnsupportedMapError(f"onehot requires |{name}| < 2^24")
+        self.items_f = jnp.asarray(hl.items.astype(np.float32))
+        self.ids_f = jnp.asarray(hl.hash_ids.astype(np.float32))
+        self.w_hi = jnp.asarray((w >> 16).astype(np.float32))
+        self.w_lo = jnp.asarray((w & 0xFFFF).astype(np.float32))
+        self.sizes_f = jnp.asarray(hl.sizes.astype(np.float32))
+        self.child_row_f = jnp.asarray(hl.child_row.astype(np.float32))
+        self.child_type_f = jnp.asarray(hl.child_type.astype(np.float32))
+        self.child_escape_f = jnp.asarray(hl.child_escape.astype(np.float32))
+        self.child_leafrow_f = jnp.asarray(
+            hl.child_leafrow.astype(np.float32))
+
+    def rows(self, row):
+        """row [L] → (items, ids, w_hi, w_lo, sizes, child_row,
+        child_type, child_escape, child_leafrow, margin); [L, Sl] each
+        except sizes/margin [L].  w_hi/w_lo are exact f32 16-bit halves
+        of the 16.16 weights."""
+        if self.Bl == 1:
+            # single-bucket level (every TAKE root): broadcast the row —
+            # no one-hot matmul, and XLA fuses broadcasts into consumers
+            # without materializing [L, S] copies
+            L = row.shape[0]
+
+            def bc(t):
+                return jnp.broadcast_to(t[0], (L,) + t.shape[1:])
+
+            if self.strategy == "gather":
+                return (bc(self.items), bc(self.hash_ids), bc(self.w_hi),
+                        bc(self.w_lo), bc(self.sizes), bc(self.child_row),
+                        bc(self.child_type), bc(self.child_escape),
+                        bc(self.child_leafrow), bc(self.margin))
+            return (bc(self.items_f).astype(jnp.int32),
+                    bc(self.ids_f).astype(jnp.int32).astype(jnp.uint32),
+                    bc(self.w_hi), bc(self.w_lo),
+                    bc(self.sizes_f).astype(jnp.int32),
+                    bc(self.child_row_f).astype(jnp.int32),
+                    bc(self.child_type_f).astype(jnp.int32),
+                    bc(self.child_escape_f) > 0.5,
+                    bc(self.child_leafrow_f).astype(jnp.int32),
+                    bc(self.margin))
+        if self.strategy == "gather":
+            r = jnp.clip(row, 0, self.Bl - 1)
+            return (self.items[r], self.hash_ids[r], self.w_hi[r],
+                    self.w_lo[r], self.sizes[r], self.child_row[r],
+                    self.child_type[r], self.child_escape[r],
+                    self.child_leafrow[r], self.margin[r])
+        oh = (row[:, None] == jnp.arange(self.Bl)).astype(jnp.float32)
+        items = (oh @ self.items_f).astype(jnp.int32)
+        ids = (oh @ self.ids_f).astype(jnp.int32).astype(jnp.uint32)
+        w_hi = oh @ self.w_hi
+        w_lo = oh @ self.w_lo
+        sizes = (oh @ self.sizes_f).astype(jnp.int32)
+        child_row = (oh @ self.child_row_f).astype(jnp.int32)
+        child_type = (oh @ self.child_type_f).astype(jnp.int32)
+        child_escape = (oh @ self.child_escape_f) > 0.5
+        child_leafrow = (oh @ self.child_leafrow_f).astype(jnp.int32)
+        margin = oh @ self.margin
+        return (items, ids, w_hi, w_lo, sizes, child_row, child_type,
+                child_escape, child_leafrow, margin)
+
+    def select(self, j, *tables):
+        """tables[i][l, j[l]] for each [L, Sl] table, without gathers."""
+        if self.strategy == "gather":
+            jj = j[:, None]
+            return tuple(jnp.take_along_axis(t, jj, axis=1)[:, 0]
+                         for t in tables)
+        sel = (j[:, None] == jnp.arange(self.Sl))
+        out = []
+        for t in tables:
+            if t.dtype == jnp.bool_:
+                out.append(jnp.where(sel, t, False).any(axis=1))
+            else:
+                out.append(jnp.where(sel, t, 0).sum(axis=1, dtype=t.dtype))
+        return tuple(out)
+
+
+def _u32(v):
+    return jnp.asarray(v).astype(jnp.uint32)
+
+
+def _weight_at(weights, item, strategy):
+    """weights[item] for item [L] (strategy-aware, exact: w ≤ 2^16)."""
+    n = weights.shape[0]
+    idx = jnp.clip(item, 0, n - 1)
+    if strategy == "gather":
+        return weights[idx].astype(jnp.int64)
+    oh = (idx[:, None] == jnp.arange(n)).astype(jnp.float32)
+    return (oh @ weights.astype(jnp.float32)).astype(jnp.int64)
+
+
+def _is_out_batch(weights, item, x, strategy):
+    """Device overload rejection (mapper.c:424-438), batched over [L]."""
+    n = weights.shape[0]
+    w = _weight_at(weights, item, strategy)
+    oob = item >= n
+    hashed = (hashing.jx_hash2(_u32(x), _u32(item)) &
+              jnp.uint32(0xFFFF)).astype(jnp.int64) >= w
+    return oob | jnp.where(w >= 0x10000, False,
+                           jnp.where(w == 0, True, hashed))
+
+
+# ---------------------------------------------------------------- descent ---
+
+def _exact_q2(dt: DeviceTables, u2, w_hi2, w_lo2):
+    """Exact straw2 draws for [L, 2] candidate pairs: the full
+    fixed-point LUT + trunc-div math, but on two items per lane."""
+    a = dt.ln_numer(u2)                          # [L, 2] f64
+    w = w_hi2.astype(jnp.float64) * 65536.0 + w_lo2.astype(jnp.float64)
+    q = jnp.floor(a / jnp.maximum(w, 1.0))
+    q = q - (q * w > a)
+    q = q + ((q + 1.0) * w <= a)
+    return jnp.where(w > 0, q, _INF)
+
+
+def _straw2_select(dt: DeviceTables, u, w_hi, w_lo, sizes, margin,
+                   exact: bool):
+    """argmin of the straw2 draws over the item axis → (j [L], ambig).
+
+    Approx mode: f32 polynomial draws pick ≤ 2 candidates within the
+    proven error margin; the exact LUT math then decides between them
+    (first-index tie-break preserved).  Lanes with > 2 candidates in the
+    margin are flagged ambiguous.  Exact mode: full-width LUT math."""
+    Sl = u.shape[1]
+    valid = ((w_hi > 0) | (w_lo > 0)) & (jnp.arange(Sl) < sizes[:, None])
+    if exact:
+        a = dt.ln_numer(u)
+        w = w_hi.astype(jnp.float64) * 65536.0 + w_lo.astype(jnp.float64)
+        q = jnp.floor(a / jnp.maximum(w, 1.0))
+        q = q - (q * w > a)
+        q = q + ((q + 1.0) * w <= a)
+        q = jnp.where(valid, q, _INF)
+        return (jnp.argmin(q, axis=1).astype(jnp.int32),
+                jnp.zeros(u.shape[0], dtype=bool))
+    # one top_k(3) pass gives the two candidates AND the ambiguity
+    # sentinel (3rd value inside the margin) without re-running the
+    # hash/poly chain per reduction
+    w_f = w_hi * jnp.float32(65536.0) + w_lo
+    qa = _approx_numer_f32(u) / jnp.maximum(w_f, jnp.float32(1.0))
+    nega = jnp.where(valid, -qa, -jnp.float32(_INF))
+    k = min(3, Sl)
+    vals, idxs = jax.lax.top_k(nega, k)          # [L, k] largest of -qa
+    m1 = -vals[:, 0]
+    thr = m1 + margin
+    i1 = idxs[:, 0].astype(jnp.int32)
+    if Sl >= 2:
+        within2 = (-vals[:, 1]) <= thr
+        i2 = idxs[:, 1].astype(jnp.int32)
+    else:
+        within2 = jnp.zeros(u.shape[0], dtype=bool)
+        i2 = i1
+    if Sl >= 3:
+        ambig = ((-vals[:, 2]) <= thr) & jnp.isfinite(m1)
+    else:
+        ambig = jnp.zeros(u.shape[0], dtype=bool)
+    # exact compare between the pair, in index order (first-index wins
+    # exact ties, matching the scalar strict-'>' scan)
+    ia = jnp.where(within2, jnp.minimum(i1, i2), i1)
+    ib = jnp.where(within2, jnp.maximum(i1, i2), i1)
+    sel_a = jnp.arange(Sl)[None, :] == ia[:, None]
+    sel_b = jnp.arange(Sl)[None, :] == ib[:, None]
+
+    def pick2(t):
+        ti = t.astype(jnp.float32) if t.dtype == jnp.uint32 else t
+        a = jnp.where(sel_a, ti, 0).sum(axis=1)
+        b = jnp.where(sel_b, ti, 0).sum(axis=1)
+        return a, b
+
+    ua, ub = pick2(u.astype(jnp.int32))
+    wha, whb = pick2(w_hi)
+    wla, wlb = pick2(w_lo)
+    q2 = _exact_q2(dt, jnp.stack([ua, ub], -1).astype(jnp.int32),
+                   jnp.stack([wha, whb], -1), jnp.stack([wla, wlb], -1))
+    j = jnp.where(within2 & (q2[:, 1] < q2[:, 0]), ib, ia)
+    return j, ambig
+
+
+def _descend_batch(levels: List[_DevLevel], dt: DeviceTables,
+                   target_type: int, row0, x, r, want_leafrow: bool,
+                   exact: bool = False):
+    """Batched hierarchy walk: row0/x/r are [L]; returns
+    (item [L], status [L], leafrow [L], ambig [L]).  Statically
+    unrolled over levels; every level is one straw2 selection over that
+    level's width."""
+    L = x.shape[0]
+    cur = jnp.maximum(row0, 0)
+    done = row0 < 0
+    status = jnp.where(done, jnp.int32(_SKIP), jnp.int32(_REJECT))
+    result = jnp.full((L,), ITEM_NONE, dtype=jnp.int32)
+    leafrow = jnp.full((L,), -1, dtype=jnp.int32)
+    ambig = jnp.zeros((L,), dtype=bool)
+    xb = _u32(x)
+    rb = _u32(r)
+    for lvl in levels:
+        (items, ids, w_hi, w_lo, sizes, child_row, child_type,
+         child_escape, child_leafrow, margin) = lvl.rows(cur)
+        empty = sizes == 0
+        u = hashing.jx_hash3(xb[:, None], ids, rb[:, None]) & \
+            jnp.uint32(0xFFFF)
+        # materialize u: it feeds the top_k draw AND the exact top-2
+        # re-evaluation — without the barrier XLA re-runs the ~140-op
+        # hash chain for every consumer
+        u = jax.lax.optimization_barrier(u)
+        j, amb = _straw2_select(dt, u, w_hi, w_lo, sizes, margin, exact)
+        ambig = ambig | ((~done) & (~empty) & amb)
+        item, ctype, nrow, esc, lrow = lvl.select(
+            j, items, child_type, child_row, child_escape, child_leafrow)
+        is_dev = item >= 0
+        match = ctype == target_type
+        lvl_reject = empty
+        lvl_skip = (~empty) & (esc | ((~match) & is_dev))
+        lvl_done = lvl_reject | lvl_skip | ((~empty) & match & (~esc))
+        status = jnp.where(
+            done, status,
+            jnp.where(lvl_reject, _REJECT,
+                      jnp.where(lvl_skip, _SKIP,
+                                jnp.where(match, _OK, status))))
+        keep = done | (~match) | empty | esc
+        result = jnp.where(keep, result, item)
+        if want_leafrow:
+            leafrow = jnp.where(keep, leafrow, lrow)
+        new_done = done | lvl_done
+        cur = jnp.where(new_done, cur, nrow)
+        done = new_done
+    status = jnp.where(done, status, jnp.int32(_SKIP))
+    return result, status, leafrow, ambig
+
+
+# ------------------------------------------------------------- choose step --
+
+@dataclass(frozen=True)
+class _ChooseSpec:
+    """Static description of one choose step inside a take block."""
+    firstn: bool
+    leaf: bool
+    numrep: int
+    target_type: int
+    tries: int               # choose_total_tries + 1 (or rule override)
+    recurse_tries: int
+    vary_r: int
+    stable: bool
+    root: int                # static bucket id
+
+
+class _FastChoose:
+    """Candidate grids + unrolled resolve for one choose step."""
+
+    def __init__(self, cmap: CrushMap, cm: CompiledMap, dt: DeviceTables,
+                 spec: _ChooseSpec, strategy: str, extra: int,
+                 exact_select: bool = False):
+        self.spec = spec
+        self.strategy = strategy
+        self.dt = dt
+        self.exact_select = exact_select
+        self.max_devices = cm.max_devices
+        self.P = cm.n_positions
+        levels_h, leaf_class = _build_levels(
+            cmap, cm, [spec.root], spec.target_type)
+        # The compact [N, R] candidate grid models the weight-set
+        # position as 0 and (for stable chooseleaf) the leaf rep_base as
+        # 0.  That is exact when P == 1 (all positions identical) and
+        # stable=1.  Otherwise candidates are per (rep, f) with pos=rep
+        # assuming outpos == rep; a prior skip breaks the assumption and
+        # flags the lane for exact fallback.
+        self.per_rep = spec.firstn and (
+            self.P > 1 or (spec.leaf and not spec.stable))
+        if spec.firstn:
+            self.R = spec.numrep + extra
+            self.rounds = 0
+        else:
+            self.rounds = 1 + max(2, extra // 2)
+            self.R = spec.numrep * self.rounds
+        par_pos = list(range(spec.numrep)) if self.per_rep else [0]
+        self.levels = {p: [_DevLevel(h, p, strategy) for h in levels_h]
+                       for p in par_pos}
+        # leaf positions: firstn uses pos=outpos (grid: rep or 0);
+        # indep leaf uses pos=rep — per-rep tables only needed when P>1
+        self.leaf_levels: Dict[int, list] = {}
+        self.has_leaf = bool(spec.leaf and leaf_class)
+        if self.has_leaf:
+            lh, sub = _build_levels(cmap, cm, leaf_class, 0)
+            if sub:
+                raise UnsupportedMapError(
+                    "chooseleaf targets nest buckets of the same type")
+            if spec.firstn:
+                leaf_pos = par_pos
+            else:
+                leaf_pos = list(range(spec.numrep)) if self.P > 1 else [0]
+            self.leaf_levels = {
+                p: [_DevLevel(h, p, strategy) for h in lh]
+                for p in leaf_pos}
+
+    # ---- candidate grids -------------------------------------------------
+    def _descend_grid(self, levels, target_type, x, row0, rvals,
+                      want_leafrow):
+        """x [N]; row0/rvals [N, K] → (item, status, leafrow, ambig),
+        each [N, K]."""
+        N, K = rvals.shape
+        xg = jnp.repeat(x, K)
+        item, status, leafrow, ambig = _descend_batch(
+            levels, self.dt, target_type, row0.reshape(-1), xg,
+            rvals.reshape(-1).astype(jnp.int32), want_leafrow,
+            exact=self.exact_select)
+        return (item.reshape(N, K), status.reshape(N, K),
+                leafrow.reshape(N, K), ambig.reshape(N, K))
+
+    def parent_cands(self, x):
+        """→ (item, status, leafrow, ambig) each [N, G, R]."""
+        spec = self.spec
+        N = x.shape[0]
+        groups = list(range(spec.numrep)) if self.per_rep else [0]
+        rvals = jnp.broadcast_to(
+            jnp.arange(self.R, dtype=jnp.int32), (N, self.R))
+        outs = []
+        for g in groups:
+            row0 = jnp.zeros((N, self.R), dtype=jnp.int32)
+            outs.append(self._descend_grid(
+                self.levels[g], spec.target_type, x, row0, rvals,
+                self.has_leaf))
+        return tuple(jnp.stack([o[i] for o in outs], axis=1)
+                     for i in range(4))
+
+    def leaf_cands(self, x, p_leafrow):
+        """Leaf grids per parent candidate: [N, G, R, F'] (dev, status).
+
+        p_leafrow: [N, G, R].  The leaf r depends on the parent slot:
+        firstn: r' = rep_base + sub_r + ft (rep_base 0 when stable, rep
+        when per-rep); indep: r' = rep + r_parent + numrep·ft with
+        rep = r_parent mod numrep (slots are unique per rep).
+        """
+        spec = self.spec
+        N, G, R = p_leafrow.shape
+        rs = jnp.arange(R, dtype=jnp.int32)
+        devs, sts = [], []
+        ambig = jnp.zeros((N,), dtype=bool)
+        for g in range(G):
+            row0 = p_leafrow[:, g]                       # [N, R]
+            gdevs, gsts = [], []
+            for ft in range(spec.recurse_tries):
+                if spec.firstn:
+                    sub_r = (rs >> (spec.vary_r - 1)) if spec.vary_r \
+                        else jnp.zeros_like(rs)
+                    rep_base = g if (self.per_rep and not spec.stable) \
+                        else 0
+                    r_leaf = jnp.broadcast_to(
+                        rep_base + sub_r + ft, (N, R))
+                    lv = self.leaf_levels[g if self.per_rep else 0]
+                    dev, st, _, amb = self._descend_grid(
+                        lv, 0, x, row0, r_leaf, False)
+                    ambig = ambig | amb.any(axis=1)
+                else:
+                    # indep: rep = slot mod numrep; one sub-grid per rep
+                    # so each slot gets its rep-dependent r and (P>1)
+                    # its rep-positioned weight tables
+                    dev = jnp.full((N, R), jnp.int32(ITEM_NONE))
+                    st = jnp.full((N, R), jnp.int32(_SKIP))
+                    for rep in range(spec.numrep):
+                        slots = list(range(rep, R, spec.numrep))
+                        if not slots:
+                            continue
+                        sl = jnp.asarray(slots, dtype=jnp.int32)
+                        r_parent = jnp.broadcast_to(sl, (N, len(slots)))
+                        r_leaf = rep + r_parent + spec.numrep * ft
+                        lv = self.leaf_levels[rep if self.P > 1 else 0]
+                        d, s, _, amb = self._descend_grid(
+                            lv, 0, x, row0[:, sl], r_leaf, False)
+                        dev = dev.at[:, sl].set(d)
+                        st = st.at[:, sl].set(s)
+                        ambig = ambig | amb.any(axis=1)
+                gdevs.append(dev)
+                gsts.append(st)
+            devs.append(jnp.stack(gdevs, -1))
+            sts.append(jnp.stack(gsts, -1))
+        return jnp.stack(devs, 1), jnp.stack(sts, 1), ambig
+
+    # ---- execution -------------------------------------------------------
+    def run(self, x, weights, count_limit: int):
+        """count_limit: static int (result_max at rule level).
+        → (out [N,numrep], out2, got [N], incomplete [N])."""
+        spec = self.spec
+        N = x.shape[0]
+        p_item, p_status, p_leafrow, p_ambig = self.parent_cands(x)
+        ambig_lane = p_ambig.reshape(N, -1).any(axis=1)
+        leaf_pack = None
+        if spec.leaf:
+            if self.has_leaf:
+                l_dev, l_st, l_amb = self.leaf_cands(x, p_leafrow)
+                ambig_lane = ambig_lane | l_amb
+            else:
+                shape = p_item.shape + (spec.recurse_tries,)
+                l_dev = jnp.full(shape, jnp.int32(ITEM_NONE))
+                l_st = jnp.full(shape, jnp.int32(_SKIP))
+            l_out = _is_out_batch(
+                weights, l_dev.reshape(-1),
+                jnp.repeat(x, l_dev.size // N),
+                self.strategy).reshape(l_dev.shape)
+            leaf_pack = (l_dev, l_st, l_out)
+        if spec.target_type == 0:
+            p_out = _is_out_batch(
+                weights, p_item.reshape(-1),
+                jnp.repeat(x, p_item.size // N),
+                self.strategy).reshape(p_item.shape)
+        else:
+            p_out = jnp.zeros(p_item.shape, dtype=bool)
+        if spec.firstn:
+            out, out2, got, inc = self._resolve_firstn(
+                p_item, p_status, p_out, leaf_pack, count_limit)
+        else:
+            out, out2, got, inc = self._resolve_indep(
+                p_item, p_status, p_out, leaf_pack, count_limit)
+        return out, out2, got, inc | ambig_lane
+
+    def _leaf_resolve(self, leaf_pack, g, r, out2, outpos, windowed):
+        """Walk the leaf retry chain for slot (g, r) against current
+        out2 state → (leaf_dev [N], leaf_ok [N])."""
+        l_dev, l_st, l_is_out = leaf_pack
+        N = l_dev.shape[0]
+        NONE = jnp.int32(ITEM_NONE)
+        slot_ids = jnp.arange(out2.shape[1])
+        ldev = jnp.full((N,), NONE)
+        lok = jnp.zeros((N,), dtype=bool)
+        ldone = jnp.zeros((N,), dtype=bool)
+        for ft in range(l_dev.shape[-1]):
+            d = l_dev[:, g, r, ft]
+            st = l_st[:, g, r, ft]
+            lo = l_is_out[:, g, r, ft]
+            if windowed:
+                lcol = jnp.any(
+                    (slot_ids[None, :] < outpos[:, None]) &
+                    (out2 == d[:, None]), axis=1)
+            else:
+                lcol = jnp.zeros((N,), dtype=bool)
+            succ = (~ldone) & (st == _OK) & (~lcol) & (~lo)
+            hard = (~ldone) & (st == _SKIP)
+            ldev = jnp.where(succ, d, ldev)
+            lok = lok | succ
+            ldone = ldone | succ | hard
+        return ldev, lok
+
+    def _resolve_firstn(self, p_item, p_status, p_out, leaf_pack,
+                        count_limit: int):
+        spec = self.spec
+        N = p_item.shape[0]
+        R_out = spec.numrep
+        NONE = jnp.int32(ITEM_NONE)
+        out = jnp.full((N, R_out), NONE)
+        out2 = jnp.full((N, R_out), NONE)
+        outpos = jnp.zeros((N,), dtype=jnp.int32)
+        incomplete = jnp.zeros((N,), dtype=bool)
+        slot_ids = jnp.arange(R_out)
+        for rep in range(spec.numrep):
+            g = rep if self.per_rep else 0
+            placed = jnp.zeros((N,), dtype=bool)
+            skipped = jnp.zeros((N,), dtype=bool)
+            item_sel = jnp.full((N,), NONE)
+            leaf_sel = jnp.full((N,), NONE)
+            budget = self.R - rep
+            for f in range(min(budget, spec.tries)):
+                r = rep + f
+                item = p_item[:, g, r]
+                status = p_status[:, g, r]
+                collide = jnp.any(
+                    (slot_ids[None, :] < outpos[:, None]) &
+                    (out == item[:, None]), axis=1)
+                reject = status == _REJECT
+                if spec.leaf:
+                    ldev, lok = self._leaf_resolve(
+                        leaf_pack, g, r, out2, outpos, windowed=True)
+                    is_bucket = item < 0
+                    leaf_val = jnp.where(is_bucket, ldev, item)
+                    reject = reject | (
+                        (status == _OK) & (~collide) & is_bucket & (~lok))
+                else:
+                    leaf_val = jnp.full((N,), NONE)
+                if spec.target_type == 0:
+                    reject = reject | (
+                        (status == _OK) & (~collide) & p_out[:, g, r])
+                ok = (status == _OK) & (~collide) & (~reject)
+                skip = status == _SKIP
+                active = (~placed) & (~skipped)
+                place_now = active & ok
+                item_sel = jnp.where(place_now, item, item_sel)
+                if spec.leaf:
+                    leaf_sel = jnp.where(place_now, leaf_val, leaf_sel)
+                placed = placed | place_now
+                skipped = skipped | (active & skip)
+            if budget < spec.tries:
+                incomplete = incomplete | ((~placed) & (~skipped))
+            if self.per_rep:
+                # grids assumed outpos == rep (pos / leaf rep_base)
+                incomplete = incomplete | (placed & (outpos != rep))
+            do_place = placed & (outpos < count_limit)
+            sel = do_place[:, None] & (slot_ids[None, :] == outpos[:, None])
+            out = jnp.where(sel, item_sel[:, None], out)
+            if spec.leaf:
+                out2 = jnp.where(sel, leaf_sel[:, None], out2)
+            outpos = outpos + do_place.astype(jnp.int32)
+        return out, out2, outpos, incomplete
+
+    def _resolve_indep(self, p_item, p_status, p_out, leaf_pack,
+                       count_limit: int):
+        spec = self.spec
+        N = p_item.shape[0]
+        R_out = spec.numrep
+        limit = min(spec.numrep, count_limit)
+        NONE = jnp.int32(ITEM_NONE)
+        UNDEF = jnp.int32(ITEM_UNDEF)
+        active = jnp.broadcast_to(jnp.arange(R_out) < limit, (N, R_out))
+        out = jnp.where(active, UNDEF, NONE)
+        out2 = jnp.where(active, UNDEF, NONE)
+        dummy_pos = jnp.zeros((N,), dtype=jnp.int32)
+        for f in range(self.rounds):
+            for rep in range(min(spec.numrep, limit)):
+                r = rep + spec.numrep * f
+                if r >= self.R:
+                    continue
+                item = p_item[:, 0, r]
+                status = p_status[:, 0, r]
+                pending = active[:, rep] & (out[:, rep] == UNDEF)
+                collide = jnp.any(out == item[:, None], axis=1)
+                hard = status == _SKIP
+                if spec.leaf:
+                    ldev, _ = self._leaf_resolve(
+                        leaf_pack, 0, r, out2, dummy_pos, windowed=False)
+                    is_bucket = item < 0
+                    leaf_val = jnp.where(is_bucket, ldev, item)
+                    leaf_fail = is_bucket & (ldev == NONE)
+                else:
+                    leaf_val = jnp.full((N,), NONE)
+                    leaf_fail = jnp.zeros((N,), dtype=bool)
+                out_dev = (status == _OK) & p_out[:, 0, r] \
+                    if spec.target_type == 0 \
+                    else jnp.zeros((N,), dtype=bool)
+                ok = (status == _OK) & (~collide) & (~leaf_fail) & \
+                    (~out_dev)
+                place = pending & ok
+                out = out.at[:, rep].set(
+                    jnp.where(place, item, out[:, rep]))
+                out2 = out2.at[:, rep].set(
+                    jnp.where(place, leaf_val, out2[:, rep]))
+                pin = pending & hard & (~ok)
+                out = out.at[:, rep].set(jnp.where(pin, NONE, out[:, rep]))
+                out2 = out2.at[:, rep].set(
+                    jnp.where(pin, NONE, out2[:, rep]))
+        incomplete = jnp.any(out == UNDEF, axis=1) \
+            if self.rounds < spec.tries \
+            else jnp.zeros((N,), dtype=bool)
+        out = jnp.where(out == UNDEF, NONE, out)
+        out2 = jnp.where(out2 == UNDEF, NONE, out2)
+        got = jnp.full((N,), jnp.int32(limit))
+        return out, out2, got, incomplete
+
+
+# ------------------------------------------------------------ rule driver ---
+
+class FastMapper:
+    """Candidate-parallel batched do_rule for one CrushMap.
+
+    map_batch returns (results [N, result_max], incomplete [N]): lanes
+    flagged incomplete must be recomputed by a bit-exact fallback (the
+    native C++ mapper or the scalar oracle).
+    """
+
+    def __init__(self, cmap: CrushMap, choose_args_key: object = None,
+                 strategy: Optional[str] = None,
+                 extra_tries: Optional[int] = None):
+        self.cmap = cmap
+        self.compiled = compile_map(cmap, choose_args_key, n_positions=1)
+        if strategy is None:
+            strategy = os.environ.get("CEPH_TPU_LOOKUP")
+        if strategy is None:
+            strategy = "gather" if jax.devices()[0].platform == "cpu" \
+                else "onehot"
+        self.strategy = strategy
+        self.dt = self.compiled.tables(strategy)
+        if extra_tries is None:
+            extra_tries = int(os.environ.get("CEPH_TPU_FASTMAP_EXTRA", "8"))
+        self.extra = max(2, extra_tries)
+        self.exact_select = \
+            os.environ.get("CEPH_TPU_SELECT", "approx") == "exact"
+        self._jitted = {}
+        self._plans: Dict[Tuple[int, int], list] = {}
+
+    # ---- host-side rule analysis ----------------------------------------
+    def _plan(self, ruleno: int, result_max: int) -> list:
+        """Parse the rule into a static plan:
+        ("choose", _FastChoose) | ("choose_dead",) | ("emit_take", item)
+        | ("emit",)."""
+        key = (ruleno, result_max)
+        if key in self._plans:
+            return self._plans[key]
+        cmap = self.cmap
+        t = cmap.tunables
+        rule = cmap.rules[ruleno]
+        choose_tries = t.choose_total_tries + 1
+        choose_leaf_tries = 0
+        vary_r = t.chooseleaf_vary_r
+        stable = bool(t.chooseleaf_stable)
+        plan = []
+        pending_take: Optional[int] = None
+        took_choose = False
+        for op, arg1, arg2 in rule.steps:
+            if op == RULE_TAKE:
+                pending_take = arg1
+                took_choose = False
+            elif op == RULE_SET_CHOOSE_TRIES:
+                if arg1 > 0:
+                    choose_tries = arg1
+            elif op == RULE_SET_CHOOSELEAF_TRIES:
+                if arg1 > 0:
+                    choose_leaf_tries = arg1
+            elif op in (RULE_SET_CHOOSE_LOCAL_TRIES,
+                        RULE_SET_CHOOSE_LOCAL_FALLBACK_TRIES):
+                if arg1 > 0:
+                    raise UnsupportedMapError("local_tries rule step")
+            elif op == RULE_SET_CHOOSELEAF_VARY_R:
+                if arg1 >= 0:
+                    vary_r = arg1
+            elif op == RULE_SET_CHOOSELEAF_STABLE:
+                if arg1 >= 0:
+                    stable = bool(arg1)
+            elif op in (RULE_CHOOSE_FIRSTN, RULE_CHOOSELEAF_FIRSTN,
+                        RULE_CHOOSE_INDEP, RULE_CHOOSELEAF_INDEP):
+                if took_choose:
+                    raise UnsupportedRuleError(
+                        "chained choose steps (choose feeding choose)")
+                took_choose = True
+                firstn = op in (RULE_CHOOSE_FIRSTN, RULE_CHOOSELEAF_FIRSTN)
+                leaf = op in (RULE_CHOOSELEAF_FIRSTN, RULE_CHOOSELEAF_INDEP)
+                numrep = arg1
+                if numrep <= 0:
+                    numrep += result_max
+                    if numrep <= 0:
+                        took_choose = False
+                        continue
+                if firstn:
+                    if choose_leaf_tries:
+                        recurse_tries = choose_leaf_tries
+                    elif t.chooseleaf_descend_once:
+                        recurse_tries = 1
+                    else:
+                        recurse_tries = choose_tries
+                else:
+                    recurse_tries = choose_leaf_tries or 1
+                if recurse_tries > 4:
+                    raise UnsupportedRuleError(
+                        f"recurse_tries {recurse_tries} too large for "
+                        "the candidate grid")
+                if pending_take is None or pending_take >= 0 or \
+                        cmap.bucket(pending_take) is None:
+                    plan.append(("choose_dead",))
+                    continue
+                spec = _ChooseSpec(
+                    firstn=firstn, leaf=leaf, numrep=numrep,
+                    target_type=arg2, tries=choose_tries,
+                    recurse_tries=recurse_tries, vary_r=vary_r,
+                    stable=stable, root=pending_take)
+                plan.append(("choose", _FastChoose(
+                    cmap, self.compiled, self.dt, spec, self.strategy,
+                    self.extra, exact_select=self.exact_select)))
+            elif op == RULE_EMIT:
+                if not took_choose and pending_take is not None:
+                    ok = (0 <= pending_take < cmap.max_devices) or \
+                        (cmap.bucket(pending_take) is not None)
+                    plan.append(("emit_take",
+                                 pending_take if ok else None))
+                else:
+                    plan.append(("emit",))
+                pending_take = None
+                took_choose = False
+            else:
+                raise UnsupportedRuleError(f"rule op {op}")
+        self._plans[key] = plan
+        return plan
+
+    def _trace(self, plan, result_max: int, xs, weights):
+        N = xs.shape[0]
+        NONE = jnp.int32(ITEM_NONE)
+        result = jnp.full((N, result_max), NONE)
+        rpos = jnp.zeros((N,), dtype=jnp.int32)
+        incomplete = jnp.zeros((N,), dtype=bool)
+        res_ids = jnp.arange(result_max)
+        pend_out = None            # (vals [N, n], count [N]) awaiting emit
+        x = xs.astype(jnp.int32)
+        for entry in plan:
+            kind = entry[0]
+            if kind == "choose":
+                fc: _FastChoose = entry[1]
+                out, out2, got, inc = fc.run(x, weights, result_max)
+                incomplete = incomplete | inc
+                pend_out = (out2 if fc.spec.leaf else out, got)
+            elif kind == "choose_dead":
+                pend_out = (jnp.full((N, 1), NONE),
+                            jnp.zeros((N,), dtype=jnp.int32))
+            elif kind == "emit_take":
+                if entry[1] is None:
+                    pend_out = None
+                    continue
+                can = rpos < result_max
+                sel = can[:, None] & (res_ids[None, :] == rpos[:, None])
+                result = jnp.where(sel, jnp.int32(entry[1]), result)
+                rpos = rpos + can.astype(jnp.int32)
+                pend_out = None
+            else:   # emit
+                if pend_out is None:
+                    continue
+                vals, count = pend_out
+                for i in range(vals.shape[1]):
+                    ok = (i < count) & (rpos < result_max)
+                    sel = ok[:, None] & (res_ids[None, :] == rpos[:, None])
+                    result = jnp.where(sel, vals[:, i:i + 1], result)
+                    rpos = rpos + ok.astype(jnp.int32)
+                pend_out = None
+        return result, incomplete
+
+    # ---- public ----------------------------------------------------------
+    def _get_jitted(self, ruleno: int, result_max: int, mesh=None):
+        from ..parallel.mesh import mesh_cache_key
+        key = (ruleno, result_max,
+               mesh_cache_key(mesh) if mesh is not None else None)
+        if key not in self._jitted:
+            plan = self._plan(ruleno, result_max)
+            fn = functools.partial(self._trace, plan, result_max)
+            if mesh is None:
+                self._jitted[key] = jax.jit(fn)
+            else:
+                from jax.sharding import NamedSharding, PartitionSpec as P
+                axis = mesh.axis_names[0]
+                batch = NamedSharding(mesh, P(axis))
+                repl = NamedSharding(mesh, P())
+                self._jitted[key] = jax.jit(
+                    fn, in_shardings=(batch, repl),
+                    out_shardings=(batch, batch))
+        return self._jitted[key]
+
+    def grid_width(self, ruleno: int, result_max: int) -> int:
+        return max((e[1].R * (e[1].spec.numrep if e[1].per_rep else 1)
+                    for e in self._plan(ruleno, result_max)
+                    if e[0] == "choose"), default=1)
+
+    # candidate grids multiply lane width by R·G; cap device working set
+    MAX_GRID_LANES_PER_CALL = 1 << 21
+
+    def map_batch(self, ruleno: int, xs, result_max: int,
+                  weights: Sequence[int], mesh=None
+                  ) -> Tuple[np.ndarray, np.ndarray]:
+        """→ (results [N, result_max] i32, incomplete [N] bool)."""
+        if ruleno < 0 or ruleno >= self.cmap.max_rules or \
+                self.cmap.rules[ruleno] is None:
+            raise ValueError(f"no rule {ruleno}")
+        self._plan(ruleno, result_max)       # raise Unsupported early
+        jitted = self._get_jitted(ruleno, result_max, mesh)
+        w = np.zeros(self.compiled.max_devices, dtype=np.int32)
+        w_in = np.asarray(weights, dtype=np.int64)
+        w[:min(len(w_in), len(w))] = w_in[:len(w)]
+        xs_np = np.asarray(xs, dtype=np.int64).astype(np.uint32) \
+            .astype(np.int32)
+        n = len(xs_np)
+        gw = self.grid_width(ruleno, result_max)
+        cap = max(1 << 12, self.MAX_GRID_LANES_PER_CALL // gw)
+        cap *= (mesh.size if mesh is not None else 1)
+        if n > cap:
+            pad = (-n) % cap
+            xs_pad = np.concatenate([xs_np, xs_np[:1].repeat(pad)]) \
+                if pad else xs_np
+            outs, incs = [], []
+            for i in range(0, len(xs_pad), cap):
+                o, inc = self.map_batch(ruleno, xs_pad[i:i + cap],
+                                        result_max, weights, mesh)
+                outs.append(o)
+                incs.append(inc)
+            return np.concatenate(outs)[:n], np.concatenate(incs)[:n]
+        if mesh is not None:
+            pad = (-n) % mesh.size
+            if pad:
+                xs_np = np.concatenate([xs_np, xs_np[:1].repeat(pad)])
+        out, inc = jitted(jnp.asarray(xs_np), jnp.asarray(w))
+        return np.asarray(out)[:n], np.asarray(inc)[:n]

@@ -590,9 +590,17 @@ class XlaMapper:
     """
 
     def __init__(self, cmap: CrushMap, choose_args_key: object = None,
-                 n_positions: int = 8, strategy: Optional[str] = None):
+                 n_positions: int = 8, strategy: Optional[str] = None,
+                 fast: Optional[bool] = None):
         self.cmap = cmap
+        self.choose_args_key = choose_args_key
         self.compiled = compile_map(cmap, choose_args_key, n_positions)
+        if fast is None:
+            fast = os.environ.get("CEPH_TPU_FASTMAP", "1") != "0"
+        self._fast_enabled = fast
+        self._fast = None                 # lazy FastMapper
+        self._fast_unsupported = set()    # rule keys outside fast subset
+        self._exact_fallback = None       # lazy NativeMapper/scalar fn
         auto = False
         if strategy is None:
             strategy = os.environ.get("CEPH_TPU_LOOKUP")
@@ -753,16 +761,65 @@ class XlaMapper:
     # sweep streams chunks through one compiled executable)
     MAX_LANES_PER_CALL = 1 << 17
 
+    def _exact_rows(self, ruleno: int, xs_rows, result_max: int, weights):
+        """Bit-exact recompute for fallback lanes: the native C++
+        interpreter when buildable, else the scalar oracle."""
+        if self._exact_fallback is None:
+            try:
+                from ..native_bridge import NativeMapper
+                nm = NativeMapper(self.cmap,
+                                  choose_args_key=self.choose_args_key)
+                self._exact_fallback = (
+                    lambda rn, xr, rm, w: nm.map_batch(rn, xr, rm, w))
+            except Exception:
+                args = self.cmap.choose_args.get(self.choose_args_key) \
+                    if self.choose_args_key is not None else None
+
+                def scalar_rows(rn, xr, rm, w):
+                    res = np.full((len(xr), rm), ITEM_NONE, dtype=np.int32)
+                    for i, xv in enumerate(xr):
+                        got = scalar_do_rule(self.cmap, rn, int(xv), rm,
+                                             list(w), choose_args=args)
+                        res[i, :len(got)] = got
+                    return res
+
+                from .scalar_mapper import do_rule as scalar_do_rule
+                self._exact_fallback = scalar_rows
+        return self._exact_fallback(ruleno, xs_rows, result_max, weights)
+
     def map_batch(self, ruleno: int, xs, result_max: int,
                   weights: Sequence[int], mesh=None) -> np.ndarray:
         """[N] x values -> [N, result_max] i32 osd ids (ITEM_NONE padded).
 
         With ``mesh``, the x axis is sharded across the device mesh (the
         multi-chip ParallelPGMapper); N is padded to the mesh size.
+
+        Dispatch: the level-synchronous FastMapper handles supported
+        rules (with incomplete lanes recomputed bit-exactly host-side);
+        rules outside its subset run the general vmapped trace below.
         """
         if ruleno < 0 or ruleno >= self.cmap.max_rules or \
                 self.cmap.rules[ruleno] is None:
             raise ValueError(f"no rule {ruleno}")
+        fkey = (ruleno, result_max)
+        if self._fast_enabled and fkey not in self._fast_unsupported:
+            try:
+                if self._fast is None:
+                    from .fast_mapper import FastMapper
+                    self._fast = FastMapper(
+                        self.cmap, choose_args_key=self.choose_args_key,
+                        strategy=self.tables.strategy)
+                out, inc = self._fast.map_batch(
+                    ruleno, xs, result_max, weights, mesh=mesh)
+                if inc.any():
+                    rows = np.flatnonzero(inc)
+                    xs_np = np.asarray(xs, dtype=np.int64)[rows]
+                    out = np.array(out)    # jax arrays are read-only
+                    out[rows] = self._exact_rows(
+                        ruleno, xs_np, result_max, weights)
+                return out
+            except UnsupportedMapError:
+                self._fast_unsupported.add(fkey)
         jitted = self._get_jitted(ruleno, result_max, mesh)
         w = np.zeros(self.compiled.max_devices, dtype=np.int32)
         w_in = np.asarray(weights, dtype=np.int64)
