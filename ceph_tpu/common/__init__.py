@@ -1,0 +1,7 @@
+from .admin import AdminServer, admin_request
+from .options import Option, OptionError, Options, config
+from .perf_counters import PerfCounters, PerfCountersCollection, perf
+
+__all__ = ["AdminServer", "admin_request",
+           "Option", "OptionError", "Options", "config",
+           "PerfCounters", "PerfCountersCollection", "perf"]

@@ -1,0 +1,254 @@
+"""Typed configuration registry — the L0 config/flag substrate.
+
+Plays the role of the reference's single typed options table
+(src/common/options.cc — ~1,704 `Option` rows with type / default /
+min / max / enum / description) and its layered `md_config_t`
+(src/common/config.{h,cc}): compiled defaults < config file < env
+< runtime `set`, with observer callbacks for live reconfig
+(src/common/config_obs.h).
+
+Design differences from the reference (deliberate, TPU-native):
+  * the table is tiny and grows with the framework — every tunable the
+    runtime reads (lookup strategy, lane caps, cache capacities) is
+    REQUIRED to come from here, so a `config().dump()` shows the entire
+    knob surface the way `ceph daemon ... config show` does;
+  * values are plain Python scalars — the accelerator never sees the
+    registry, only operands derived from it at dispatch time.
+
+Env layering: option `foo_bar` reads `CEPH_TPU_FOO_BAR` (the round-1
+ad-hoc env names are preserved as `env` aliases where they differed).
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+TYPE_INT = "int"
+TYPE_FLOAT = "float"
+TYPE_BOOL = "bool"
+TYPE_STR = "str"
+
+# precedence of value sources, low to high (reference: config layering,
+# src/common/config.cc — default < file < env < runtime override)
+LEVEL_DEFAULT = 0
+LEVEL_FILE = 1
+LEVEL_ENV = 2
+LEVEL_RUNTIME = 3
+
+
+class OptionError(ValueError):
+    pass
+
+
+@dataclass(frozen=True)
+class Option:
+    """One typed knob (reference schema: src/common/options.h)."""
+    name: str
+    type: str
+    default: Any
+    desc: str = ""
+    min: Optional[float] = None
+    max: Optional[float] = None
+    enum_values: Optional[Tuple[str, ...]] = None
+    env: Optional[str] = None            # env var override (default derived)
+    runtime: bool = True                 # changeable after startup
+
+    def env_var(self) -> str:
+        return self.env or ("CEPH_TPU_" + self.name.upper())
+
+    def coerce(self, value: Any) -> Any:
+        try:
+            if self.type == TYPE_INT:
+                v = int(value)
+            elif self.type == TYPE_FLOAT:
+                v = float(value)
+            elif self.type == TYPE_BOOL:
+                if isinstance(value, str):
+                    lv = value.strip().lower()
+                    if lv in ("1", "true", "yes", "on"):
+                        v = True
+                    elif lv in ("0", "false", "no", "off"):
+                        v = False
+                    else:
+                        raise OptionError(
+                            f"{self.name}: bad bool {value!r}")
+                else:
+                    v = bool(value)
+            elif self.type == TYPE_STR:
+                v = str(value)
+            else:
+                raise OptionError(f"{self.name}: unknown type {self.type}")
+        except (TypeError, ValueError) as e:
+            raise OptionError(f"{self.name}: {e}") from e
+        if self.min is not None and v < self.min:
+            raise OptionError(f"{self.name}: {v} < min {self.min}")
+        if self.max is not None and v > self.max:
+            raise OptionError(f"{self.name}: {v} > max {self.max}")
+        if self.enum_values is not None and v not in self.enum_values:
+            raise OptionError(
+                f"{self.name}: {v!r} not in {self.enum_values}")
+        return v
+
+
+class Options:
+    """The registry + layered value store."""
+
+    def __init__(self, table: Sequence[Option] = ()):
+        self._lock = threading.RLock()
+        self._schema: Dict[str, Option] = {}
+        # name -> {level: value}
+        self._values: Dict[str, Dict[int, Any]] = {}
+        self._observers: Dict[str, List[Callable[[str, Any], None]]] = {}
+        for opt in table:
+            self.register(opt)
+
+    # ------------------------------------------------------------ schema --
+    def register(self, opt: Option) -> None:
+        with self._lock:
+            if opt.name in self._schema:
+                raise OptionError(f"duplicate option {opt.name}")
+            self._schema[opt.name] = opt
+
+    def schema(self, name: str) -> Option:
+        try:
+            return self._schema[name]
+        except KeyError:
+            raise OptionError(f"unknown option {name}") from None
+
+    def names(self) -> List[str]:
+        return sorted(self._schema)
+
+    # ------------------------------------------------------------ values --
+    def get(self, name: str) -> Any:
+        opt = self.schema(name)
+        with self._lock:
+            levels = self._values.get(name, {})
+            if LEVEL_RUNTIME in levels:
+                return levels[LEVEL_RUNTIME]
+            if LEVEL_ENV in levels:
+                return levels[LEVEL_ENV]
+            ev = os.environ.get(opt.env_var())
+            if ev is not None:
+                # malformed env fails LOUDLY: silently regressing an
+                # operator's setting to the default is worse than a crash
+                return opt.coerce(ev)
+            if LEVEL_FILE in levels:
+                return levels[LEVEL_FILE]
+            return opt.default
+
+    def __getitem__(self, name: str) -> Any:
+        return self.get(name)
+
+    def set(self, name: str, value: Any, level: int = LEVEL_RUNTIME) -> Any:
+        opt = self.schema(name)
+        if level == LEVEL_RUNTIME and not opt.runtime:
+            raise OptionError(f"{name} is not runtime-changeable")
+        v = opt.coerce(value)
+        with self._lock:
+            self._values.setdefault(name, {})[level] = v
+            obs = list(self._observers.get(name, ()))
+        for cb in obs:
+            cb(name, v)
+        return v
+
+    def clear(self, name: str, level: int = LEVEL_RUNTIME) -> None:
+        with self._lock:
+            self._values.get(name, {}).pop(level, None)
+
+    def load_file(self, path: str) -> None:
+        """JSON config file: {"option": value, ...} at LEVEL_FILE."""
+        with open(path) as f:
+            data = json.load(f)
+        if not isinstance(data, dict):
+            raise OptionError(f"{path}: expected a JSON object")
+        for k, v in data.items():
+            self.set(k, v, level=LEVEL_FILE)
+
+    # --------------------------------------------------------- observers --
+    def observe(self, name: str, cb: Callable[[str, Any], None]) -> None:
+        """Live-reconfig callback (reference: config_obs.h)."""
+        self.schema(name)
+        with self._lock:
+            self._observers.setdefault(name, []).append(cb)
+
+    # -------------------------------------------------------------- dump --
+    def dump(self) -> Dict[str, Dict[str, Any]]:
+        """`config show`-style dump: value + provenance per option."""
+        out: Dict[str, Dict[str, Any]] = {}
+        with self._lock:
+            for name, opt in sorted(self._schema.items()):
+                levels = self._values.get(name, {})
+                if LEVEL_RUNTIME in levels:
+                    src = "runtime"
+                elif LEVEL_ENV in levels or \
+                        os.environ.get(opt.env_var()) is not None:
+                    src = "env"
+                elif LEVEL_FILE in levels:
+                    src = "file"
+                else:
+                    src = "default"
+                try:
+                    value = self.get(name)
+                except OptionError as e:
+                    value, src = f"<invalid: {e}>", "env"
+                out[name] = {"value": value, "source": src,
+                             "type": opt.type, "desc": opt.desc}
+        return out
+
+
+# ---------------------------------------------------------------- table ----
+# The framework-wide knob table.  Round-1 env names are kept as aliases
+# so existing workflows keep working (CEPH_TPU_LOOKUP etc.).
+_TABLE: Tuple[Option, ...] = (
+    Option("lookup_strategy", TYPE_STR, "auto",
+           "device table lookup lowering: auto picks gather on CPU, "
+           "onehot (MXU matmul) on accelerators",
+           enum_values=("auto", "gather", "onehot"), env="CEPH_TPU_LOOKUP"),
+    Option("fastmap_enabled", TYPE_BOOL, True,
+           "use the level-synchronous candidate-grid CRUSH mapper for "
+           "supported rules", env="CEPH_TPU_FASTMAP"),
+    Option("fastmap_extra_tries", TYPE_INT, 8,
+           "extra retry candidates per replica slot in the fast mapper "
+           "grid (lanes exceeding it fall back to the exact path)",
+           min=2, max=64, env="CEPH_TPU_FASTMAP_EXTRA"),
+    Option("straw2_select", TYPE_STR, "approx",
+           "straw2 argmin mode: approx = f32 polynomial prefilter + "
+           "exact top-2 re-check; exact = full-width fixed-point LUT",
+           enum_values=("approx", "exact"), env="CEPH_TPU_SELECT"),
+    Option("mapper_max_lanes_per_call", TYPE_INT, 1 << 17,
+           "general mapper: max x lanes per device dispatch (one-hot "
+           "intermediates are ~S*385 bytes per lane-level; keep the "
+           "working set inside HBM)", min=1 << 10),
+    Option("fastmap_max_grid_lanes", TYPE_INT, 1 << 21,
+           "fast mapper: max (lane x candidate) product per dispatch",
+           min=1 << 12),
+    Option("ec_table_cache_size", TYPE_INT, 2516,
+           "decode-matrix LRU entries per codec (reference: "
+           "ErasureCodeIsaTableCache.h:35)", min=1),
+    Option("ec_batch_max_bytes", TYPE_INT, 1 << 30,
+           "max payload bytes per batched encode/decode dispatch",
+           min=1 << 16),
+    Option("erasure_code_default_plugin", TYPE_STR, "jax",
+           "plugin used when a profile names none (reference: "
+           "osd_pool_default_erasure_code_profile, options.cc:2748)"),
+    Option("perf_counters_enabled", TYPE_BOOL, True,
+           "collect dispatch/cache/bytes counters"),
+    Option("log_level", TYPE_INT, 1,
+           "0=errors 1=info 2=debug (dout gather-level analog)",
+           min=0, max=5),
+)
+
+_config: Optional[Options] = None
+_config_lock = threading.Lock()
+
+
+def config() -> Options:
+    """The process-wide registry (CephContext._conf analog)."""
+    global _config
+    with _config_lock:
+        if _config is None:
+            _config = Options(_TABLE)
+        return _config

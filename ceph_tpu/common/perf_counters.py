@@ -1,0 +1,179 @@
+"""Perf counters — the L0 metrics substrate.
+
+Role of the reference's `PerfCounters` (src/common/perf_counters.h:
+typed u64 counters / gauges / long-run latency averages, grouped per
+subsystem, dumped as JSON over the admin socket via `perf dump`) and of
+the OSD's counter set (src/osd/osd_perf_counters.cc).
+
+TPU-native counter set: what matters on this runtime is device
+dispatches (compiles vs cached executions), bytes moved host<->device,
+batch occupancies, and table-cache hit rates — those are the knobs that
+decide whether the MXU stays fed.  Counters are cheap (dict + lock) and
+always safe to leave enabled; `perf_counters_enabled=false` turns the
+`inc` calls into no-ops for hot host loops.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from .options import OptionError, config
+
+COUNTER = "counter"      # monotonically increasing u64
+GAUGE = "gauge"          # instantaneous value
+TIME_AVG = "time_avg"    # (sum_seconds, count) -> avg latency
+
+# hot-path switch: counter updates happen per device dispatch, so the
+# enabled flag is cached module-level and kept fresh by a config
+# observer instead of re-resolving the layered registry per inc()
+_enabled: Optional[bool] = None
+
+
+def _counters_enabled() -> bool:
+    global _enabled
+    if _enabled is None:
+        cfg = config()
+        try:
+            _enabled = bool(cfg.get("perf_counters_enabled"))
+        except OptionError:
+            _enabled = True
+
+        def _refresh(_name, value):
+            global _enabled
+            _enabled = bool(value)
+
+        cfg.observe("perf_counters_enabled", _refresh)
+    return _enabled
+
+
+class PerfCounters:
+    """One named group of counters (a daemon-subsystem analog)."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self._lock = threading.Lock()
+        self._types: Dict[str, str] = {}
+        self._vals: Dict[str, Any] = {}
+
+    def add_counter(self, key: str, desc: str = "") -> None:
+        self._declare(key, COUNTER, 0)
+
+    def add_gauge(self, key: str, desc: str = "") -> None:
+        self._declare(key, GAUGE, 0)
+
+    def add_time_avg(self, key: str, desc: str = "") -> None:
+        self._declare(key, TIME_AVG, (0.0, 0))
+
+    def _declare(self, key: str, typ: str, init: Any) -> None:
+        with self._lock:
+            if key not in self._types:
+                self._types[key] = typ
+                self._vals[key] = init
+
+    # ------------------------------------------------------------ update --
+    def inc(self, key: str, by: int = 1) -> None:
+        if not _counters_enabled():
+            return
+        with self._lock:
+            if key not in self._types:
+                self._types[key] = COUNTER
+                self._vals[key] = 0
+            self._vals[key] += by
+
+    def set(self, key: str, value: Any) -> None:
+        if not _counters_enabled():
+            return
+        with self._lock:
+            self._types[key] = GAUGE
+            self._vals[key] = value
+
+    def tinc(self, key: str, seconds: float) -> None:
+        if not _counters_enabled():
+            return
+        with self._lock:
+            if self._types.get(key) != TIME_AVG:
+                self._types[key] = TIME_AVG
+                self._vals[key] = (0.0, 0)
+            s, n = self._vals[key]
+            self._vals[key] = (s + seconds, n + 1)
+
+    def time(self, key: str):
+        """Context manager: `with counters.time("map_batch_s"): ...`."""
+        return _Timer(self, key)
+
+    # -------------------------------------------------------------- read --
+    def get(self, key: str) -> Any:
+        with self._lock:
+            return self._vals.get(key)
+
+    def dump(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {}
+        with self._lock:
+            for key, typ in sorted(self._types.items()):
+                v = self._vals[key]
+                if typ == TIME_AVG:
+                    s, n = v
+                    out[key] = {"avgcount": n, "sum": round(s, 9),
+                                "avgtime": round(s / n, 9) if n else 0.0}
+                else:
+                    out[key] = v
+        return out
+
+    def reset(self) -> None:
+        with self._lock:
+            for key, typ in self._types.items():
+                self._vals[key] = (0.0, 0) if typ == TIME_AVG else 0
+
+
+class _Timer:
+    def __init__(self, pc: PerfCounters, key: str):
+        self.pc, self.key = pc, key
+
+    def __enter__(self):
+        self.t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self.pc.tinc(self.key, time.perf_counter() - self.t0)
+        return False
+
+
+class PerfCountersCollection:
+    """All groups in the process; `perf dump` analog
+    (src/common/perf_counters_collection.h)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._groups: Dict[str, PerfCounters] = {}
+
+    def get(self, name: str) -> PerfCounters:
+        with self._lock:
+            pc = self._groups.get(name)
+            if pc is None:
+                pc = self._groups[name] = PerfCounters(name)
+            return pc
+
+    def dump(self) -> Dict[str, Dict[str, Any]]:
+        with self._lock:
+            groups = list(self._groups.items())
+        return {name: pc.dump() for name, pc in sorted(groups)}
+
+    def reset(self) -> None:
+        with self._lock:
+            groups = list(self._groups.values())
+        for pc in groups:
+            pc.reset()
+
+
+_collection: Optional[PerfCountersCollection] = None
+_collection_lock = threading.Lock()
+
+
+def perf(name: str = None) -> Any:
+    """perf() -> the collection; perf("group") -> that group."""
+    global _collection
+    with _collection_lock:
+        if _collection is None:
+            _collection = PerfCountersCollection()
+    return _collection if name is None else _collection.get(name)

@@ -26,7 +26,9 @@ class MatrixCodec(ErasureCodeBase):
         super().__init__()
         self.w = 8
         self.parity: np.ndarray | None = None
-        self._cache = DecodeTableCache()
+        from ..common.options import config
+        self._cache = DecodeTableCache(
+            capacity=int(config().get("ec_table_cache_size")))
 
     # -------------------------------------------------------------- setup --
     def set_matrix(self, parity: np.ndarray, w: int = 8) -> None:

@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import numpy as np
 
+from ..common.perf_counters import perf as _perf
 from ..ops import gf, gf_jax
 from .interface import ErasureCodeError, ErasureCodeProfile
 from .matrix_codec import MatrixCodec
@@ -50,6 +51,7 @@ class ErasureCodeJax(MatrixCodec):
             raise ErasureCodeError(
                 f"technique={technique!r} not in {TECHNIQUES}")
         self.set_matrix(parity, 8)
+        self._pc = _perf("ec.jax")       # cached group handle (hot path)
         self._profile = dict(profile)
         self._profile.setdefault("plugin", "jax")
         self._profile["technique"] = technique
@@ -68,6 +70,9 @@ class ErasureCodeJax(MatrixCodec):
         if data.shape[-2] != self.k:
             raise ErasureCodeError(
                 f"expected {self.k} data chunks, got {data.shape[-2]}")
+        pc = self._pc
+        pc.inc("encode_dispatches")
+        pc.inc("encode_bytes", int(np.prod(data.shape)))
         return gf_jax.gf8_matmul(self.parity, data)
 
     # ----------------------------------------------------------- decode ---
@@ -92,6 +97,11 @@ class ErasureCodeJax(MatrixCodec):
         order = list(available_ids)
         sel = [order.index(c) for c in used]
         import jax.numpy as jnp
+        pc = self._pc
+        pc.inc("decode_dispatches")
+        pc.inc("decode_bytes", int(np.prod(chunks.shape)))
+        pc.set("decode_cache_hits", self._cache.hits)
+        pc.set("decode_cache_misses", self._cache.misses)
         rows = jnp.asarray(chunks)[..., sel, :]
         return gf_jax.gf8_matmul(R, rows)
 

@@ -276,6 +276,10 @@ class CrushMap:
     bucket_names: Dict[int, str] = field(default_factory=dict)
     device_names: Dict[int, str] = field(default_factory=dict)
     device_classes: Dict[int, str] = field(default_factory=dict)
+    # (original bucket id, class) -> shadow bucket id (CrushWrapper.h:66
+    # class_bucket equivalent; shadow trees are materialized as ordinary
+    # buckets so every mapper handles device classes natively)
+    class_bucket_ids: Dict[Tuple[int, str], int] = field(default_factory=dict)
 
     # ------------------------------------------------------------- build ----
 
@@ -322,6 +326,60 @@ class CrushMap:
             b.finalize_derived(self.tunables.straw_calc_version)
         self.max_devices = max(self.max_devices, maxdev)
 
+    def build_class_shadow(self, root_id: int, cls: str,
+                           preferred_ids: Optional[Dict[Tuple[int, str],
+                                                        int]] = None) -> int:
+        """Clone the hierarchy under ``root_id`` keeping only devices of
+        device class ``cls`` (CrushWrapper device_class_clone semantics:
+        per-class shadow trees that `step take <bucket> class <cls>`
+        selects from; reference src/crush/CrushWrapper.h:66 class_bucket,
+        CrushWrapper.cc device_class_clone).
+
+        The shadow is materialized as ordinary buckets (same alg/hash,
+        filtered items, reweighted interiors), so the scalar and batched
+        mappers need no class awareness.  Idempotent per (bucket, class);
+        ``preferred_ids`` pins shadow ids (the compiler's `id -N class c`
+        lines).
+        """
+        if self.bucket(root_id) is None:
+            raise ValueError(f"no bucket {root_id}")
+        prefer = preferred_ids or {}
+
+        def clone(bid: int) -> int:
+            key = (bid, cls)
+            if key in self.class_bucket_ids:
+                return self.class_bucket_ids[key]
+            b = self.bucket(bid)
+            items: List[int] = []
+            weights: List[int] = []
+            for pos, it in enumerate(b.items):
+                if it >= 0:
+                    if self.device_classes.get(it) == cls:
+                        items.append(it)
+                        weights.append(b.item_weight(pos))
+                elif self.bucket(it) is not None:
+                    sid = clone(it)
+                    sb = self.bucket(sid)
+                    items.append(sid)
+                    weights.append(sb.weight)
+            sid = prefer.get(key)
+            if sid is not None and self.bucket(sid) is not None:
+                raise ValueError(
+                    f"shadow id {sid} for ({bid}, {cls!r}) collides with "
+                    "an existing bucket")
+            if sid is None:
+                sid = self.next_bucket_id()
+            shadow = Bucket(id=sid, alg=b.alg, type=b.type, items=items,
+                            weights=weights, hash=b.hash)
+            shadow.finalize_derived(self.tunables.straw_calc_version)
+            self.add_bucket(shadow)
+            name = self.bucket_names.get(bid, f"bucket{-1 - bid}")
+            self.bucket_names[sid] = f"{name}~{cls}"
+            self.class_bucket_ids[key] = sid
+            return sid
+
+        return clone(root_id)
+
     @property
     def max_buckets(self) -> int:
         return len(self.buckets)
@@ -345,21 +403,83 @@ class CrushMap:
             m.add_bucket(Bucket(id=b["id"], alg=b["alg"], type=b["type"],
                                 items=list(b["items"]), weights=list(b["weights"]),
                                 hash=b.get("hash", HASH_RJENKINS1)))
-        for r in spec.get("rules", []):
+        rules = spec.get("rules", [])
+        for ruleno, r in enumerate(rules):
+            if r is None:
+                continue
             m.add_rule(Rule(steps=[tuple(s) for s in r["steps"]],
-                            name=r.get("name", "")))
+                            name=r.get("name", ""),
+                            type=r.get("type", 1),
+                            min_size=r.get("min_size", 1),
+                            max_size=r.get("max_size", 10)),
+                       r.get("id", ruleno))
+        m.type_names = {int(k): v
+                        for k, v in spec.get("type_names", {}).items()}
+        m.bucket_names = {int(k): v
+                          for k, v in spec.get("bucket_names", {}).items()}
+        m.device_names = {int(k): v
+                          for k, v in spec.get("device_names", {}).items()}
+        m.device_classes = {int(k): v
+                            for k, v in spec.get("device_classes",
+                                                 {}).items()}
+        m.class_bucket_ids = {(int(e["bucket"]), e["class"]): int(e["shadow"])
+                              for e in spec.get("class_bucket_ids", [])}
+        for key, entries in spec.get("choose_args", {}).items():
+            args: List[Optional[ChooseArg]] = [None] * len(m.buckets)
+            for e in entries:
+                idx = -1 - int(e["bucket_id"])
+                while len(args) <= idx:
+                    args.append(None)
+                args[idx] = ChooseArg(
+                    ids=list(e["ids"]) if e.get("ids") else None,
+                    weight_set=[list(row) for row in e["weight_set"]]
+                    if e.get("weight_set") else None)
+            try:
+                k2: object = int(key)
+            except (TypeError, ValueError):
+                k2 = key
+            m.choose_args[k2] = args
+        if "num_devices" in spec:
+            m.max_devices = max(m.max_devices, int(spec["num_devices"]))
         m.finalize()
         return m
 
     def to_spec(self) -> dict:
-        return {
+        spec = {
             "tunables": {k: getattr(self.tunables, k)
                          for k in Tunables.__dataclass_fields__},
             "buckets": [
                 {"id": b.id, "alg": b.alg, "type": b.type, "hash": b.hash,
                  "items": list(b.items), "weights": list(b.weights)}
                 for b in self.buckets if b is not None],
-            "rules": [{"steps": [list(s) for s in r.steps], "name": r.name}
-                      for r in self.rules if r is not None],
+            "rules": [{"id": i, "steps": [list(s) for s in r.steps],
+                       "name": r.name, "type": r.type,
+                       "min_size": r.min_size, "max_size": r.max_size}
+                      for i, r in enumerate(self.rules) if r is not None],
             "num_devices": self.max_devices,
         }
+        if self.type_names:
+            spec["type_names"] = {str(k): v
+                                  for k, v in self.type_names.items()}
+        if self.bucket_names:
+            spec["bucket_names"] = {str(k): v
+                                    for k, v in self.bucket_names.items()}
+        if self.device_names:
+            spec["device_names"] = {str(k): v
+                                    for k, v in self.device_names.items()}
+        if self.device_classes:
+            spec["device_classes"] = {str(k): v
+                                      for k, v in self.device_classes.items()}
+        if self.class_bucket_ids:
+            spec["class_bucket_ids"] = [
+                {"bucket": b, "class": c, "shadow": s}
+                for (b, c), s in sorted(self.class_bucket_ids.items())]
+        if self.choose_args:
+            spec["choose_args"] = {
+                str(key): [{"bucket_id": -1 - idx,
+                            "weight_set": arg.weight_set,
+                            "ids": arg.ids}
+                           for idx, arg in enumerate(args)
+                           if arg is not None]
+                for key, args in self.choose_args.items()}
+        return spec

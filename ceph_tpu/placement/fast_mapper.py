@@ -38,7 +38,6 @@ compile_map enforces.
 from __future__ import annotations
 
 import functools
-import os
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -47,6 +46,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from ..common.options import config as _config
 from ..ops import hashing
 from .crush_map import (
     ITEM_NONE, ITEM_UNDEF,
@@ -811,17 +811,17 @@ class FastMapper:
         self.cmap = cmap
         self.compiled = compile_map(cmap, choose_args_key, n_positions=1)
         if strategy is None:
-            strategy = os.environ.get("CEPH_TPU_LOOKUP")
+            cfg = _config().get("lookup_strategy")
+            strategy = None if cfg == "auto" else cfg
         if strategy is None:
             strategy = "gather" if jax.devices()[0].platform == "cpu" \
                 else "onehot"
         self.strategy = strategy
         self.dt = self.compiled.tables(strategy)
         if extra_tries is None:
-            extra_tries = int(os.environ.get("CEPH_TPU_FASTMAP_EXTRA", "8"))
+            extra_tries = int(_config().get("fastmap_extra_tries"))
         self.extra = max(2, extra_tries)
-        self.exact_select = \
-            os.environ.get("CEPH_TPU_SELECT", "approx") == "exact"
+        self.exact_select = _config().get("straw2_select") == "exact"
         self._jitted = {}
         self._plans: Dict[Tuple[int, int], list] = {}
 
@@ -982,8 +982,6 @@ class FastMapper:
                     for e in self._plan(ruleno, result_max)
                     if e[0] == "choose"), default=1)
 
-    # candidate grids multiply lane width by R·G; cap device working set
-    MAX_GRID_LANES_PER_CALL = 1 << 21
 
     def map_batch(self, ruleno: int, xs, result_max: int,
                   weights: Sequence[int], mesh=None
@@ -1001,7 +999,9 @@ class FastMapper:
             .astype(np.int32)
         n = len(xs_np)
         gw = self.grid_width(ruleno, result_max)
-        cap = max(1 << 12, self.MAX_GRID_LANES_PER_CALL // gw)
+        # candidate grids multiply lane width by R*G; cap device working set
+        max_grid = int(_config().get("fastmap_max_grid_lanes"))
+        cap = max(1 << 12, max_grid // gw)
         cap *= (mesh.size if mesh is not None else 1)
         if n > cap:
             pad = (-n) % cap

@@ -43,6 +43,8 @@ jax.config.update("jax_enable_x64", True)
 import jax.numpy as jnp  # noqa: E402
 from jax import lax  # noqa: E402
 
+from ..common.options import config as _config  # noqa: E402
+from ..common.perf_counters import perf as _perf  # noqa: E402
 from ..ops import hashing  # noqa: E402
 from . import lntable  # noqa: E402
 from .crush_map import (  # noqa: E402
@@ -596,14 +598,15 @@ class XlaMapper:
         self.choose_args_key = choose_args_key
         self.compiled = compile_map(cmap, choose_args_key, n_positions)
         if fast is None:
-            fast = os.environ.get("CEPH_TPU_FASTMAP", "1") != "0"
+            fast = bool(_config().get("fastmap_enabled"))
         self._fast_enabled = fast
         self._fast = None                 # lazy FastMapper
         self._fast_unsupported = set()    # rule keys outside fast subset
         self._exact_fallback = None       # lazy NativeMapper/scalar fn
         auto = False
         if strategy is None:
-            strategy = os.environ.get("CEPH_TPU_LOOKUP")
+            cfg = _config().get("lookup_strategy")
+            strategy = None if cfg == "auto" else cfg
         if strategy is None:
             # one-hot matmul lookups on real accelerators; row gathers on
             # CPU where XLA lowers them efficiently
@@ -756,10 +759,6 @@ class XlaMapper:
                     fn, in_shardings=(batch, repl), out_shardings=batch)
         return self._jitted[key]
 
-    # one-hot intermediates are ~S*385 bytes per lane-level; cap the lanes
-    # per device dispatch so working set stays well inside HBM (the full
-    # sweep streams chunks through one compiled executable)
-    MAX_LANES_PER_CALL = 1 << 17
 
     def _exact_rows(self, ruleno: int, xs_rows, result_max: int, weights):
         """Bit-exact recompute for fallback lanes: the native C++
@@ -801,6 +800,9 @@ class XlaMapper:
         if ruleno < 0 or ruleno >= self.cmap.max_rules or \
                 self.cmap.rules[ruleno] is None:
             raise ValueError(f"no rule {ruleno}")
+        pc = _perf("crush.mapper")
+        pc.inc("map_batch_calls")
+        pc.inc("lanes", len(xs))
         fkey = (ruleno, result_max)
         if self._fast_enabled and fkey not in self._fast_unsupported:
             try:
@@ -809,10 +811,12 @@ class XlaMapper:
                     self._fast = FastMapper(
                         self.cmap, choose_args_key=self.choose_args_key,
                         strategy=self.tables.strategy)
-                out, inc = self._fast.map_batch(
-                    ruleno, xs, result_max, weights, mesh=mesh)
+                with pc.time("fast_map_s"):
+                    out, inc = self._fast.map_batch(
+                        ruleno, xs, result_max, weights, mesh=mesh)
                 if inc.any():
                     rows = np.flatnonzero(inc)
+                    pc.inc("fallback_lanes", len(rows))
                     xs_np = np.asarray(xs, dtype=np.int64)[rows]
                     out = np.array(out)    # jax arrays are read-only
                     out[rows] = self._exact_rows(
@@ -820,6 +824,7 @@ class XlaMapper:
                 return out
             except UnsupportedMapError:
                 self._fast_unsupported.add(fkey)
+                pc.inc("fast_unsupported_rules")
         jitted = self._get_jitted(ruleno, result_max, mesh)
         w = np.zeros(self.compiled.max_devices, dtype=np.int32)
         w_in = np.asarray(weights, dtype=np.int64)
@@ -827,7 +832,8 @@ class XlaMapper:
         xs_np = np.asarray(xs, dtype=np.int64).astype(np.uint32) \
             .astype(np.int32)
         n = len(xs_np)
-        cap = self.MAX_LANES_PER_CALL * (mesh.size if mesh is not None else 1)
+        cap = int(_config().get("mapper_max_lanes_per_call"))
+        cap *= (mesh.size if mesh is not None else 1)
         if n > cap:
             # pad to a multiple of cap so every chunk reuses one executable
             pad = (-n) % cap
@@ -841,5 +847,6 @@ class XlaMapper:
             pad = (-n) % mesh.size
             if pad:
                 xs_np = np.concatenate([xs_np, xs_np[:1].repeat(pad)])
-        out = np.asarray(jitted(jnp.asarray(xs_np), jnp.asarray(w)))
+        with pc.time("general_map_s"):
+            out = np.asarray(jitted(jnp.asarray(xs_np), jnp.asarray(w)))
         return out[:n]

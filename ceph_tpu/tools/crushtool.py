@@ -6,10 +6,16 @@ Mirrors the `crushtool --test` harness (reference: src/tools/crushtool.cc:365
 and statistics — but the sweep is one batched device call per rule
 (CrushTester.cc:612's per-x loop collapsed into XlaMapper.map_batch).
 
+Also compiles/decompiles the crushmap text language (`-c`/`-d`, the
+CrushCompiler role, src/crush/CrushCompiler.cc): input maps may be
+either JSON specs or `.crush` text (auto-detected).
+
 Usage:
-    python -m ceph_tpu.tools.crushtool --infn map.json --test \
+    python -m ceph_tpu.tools.crushtool --infn map.crush --test \
         --min-x 0 --max-x 1023 --rule 0 --num-rep 3 \
         --show-utilization [--scalar] [--weight OSD W]...
+    python -m ceph_tpu.tools.crushtool -c map.crush -o map.json
+    python -m ceph_tpu.tools.crushtool -d map.json [-o map.crush]
 """
 from __future__ import annotations
 
@@ -84,10 +90,26 @@ def run_test(cmap: CrushMap, args) -> int:
     return 0
 
 
+def load_map(path: str) -> CrushMap:
+    """JSON spec or crushmap text, auto-detected."""
+    with open(path) as f:
+        text = f.read()
+    stripped = text.lstrip()
+    if stripped.startswith("{"):
+        return CrushMap.from_spec(json.loads(text))
+    from ..placement.compiler import compile_crushmap
+    return compile_crushmap(text)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="crushtool")
-    ap.add_argument("--infn", "-i", required=True,
-                    help="crush map JSON spec (CrushMap.to_spec format)")
+    ap.add_argument("--infn", "-i",
+                    help="crush map: JSON spec or crushmap text")
+    ap.add_argument("-c", "--compile", metavar="SRC",
+                    help="compile crushmap text -> JSON spec")
+    ap.add_argument("-d", "--decompile", metavar="SRC",
+                    help="decompile map -> crushmap text")
+    ap.add_argument("-o", "--outfn", help="output file (default stdout)")
     ap.add_argument("--test", action="store_true")
     ap.add_argument("--min-x", type=int, default=0)
     ap.add_argument("--max-x", type=int, default=1023)
@@ -109,15 +131,33 @@ def main(argv=None) -> int:
     if args.weight:
         args.weight = [(int(o), w) for o, w in args.weight]
 
-    with open(args.infn) as f:
-        cmap = CrushMap.from_spec(json.load(f))
+    def emit(text: str) -> None:
+        if args.outfn:
+            with open(args.outfn, "w") as f:
+                f.write(text)
+        else:
+            sys.stdout.write(text)
+
+    if args.compile:
+        from ..placement.compiler import compile_crushmap
+        with open(args.compile) as f:
+            cmap = compile_crushmap(f.read())
+        emit(json.dumps(cmap.to_spec(), indent=2) + "\n")
+        return 0
+    if args.decompile:
+        from ..placement.compiler import decompile_crushmap
+        emit(decompile_crushmap(load_map(args.decompile)))
+        return 0
+    if not args.infn:
+        ap.error("need --infn (or -c/-d)")
+    cmap = load_map(args.infn)
     if args.dump:
         json.dump(cmap.to_spec(), sys.stdout, indent=2)
         print()
         return 0
     if args.test:
         return run_test(cmap, args)
-    ap.error("nothing to do (--test or --dump)")
+    ap.error("nothing to do (--test, --dump, -c or -d)")
     return 1
 
 

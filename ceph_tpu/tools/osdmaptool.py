@@ -92,9 +92,11 @@ def main(argv=None) -> int:
         return 0
     if args.test_map_pgs:
         stats = test_map_pgs(om, scalar=args.scalar)
+        # timing is nondeterministic -> stderr (goldens pin stdout only)
         print(f"pool throughput: {stats['total_pgs']} pgs in "
               f"{stats['seconds']:.3f}s "
-              f"({stats['total_pgs'] / stats['seconds']:,.0f} pg/s)")
+              f"({stats['total_pgs'] / stats['seconds']:,.0f} pg/s)",
+              file=sys.stderr)
         print(f" avg {stats['pg_per_osd_avg']:.2f} "
               f"min {stats['pg_per_osd_min']} max {stats['pg_per_osd_max']} "
               f"over {stats['osds_used']} osds")

@@ -1,0 +1,89 @@
+  $ python -m ceph_tpu.tools.crushtool -d classes.crush
+  # begin crush map
+  tunable choose_local_tries 0
+  tunable choose_local_fallback_tries 0
+  tunable choose_total_tries 50
+  tunable chooseleaf_descend_once 1
+  tunable chooseleaf_vary_r 1
+  tunable chooseleaf_stable 1
+  tunable straw_calc_version 1
+  tunable allowed_bucket_algs 62
+  
+  # devices
+  device 0 osd.0 class hdd
+  device 1 osd.1 class ssd
+  device 2 osd.2 class hdd
+  device 3 osd.3 class ssd
+  device 4 osd.4 class hdd
+  device 5 osd.5 class ssd
+  
+  # types
+  type 0 osd
+  type 1 host
+  type 10 root
+  
+  # buckets
+  host h1 {
+  	id -1		# do not change unnecessarily
+  	id -11 class hdd		# do not change unnecessarily
+  	id -21 class ssd		# do not change unnecessarily
+  	# weight 2.00000
+  	alg straw2
+  	hash 0	# rjenkins1
+  	item osd.0 weight 1.00000
+  	item osd.1 weight 1.00000
+  }
+  host h2 {
+  	id -2		# do not change unnecessarily
+  	id -12 class hdd		# do not change unnecessarily
+  	id -22 class ssd		# do not change unnecessarily
+  	# weight 2.00000
+  	alg straw2
+  	hash 0	# rjenkins1
+  	item osd.2 weight 1.00000
+  	item osd.3 weight 1.00000
+  }
+  host h3 {
+  	id -3		# do not change unnecessarily
+  	id -13 class hdd		# do not change unnecessarily
+  	id -23 class ssd		# do not change unnecessarily
+  	# weight 2.00000
+  	alg straw2
+  	hash 0	# rjenkins1
+  	item osd.4 weight 1.00000
+  	item osd.5 weight 1.00000
+  }
+  root default {
+  	id -4		# do not change unnecessarily
+  	id -14 class hdd		# do not change unnecessarily
+  	id -24 class ssd		# do not change unnecessarily
+  	# weight 6.00000
+  	alg straw2
+  	hash 0	# rjenkins1
+  	item h1 weight 2.00000
+  	item h2 weight 2.00000
+  	item h3 weight 2.00000
+  }
+  
+  # rules
+  rule ssd_rule {
+  	id 0
+  	type replicated
+  	min_size 1
+  	max_size 10
+  	step take default class ssd
+  	step chooseleaf firstn 0 type host
+  	step emit
+  }
+  
+  # end crush map
+
+  $ python -m ceph_tpu.tools.crushtool -i classes.crush --test --scalar --show-mappings --min-x 0 --max-x 7 --rule 0 --num-rep 2
+  CRUSH rule 0 x 0 [1, 5]
+  CRUSH rule 0 x 1 [3, 1]
+  CRUSH rule 0 x 2 [1, 3]
+  CRUSH rule 0 x 3 [3, 1]
+  CRUSH rule 0 x 4 [1, 3]
+  CRUSH rule 0 x 5 [1, 3]
+  CRUSH rule 0 x 6 [3, 5]
+  CRUSH rule 0 x 7 [3, 5]

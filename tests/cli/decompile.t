@@ -1,0 +1,71 @@
+  $ python -m ceph_tpu.tools.crushtool -d basic.crush
+  # begin crush map
+  tunable choose_local_tries 0
+  tunable choose_local_fallback_tries 0
+  tunable choose_total_tries 50
+  tunable chooseleaf_descend_once 1
+  tunable chooseleaf_vary_r 1
+  tunable chooseleaf_stable 1
+  tunable straw_calc_version 1
+  tunable allowed_bucket_algs 62
+  
+  # devices
+  device 0 osd.0
+  device 1 osd.1
+  device 2 osd.2
+  device 3 osd.3
+  device 4 osd.4
+  device 5 osd.5
+  
+  # types
+  type 0 osd
+  type 1 host
+  type 10 root
+  
+  # buckets
+  host host-a {
+  	id -1		# do not change unnecessarily
+  	# weight 2.00000
+  	alg straw2
+  	hash 0	# rjenkins1
+  	item osd.0 weight 1.00000
+  	item osd.1 weight 1.00000
+  }
+  host host-b {
+  	id -2		# do not change unnecessarily
+  	# weight 2.00000
+  	alg straw2
+  	hash 0	# rjenkins1
+  	item osd.2 weight 1.00000
+  	item osd.3 weight 1.00000
+  }
+  host host-c {
+  	id -3		# do not change unnecessarily
+  	# weight 3.00000
+  	alg straw2
+  	hash 0	# rjenkins1
+  	item osd.4 weight 1.00000
+  	item osd.5 weight 2.00000
+  }
+  root default {
+  	id -4		# do not change unnecessarily
+  	# weight 7.00000
+  	alg straw2
+  	hash 0	# rjenkins1
+  	item host-a weight 2.00000
+  	item host-b weight 2.00000
+  	item host-c weight 3.00000
+  }
+  
+  # rules
+  rule replicated_rule {
+  	id 0
+  	type replicated
+  	min_size 1
+  	max_size 10
+  	step take default
+  	step chooseleaf firstn 0 type host
+  	step emit
+  }
+  
+  # end crush map

@@ -1,0 +1,119 @@
+  $ python -m ceph_tpu.tools.crushtool -i basic.crush --dump
+  {
+    "tunables": {
+      "choose_local_tries": 0,
+      "choose_local_fallback_tries": 0,
+      "choose_total_tries": 50,
+      "chooseleaf_descend_once": 1,
+      "chooseleaf_vary_r": 1,
+      "chooseleaf_stable": 1,
+      "straw_calc_version": 1,
+      "allowed_bucket_algs": 62
+    },
+    "buckets": [
+      {
+        "id": -1,
+        "alg": 5,
+        "type": 1,
+        "hash": 0,
+        "items": [
+          0,
+          1
+        ],
+        "weights": [
+          65536,
+          65536
+        ]
+      },
+      {
+        "id": -2,
+        "alg": 5,
+        "type": 1,
+        "hash": 0,
+        "items": [
+          2,
+          3
+        ],
+        "weights": [
+          65536,
+          65536
+        ]
+      },
+      {
+        "id": -3,
+        "alg": 5,
+        "type": 1,
+        "hash": 0,
+        "items": [
+          4,
+          5
+        ],
+        "weights": [
+          65536,
+          131072
+        ]
+      },
+      {
+        "id": -4,
+        "alg": 5,
+        "type": 10,
+        "hash": 0,
+        "items": [
+          -1,
+          -2,
+          -3
+        ],
+        "weights": [
+          131072,
+          131072,
+          196608
+        ]
+      }
+    ],
+    "rules": [
+      {
+        "id": 0,
+        "steps": [
+          [
+            1,
+            -4,
+            0
+          ],
+          [
+            6,
+            0,
+            1
+          ],
+          [
+            4,
+            0,
+            0
+          ]
+        ],
+        "name": "replicated_rule",
+        "type": 1,
+        "min_size": 1,
+        "max_size": 10
+      }
+    ],
+    "num_devices": 6,
+    "type_names": {
+      "0": "osd",
+      "1": "host",
+      "10": "root"
+    },
+    "bucket_names": {
+      "-1": "host-a",
+      "-2": "host-b",
+      "-3": "host-c",
+      "-4": "default"
+    },
+    "device_names": {
+      "0": "osd.0",
+      "1": "osd.1",
+      "2": "osd.2",
+      "3": "osd.3",
+      "4": "osd.4",
+      "5": "osd.5"
+    }
+  }

@@ -1,0 +1,3 @@
+  $ python -m ceph_tpu.tools.osdmaptool cluster.json --test-map-pgs --scalar
+   avg 21.33 min 12 max 30 over 6 osds
+   total replicas 128

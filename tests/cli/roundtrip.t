@@ -1,0 +1,2 @@
+  $ python -m ceph_tpu.tools.crushtool -d basic.crush -o /tmp/rt1.crush && python -m ceph_tpu.tools.crushtool -d /tmp/rt1.crush -o /tmp/rt2.crush && diff /tmp/rt1.crush /tmp/rt2.crush && echo round-trip-stable
+  round-trip-stable

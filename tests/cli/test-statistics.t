@@ -1,0 +1,3 @@
+  $ python -m ceph_tpu.tools.crushtool -i basic.crush --test --scalar --show-statistics --show-bad-mappings --min-x 0 --max-x 255 --rule 0 --num-rep 4
+  rule 0 (num_rep 4) size 3:	256/256
+  rule 0 (num_rep 4): 256/256 bad mappings

@@ -1,0 +1,8 @@
+  $ python -m ceph_tpu.tools.crushtool -i basic.crush --test --scalar --show-utilization --min-x 0 --max-x 255 --rule 0 --num-rep 3
+  rule 0 (num_rep 3) num_osds_mapped 6
+    device 0:		 stored : 133	 expected : 128.00	 deviation : 1.04
+    device 1:		 stored : 123	 expected : 128.00	 deviation : 0.96
+    device 2:		 stored : 121	 expected : 128.00	 deviation : 0.95
+    device 3:		 stored : 135	 expected : 128.00	 deviation : 1.05
+    device 4:		 stored : 78	 expected : 128.00	 deviation : 0.61
+    device 5:		 stored : 178	 expected : 128.00	 deviation : 1.39

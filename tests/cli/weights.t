@@ -1,0 +1,7 @@
+  $ python -m ceph_tpu.tools.crushtool -i basic.crush --test --scalar --show-utilization --min-x 0 --max-x 255 --rule 0 --num-rep 2 --weight 0 0 --weight 5 0.5
+  rule 0 (num_rep 2) num_osds_mapped 5
+    device 1:		 stored : 118	 expected : 102.40	 deviation : 1.15
+    device 2:		 stored : 97	 expected : 102.40	 deviation : 0.95
+    device 3:		 stored : 109	 expected : 102.40	 deviation : 1.06
+    device 4:		 stored : 108	 expected : 102.40	 deviation : 1.05
+    device 5:		 stored : 80	 expected : 102.40	 deviation : 0.78

@@ -1,0 +1,78 @@
+"""Golden CLI transcripts — cram-style byte-exact pinning.
+
+The reference pins `crushtool`/`osdmaptool` behavior with ~60 cram `.t`
+files (src/test/cli/crushtool/*.t: lines `  $ cmd` followed by the
+expected stdout, byte-exact).  Same format here: transcripts live in
+tests/cli/*.t, run with CWD tests/cli so data file paths are relative.
+
+Regenerate after an intentional output change with:
+    CEPH_TPU_REGEN_TRANSCRIPTS=1 python -m pytest tests/test_cli_transcripts.py
+"""
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+CLI_DIR = Path(__file__).parent / "cli"
+TRANSCRIPTS = sorted(CLI_DIR.glob("*.t"))
+REGEN = os.environ.get("CEPH_TPU_REGEN_TRANSCRIPTS") == "1"
+
+
+def parse_transcript(text):
+    """-> list of (command, expected_output_lines)."""
+    blocks = []
+    cmd = None
+    out = []
+    for line in text.splitlines():
+        if line.startswith("  $ "):
+            if cmd is not None:
+                blocks.append((cmd, out))
+            cmd = line[4:]
+            out = []
+        elif line.startswith("  > ") and cmd is not None and not out:
+            cmd += "\n" + line[4:]
+        elif line.startswith("  ") and cmd is not None:
+            out.append(line[2:])
+        # comment / blank lines between blocks are ignored
+    if cmd is not None:
+        blocks.append((cmd, out))
+    return blocks
+
+
+def run_command(cmd: str) -> str:
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    root = str(Path(__file__).parent.parent)
+    env["PYTHONPATH"] = root + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.run(
+        cmd, shell=True, cwd=str(CLI_DIR), env=env,
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+        text=True, timeout=600)
+    return proc.stdout
+
+
+@pytest.mark.parametrize("path", TRANSCRIPTS,
+                         ids=[p.name for p in TRANSCRIPTS])
+def test_transcript(path):
+    text = path.read_text()
+    blocks = parse_transcript(text)
+    assert blocks, f"{path.name}: no command blocks"
+    if REGEN:
+        lines = []
+        for cmd, _ in blocks:
+            first, *rest = cmd.split("\n")
+            lines.append(f"  $ {first}")
+            lines.extend(f"  > {r}" for r in rest)
+            got = run_command(cmd)
+            lines.extend("  " + ln for ln in got.splitlines())
+            lines.append("")
+        path.write_text("\n".join(lines).rstrip("\n") + "\n")
+        pytest.skip(f"regenerated {path.name}")
+    for cmd, expected in blocks:
+        got = run_command(cmd).splitlines()
+        assert got == expected, (
+            f"{path.name}: transcript mismatch for {cmd!r}\n"
+            f"--- expected ---\n" + "\n".join(expected) +
+            "\n--- got ---\n" + "\n".join(got))

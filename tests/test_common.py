@@ -1,0 +1,186 @@
+"""L0 substrate: options registry, perf counters, admin server.
+
+Reference surfaces: src/common/options.cc (typed table),
+src/common/config.{h,cc} (layering + observers),
+src/common/perf_counters.h (counter types + perf dump),
+src/common/admin_socket.{h,cc} (JSON command socket).
+"""
+import json
+import os
+
+import pytest
+
+from ceph_tpu.common import (AdminServer, Option, OptionError, Options,
+                             admin_request, config, perf)
+from ceph_tpu.common.options import (LEVEL_ENV, LEVEL_FILE, LEVEL_RUNTIME,
+                                     TYPE_BOOL, TYPE_INT, TYPE_STR)
+
+
+def make_opts():
+    return Options([
+        Option("alpha", TYPE_INT, 10, "an int", min=0, max=100),
+        Option("beta", TYPE_BOOL, False, "a bool"),
+        Option("gamma", TYPE_STR, "x", "an enum",
+               enum_values=("x", "y", "z")),
+    ])
+
+
+def test_defaults_and_typing():
+    o = make_opts()
+    assert o.get("alpha") == 10
+    assert o.get("beta") is False
+    assert o.set("alpha", "42") == 42          # string coerced to int
+    assert o.get("alpha") == 42
+    assert o.set("beta", "yes") is True
+
+
+def test_bounds_and_enum_rejected():
+    o = make_opts()
+    with pytest.raises(OptionError):
+        o.set("alpha", 101)
+    with pytest.raises(OptionError):
+        o.set("alpha", -1)
+    with pytest.raises(OptionError):
+        o.set("gamma", "w")
+    with pytest.raises(OptionError):
+        o.set("nope", 1)
+    with pytest.raises(OptionError):
+        o.get("nope")
+
+
+def test_layering_precedence():
+    o = make_opts()
+    o.set("alpha", 20, level=LEVEL_FILE)
+    assert o.get("alpha") == 20
+    o.set("alpha", 30, level=LEVEL_ENV)
+    assert o.get("alpha") == 30
+    o.set("alpha", 40, level=LEVEL_RUNTIME)
+    assert o.get("alpha") == 40
+    o.clear("alpha", LEVEL_RUNTIME)
+    assert o.get("alpha") == 30
+    o.clear("alpha", LEVEL_ENV)
+    assert o.get("alpha") == 20
+
+
+def test_env_var_layer(monkeypatch):
+    o = make_opts()
+    monkeypatch.setenv("CEPH_TPU_ALPHA", "55")
+    assert o.get("alpha") == 55
+    # env beats file (documented precedence: default < file < env)
+    o.set("alpha", 20, level=LEVEL_FILE)
+    assert o.get("alpha") == 55
+    # malformed env fails loudly (silently dropping an operator setting
+    # is worse than a crash) but dump() stays alive
+    monkeypatch.setenv("CEPH_TPU_ALPHA", "banana")
+    with pytest.raises(OptionError):
+        o.get("alpha")
+    assert "invalid" in str(o.dump()["alpha"]["value"])
+    # runtime beats env
+    monkeypatch.setenv("CEPH_TPU_ALPHA", "55")
+    o.set("alpha", 60)
+    assert o.get("alpha") == 60
+
+
+def test_observer_fires():
+    o = make_opts()
+    seen = []
+    o.observe("alpha", lambda k, v: seen.append((k, v)))
+    o.set("alpha", 5)
+    assert seen == [("alpha", 5)]
+
+
+def test_load_file(tmp_path):
+    o = make_opts()
+    p = tmp_path / "conf.json"
+    p.write_text(json.dumps({"alpha": 33, "gamma": "z"}))
+    o.load_file(str(p))
+    assert o.get("alpha") == 33
+    assert o.get("gamma") == "z"
+
+
+def test_dump_provenance():
+    o = make_opts()
+    o.set("alpha", 12)
+    d = o.dump()
+    assert d["alpha"]["value"] == 12 and d["alpha"]["source"] == "runtime"
+    assert d["beta"]["source"] == "default"
+
+
+def test_global_table_has_framework_knobs():
+    c = config()
+    for name in ("lookup_strategy", "fastmap_enabled",
+                 "fastmap_extra_tries", "straw2_select",
+                 "ec_table_cache_size", "mapper_max_lanes_per_call"):
+        assert name in c.names()
+    # round-1 env aliases preserved
+    assert c.schema("lookup_strategy").env_var() == "CEPH_TPU_LOOKUP"
+    assert c.schema("fastmap_enabled").env_var() == "CEPH_TPU_FASTMAP"
+
+
+# ------------------------------------------------------------- counters ----
+
+def test_counters_basics():
+    pc = perf("test.group1")
+    pc.inc("dispatches")
+    pc.inc("dispatches", 4)
+    pc.set("batch_lanes", 1024)
+    pc.tinc("map_s", 0.5)
+    pc.tinc("map_s", 1.5)
+    d = pc.dump()
+    assert d["dispatches"] == 5
+    assert d["batch_lanes"] == 1024
+    assert d["map_s"]["avgcount"] == 2
+    assert abs(d["map_s"]["avgtime"] - 1.0) < 1e-9
+
+
+def test_counters_timer_and_reset():
+    pc = perf("test.group2")
+    with pc.time("op_s"):
+        pass
+    assert pc.dump()["op_s"]["avgcount"] == 1
+    pc.reset()
+    assert pc.dump()["op_s"]["avgcount"] == 0
+
+
+def test_collection_dump_groups():
+    perf("test.group3").inc("x")
+    allg = perf().dump()
+    assert "test.group3" in allg and allg["test.group3"]["x"] >= 1
+
+
+def test_counters_disabled(monkeypatch):
+    config().set("perf_counters_enabled", False)
+    try:
+        pc = perf("test.group4")
+        pc.inc("n")
+        assert pc.dump().get("n", 0) == 0
+    finally:
+        config().set("perf_counters_enabled", True)
+
+
+# ---------------------------------------------------------------- admin ----
+
+def test_admin_inprocess_commands():
+    srv = AdminServer()
+    assert srv.handle({"prefix": "config get",
+                       "key": "fastmap_enabled"})["result"]
+    r = srv.handle({"prefix": "config set", "key": "log_level",
+                    "value": 2})
+    assert r["result"]["success"] and config().get("log_level") == 2
+    config().set("log_level", 1)
+    assert "error" in srv.handle({"prefix": "bogus"})
+    assert "perf dump" in srv.handle({"prefix": "help"})["result"]
+
+
+def test_admin_unix_socket(tmp_path):
+    srv = AdminServer()
+    path = str(tmp_path / "admin.sock")
+    srv.serve(path)
+    try:
+        r = admin_request(path, {"prefix": "config get",
+                                 "key": "straw2_select"})
+        assert r["result"]["straw2_select"] in ("approx", "exact")
+        r2 = admin_request(path, {"prefix": "perf dump"})
+        assert "result" in r2
+    finally:
+        srv.close()

@@ -1,0 +1,280 @@
+"""Crushmap text language compiler/decompiler.
+
+Reference surface: src/crush/CrushCompiler.cc + grammar.h behind
+`crushtool -c/-d`; golden-transcript style pinned in test_tools.py.
+"""
+import numpy as np
+import pytest
+
+from ceph_tpu.placement import scalar_mapper
+from ceph_tpu.placement.compiler import (CompileError, compile_crushmap,
+                                         decompile_crushmap)
+from ceph_tpu.placement.crush_map import (
+    BUCKET_STRAW2, RULE_CHOOSELEAF_FIRSTN, RULE_EMIT, RULE_TAKE, WEIGHT_ONE)
+
+BASIC = """
+# minimal but realistic map
+tunable choose_total_tries 50
+tunable chooseleaf_stable 1
+
+device 0 osd.0
+device 1 osd.1
+device 2 osd.2
+device 3 osd.3
+
+type 0 osd
+type 1 host
+type 10 root
+
+host node-a {
+    id -1
+    alg straw2
+    hash 0
+    item osd.0 weight 1.00000
+    item osd.1 weight 1.00000
+}
+host node-b {
+    id -2
+    alg straw2
+    hash 0
+    item osd.2 weight 1.00000
+    item osd.3 weight 2.00000
+}
+root default {
+    id -3
+    alg straw2
+    hash 0
+    item node-a weight 2.00000
+    item node-b weight 3.00000
+}
+
+rule replicated_rule {
+    id 0
+    type replicated
+    min_size 1
+    max_size 10
+    step take default
+    step chooseleaf firstn 0 type host
+    step emit
+}
+"""
+
+
+def test_compile_basic():
+    m = compile_crushmap(BASIC)
+    assert m.max_devices == 4
+    assert m.bucket(-3).items == [-1, -2]
+    assert m.bucket(-3).weights == [2 * WEIGHT_ONE, 3 * WEIGHT_ONE]
+    assert m.bucket(-2).weights == [WEIGHT_ONE, 2 * WEIGHT_ONE]
+    assert m.tunables.chooseleaf_stable == 1
+    rule = m.rules[0]
+    assert rule.name == "replicated_rule"
+    assert rule.steps == [(RULE_TAKE, -3, 0),
+                          (RULE_CHOOSELEAF_FIRSTN, 0, 1),
+                          (RULE_EMIT, 0, 0)]
+    assert m.type_names[10] == "root"
+    assert m.bucket_names[-1] == "node-a"
+
+
+def test_compiled_map_actually_maps():
+    m = compile_crushmap(BASIC)
+    weights = [WEIGHT_ONE] * m.max_devices
+    out = scalar_mapper.do_rule(m, 0, 1234, 2, weights)
+    assert len(out) == 2 and all(0 <= o < 4 for o in out)
+
+
+def test_roundtrip_text_map_text():
+    m1 = compile_crushmap(BASIC)
+    text1 = decompile_crushmap(m1)
+    m2 = compile_crushmap(text1)
+    text2 = decompile_crushmap(m2)
+    assert text1 == text2                       # canonical fixed point
+    # and the two maps place identically
+    weights = [WEIGHT_ONE] * m1.max_devices
+    for x in range(64):
+        assert scalar_mapper.do_rule(m1, 0, x, 3, weights) == \
+            scalar_mapper.do_rule(m2, 0, x, 3, weights)
+
+
+def test_bucket_default_weight_from_children():
+    text = BASIC.replace("item node-a weight 2.00000",
+                         "item node-a").replace(
+        "item node-b weight 3.00000", "item node-b")
+    m = compile_crushmap(text)
+    assert m.bucket(-3).weights == [2 * WEIGHT_ONE, 3 * WEIGHT_ONE]
+
+
+def test_item_pos_reorders():
+    text = """
+device 0 osd.0
+device 1 osd.1
+type 0 osd
+type 1 host
+host h {
+    id -1
+    alg straw2
+    hash 0
+    item osd.1 weight 1.00000 pos 1
+    item osd.0 weight 1.00000 pos 0
+}
+"""
+    m = compile_crushmap(text)
+    assert m.bucket(-1).items == [0, 1]
+
+
+def test_device_classes_and_class_take():
+    text = """
+device 0 osd.0 class hdd
+device 1 osd.1 class ssd
+device 2 osd.2 class hdd
+device 3 osd.3 class ssd
+type 0 osd
+type 1 host
+type 10 root
+host h1 {
+    id -1
+    id -11 class hdd
+    id -21 class ssd
+    alg straw2
+    hash 0
+    item osd.0 weight 1.00000
+    item osd.1 weight 1.00000
+}
+host h2 {
+    id -2
+    id -12 class hdd
+    id -22 class ssd
+    alg straw2
+    hash 0
+    item osd.2 weight 1.00000
+    item osd.3 weight 1.00000
+}
+root default {
+    id -3
+    id -13 class hdd
+    id -23 class ssd
+    alg straw2
+    hash 0
+    item h1 weight 2.00000
+    item h2 weight 2.00000
+}
+rule ssd_rule {
+    id 0
+    type replicated
+    min_size 1
+    max_size 10
+    step take default class ssd
+    step chooseleaf firstn 0 type host
+    step emit
+}
+"""
+    m = compile_crushmap(text)
+    # declared shadow ids honored
+    assert m.class_bucket_ids[(-3, "ssd")] == -23
+    assert m.class_bucket_ids[(-1, "hdd")] == -11
+    shadow_root = m.bucket(-23)
+    assert shadow_root is not None
+    assert set(shadow_root.items) == {-21, -22}
+    # shadow hosts contain only ssd devices
+    assert m.bucket(-21).items == [1]
+    assert m.bucket(-22).items == [3]
+    # the rule takes the shadow root
+    assert m.rules[0].steps[0] == (RULE_TAKE, -23, 0)
+    # mapping only ever lands on ssd osds
+    weights = [WEIGHT_ONE] * m.max_devices
+    for x in range(128):
+        out = scalar_mapper.do_rule(m, 0, x, 2, weights)
+        assert all(o in (1, 3) for o in out), out
+    # shadow buckets fold back into class lines on decompile
+    text2 = decompile_crushmap(m)
+    assert "id -23 class ssd" in text2
+    assert "step take default class ssd" in text2
+    m2 = compile_crushmap(text2)
+    for x in range(64):
+        assert scalar_mapper.do_rule(m, 0, x, 2, weights) == \
+            scalar_mapper.do_rule(m2, 0, x, 2, weights)
+
+
+def test_choose_args_roundtrip():
+    text = BASIC + """
+choose_args 0 {
+  {
+    bucket_id -3
+    weight_set [
+      [ 1.00000 2.00000 ]
+      [ 2.00000 1.00000 ]
+    ]
+  }
+}
+"""
+    m = compile_crushmap(text)
+    assert 0 in m.choose_args
+    arg = m.choose_args[0][2]       # bucket -3 -> index 2
+    assert arg.weight_set == [[WEIGHT_ONE, 2 * WEIGHT_ONE],
+                              [2 * WEIGHT_ONE, WEIGHT_ONE]]
+    text2 = decompile_crushmap(m)
+    m2 = compile_crushmap(text2)
+    assert m2.choose_args[0][2].weight_set == arg.weight_set
+
+
+def test_errors():
+    with pytest.raises(CompileError):
+        compile_crushmap("bogus directive")
+    with pytest.raises(CompileError):
+        compile_crushmap("tunable not_a_tunable 1")
+    with pytest.raises(CompileError):
+        compile_crushmap("""
+type 1 host
+host h { id -1 alg nosuchalg hash 0 }
+""")
+    with pytest.raises(CompileError):        # item not defined
+        compile_crushmap("""
+type 1 host
+host h { id -1 alg straw2 hash 0 item osd.9 weight 1.0 }
+""")
+    with pytest.raises(CompileError):        # unterminated bucket
+        compile_crushmap("""
+type 1 host
+host h { id -1 alg straw2 hash 0
+""")
+
+
+def test_set_steps_and_indep():
+    text = """
+device 0 osd.0
+device 1 osd.1
+device 2 osd.2
+type 0 osd
+type 10 root
+root default {
+    id -1
+    alg straw2
+    hash 0
+    item osd.0 weight 1.00000
+    item osd.1 weight 1.00000
+    item osd.2 weight 1.00000
+}
+rule ec_rule {
+    id 1
+    type erasure
+    min_size 3
+    max_size 6
+    step set_chooseleaf_tries 5
+    step set_choose_tries 100
+    step take default
+    step choose indep 0 type osd
+    step emit
+}
+"""
+    m = compile_crushmap(text)
+    assert m.rules[0] is None and m.rules[1] is not None
+    r = m.rules[1]
+    assert r.type == 3
+    ops = [s[0] for s in r.steps]
+    from ceph_tpu.placement.crush_map import (
+        RULE_CHOOSE_INDEP, RULE_SET_CHOOSELEAF_TRIES, RULE_SET_CHOOSE_TRIES)
+    assert RULE_SET_CHOOSELEAF_TRIES in ops and RULE_SET_CHOOSE_TRIES in ops
+    assert RULE_CHOOSE_INDEP in ops
+    text2 = decompile_crushmap(m)
+    m2 = compile_crushmap(text2)
+    assert m2.rules[1].steps == r.steps

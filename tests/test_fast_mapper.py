@@ -1,0 +1,230 @@
+"""FastMapper (level-synchronous candidate-grid CRUSH) — correctness.
+
+The fast path returns (results, incomplete); combined with the exact
+fallback for flagged lanes it must be bit-exact vs the scalar oracle
+(validated against the reference C by tests/test_scalar_mapper.py).
+These tests drive FastMapper DIRECTLY (not through XlaMapper dispatch)
+so a silent fall-back can't mask a fast-path bug, and assert the
+incomplete rate stays small enough to matter for throughput.
+
+Reference semantics: crush_choose_firstn/indep retry bookkeeping
+(src/crush/mapper.c:460-843).
+"""
+import numpy as np
+import pytest
+
+from ceph_tpu.placement import scalar_mapper
+from ceph_tpu.placement.builder import (TYPE_HOST, TYPE_OSD, TYPE_RACK,
+                                        build_flat_cluster)
+from ceph_tpu.placement.crush_map import (
+    ITEM_NONE, RULE_CHOOSELEAF_FIRSTN, RULE_CHOOSELEAF_INDEP,
+    RULE_CHOOSE_FIRSTN, RULE_CHOOSE_INDEP, RULE_EMIT,
+    RULE_SET_CHOOSELEAF_STABLE, RULE_SET_CHOOSELEAF_VARY_R, RULE_TAKE,
+    ChooseArg, Rule, WEIGHT_ONE,
+)
+from ceph_tpu.placement.fast_mapper import FastMapper, UnsupportedRuleError
+
+
+def check_fast(cmap, ruleno, result_max, weights, xs, choose_args_key=None,
+               max_incomplete_frac=0.05, **fm_kw):
+    """FastMapper + oracle fallback == scalar oracle, elementwise."""
+    choose_args = cmap.choose_args.get(choose_args_key) \
+        if choose_args_key is not None else None
+    fm = FastMapper(cmap, choose_args_key=choose_args_key, **fm_kw)
+    out, inc = fm.map_batch(ruleno, xs, result_max, weights)
+    n_inc = int(inc.sum())
+    assert n_inc <= max(2, int(max_incomplete_frac * len(xs))), \
+        f"{n_inc}/{len(xs)} lanes incomplete — grid too lossy"
+    mismatches = []
+    for i, x in enumerate(xs):
+        want = scalar_mapper.do_rule(cmap, ruleno, int(x), result_max,
+                                     weights, choose_args)
+        want = want + [ITEM_NONE] * (result_max - len(want))
+        if inc[i]:
+            continue           # exact-fallback lanes checked by XlaMapper
+        if list(out[i]) != want:
+            mismatches.append((int(x), list(out[i]), want))
+    assert not mismatches, f"{len(mismatches)} wrong lanes: " \
+        f"{mismatches[:5]}"
+    return n_inc
+
+
+XS = np.arange(512)
+XS_BIG = np.concatenate([np.arange(256),
+                         np.asarray([2**31 - 1, 2**31, 2**32 - 1])])
+
+
+def test_firstn_chooseleaf_replicated():
+    cmap, root = build_flat_cluster(n_hosts=8, osds_per_host=4)
+    cmap.add_rule(Rule(steps=[(RULE_TAKE, root, 0),
+                              (RULE_CHOOSELEAF_FIRSTN, 0, TYPE_HOST),
+                              (RULE_EMIT, 0, 0)]))
+    check_fast(cmap, 0, 3, [WEIGHT_ONE] * cmap.max_devices, XS)
+
+
+def test_firstn_direct_osd():
+    cmap, root = build_flat_cluster(n_hosts=5, osds_per_host=6)
+    cmap.add_rule(Rule(steps=[(RULE_TAKE, root, 0),
+                              (RULE_CHOOSE_FIRSTN, 0, TYPE_OSD),
+                              (RULE_EMIT, 0, 0)]))
+    check_fast(cmap, 0, 3, [WEIGHT_ONE] * cmap.max_devices, XS)
+
+
+def test_indep_chooseleaf_ec():
+    # 6 reps over 10 hosts: late slots collide often, and the static
+    # grid covers rounds=5 vs the reference's 51 tries — ~7% of lanes
+    # legitimately flag for exact fallback (0.6^5); wide maps are ~0%
+    cmap, root = build_flat_cluster(n_hosts=10, osds_per_host=3)
+    cmap.add_rule(Rule(steps=[(RULE_TAKE, root, 0),
+                              (RULE_CHOOSELEAF_INDEP, 0, TYPE_HOST),
+                              (RULE_EMIT, 0, 0)]))
+    check_fast(cmap, 0, 6, [WEIGHT_ONE] * cmap.max_devices, XS,
+               max_incomplete_frac=0.12)
+
+
+def test_indep_direct_osd():
+    cmap, root = build_flat_cluster(n_hosts=6, osds_per_host=5)
+    cmap.add_rule(Rule(steps=[(RULE_TAKE, root, 0),
+                              (RULE_CHOOSE_INDEP, 4, TYPE_OSD),
+                              (RULE_EMIT, 0, 0)]))
+    check_fast(cmap, 0, 4, [WEIGHT_ONE] * cmap.max_devices, XS)
+
+
+def test_mixed_weights_and_out_devices():
+    cmap, root = build_flat_cluster(n_hosts=8, osds_per_host=4, seed=3)
+    cmap.add_rule(Rule(steps=[(RULE_TAKE, root, 0),
+                              (RULE_CHOOSELEAF_FIRSTN, 0, TYPE_HOST),
+                              (RULE_EMIT, 0, 0)]))
+    rng = np.random.default_rng(11)
+    weights = []
+    for _ in range(cmap.max_devices):
+        roll = rng.random()
+        weights.append(0 if roll < 0.15 else
+                       int(WEIGHT_ONE * rng.random()) if roll < 0.4 else
+                       WEIGHT_ONE)
+    # rejection retries make lanes burn more candidates: allow more
+    # fallback but require the fast results that ARE kept to be exact
+    check_fast(cmap, 0, 3, weights, XS, max_incomplete_frac=0.25)
+
+
+def test_large_x_values():
+    cmap, root = build_flat_cluster(n_hosts=6, osds_per_host=4, seed=7)
+    cmap.add_rule(Rule(steps=[(RULE_TAKE, root, 0),
+                              (RULE_CHOOSELEAF_FIRSTN, 0, TYPE_HOST),
+                              (RULE_EMIT, 0, 0)]))
+    check_fast(cmap, 0, 3, [WEIGHT_ONE] * cmap.max_devices, XS_BIG)
+
+
+def test_vary_r_stable_off():
+    cmap, root = build_flat_cluster(n_hosts=6, osds_per_host=4, seed=13)
+    cmap.add_rule(Rule(steps=[(RULE_SET_CHOOSELEAF_VARY_R, 1, 0),
+                              (RULE_SET_CHOOSELEAF_STABLE, 0, 0),
+                              (RULE_TAKE, root, 0),
+                              (RULE_CHOOSELEAF_FIRSTN, 0, TYPE_HOST),
+                              (RULE_EMIT, 0, 0)]))
+    check_fast(cmap, 0, 3, [WEIGHT_ONE] * cmap.max_devices, XS[:256],
+               max_incomplete_frac=0.25)
+
+
+def test_racks_two_level_unsupported_chain_falls_back():
+    """choose RACK then chooseleaf HOST = chained chooses — outside the
+    fast subset; must raise UnsupportedRuleError (dispatch catches it)."""
+    cmap, root = build_flat_cluster(n_racks=3, n_hosts=9, osds_per_host=3)
+    cmap.add_rule(Rule(steps=[(RULE_TAKE, root, 0),
+                              (RULE_CHOOSE_FIRSTN, 2, TYPE_RACK),
+                              (RULE_CHOOSELEAF_FIRSTN, 2, TYPE_HOST),
+                              (RULE_EMIT, 0, 0)]))
+    fm = FastMapper(cmap)
+    with pytest.raises(UnsupportedRuleError):
+        fm.map_batch(0, XS[:8], 4, [WEIGHT_ONE] * cmap.max_devices)
+
+
+def test_multiple_takes_emits():
+    cmap, root = build_flat_cluster(n_hosts=4, osds_per_host=3, seed=17)
+    cmap.add_rule(Rule(steps=[(RULE_TAKE, -1, 0),
+                              (RULE_CHOOSE_FIRSTN, 1, TYPE_OSD),
+                              (RULE_EMIT, 0, 0),
+                              (RULE_TAKE, root, 0),
+                              (RULE_CHOOSELEAF_FIRSTN, 2, TYPE_HOST),
+                              (RULE_EMIT, 0, 0)]))
+    check_fast(cmap, 0, 3, [WEIGHT_ONE] * cmap.max_devices, XS[:256])
+
+
+def test_choose_args_single_position():
+    """P==1 weight sets are exact in the compact grid."""
+    cmap, root = build_flat_cluster(n_hosts=5, osds_per_host=4, seed=19)
+    rng = np.random.default_rng(23)
+    args = []
+    for b in cmap.buckets:
+        if b is None:
+            args.append(None)
+            continue
+        ws = [[max(1, int(w * (0.5 + rng.random()))) for w in b.weights]]
+        args.append(ChooseArg(ids=None, weight_set=ws))
+    cmap.choose_args["p"] = args
+    cmap.add_rule(Rule(steps=[(RULE_TAKE, root, 0),
+                              (RULE_CHOOSELEAF_FIRSTN, 0, TYPE_HOST),
+                              (RULE_EMIT, 0, 0)]))
+    check_fast(cmap, 0, 3, [WEIGHT_ONE] * cmap.max_devices, XS[:256],
+               choose_args_key="p")
+
+
+def test_exact_select_mode_matches():
+    """CEPH_TPU_SELECT=exact path (full-width LUT, no approx filter)."""
+    cmap, root = build_flat_cluster(n_hosts=6, osds_per_host=4, seed=29)
+    cmap.add_rule(Rule(steps=[(RULE_TAKE, root, 0),
+                              (RULE_CHOOSELEAF_FIRSTN, 0, TYPE_HOST),
+                              (RULE_EMIT, 0, 0)]))
+    from ceph_tpu.common import config
+    config().set("straw2_select", "exact")
+    try:
+        check_fast(cmap, 0, 3, [WEIGHT_ONE] * cmap.max_devices, XS[:256])
+    finally:
+        config().clear("straw2_select")
+
+
+def test_numrep_exceeds_domains():
+    cmap, root = build_flat_cluster(n_hosts=3, osds_per_host=4)
+    cmap.add_rule(Rule(steps=[(RULE_TAKE, root, 0),
+                              (RULE_CHOOSELEAF_FIRSTN, 0, TYPE_HOST),
+                              (RULE_EMIT, 0, 0)]))
+    check_fast(cmap, 0, 5, [WEIGHT_ONE] * cmap.max_devices, XS[:128],
+               max_incomplete_frac=1.0)   # budget exhaustion flags lanes
+
+
+def test_randomized_topologies_sweep():
+    """Many random small clusters x both rule families."""
+    rng = np.random.default_rng(31)
+    for trial in range(6):
+        n_hosts = int(rng.integers(3, 12))
+        oph = int(rng.integers(2, 6))
+        cmap, root = build_flat_cluster(n_hosts=n_hosts, osds_per_host=oph,
+                                        seed=int(rng.integers(1 << 30)))
+        firstn = bool(rng.integers(2))
+        op = RULE_CHOOSELEAF_FIRSTN if firstn else RULE_CHOOSELEAF_INDEP
+        cmap.add_rule(Rule(steps=[(RULE_TAKE, root, 0),
+                                  (op, 0, TYPE_HOST),
+                                  (RULE_EMIT, 0, 0)]))
+        rmax = int(rng.integers(2, min(5, n_hosts) + 1))
+        weights = [WEIGHT_ONE if rng.random() > 0.1 else 0
+                   for _ in range(cmap.max_devices)]
+        check_fast(cmap, 0, rmax, weights, np.arange(128),
+                   max_incomplete_frac=0.3)
+
+
+def test_incomplete_lanes_resolved_by_dispatch():
+    """End-to-end: XlaMapper(fast) == scalar for EVERY lane, including
+    the incomplete ones it recomputes via the exact fallback."""
+    from ceph_tpu.placement.xla_mapper import XlaMapper
+    cmap, root = build_flat_cluster(n_hosts=4, osds_per_host=3, seed=41)
+    cmap.add_rule(Rule(steps=[(RULE_TAKE, root, 0),
+                              (RULE_CHOOSELEAF_FIRSTN, 0, TYPE_HOST),
+                              (RULE_EMIT, 0, 0)]))
+    weights = [WEIGHT_ONE if i % 5 else 0 for i in range(cmap.max_devices)]
+    mapper = XlaMapper(cmap, fast=True)
+    xs = np.arange(512)
+    got = mapper.map_batch(0, xs, 4, weights)
+    for i, x in enumerate(xs):
+        want = scalar_mapper.do_rule(cmap, 0, int(x), 4, weights)
+        want = want + [ITEM_NONE] * (4 - len(want))
+        assert list(got[i]) == want, f"x={x}"

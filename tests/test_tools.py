@@ -70,9 +70,9 @@ def test_osdmaptool_test_map_pgs(map_spec, tmp_path, capsys):
     p.write_text(json.dumps(cluster))
     rc = osdmaptool.main([str(p), "--test-map-pgs"])
     assert rc == 0
-    out = capsys.readouterr().out
-    assert "96 pgs" in out
-    assert "total replicas 320" in out
+    cap = capsys.readouterr()
+    assert "96 pgs" in cap.err       # timing line -> stderr (goldens)
+    assert "total replicas 320" in cap.out
 
 
 def test_ec_bench_json(capsys):
