@@ -85,6 +85,9 @@ class PGPool:
     crush_rule: int = 0
     flags: int = FLAG_HASHPSPOOL
     erasure_code_profile: str = ""
+    # EC stripe unit (reference: osd_pool_erasure_code_stripe_unit,
+    # default 4 KiB); chunk size of every stripe in the pool
+    stripe_unit: int = 4096
 
     def __post_init__(self):
         if not self.pgp_num:

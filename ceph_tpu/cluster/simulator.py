@@ -6,8 +6,16 @@ shard payloads in dicts; placement runs through the real OSDMap pipeline
 (batched CRUSH on device); EC pools stripe/encode through the real codec
 registry (batched bit-plane matmuls on device).
 
+EC objects use the reference's stripewise shard layout (stripe_info_t,
+src/osd/ECUtil.h:28-60): an object of S stripes stores, on shard j, the
+concatenation of its S chunk-j slices — so `write(offset, len)` is a
+read-modify-write through ceph_tpu.cluster.ec_rmw (the ECBackend
+start_rmw / ExtentCache pipeline, src/osd/ECBackend.cc:1876) and
+recovery rebuilds whole shard files with stripe-batched decodes.
+
 put(object) → ps hash → PG → up set → store shards on OSDs
 get(object) → gather surviving shards → minimum_to_decode → decode
+write(object, offset, data) → RMW partial-stripe overwrite
 kill/out OSDs → remap diff (old vs new batched mapping) → recover_all
 rebuilds lost shards via batched decode and re-places them — the
 ECBackend recovery flow (src/osd/ECBackend.cc:757,433,462) collapsed
@@ -24,6 +32,7 @@ from ..ec import instance as ec_registry
 from ..ec.interface import ErasureCodeError
 from ..ops import hashing
 from ..placement.crush_map import ITEM_NONE
+from .ec_rmw import ExtentCache, RmwPipeline, StripeInfo
 from .osdmap import OSDMap, PGPool, POOL_ERASURE, POOL_REPLICATED
 
 ShardKey = Tuple[int, int, str, int]   # (pool, pg, object, shard)
@@ -53,9 +62,10 @@ class SimOSD:
 
 @dataclass
 class ObjectInfo:
-    """Client-side record of a written object (size for unpad)."""
+    """Client-side record of a written object."""
     size: int
-    chunk_size: int
+    chunk_size: int          # per-stripe chunk bytes (EC) / size (rep)
+    n_stripes: int = 1
 
 
 class ClusterSim:
@@ -67,21 +77,41 @@ class ClusterSim:
         self.codecs: Dict[int, object] = {}
         self.objects: Dict[Tuple[int, str], ObjectInfo] = {}
         self.ec_profiles: Dict[str, Dict[str, str]] = {}
+        self.extent_cache = ExtentCache()
+        self._rmw: Dict[int, RmwPipeline] = {}
 
     # ------------------------------------------------------------- pools --
     def create_ec_profile(self, name: str, profile: Dict[str, str]) -> None:
         """Validates by instantiating the plugin, like the mon
         (src/mon/OSDMonitor.cc:7349-7444)."""
-        ec_registry().factory(profile.get("plugin", "jax"), profile)
+        from ..common.options import config
+        default = config().get("erasure_code_default_plugin")
+        ec_registry().factory(profile.get("plugin", default), profile)
         self.ec_profiles[name] = dict(profile)
 
     def codec_for(self, pool: PGPool):
         codec = self.codecs.get(pool.id)
         if codec is None:
+            from ..common.options import config
             prof = self.ec_profiles[pool.erasure_code_profile]
-            codec = ec_registry().factory(prof.get("plugin", "jax"), prof)
+            codec = ec_registry().factory(
+                prof.get("plugin",
+                         config().get("erasure_code_default_plugin")),
+                prof)
             self.codecs[pool.id] = codec
         return codec
+
+    def _sinfo(self, pool: PGPool) -> StripeInfo:
+        codec = self.codec_for(pool)
+        return StripeInfo(codec.get_data_chunk_count(), pool.stripe_unit)
+
+    def _pipeline(self, pool: PGPool) -> RmwPipeline:
+        p = self._rmw.get(pool.id)
+        if p is None:
+            p = RmwPipeline(self.codec_for(pool), pool.stripe_unit,
+                            cache=self.extent_cache)
+            self._rmw[pool.id] = p
+        return p
 
     # ---------------------------------------------------------- placement --
     def object_pg(self, pool: PGPool, name: str) -> int:
@@ -91,6 +121,41 @@ class ClusterSim:
     def pg_up(self, pool: PGPool, pg: int) -> List[int]:
         up, _, acting, _ = self.osdmap.pg_to_up_acting_osds(pool.id, pg)
         return acting or up
+
+    # ------------------------------------------------------- shard access --
+    def _shard_sources(self, up: List[int], shard: int) -> List[int]:
+        tgt = up[shard] if shard < len(up) else ITEM_NONE
+        return ([tgt] if tgt != ITEM_NONE else []) + \
+            [o.id for o in self.osds]
+
+    def _read_shard(self, pool_id: int, pg: int, name: str, shard: int,
+                    up: List[int]) -> Optional[np.ndarray]:
+        """Up set first, then any live OSD (stale-map/pre-recovery)."""
+        for o in self._shard_sources(up, shard):
+            p = self.osds[o].get((pool_id, pg, name, shard))
+            if p is not None:
+                return p
+        return None
+
+    def _write_shard(self, pool_id: int, pg: int, name: str, shard: int,
+                     up: List[int], payload: np.ndarray) -> Optional[int]:
+        tgt = up[shard] if shard < len(up) else ITEM_NONE
+        if tgt == ITEM_NONE:
+            # degraded write: the shard is homeless.  Stale copies of
+            # the PREVIOUS version must not survive — the any-live-OSD
+            # read fallback would otherwise mix shard versions and
+            # decode garbage (the real system prevents this with
+            # per-shard versions + peering; the simulator's equivalent
+            # is deleting the outdated copy).
+            for o in self.osds:
+                o.delete((pool_id, pg, name, shard))
+            return None
+        self.osds[tgt].put((pool_id, pg, name, shard), payload)
+        # a successful write also supersedes any stray stale copies
+        for o in self.osds:
+            if o.id != tgt:
+                o.delete((pool_id, pg, name, shard))
+        return tgt
 
     # --------------------------------------------------------------- I/O --
     def put(self, pool_id: int, name: str, data: bytes) -> List[int]:
@@ -109,17 +174,65 @@ class ClusterSim:
             return placed
         codec = self.codec_for(pool)
         k, mm = codec.get_data_chunk_count(), codec.get_coding_chunk_count()
-        chunks = codec.encode(set(range(k + mm)), data)
+        si = self._sinfo(pool)
+        n_str = max(1, si.stripe_count(len(data)))
+        buf = np.zeros(n_str * si.stripe_width, dtype=np.uint8)
+        buf[:len(data)] = np.frombuffer(data, dtype=np.uint8)
+        dchunks = buf.reshape(n_str, k, si.chunk_size)
+        parity = np.asarray(codec.encode_chunks_batch(dchunks))
+        full = np.concatenate([dchunks, parity], axis=1)   # [S, k+m, U]
         placed = []
-        for shard, payload in chunks.items():
-            tgt = up[shard] if shard < len(up) else ITEM_NONE
-            if tgt == ITEM_NONE:
-                continue   # degraded write: shard currently homeless
-            self.osds[tgt].put((pool_id, pg, name, shard), payload)
-            placed.append(tgt)
+        for shard in range(k + mm):
+            tgt = self._write_shard(pool_id, pg, name, shard, up,
+                                    full[:, shard].reshape(-1))
+            if tgt is not None:
+                placed.append(tgt)
+        self.extent_cache.invalidate_object((pool_id, name))
         self.objects[(pool_id, name)] = ObjectInfo(
-            len(data), codec.get_chunk_size(len(data)))
+            len(data), si.chunk_size, n_str)
         return placed
+
+    def _gather_stripes(self, pool: PGPool, name: str, info: ObjectInfo,
+                        stripes: List[int]) -> Dict[int, np.ndarray]:
+        """Materialize OLD data chunks [k, U] for the given stripes,
+        decoding degraded ones (batched per erasure signature)."""
+        codec = self.codec_for(pool)
+        k, mm = codec.get_data_chunk_count(), codec.get_coding_chunk_count()
+        U = info.chunk_size
+        pg = self.object_pg(pool, name)
+        up = self.pg_up(pool, pg)
+        shard_files: Dict[int, Optional[np.ndarray]] = {}
+        for shard in range(k + mm):
+            f = self._read_shard(pool.id, pg, name, shard, up)
+            if f is not None and len(f) >= info.n_stripes * U:
+                shard_files[shard] = f
+        avail = set(shard_files)
+        out: Dict[int, np.ndarray] = {}
+        missing_data = [c for c in range(k) if c not in avail]
+        if not missing_data:
+            for s in stripes:
+                out[s] = np.stack([
+                    shard_files[c][s * U:(s + 1) * U] for c in range(k)])
+            return out
+        try:
+            plan = sorted(codec.minimum_to_decode(set(range(k)), avail))
+        except ErasureCodeError:
+            raise IOError(f"object {name}: unrecoverable "
+                          f"(only shards {sorted(avail)})")
+        sub = np.stack([
+            np.stack([shard_files[c][s * U:(s + 1) * U] for c in plan])
+            for s in stripes])                       # [S, n_plan, U]
+        dec = np.asarray(codec.decode_chunks_batch(
+            plan, sub, missing_data))                # [S, n_miss, U]
+        for j, s in enumerate(stripes):
+            chunks = np.zeros((k, U), dtype=np.uint8)
+            for c in range(k):
+                if c in avail:
+                    chunks[c] = shard_files[c][s * U:(s + 1) * U]
+            for i, c in enumerate(missing_data):
+                chunks[c] = dec[j, i]
+            out[s] = chunks
+        return out
 
     def get(self, pool_id: int, name: str) -> bytes:
         pool = self.osdmap.pools[pool_id]
@@ -127,8 +240,6 @@ class ClusterSim:
         pg = self.object_pg(pool, name)
         up = self.pg_up(pool, pg)
         if pool.type == POOL_REPLICATED:
-            # up set first, then any live OSD (stale-map / pre-recovery
-            # reads, same as the EC branch below)
             sources = [o for o in up if o != ITEM_NONE] + \
                 [o.id for o in self.osds]
             for o in sources:
@@ -136,23 +247,59 @@ class ClusterSim:
                 if payload is not None:
                     return payload.tobytes()[:info.size]
             raise IOError(f"object {name}: no replica available")
+        stripes = list(range(info.n_stripes))
+        chunks = self._gather_stripes(pool, name, info, stripes)
+        buf = np.concatenate([chunks[s].reshape(-1) for s in stripes])
+        return buf.tobytes()[:info.size]
+
+    def write(self, pool_id: int, name: str, offset: int,
+              data: bytes) -> List[int]:
+        """Partial overwrite.  EC pools run the RMW pipeline (requires
+        FLAG_EC_OVERWRITES semantics); replicated pools splice bytes."""
+        pool = self.osdmap.pools[pool_id]
+        info = self.objects.get((pool_id, name))
+        if pool.type == POOL_REPLICATED:
+            old = self.get(pool_id, name) if info else b""
+            size = max(len(old), offset + len(data))
+            buf = bytearray(size)
+            buf[:len(old)] = old
+            buf[offset:offset + len(data)] = data
+            return self.put(pool_id, name, bytes(buf))
+        if info is None:
+            info = ObjectInfo(0, pool.stripe_unit, 0)
+        pg = self.object_pg(pool, name)
+        up = self.pg_up(pool, pg)
         codec = self.codec_for(pool)
         k, mm = codec.get_data_chunk_count(), codec.get_coding_chunk_count()
-        avail: Dict[int, np.ndarray] = {}
-        # shards may live on osds outside the current up set (stale map);
-        # search up first, then everywhere (the real system would backfill)
+        si = self._sinfo(pool)
+        pipe = self._pipeline(pool)
+
+        def read_stripe(idx: int) -> Optional[np.ndarray]:
+            if idx >= info.n_stripes:
+                return None
+            got = self._gather_stripes(pool, name, info, [idx])
+            return got.get(idx)
+
+        new_chunks, new_size = pipe.write(
+            (pool_id, name), info.size, offset, data, read_stripe)
+        n_str = max(info.n_stripes, si.stripe_count(new_size))
+        # grow shard files if the object extended
+        placed: Set[int] = set()
         for shard in range(k + mm):
-            tgt = up[shard] if shard < len(up) else ITEM_NONE
-            sources = ([tgt] if tgt != ITEM_NONE else []) + \
-                [o.id for o in self.osds]
-            for o in sources:
-                payload = self.osds[o].get((pool_id, pg, name, shard))
-                if payload is not None:
-                    avail[shard] = payload
-                    break
-        plan = codec.minimum_to_decode(set(range(k)), set(avail))
-        out = codec.decode_concat({c: avail[c] for c in plan})
-        return out.tobytes()[:info.size]
+            f = self._read_shard(pool.id, pg, name, shard, up)
+            U = si.chunk_size
+            need = n_str * U
+            buf = np.zeros(need, dtype=np.uint8)
+            if f is not None:
+                buf[:min(len(f), need)] = f[:need]
+            for idx, chunks in new_chunks.items():
+                buf[idx * U:(idx + 1) * U] = chunks[shard]
+            tgt = self._write_shard(pool_id, pg, name, shard, up, buf)
+            if tgt is not None:
+                placed.add(tgt)
+        self.objects[(pool_id, name)] = ObjectInfo(
+            new_size, si.chunk_size, n_str)
+        return sorted(placed)
 
     # ----------------------------------------------------------- failure --
     def kill_osd(self, osd: int) -> None:
@@ -173,23 +320,20 @@ class ClusterSim:
     # ---------------------------------------------------------- recovery --
     def remap_diff(self, pool_id: int, old_up: np.ndarray
                    ) -> Dict[int, List[int]]:
-        """Batched old-vs-new mapping diff: {pg: shards whose home moved}."""
+        """Batched old-vs-new mapping diff: {pg: shards whose home moved}
+        — vectorized, no per-PG Python loop."""
         new_up, _ = self.osdmap.map_pgs_batch(pool_id)
-        diffs: Dict[int, List[int]] = {}
         n = min(len(old_up), len(new_up))
-        for pg in range(n):
-            moved = [s for s in range(new_up.shape[1])
-                     if old_up[pg][s] != new_up[pg][s]]
-            if moved:
-                diffs[pg] = moved
-        return diffs
+        diff = old_up[:n] != new_up[:n]
+        pgs = np.flatnonzero(diff.any(axis=1))
+        return {int(pg): [int(s) for s in np.flatnonzero(diff[pg])]
+                for pg in pgs}
 
     def recover_all(self, pool_id: int) -> Dict[str, int]:
-        """Rebuild every unreadable/misplaced shard onto the current up set.
-
-        The batched analog of ECBackend::recover_object: group damaged
-        stripes by erasure signature, decode each group in one batched
-        device call, write rebuilt shards to their new homes.
+        """Rebuild every unreadable/misplaced shard onto the current up
+        set: the batched analog of ECBackend::recover_object — damaged
+        objects' stripes are grouped by erasure signature and each group
+        decodes in one device call.
         """
         pool = self.osdmap.pools[pool_id]
         stats = {"objects_scanned": 0, "shards_rebuilt": 0,
@@ -201,12 +345,7 @@ class ClusterSim:
                 stats["objects_scanned"] += 1
                 pg = self.object_pg(pool, name)
                 up = self.pg_up(pool, pg)
-                payload = None
-                for o in range(len(self.osds)):
-                    p = self.osds[o].get((pool_id, pg, name, 0))
-                    if p is not None:
-                        payload = p
-                        break
+                payload = self._read_shard(pool_id, pg, name, 0, up)
                 if payload is None:
                     continue
                 for o in up:
@@ -219,56 +358,63 @@ class ClusterSim:
         codec = self.codec_for(pool)
         k, mm = codec.get_data_chunk_count(), codec.get_coding_chunk_count()
         n_shards = k + mm
-        # signature -> list of (pg, name, up, avail_chunks dict)
-        groups: Dict[Tuple[Tuple[int, ...], Tuple[int, ...]], List] = {}
+        # (avail_plan, missing, U) -> list of (name, up, shard_files,
+        #  n_stripes) sharing one decode executable
+        groups: Dict[Tuple, List] = {}
         for (pid, name), info in self.objects.items():
             if pid != pool_id:
                 continue
             stats["objects_scanned"] += 1
             pg = self.object_pg(pool, name)
             up = self.pg_up(pool, pg)
-            avail: Dict[int, np.ndarray] = {}
+            U = info.chunk_size
+            shard_files: Dict[int, np.ndarray] = {}
             missing: List[int] = []
             for shard in range(n_shards):
-                found = None
-                for o in range(len(self.osds)):
-                    p = self.osds[o].get((pool_id, pg, name, shard))
-                    if p is not None:
-                        found = p
-                        break
-                if found is None:
+                f = self._read_shard(pool_id, pg, name, shard, up)
+                if f is None or len(f) < info.n_stripes * U:
                     missing.append(shard)
                 else:
-                    avail[shard] = found
-            if missing:
-                # chunk size is part of the key: stripes only batch with
-                # shape-identical peers
-                chunk_len = len(next(iter(avail.values()))) if avail else 0
-                key = (tuple(sorted(avail)[:k]), tuple(missing), chunk_len)
-                groups.setdefault(key, []).append((pg, name, up, avail))
+                    shard_files[shard] = f
             # re-place surviving shards that are off their new home
-            for shard, payload in avail.items():
+            for shard, payload in shard_files.items():
                 tgt = up[shard] if shard < len(up) else ITEM_NONE
                 if tgt != ITEM_NONE and \
                         self.osds[tgt].get((pool_id, pg, name, shard)) is None:
                     self.osds[tgt].put((pool_id, pg, name, shard), payload)
                     stats["shards_copied"] += 1
-        for (use, missing, _chunk_len), members in groups.items():
-            if len(use) < k:
-                continue   # unrecoverable group
+            if not missing:
+                continue
+            avail = set(shard_files)
+            try:
+                plan = tuple(sorted(codec.minimum_to_decode(
+                    set(missing), avail)))
+            except ErasureCodeError:
+                continue   # unrecoverable object
+            key = (plan, tuple(missing), U)
+            groups.setdefault(key, []).append(
+                (name, up, shard_files, info.n_stripes, pg))
+        for (plan, missing, U), members in groups.items():
             stats["batches"] += 1
-            batch = np.stack([
-                np.stack([avail[c] for c in use]) for _, _, _, avail
-                in members])
-            rebuilt = codec.decode_chunks_batch(list(use), batch,
-                                                list(missing))
-            for i, (pg, name, up, _avail) in enumerate(members):
-                for j, shard in enumerate(missing):
+            # batch axis = every damaged stripe of every member object
+            blocks = []
+            for name, up, files, n_str, pg in members:
+                blocks.append(np.stack(
+                    [np.stack([files[c][s * U:(s + 1) * U]
+                               for c in plan]) for s in range(n_str)]))
+            batch = np.concatenate(blocks)          # [sum_S, n_plan, U]
+            rebuilt = np.asarray(codec.decode_chunks_batch(
+                list(plan), batch, list(missing)))
+            pos = 0
+            for name, up, files, n_str, pg in members:
+                part = rebuilt[pos:pos + n_str]      # [S, n_miss, U]
+                pos += n_str
+                for i, shard in enumerate(missing):
                     tgt = up[shard] if shard < len(up) else ITEM_NONE
                     if tgt == ITEM_NONE:
                         continue
                     self.osds[tgt].put((pool_id, pg, name, shard),
-                                       rebuilt[i, j])
+                                       part[:, i].reshape(-1))
                     stats["shards_rebuilt"] += 1
         return stats
 
@@ -286,18 +432,22 @@ class ClusterSim:
             if pid != pool_id:
                 continue
             pg = self.object_pg(pool, name)
-            shards: Dict[int, np.ndarray] = {}
+            up = self.pg_up(pool, pg)
+            U = info.chunk_size
+            files: Dict[int, np.ndarray] = {}
             for shard in range(k + mm):
-                for o in range(len(self.osds)):
-                    p = self.osds[o].get((pool_id, pg, name, shard))
-                    if p is not None:
-                        shards[shard] = p
-                        break
-            if set(range(k)) <= set(shards):
-                parity = codec.encode_chunks(
-                    np.stack([shards[i] for i in range(k)]))
-                for j in range(mm):
-                    if k + j in shards and \
-                            not np.array_equal(parity[j], shards[k + j]):
+                f = self._read_shard(pool_id, pg, name, shard, up)
+                if f is not None and len(f) >= info.n_stripes * U:
+                    files[shard] = f
+            if not set(range(k)) <= set(files):
+                continue
+            dchunks = np.stack([
+                files[c].reshape(info.n_stripes, U) for c in range(k)],
+                axis=1)                              # [S, k, U]
+            parity = np.asarray(codec.encode_chunks_batch(dchunks))
+            for j in range(mm):
+                if k + j in files:
+                    want = files[k + j].reshape(info.n_stripes, U)
+                    if not np.array_equal(parity[:, j], want):
                         bad.append((name, k + j))
         return bad

@@ -97,15 +97,21 @@ class AdminServer:
             except (OSError, ValueError):
                 return            # closed
             with conn:
-                buf = b""
-                while b"\n" not in buf:
-                    chunk = conn.recv(65536)
-                    if not chunk:
-                        break
-                    buf += chunk
-                if buf:
-                    line = buf.split(b"\n", 1)[0].decode()
-                    conn.sendall(self.handle_json(line).encode() + b"\n")
+                try:
+                    # a silent client must not wedge the admin socket
+                    conn.settimeout(5.0)
+                    buf = b""
+                    while b"\n" not in buf:
+                        chunk = conn.recv(65536)
+                        if not chunk:
+                            break
+                        buf += chunk
+                    if buf:
+                        line = buf.split(b"\n", 1)[0].decode()
+                        conn.sendall(
+                            self.handle_json(line).encode() + b"\n")
+                except OSError:
+                    continue       # timeout / reset: drop this client
 
     def close(self) -> None:
         if self._sock is not None:

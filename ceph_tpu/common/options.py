@@ -150,8 +150,15 @@ class Options:
         with self._lock:
             self._values.setdefault(name, {})[level] = v
             obs = list(self._observers.get(name, ()))
-        for cb in obs:
-            cb(name, v)
+        # observers see the EFFECTIVE value: a set at a masked level
+        # (e.g. file under an env override) must not poison caches
+        try:
+            eff = self.get(name)
+        except OptionError:
+            eff = None
+        if eff is not None:
+            for cb in obs:
+                cb(name, eff)
         return v
 
     def clear(self, name: str, level: int = LEVEL_RUNTIME) -> None:
@@ -225,20 +232,23 @@ _TABLE: Tuple[Option, ...] = (
     Option("fastmap_max_grid_lanes", TYPE_INT, 1 << 21,
            "fast mapper: max (lane x candidate) product per dispatch",
            min=1 << 12),
+    Option("fastmap_max_grid_mib", TYPE_INT, 8192,
+           "fast mapper: HBM budget (MiB) per [rows, level-width] "
+           "working buffer; lanes per dispatch scale down to fit "
+           "(8 GiB measured fastest on v5e-1 for 10k-OSD sweeps)",
+           min=64),
     Option("ec_table_cache_size", TYPE_INT, 2516,
            "decode-matrix LRU entries per codec (reference: "
            "ErasureCodeIsaTableCache.h:35)", min=1),
-    Option("ec_batch_max_bytes", TYPE_INT, 1 << 30,
-           "max payload bytes per batched encode/decode dispatch",
-           min=1 << 16),
+    Option("ec_kernel", TYPE_STR, "auto",
+           "GF(2^8) matmul lowering: auto = pallas VMEM-unpack kernel "
+           "on TPU, xla elsewhere; both bit-identical",
+           enum_values=("auto", "xla", "pallas")),
     Option("erasure_code_default_plugin", TYPE_STR, "jax",
            "plugin used when a profile names none (reference: "
            "osd_pool_default_erasure_code_profile, options.cc:2748)"),
     Option("perf_counters_enabled", TYPE_BOOL, True,
            "collect dispatch/cache/bytes counters"),
-    Option("log_level", TYPE_INT, 1,
-           "0=errors 1=info 2=debug (dout gather-level analog)",
-           min=0, max=5),
 )
 
 _config: Optional[Options] = None

@@ -27,6 +27,11 @@ DEFAULT_M = 3
 TECHNIQUES = ("reed_sol_van", "cauchy", "cauchy_good", "isa_rs")
 
 
+def _pallas_ok() -> bool:
+    from ..ops import gf_pallas
+    return gf_pallas.available()
+
+
 class ErasureCodeJax(MatrixCodec):
     """RS/Cauchy codec whose stripe math executes on the accelerator."""
 
@@ -65,6 +70,18 @@ class ErasureCodeJax(MatrixCodec):
     def encode_chunks_batch(self, data: np.ndarray) -> np.ndarray:
         return np.asarray(self.encode_chunks_device(data))
 
+    def _matmul(self, matrix, data):
+        """Backend select: XLA lowering or the Pallas VMEM-unpack
+        kernel (ec_kernel option: auto = pallas on TPU, xla elsewhere;
+        both bit-identical — see ops/gf_pallas.py)."""
+        from ..common.options import config
+        mode = config().get("ec_kernel")
+        if mode == "pallas" or (mode == "auto" and _pallas_ok()):
+            from ..ops import gf_pallas
+            return gf_pallas.bitplane_matmul(
+                gf_jax.matrix_to_device(matrix), data)
+        return gf_jax.gf8_matmul(matrix, data)
+
     def encode_chunks_device(self, data):
         """[..., k, L] -> [..., m, L]; stays on device (jax.Array out)."""
         if data.shape[-2] != self.k:
@@ -73,7 +90,7 @@ class ErasureCodeJax(MatrixCodec):
         pc = self._pc
         pc.inc("encode_dispatches")
         pc.inc("encode_bytes", int(np.prod(data.shape)))
-        return gf_jax.gf8_matmul(self.parity, data)
+        return self._matmul(self.parity, data)
 
     # ----------------------------------------------------------- decode ---
     def decode_chunks(self, available_ids, chunks, erased_ids):
@@ -102,8 +119,15 @@ class ErasureCodeJax(MatrixCodec):
         pc.inc("decode_bytes", int(np.prod(chunks.shape)))
         pc.set("decode_cache_hits", self._cache.hits)
         pc.set("decode_cache_misses", self._cache.misses)
-        rows = jnp.asarray(chunks)[..., sel, :]
-        return gf_jax.gf8_matmul(R, rows)
+        dev = jnp.asarray(chunks)
+        if sel == list(range(len(order))):
+            rows = dev                  # already the exact row set
+        else:
+            # static per-row slices, NOT dev[..., sel, :]: a fancy-index
+            # gather lowers to ~0.1 G elem/s serial loops on TPU
+            # (measured 60x slower than the encode matmul it feeds)
+            rows = jnp.stack([dev[..., i, :] for i in sel], axis=-2)
+        return self._matmul(R, rows)
 
 
 def _factory(profile: ErasureCodeProfile):

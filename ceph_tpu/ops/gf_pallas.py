@@ -1,0 +1,109 @@
+"""Pallas TPU kernel for the GF(2^8) bit-plane matmul.
+
+Streams [k, TILE] byte tiles into VMEM, unpacks to bit-planes IN VMEM,
+runs the int8 MXU matmul, and packs parity bytes before they leave the
+core, so the 8x bit expansion never touches HBM.  Measured on v5e-1 it
+currently matches the XLA lowering (~7.5 ms per 134 MB batch for
+RS(8,3)) — both are bound by MXU shape utilization (M=8m=24, K=8k=64
+against the 128x128 array) and the int32 bit-twiddling this Mosaic
+forces (u8 vector shifts/compares/adds all fail to legalize).  Kept as
+the TPU-kernel foothold: shape-packing or plane-major-at-rest layouts
+improve from here without touching callers.
+
+Same math, bit-for-bit: out = pack((B @ unpack(d)) & 1) with the
+bit-row convention of gf.gf8_bitmatrix (row 8i+b = bit b of symbol row
+i).  Wired into the jax codec's encode/decode via the `ec_kernel`
+option (auto = this kernel on TPU, XLA elsewhere).
+
+Reference roles: ISA-L ec_encode_data (src/erasure-code/isa/
+ErasureCodeIsa.cc:129), jerasure bitmatrix schedules
+(src/erasure-code/jerasure/ErasureCodeJerasure.cc:162).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# pallas is TPU-only here; import lazily so CPU test runs never touch it
+_TILE = 2048          # byte lanes per program (multiple of 128)
+
+
+def _kernel(bitmat_ref, data_ref, out_ref):
+    """bitmat [8m, 8k] i8 (VMEM-resident), data [1, k, T] u8 ->
+    out [1, m, T] u8.  Bit twiddling stays in uint8 so the VPU packs
+    4x the lanes per cycle vs int32."""
+    d = data_ref[0]                              # [k, T] uint8
+    k, T = d.shape
+    # int32 twiddling throughout: this Mosaic rejects u8 vector shifts,
+    # u8 compares, i8 adds AND i1/i8 reshapes — i32 is the only
+    # vector-legal route (measured equal to the XLA lowering anyway;
+    # the kernel is MXU-shape-bound at M=8m, K=8k, not unpack-bound)
+    shifts = jax.lax.broadcasted_iota(jnp.int32, (k, 8, T), 1)
+    bits = ((d[:, None, :].astype(jnp.int32) >> shifts) & 1)
+    bits = bits.reshape(8 * k, T).astype(jnp.int8)
+    acc = jax.lax.dot_general(
+        bitmat_ref[:], bits, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32)        # [8m, T]
+    m = acc.shape[0] // 8
+    bit_i32 = (acc & 1).reshape(m, 8, T)
+    out = bit_i32[:, 0, :]
+    for b in range(1, 8):
+        out = out | (bit_i32[:, b, :] << b)
+    out_ref[0] = out.astype(jnp.uint8)
+
+
+@functools.partial(jax.jit, static_argnames=())
+def _bitplane_matmul_pallas(bitmat, data):
+    """bitmat [8m, 8k] int8, data [B, k, L] uint8 -> [B, m, L] uint8.
+    L must be a multiple of _TILE (caller pads)."""
+    from jax.experimental import pallas as pl
+    B, k, L = data.shape
+    m = bitmat.shape[0] // 8
+    grid = (B, L // _TILE)
+    # index maps must be i32: under jax_enable_x64 (which the CRUSH
+    # mapper turns on process-wide) they trace as i64 and Mosaic fails
+    # to legalize the func.return
+    with jax.enable_x64(False):
+        return pl.pallas_call(
+            _kernel,
+            out_shape=jax.ShapeDtypeStruct((B, m, L), jnp.uint8),
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((bitmat.shape[0], bitmat.shape[1]),
+                             lambda b, l: (0, 0)),
+                pl.BlockSpec((1, k, _TILE), lambda b, l: (b, 0, l)),
+            ],
+            out_specs=pl.BlockSpec((1, m, _TILE), lambda b, l: (b, 0, l)),
+        )(bitmat, data)
+
+
+def available() -> bool:
+    """Pallas path only on real TPU backends."""
+    try:
+        return jax.devices()[0].platform == "tpu"
+    except Exception:
+        return False
+
+
+def bitplane_matmul(bitmat, data) -> jax.Array:
+    """Drop-in for gf_jax.bitplane_matmul with VMEM bit-unpacking.
+
+    data [..., k, L] uint8; leading axes flattened to one batch dim;
+    L padded to the tile size and cropped after.
+    """
+    data = jnp.asarray(data)
+    lead = data.shape[:-2]
+    k, L = data.shape[-2], data.shape[-1]
+    B = int(np.prod(lead)) if lead else 1
+    d3 = data.reshape(B, k, L)
+    pad = (-L) % _TILE
+    if pad:
+        d3 = jnp.pad(d3, ((0, 0), (0, 0), (0, pad)))
+    out = _bitplane_matmul_pallas(jnp.asarray(bitmat, jnp.int8), d3)
+    if pad:
+        out = out[..., :L]
+    m = out.shape[-2]
+    return out.reshape(lead + (m, L))

@@ -323,6 +323,10 @@ class CrushCompiler:
                     min_size=min_size, max_size=max_size)
         if ruleno < 0:
             ruleno = self.map.max_rules
+        if ruleno < self.map.max_rules and \
+                self.map.rules[ruleno] is not None:
+            raise CompileError(f"duplicate rule id {ruleno}",
+                               self.t.line())
         self.map.add_rule(rule, ruleno)
 
     def _step(self) -> Tuple[int, int, int]:

@@ -101,22 +101,24 @@ def _approx_numer_f32(u):
 
 @functools.lru_cache(maxsize=None)
 def _approx_error_bound() -> float:
-    """Measured max |approx - exact| over every u, with 4x slack for
-    platform fma/reassociation differences, plus the f32 division and
-    weight-rounding error terms (each ≤ 2^24-scale on a 2^48 value)."""
+    """Max |approx - LUT| of THIS backend's poly evaluation, measured by
+    running the device computation over every u at init (one [65536]
+    dispatch, cached per backend).
+
+    The bound is irreducible at ~2^29.5: the reference LUT is built from
+    128-segment fixed-point tables (src/crush/crush_ln_table.h) and
+    deviates from ANY smooth function by that much — a better polynomial
+    cannot shrink it.  Measuring on-device replaces the old 4x
+    reassociation slack with the true value, which is what keeps the
+    candidate window narrow (~4 u-steps at host weights) so the exact
+    top-K re-check below almost never overflows K.
+    """
+    import jax as _jax
+    u = jnp.arange(65536, dtype=jnp.int32)
+    na = np.asarray(_jax.jit(_approx_numer_f32)(u)).astype(np.float64)
     n_exact = (-lntable.straw2_ln_lut()).astype(np.float64)
-    u = np.arange(65536, dtype=np.int64)
-    v = (u + 1).astype(np.float32)
-    bits = v.view(np.int32)
-    e = (bits >> 23) - 127
-    mant = ((bits & 0x7FFFFF) | 0x3F800000).view(np.float32)
-    p = np.float32(_LOG2_POLY[-1])
-    for c in _LOG2_POLY[-2::-1]:
-        p = (p * mant + np.float32(c)).astype(np.float32)
-    log2v = e.astype(np.float32) + p
-    na = (np.float32(_2P44_F) * (np.float32(16.0) - log2v)).astype(np.float64)
     d = float(np.abs(na - n_exact).max())
-    return 4.0 * d + float(2 ** 26)
+    return 1.25 * d + float(2 ** 20)
 
 
 class UnsupportedRuleError(UnsupportedMapError):
@@ -221,13 +223,14 @@ class _DevLevel:
         self.Bl, self.Sl = hl.items.shape
         pos_c = min(pos, hl.weights.shape[1] - 1)
         w = hl.weights[:, pos_c, :].astype(np.int64)
-        # per-row conservative margin: |q_approx - q_exact| ≤ bound/w + 2
-        # for every valid item; doubled so it bounds a PAIR gap
+        # per-row margin: 2*bound/wmin bounds a candidate-pair gap; a
+        # small relative term for f32 division rounding is added at
+        # select time
         bound = _approx_error_bound()
         valid = (w > 0) & (np.arange(self.Sl)[None, :] < hl.sizes[:, None])
         wmin = np.where(valid, w, np.int64(1) << 40).min(
             axis=1, initial=np.int64(1) << 40)
-        margin = (2.0 * bound / np.maximum(wmin, 1) + 4.0).astype(
+        margin = (2.0 * bound / np.maximum(wmin, 1) + 64.0).astype(
             np.float32)
         self.margin = jnp.asarray(margin)
         if strategy == "gather":
@@ -346,25 +349,34 @@ def _is_out_batch(weights, item, x, strategy):
 
 # ---------------------------------------------------------------- descent ---
 
-def _exact_q2(dt: DeviceTables, u2, w_hi2, w_lo2):
-    """Exact straw2 draws for [L, 2] candidate pairs: the full
-    fixed-point LUT + trunc-div math, but on two items per lane."""
-    a = dt.ln_numer(u2)                          # [L, 2] f64
-    w = w_hi2.astype(jnp.float64) * 65536.0 + w_lo2.astype(jnp.float64)
+def _exact_qk(dt: DeviceTables, uk, w_hik, w_lok):
+    """Exact straw2 draws for [L, K] candidates: the full fixed-point
+    LUT + trunc-div math, on K items per lane."""
+    a = dt.ln_numer(uk)                          # [L, K] f64
+    w = w_hik.astype(jnp.float64) * 65536.0 + w_lok.astype(jnp.float64)
     q = jnp.floor(a / jnp.maximum(w, 1.0))
     q = q - (q * w > a)
     q = q + ((q + 1.0) * w <= a)
     return jnp.where(w > 0, q, _INF)
 
 
+_TOPK = 4          # approx candidates re-checked exactly per selection
+
+
 def _straw2_select(dt: DeviceTables, u, w_hi, w_lo, sizes, margin,
                    exact: bool):
     """argmin of the straw2 draws over the item axis → (j [L], ambig).
 
-    Approx mode: f32 polynomial draws pick ≤ 2 candidates within the
-    proven error margin; the exact LUT math then decides between them
-    (first-index tie-break preserved).  Lanes with > 2 candidates in the
-    margin are flagged ambiguous.  Exact mode: full-width LUT math."""
+    Approx mode: f32 polynomial draws prefilter to the top-K smallest;
+    every candidate inside the proven error margin is then re-drawn with
+    the EXACT fixed-point LUT math and the exact minimum wins
+    (first-index tie-break preserved).  A lane is ambiguous only when
+    more than K candidates fall inside the margin — with the measured
+    on-device bound the in-margin count is ~0.06 expected, so
+    P(ambiguous) ≈ 1e-7 per selection.  Masked min-reductions are used
+    instead of lax.top_k, whose TPU lowering is a full [L, S] sort.
+
+    Exact mode: full-width LUT math (CEPH_TPU_SELECT=exact)."""
     Sl = u.shape[1]
     valid = ((w_hi > 0) | (w_lo > 0)) & (jnp.arange(Sl) < sizes[:, None])
     if exact:
@@ -376,47 +388,43 @@ def _straw2_select(dt: DeviceTables, u, w_hi, w_lo, sizes, margin,
         q = jnp.where(valid, q, _INF)
         return (jnp.argmin(q, axis=1).astype(jnp.int32),
                 jnp.zeros(u.shape[0], dtype=bool))
-    # one top_k(3) pass gives the two candidates AND the ambiguity
-    # sentinel (3rd value inside the margin) without re-running the
-    # hash/poly chain per reduction
     w_f = w_hi * jnp.float32(65536.0) + w_lo
     qa = _approx_numer_f32(u) / jnp.maximum(w_f, jnp.float32(1.0))
-    nega = jnp.where(valid, -qa, -jnp.float32(_INF))
-    k = min(3, Sl)
-    vals, idxs = jax.lax.top_k(nega, k)          # [L, k] largest of -qa
-    m1 = -vals[:, 0]
-    thr = m1 + margin
-    i1 = idxs[:, 0].astype(jnp.int32)
-    if Sl >= 2:
-        within2 = (-vals[:, 1]) <= thr
-        i2 = idxs[:, 1].astype(jnp.int32)
-    else:
-        within2 = jnp.zeros(u.shape[0], dtype=bool)
-        i2 = i1
-    if Sl >= 3:
-        ambig = ((-vals[:, 2]) <= thr) & jnp.isfinite(m1)
+    qa = jnp.where(valid, qa, jnp.float32(_INF))
+    qa = jax.lax.optimization_barrier(qa)
+    cols = jnp.arange(Sl, dtype=jnp.int32)
+    K = min(_TOPK, Sl)
+    u_i = u.astype(jnp.int32)
+    idxs, mins, us, whs, wls = [], [], [], [], []
+    work = qa
+    for _ in range(K):
+        ik = jnp.argmin(work, axis=1).astype(jnp.int32)
+        sel = cols[None, :] == ik[:, None]
+        mk = jnp.where(sel, work, 0).sum(axis=1)
+        us.append(jnp.where(sel, u_i, 0).sum(axis=1))
+        whs.append(jnp.where(sel, w_hi, 0).sum(axis=1))
+        wls.append(jnp.where(sel, w_lo, 0).sum(axis=1))
+        idxs.append(ik)
+        mins.append(mk)
+        work = jnp.where(sel, jnp.float32(_INF), work)
+    m1 = mins[0]
+    # margin + relative term for f32 division rounding (~2 ulp)
+    thr = m1 + margin + jnp.float32(2.0 ** -21) * jnp.abs(m1)
+    # ambiguous only if the (K+1)-th smallest approx is still in margin
+    if Sl > K:
+        ambig = (jnp.min(work, axis=1) <= thr) & jnp.isfinite(m1)
     else:
         ambig = jnp.zeros(u.shape[0], dtype=bool)
-    # exact compare between the pair, in index order (first-index wins
-    # exact ties, matching the scalar strict-'>' scan)
-    ia = jnp.where(within2, jnp.minimum(i1, i2), i1)
-    ib = jnp.where(within2, jnp.maximum(i1, i2), i1)
-    sel_a = jnp.arange(Sl)[None, :] == ia[:, None]
-    sel_b = jnp.arange(Sl)[None, :] == ib[:, None]
-
-    def pick2(t):
-        ti = t.astype(jnp.float32) if t.dtype == jnp.uint32 else t
-        a = jnp.where(sel_a, ti, 0).sum(axis=1)
-        b = jnp.where(sel_b, ti, 0).sum(axis=1)
-        return a, b
-
-    ua, ub = pick2(u.astype(jnp.int32))
-    wha, whb = pick2(w_hi)
-    wla, wlb = pick2(w_lo)
-    q2 = _exact_q2(dt, jnp.stack([ua, ub], -1).astype(jnp.int32),
-                   jnp.stack([wha, whb], -1), jnp.stack([wla, wlb], -1))
-    j = jnp.where(within2 & (q2[:, 1] < q2[:, 0]), ib, ia)
-    return j, ambig
+    iK = jnp.stack(idxs, -1)                       # [L, K]
+    within = jnp.stack(mins, -1) <= thr[:, None]
+    q_ex = _exact_qk(dt, jnp.stack(us, -1),
+                     jnp.stack(whs, -1), jnp.stack(wls, -1))
+    q_ex = jnp.where(within, q_ex, _INF)
+    q_min = jnp.min(q_ex, axis=1)
+    # exact ties break on the smallest ORIGINAL index (the scalar scan
+    # keeps the first item on '>' comparisons)
+    j = jnp.min(jnp.where(q_ex == q_min[:, None], iK, Sl), axis=1)
+    return j.astype(jnp.int32), ambig
 
 
 def _descend_batch(levels: List[_DevLevel], dt: DeviceTables,
@@ -624,6 +632,11 @@ class _FastChoose:
         spec = self.spec
         N = x.shape[0]
         p_item, p_status, p_leafrow, p_ambig = self.parent_cands(x)
+        # materialize the candidate grids: the resolve chains below read
+        # dozens of [:, g, r] slices, and without a barrier XLA happily
+        # recomputes the whole descent per consumer (measured 16x blowup)
+        p_item, p_status, p_leafrow = jax.lax.optimization_barrier(
+            (p_item, p_status, p_leafrow))
         ambig_lane = p_ambig.reshape(N, -1).any(axis=1)
         leaf_pack = None
         if spec.leaf:
@@ -638,12 +651,13 @@ class _FastChoose:
                 weights, l_dev.reshape(-1),
                 jnp.repeat(x, l_dev.size // N),
                 self.strategy).reshape(l_dev.shape)
-            leaf_pack = (l_dev, l_st, l_out)
+            leaf_pack = jax.lax.optimization_barrier((l_dev, l_st, l_out))
         if spec.target_type == 0:
             p_out = _is_out_batch(
                 weights, p_item.reshape(-1),
                 jnp.repeat(x, p_item.size // N),
                 self.strategy).reshape(p_item.shape)
+            p_out = jax.lax.optimization_barrier(p_out)
         else:
             p_out = jnp.zeros(p_item.shape, dtype=bool)
         if spec.firstn:
@@ -964,7 +978,16 @@ class FastMapper:
                mesh_cache_key(mesh) if mesh is not None else None)
         if key not in self._jitted:
             plan = self._plan(ruleno, result_max)
-            fn = functools.partial(self._trace, plan, result_max)
+            inner = functools.partial(self._trace, plan, result_max)
+
+            # one-hot tables hold integer values up to 2^16 (ids, row
+            # indices, weight halves); TPU's DEFAULT f32 matmul runs the
+            # MXU in bf16 and silently rounds them (observed: device id
+            # 9693 -> 9728).  HIGHEST forces f32-exact passes.
+            def fn(xs, weights):
+                with jax.default_matmul_precision("highest"):
+                    return inner(xs, weights)
+
             if mesh is None:
                 self._jitted[key] = jax.jit(fn)
             else:
@@ -982,11 +1005,31 @@ class FastMapper:
                     for e in self._plan(ruleno, result_max)
                     if e[0] == "choose"), default=1)
 
+    def max_level_width(self, ruleno: int, result_max: int) -> int:
+        """Widest level table any descent touches (the S in the [rows, S]
+        working set)."""
+        width = 1
+        for e in self._plan(ruleno, result_max):
+            if e[0] != "choose":
+                continue
+            fc: _FastChoose = e[1]
+            for levels in list(fc.levels.values()) + \
+                    list(fc.leaf_levels.values()):
+                for lvl in levels:
+                    width = max(width, lvl.Sl)
+        return width
+
 
     def map_batch(self, ruleno: int, xs, result_max: int,
                   weights: Sequence[int], mesh=None
                   ) -> Tuple[np.ndarray, np.ndarray]:
-        """→ (results [N, result_max] i32, incomplete [N] bool)."""
+        """→ (results [N, result_max] i32, incomplete [N] bool).
+
+        Chunks stream through one compiled executable and stay ON DEVICE
+        until a single final readback: device→host transfers through the
+        driver tunnel cost ~0.25 s of latency each (measured), which at
+        per-chunk granularity was 25x the actual compute time.
+        """
         if ruleno < 0 or ruleno >= self.cmap.max_rules or \
                 self.cmap.rules[ruleno] is None:
             raise ValueError(f"no rule {ruleno}")
@@ -995,28 +1038,37 @@ class FastMapper:
         w = np.zeros(self.compiled.max_devices, dtype=np.int32)
         w_in = np.asarray(weights, dtype=np.int64)
         w[:min(len(w_in), len(w))] = w_in[:len(w)]
+        w_dev = jnp.asarray(w)
         xs_np = np.asarray(xs, dtype=np.int64).astype(np.uint32) \
             .astype(np.int32)
         n = len(xs_np)
         gw = self.grid_width(ruleno, result_max)
-        # candidate grids multiply lane width by R*G; cap device working set
+        # candidate grids multiply lane width by R*G, and each level
+        # materializes ~4 [rows, S] f32 buffers (hash, qa, selects) —
+        # cap lanes so rows*S stays inside the HBM budget
         max_grid = int(_config().get("fastmap_max_grid_lanes"))
-        cap = max(1 << 12, max_grid // gw)
+        budget_rows_s = int(_config().get("fastmap_max_grid_mib")) \
+            * (1 << 20) // 16          # bytes / (4 buffers x f32)
+        width = self.max_level_width(ruleno, result_max)
+        cap = max(1 << 10, min(max_grid // gw,
+                               budget_rows_s // (gw * width)))
         cap *= (mesh.size if mesh is not None else 1)
         if n > cap:
-            pad = (-n) % cap
-            xs_pad = np.concatenate([xs_np, xs_np[:1].repeat(pad)]) \
-                if pad else xs_np
-            outs, incs = [], []
-            for i in range(0, len(xs_pad), cap):
-                o, inc = self.map_batch(ruleno, xs_pad[i:i + cap],
-                                        result_max, weights, mesh)
-                outs.append(o)
-                incs.append(inc)
-            return np.concatenate(outs)[:n], np.concatenate(incs)[:n]
-        if mesh is not None:
+            pad = (-n) % cap                    # cap is mesh-aligned
+        elif mesh is not None:
             pad = (-n) % mesh.size
-            if pad:
-                xs_np = np.concatenate([xs_np, xs_np[:1].repeat(pad)])
-        out, inc = jitted(jnp.asarray(xs_np), jnp.asarray(w))
-        return np.asarray(out)[:n], np.asarray(inc)[:n]
+        else:
+            pad = 0
+        xs_pad = np.concatenate([xs_np, xs_np[:1].repeat(pad)]) \
+            if pad else xs_np
+        outs, incs = [], []
+        for i in range(0, len(xs_pad), cap):
+            o, inc = jitted(jnp.asarray(xs_pad[i:i + cap]), w_dev)
+            outs.append(o)
+            incs.append(inc)
+        if len(outs) == 1:
+            out_d, inc_d = outs[0], incs[0]
+        else:
+            out_d = jnp.concatenate(outs)
+            inc_d = jnp.concatenate(incs)
+        return np.asarray(out_d)[:n], np.asarray(inc_d)[:n]

@@ -747,7 +747,14 @@ class XlaMapper:
         key = (ruleno, result_max,
                mesh_cache_key(mesh) if mesh is not None else None)
         if key not in self._jitted:
-            fn = functools.partial(self._trace_rule, ruleno, result_max)
+            inner = functools.partial(self._trace_rule, ruleno, result_max)
+
+            # one-hot table values reach 2^16; TPU DEFAULT matmuls run
+            # bf16 on the MXU and round them (see fast_mapper._get_jitted)
+            def fn(xs, weights):
+                with jax.default_matmul_precision("highest"):
+                    return inner(xs, weights)
+
             if mesh is None:
                 self._jitted[key] = jax.jit(fn)
             else:
@@ -835,14 +842,19 @@ class XlaMapper:
         cap = int(_config().get("mapper_max_lanes_per_call"))
         cap *= (mesh.size if mesh is not None else 1)
         if n > cap:
-            # pad to a multiple of cap so every chunk reuses one executable
+            # pad to a multiple of cap so every chunk reuses one
+            # executable; chunk results stay on device until ONE final
+            # readback (tunnel transfers cost ~0.25s latency each)
             pad = (-n) % cap
             xs_pad = np.concatenate([xs_np, xs_np[:1].repeat(pad)]) \
                 if pad else xs_np
-            parts = [self.map_batch(ruleno, xs_pad[i:i + cap], result_max,
-                                    weights, mesh)
-                     for i in range(0, len(xs_pad), cap)]
-            return np.concatenate(parts)[:n]
+            w_dev = jnp.asarray(w)
+            with pc.time("general_map_s"):
+                parts = [jitted(jnp.asarray(xs_pad[i:i + cap]), w_dev)
+                         for i in range(0, len(xs_pad), cap)]
+                out_d = parts[0] if len(parts) == 1 \
+                    else jnp.concatenate(parts)
+                return np.asarray(out_d)[:n]
         if mesh is not None:
             pad = (-n) % mesh.size
             if pad:

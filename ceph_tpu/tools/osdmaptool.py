@@ -80,6 +80,13 @@ def main(argv=None) -> int:
     ap.add_argument("--test-map-pgs", action="store_true")
     ap.add_argument("--scalar", action="store_true")
     ap.add_argument("--dump", action="store_true")
+    ap.add_argument("--upmap", metavar="OUT",
+                    help="balance PGs via pg_upmap_items (reference: "
+                         "osdmaptool --upmap); writes the proposed "
+                         "items as JSON")
+    ap.add_argument("--upmap-deviation", type=float, default=1.0)
+    ap.add_argument("--upmap-max", type=int, default=128,
+                    help="max upmap moves per round")
     args = ap.parse_args(argv)
     with open(args.mapfn) as f:
         spec = json.load(f)
@@ -89,6 +96,18 @@ def main(argv=None) -> int:
             "epoch": om.epoch, "max_osd": om.max_osd,
             "pools": {p.id: vars(p) for p in om.pools.values()}},
             default=str, indent=2))
+        return 0
+    if args.upmap:
+        from ..cluster.balancer import calc_pg_upmaps
+        res = calc_pg_upmaps(om, max_deviation=args.upmap_deviation,
+                             max_moves_per_round=args.upmap_max)
+        items = {f"{pid}.{pg}": [[int(a), int(b)] for a, b in pairs]
+                 for (pid, pg), pairs in sorted(res.upmap_items.items())}
+        with open(args.upmap, "w") as f:
+            json.dump({"pg_upmap_items": items}, f, indent=1)
+        print(f"balanced in {res.rounds} rounds: {res.moves} moves, "
+              f"max deviation {res.max_deviation_before:.2f} -> "
+              f"{res.max_deviation_after:.2f}")
         return 0
     if args.test_map_pgs:
         stats = test_map_pgs(om, scalar=args.scalar)

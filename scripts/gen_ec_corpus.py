@@ -1,0 +1,78 @@
+#!/usr/bin/env python3
+"""Generate the EC non-regression corpus.
+
+Role of the reference's ceph_erasure_code_non_regression + archived
+corpus (src/test/erasure-code/ceph_erasure_code_non_regression.cc,
+ceph-erasure-code-corpus/): encode a FIXED payload under every
+(plugin, technique, k, m) configuration and archive the parity bytes,
+so any change to codec output across rounds fails loudly — roundtrip
+tests alone cannot catch a self-consistent wire-format change.
+
+Writes tests/golden/ec_corpus.npz.  Regenerate ONLY for an intentional
+format change:  python scripts/gen_ec_corpus.py
+"""
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+PAYLOAD_LEN = 4096
+
+
+def payload() -> bytes:
+    """Fixed deterministic payload (an LCG, no RNG library drift)."""
+    x = 0x12345678
+    out = bytearray()
+    for _ in range(PAYLOAD_LEN):
+        x = (1103515245 * x + 12345) & 0x7FFFFFFF
+        out.append((x >> 16) & 0xFF)
+    return bytes(out)
+
+
+CONFIGS = [
+    ("jax", "reed_sol_van", 4, 2), ("jax", "reed_sol_van", 8, 3),
+    ("jax", "cauchy", 4, 2), ("jax", "cauchy_good", 6, 3),
+    ("jax", "isa_rs", 8, 4),
+    ("jerasure", "reed_sol_van", 4, 2), ("jerasure", "reed_sol_van", 8, 3),
+    ("jerasure", "reed_sol_r6_op", 4, 2),
+    ("jerasure", "cauchy_orig", 4, 2), ("jerasure", "cauchy_good", 6, 3),
+    ("isa", "reed_sol_van", 4, 2), ("isa", "cauchy", 6, 2),
+    ("shec", None, 4, 3), ("lrc", None, 4, 2), ("clay", None, 4, 2),
+]
+
+
+def profile_for(plugin, technique, k, m):
+    prof = {"k": str(k), "m": str(m)}
+    if technique:
+        prof["technique"] = technique
+    if plugin == "shec":
+        prof["c"] = "2"
+    if plugin == "lrc":
+        prof["l"] = "3"
+        prof.pop("technique", None)
+    return prof
+
+
+def main():
+    from ceph_tpu.ec import instance as ec_registry
+    data = payload()
+    out = {}
+    for plugin, technique, k, m in CONFIGS:
+        prof = profile_for(plugin, technique, k, m)
+        codec = ec_registry().factory(plugin, prof)
+        n = codec.get_chunk_count()
+        chunks = codec.encode(set(range(n)), data)
+        key = f"{plugin}.{technique or 'default'}.k{k}m{m}"
+        for c, buf in sorted(chunks.items()):
+            out[f"{key}.c{c}"] = np.asarray(buf, dtype=np.uint8)
+        print(f"{key}: {n} chunks x {len(chunks[0])} bytes")
+    dest = os.path.join(os.path.dirname(__file__), "..",
+                        "tests", "golden", "ec_corpus.npz")
+    np.savez_compressed(dest, **out)
+    print(f"wrote {dest} ({len(out)} arrays)")
+
+
+if __name__ == "__main__":
+    main()

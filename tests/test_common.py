@@ -164,10 +164,11 @@ def test_admin_inprocess_commands():
     srv = AdminServer()
     assert srv.handle({"prefix": "config get",
                        "key": "fastmap_enabled"})["result"]
-    r = srv.handle({"prefix": "config set", "key": "log_level",
-                    "value": 2})
-    assert r["result"]["success"] and config().get("log_level") == 2
-    config().set("log_level", 1)
+    r = srv.handle({"prefix": "config set", "key": "fastmap_extra_tries",
+                    "value": 10})
+    assert r["result"]["success"] and \
+        config().get("fastmap_extra_tries") == 10
+    config().clear("fastmap_extra_tries")
     assert "error" in srv.handle({"prefix": "bogus"})
     assert "perf dump" in srv.handle({"prefix": "help"})["result"]
 

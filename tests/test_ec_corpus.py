@@ -1,0 +1,50 @@
+"""EC non-regression corpus: codec output bytes are pinned.
+
+Any byte change in any plugin's encode output across versions fails
+here (roundtrip tests alone cannot catch a self-consistent wire-format
+change).  Reference: ceph_erasure_code_non_regression.cc +
+ceph-erasure-code-corpus.  Regenerate only for INTENTIONAL format
+changes: python scripts/gen_ec_corpus.py
+"""
+import os
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                "scripts"))
+from gen_ec_corpus import CONFIGS, payload, profile_for  # noqa: E402
+
+CORPUS = os.path.join(os.path.dirname(__file__), "golden",
+                      "ec_corpus.npz")
+
+
+@pytest.fixture(scope="module")
+def corpus():
+    return np.load(CORPUS)
+
+
+@pytest.mark.parametrize(
+    "plugin,technique,k,m",
+    CONFIGS, ids=[f"{p}-{t or 'default'}-k{k}m{m}"
+                  for p, t, k, m in CONFIGS])
+def test_encode_bytes_pinned(corpus, plugin, technique, k, m):
+    from ceph_tpu.ec import instance as ec_registry
+    codec = ec_registry().factory(plugin, profile_for(plugin, technique,
+                                                      k, m))
+    n = codec.get_chunk_count()
+    chunks = codec.encode(set(range(n)), payload())
+    key = f"{plugin}.{technique or 'default'}.k{k}m{m}"
+    for c in range(n):
+        want = corpus[f"{key}.c{c}"]
+        got = np.asarray(chunks[c], dtype=np.uint8)
+        assert got.shape == want.shape, f"{key} chunk {c} shape"
+        assert np.array_equal(got, want), \
+            f"{key} chunk {c}: encode bytes CHANGED — wire-format " \
+            "regression (or run scripts/gen_ec_corpus.py if intentional)"
+
+
+def test_corpus_covers_all_plugins(corpus):
+    plugins = {k.split(".")[0] for k in corpus.files}
+    assert plugins >= {"jax", "jerasure", "isa", "shec", "lrc", "clay"}
