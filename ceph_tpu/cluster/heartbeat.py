@@ -1,0 +1,64 @@
+"""Heartbeat-based failure detection.
+
+Role of the reference's OSD↔OSD heartbeats (OSD::handle_osd_ping,
+src/osd/OSD.cc:5327; peer selection maybe_update_heartbeat_peers
+:5188): each OSD pings a small peer set every tick; peers that miss
+`grace` consecutive ticks get reported to the mon, which marks them
+down after enough distinct reporters (Monitor.report_failure).
+
+Simulation-time driven (tick()), deterministic peer rings — the piece
+under test is the detection/report/mark-down pipeline, not wall-clock
+timers.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Set
+
+from .monitor import Monitor
+
+
+@dataclass
+class HeartbeatConfig:
+    n_peers: int = 3          # ring neighbors each OSD monitors
+    grace_ticks: int = 3      # missed ticks before reporting
+
+
+class HeartbeatMonitor:
+    """Drives ping rounds over a ClusterSim's OSD liveness."""
+
+    def __init__(self, sim, mon: Monitor,
+                 cfg: HeartbeatConfig = HeartbeatConfig()):
+        self.sim = sim
+        self.mon = mon
+        self.cfg = cfg
+        self.missed: Dict[int, Dict[int, int]] = {}   # target -> {peer: n}
+        self.marked_down: List[int] = []
+
+    def peers_of(self, osd: int) -> List[int]:
+        """Deterministic ring peers (the front/back messenger peer set)."""
+        n = len(self.sim.osds)
+        return [(osd + d) % n for d in range(1, self.cfg.n_peers + 1)]
+
+    def tick(self) -> List[int]:
+        """One heartbeat round; returns OSDs newly marked down."""
+        newly_down: List[int] = []
+        om = self.sim.osdmap
+        for osd in range(len(self.sim.osds)):
+            if not self.sim.osds[osd].alive or not om.is_up(osd):
+                continue                      # dead OSDs don't ping
+            for peer in self.peers_of(osd):
+                if not om.is_up(peer):
+                    continue                  # already marked down
+                if self.sim.osds[peer].alive:
+                    self.missed.get(peer, {}).pop(osd, None)
+                    continue
+                cnt = self.missed.setdefault(peer, {})
+                cnt[osd] = cnt.get(osd, 0) + 1
+                if cnt[osd] >= self.cfg.grace_ticks:
+                    if self.mon.report_failure(peer, reporter=osd):
+                        newly_down.append(peer)
+                        self.missed.pop(peer, None)
+                        break
+        self.marked_down.extend(newly_down)
+        return newly_down

@@ -1,0 +1,206 @@
+"""Monitor — the control plane: consensus log, map service, config db,
+health checks.
+
+Compact TPU-native re-creation of the mon's roles (src/mon/):
+
+  * ``PaxosLog`` — the consensus substrate (src/mon/Paxos.{h,cc}): a
+    proposal/accept/commit state machine over N in-process ranks with
+    majority acceptance and monotone proposal numbers.  One class,
+    testable, with the properties that matter: committed versions are
+    sequential, a minority cannot commit, a new leader's higher
+    proposal number supersedes a stalled one.
+  * ``Monitor`` — PaxosService analog hosting:
+      - the OSDMap service: full map + Incremental history; consumers
+        catch up via get_incrementals(since) (OSDMonitor role —
+        src/mon/OSDMonitor.cc map publication);
+      - the config db (src/mon/ConfigMonitor.cc): committed key=value
+        options pushed into the process options registry at FILE level;
+      - health checks (src/mon/HealthMonitor.cc + the osdmap checks):
+        OSD_DOWN / OSD_OUT / PG_DEGRADED computed from the current map
+        and (optionally) a ClusterSim's shard state;
+      - failure reports: OSD peers report a down OSD; past the quorum
+        threshold the mon commits a map epoch marking it down
+        (OSDMonitor::prepare_failure semantics).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..common.options import LEVEL_FILE, OptionError, config
+from ..placement.crush_map import ITEM_NONE
+from .osdmap import Incremental, OSDMap
+
+
+# ------------------------------------------------------------- consensus ---
+
+class PaxosLog:
+    """Single-decree-per-version Paxos over in-process ranks.
+
+    The reference pipelines one decree at a time through
+    collect/begin/accept/commit (Paxos.h:57-88 'The Leader election ...
+    proposal pipeline').  Here: `propose(value)` runs one round as the
+    current leader; commit succeeds iff a majority of live ranks
+    accept.  Ranks can be marked unreachable to model partitions.
+    """
+
+    def __init__(self, n_ranks: int = 3):
+        if n_ranks < 1:
+            raise ValueError("need at least one rank")
+        self.n_ranks = n_ranks
+        self.reachable = [True] * n_ranks
+        self.leader = 0
+        # per-rank acceptor state: (promised_pn, accepted_pn)
+        self.promised = [0] * n_ranks
+        self.accepted_pn = [0] * n_ranks
+        self.committed: List[Any] = []        # version v = index + 1
+        self._pn = 0
+
+    @property
+    def version(self) -> int:
+        return len(self.committed)
+
+    def quorum(self) -> int:
+        return self.n_ranks // 2 + 1
+
+    def elect(self, leader: int) -> int:
+        """New leader takes over with a higher proposal number
+        (collect phase)."""
+        self.leader = leader
+        self._pn = (max(self.promised) // 100 + 1) * 100 + leader
+        n_promised = 0
+        for r in range(self.n_ranks):
+            if self.reachable[r] and self.promised[r] < self._pn:
+                self.promised[r] = self._pn
+                n_promised += 1
+        return n_promised
+
+    def propose(self, value: Any) -> bool:
+        """begin/accept/commit one value; False when no quorum."""
+        if self._pn == 0 or self.promised[self.leader] > self._pn:
+            self.elect(self.leader)
+        accepts = 0
+        for r in range(self.n_ranks):
+            if not self.reachable[r]:
+                continue
+            if self.promised[r] <= self._pn:
+                self.accepted_pn[r] = self._pn
+                accepts += 1
+        if accepts < self.quorum():
+            return False
+        self.committed.append(value)
+        return True
+
+
+# --------------------------------------------------------------- monitor ---
+
+@dataclass
+class HealthCheck:
+    code: str
+    severity: str          # "HEALTH_WARN" | "HEALTH_ERR"
+    summary: str
+
+
+class Monitor:
+    """Single logical mon cluster (PaxosLog-backed) owning the OSDMap."""
+
+    def __init__(self, osdmap: OSDMap, n_ranks: int = 3,
+                 failure_reports_needed: int = 2):
+        self.osdmap = osdmap
+        self.paxos = PaxosLog(n_ranks)
+        self.incrementals: List[Incremental] = []
+        self.config_db: Dict[str, Any] = {}
+        self.failure_reports_needed = failure_reports_needed
+        self._failure_reports: Dict[int, set] = {}
+
+    # ------------------------------------------------------- map service --
+    def commit_incremental(self, inc: Incremental) -> bool:
+        """Propose a map mutation through consensus, then apply.
+        Epoch is validated BEFORE proposing so the consensus log can
+        never hold a decree the map refused (direct bump_epoch callers
+        can race the mon)."""
+        if inc.epoch != self.osdmap.epoch + 1:
+            raise ValueError(
+                f"incremental epoch {inc.epoch} != "
+                f"{self.osdmap.epoch} + 1")
+        if not self.paxos.propose(("osdmap", inc)):
+            return False
+        self.osdmap.apply_incremental(inc)
+        self.incrementals.append(inc)
+        return True
+
+    def next_incremental(self) -> Incremental:
+        return Incremental(epoch=self.osdmap.epoch + 1)
+
+    def get_incrementals(self, since_epoch: int) -> List[Incremental]:
+        """Deltas a consumer at `since_epoch` needs (map subscription)."""
+        return [i for i in self.incrementals if i.epoch > since_epoch]
+
+    # --------------------------------------------------------- config db --
+    def config_set(self, key: str, value: Any) -> bool:
+        """Central config commit (ConfigMonitor): consensus first, then
+        push into the process registry at FILE level."""
+        if not self.paxos.propose(("config", key, value)):
+            return False
+        self.config_db[key] = value
+        try:
+            config().set(key, value, level=LEVEL_FILE)
+        except OptionError:
+            pass          # unknown keys stay mon-side only
+        return True
+
+    def config_get(self, key: str) -> Any:
+        return self.config_db.get(key)
+
+    # ---------------------------------------------------- failure reports --
+    def report_failure(self, target: int, reporter: int) -> bool:
+        """OSD peers report a dead peer; at the threshold the mon
+        commits an epoch marking it down (OSDMonitor::prepare_failure).
+        Returns True when the target was marked down."""
+        if not self.osdmap.is_up(target):
+            return False
+        reps = self._failure_reports.setdefault(target, set())
+        reps.add(reporter)
+        if len(reps) < self.failure_reports_needed:
+            return False
+        inc = self.next_incremental()
+        inc.new_up[target] = False
+        if self.commit_incremental(inc):
+            del self._failure_reports[target]
+            return True
+        return False
+
+    # ------------------------------------------------------------ health --
+    def health(self, sim=None) -> List[HealthCheck]:
+        """HealthMonitor analog over the current map (+ optional sim
+        shard state for degraded-PG detection)."""
+        checks: List[HealthCheck] = []
+        om = self.osdmap
+        exists = om.osd_exists
+        down = int((exists & ~om.osd_up).sum())
+        if down:
+            checks.append(HealthCheck(
+                "OSD_DOWN", "HEALTH_WARN", f"{down} osds down"))
+        out = int((exists & (om.osd_weight == 0)).sum())
+        if out:
+            checks.append(HealthCheck(
+                "OSD_OUT", "HEALTH_WARN", f"{out} osds out"))
+        degraded = 0
+        if sim is not None:
+            for pid, pool in om.pools.items():
+                up, _ = om.map_pgs_batch(pid)
+                holes = (up == ITEM_NONE).any(axis=1)
+                degraded += int(holes.sum())
+        if degraded:
+            checks.append(HealthCheck(
+                "PG_DEGRADED", "HEALTH_WARN",
+                f"{degraded} pgs with unfilled slots"))
+        return checks
+
+    def health_status(self, sim=None) -> str:
+        checks = self.health(sim)
+        if any(c.severity == "HEALTH_ERR" for c in checks):
+            return "HEALTH_ERR"
+        return "HEALTH_WARN" if checks else "HEALTH_OK"

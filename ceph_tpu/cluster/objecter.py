@@ -1,0 +1,107 @@
+"""Objecter — the client-side op engine.
+
+Role of the reference Objecter (src/osdc/Objecter.cc: op_submit :2191,
+_calc_target :2688, resend on map change): a client holds its OWN
+cached OSDMap, computes each op's target from it, and when the cluster
+map moves on — targets down, epoch stale — it catches up via the mon's
+incremental stream and recomputes/resends instead of failing.
+
+The simulator plays the OSD side; ops land through ClusterSim's data
+path only when the client's computed target agrees with the current
+map (a mismatched target = the op would have been sent to the wrong
+daemon and rejected, triggering resend).
+"""
+from __future__ import annotations
+
+import copy
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+from ..common.perf_counters import perf as _perf
+from ..placement.crush_map import ITEM_NONE
+from .monitor import Monitor
+from .osdmap import OSDMap
+from .simulator import ClusterSim
+
+
+class TooManyRetries(IOError):
+    pass
+
+
+class Objecter:
+    """Client with a cached map; submits ops with retry-on-map-change."""
+
+    def __init__(self, sim: ClusterSim, mon: Monitor,
+                 max_retries: int = 8):
+        self.sim = sim
+        self.mon = mon
+        # the client's PRIVATE map copy, caught up via incrementals
+        self.osdmap = copy.deepcopy(sim.osdmap)
+        self.max_retries = max_retries
+        self._pc = _perf("objecter")
+
+    # ------------------------------------------------------------- maps --
+    def maybe_update_map(self) -> int:
+        """Consume the mon's incremental stream (subscription model)."""
+        incs = self.mon.get_incrementals(self.osdmap.epoch)
+        for inc in incs:
+            self.osdmap.apply_incremental(inc)
+            self._pc.inc("map_epochs_applied")
+        return len(incs)
+
+    def calc_target(self, pool_id: int, name: str
+                    ) -> Tuple[int, List[int]]:
+        """(pg, up set) from the CLIENT's cached map
+        (Objecter::_calc_target)."""
+        pool = self.osdmap.pools[pool_id]
+        pg = self.sim.object_pg(pool, name)
+        up, _, acting, _ = self.osdmap.pg_to_up_acting_osds(pool_id, pg)
+        return pg, (acting or up)
+
+    def _target_current(self, pool_id: int, name: str) -> bool:
+        """Would the op reach the right daemons?  (the wrong-epoch
+        rejection an OSD gives a stale client)."""
+        _, client_up = self.calc_target(pool_id, name)
+        pool = self.sim.osdmap.pools[pool_id]
+        pg = self.sim.object_pg(pool, name)
+        real_up = self.sim.pg_up(pool, pg)
+        if client_up != real_up:
+            return False
+        primary = next((o for o in client_up if o != ITEM_NONE), None)
+        return primary is not None and self.sim.osds[primary].alive
+
+    # -------------------------------------------------------------- ops --
+    def _submit(self, op, pool_id: int, name: str):
+        """op_submit: compute target, send; on stale target refresh the
+        map and resend (bounded)."""
+        self._pc.inc("op_submit")
+        for attempt in range(self.max_retries):
+            if self._target_current(pool_id, name):
+                try:
+                    return op()
+                except IOError:
+                    self._pc.inc("op_eio_retries")
+            else:
+                self._pc.inc("op_resends")
+            got = self.maybe_update_map()
+            if not got and attempt:
+                # nothing new from the mon and still failing
+                raise TooManyRetries(
+                    f"{name}: no usable target at epoch "
+                    f"{self.osdmap.epoch}")
+        raise TooManyRetries(f"{name}: gave up after "
+                             f"{self.max_retries} resends")
+
+    def put(self, pool_id: int, name: str, data: bytes) -> List[int]:
+        return self._submit(
+            lambda: self.sim.put(pool_id, name, data), pool_id, name)
+
+    def get(self, pool_id: int, name: str) -> bytes:
+        return self._submit(
+            lambda: self.sim.get(pool_id, name), pool_id, name)
+
+    def write(self, pool_id: int, name: str, offset: int,
+              data: bytes) -> List[int]:
+        return self._submit(
+            lambda: self.sim.write(pool_id, name, offset, data),
+            pool_id, name)

@@ -127,6 +127,22 @@ class PGPool:
         return sm + self.id
 
 
+@dataclass
+class Incremental:
+    """A versioned map delta (OSDMap::Incremental role): the mon
+    publishes these per epoch; consumers apply them in order instead of
+    refetching full maps.  Only the mutation surface the simulator uses."""
+    epoch: int                                   # resulting epoch
+    new_up: Dict[int, bool] = field(default_factory=dict)
+    new_weight: Dict[int, int] = field(default_factory=dict)
+    new_primary_affinity: Dict[int, int] = field(default_factory=dict)
+    new_pg_upmap_items: Dict[Tuple[int, int],
+                             Optional[List[Tuple[int, int]]]] = \
+        field(default_factory=dict)              # None = remove
+    new_pg_temp: Dict[Tuple[int, int], Optional[List[int]]] = \
+        field(default_factory=dict)
+
+
 class OSDMap:
     """The cluster map: crush + osd states + pools + exception tables."""
 
@@ -151,6 +167,30 @@ class OSDMap:
     # ------------------------------------------------------------ mutate --
     def bump_epoch(self) -> None:
         self.epoch += 1
+
+    def apply_incremental(self, inc: Incremental) -> None:
+        """Consume a map delta (OSDMap::apply_incremental): must be the
+        next epoch in sequence."""
+        if inc.epoch != self.epoch + 1:
+            raise ValueError(
+                f"incremental epoch {inc.epoch} != {self.epoch} + 1")
+        for osd, up in inc.new_up.items():
+            self.osd_up[osd] = up
+        for osd, w in inc.new_weight.items():
+            self.osd_weight[osd] = w
+        for osd, a in inc.new_primary_affinity.items():
+            self.osd_primary_affinity[osd] = a
+        for pgid, items in inc.new_pg_upmap_items.items():
+            if items is None:
+                self.pg_upmap_items.pop(pgid, None)
+            else:
+                self.pg_upmap_items[pgid] = list(items)
+        for pgid, temp in inc.new_pg_temp.items():
+            if temp is None:
+                self.pg_temp.pop(pgid, None)
+            else:
+                self.pg_temp[pgid] = list(temp)
+        self.epoch = inc.epoch
 
     def set_osd(self, osd: int, *, exists=True, up=True,
                 weight=WEIGHT_IN) -> None:

@@ -34,6 +34,7 @@ from ..ops import hashing
 from ..placement.crush_map import ITEM_NONE
 from .ec_rmw import ExtentCache, RmwPipeline, StripeInfo
 from .osdmap import OSDMap, PGPool, POOL_ERASURE, POOL_REPLICATED
+from .pglog import PGLog, Version, ZERO
 
 ShardKey = Tuple[int, int, str, int]   # (pool, pg, object, shard)
 
@@ -45,6 +46,9 @@ class SimOSD:
         self.id = osd_id
         self.store: Dict[ShardKey, np.ndarray] = {}
         self.alive = True
+        # last applied PG version per (pool, pg) — the replica-side
+        # state delta recovery compares against the authoritative log
+        self.last_complete: Dict[Tuple[int, int], Version] = {}
 
     def put(self, key: ShardKey, data: np.ndarray) -> None:
         if not self.alive:
@@ -79,6 +83,22 @@ class ClusterSim:
         self.ec_profiles: Dict[str, Dict[str, str]] = {}
         self.extent_cache = ExtentCache()
         self._rmw: Dict[int, RmwPipeline] = {}
+        # authoritative per-PG op logs (PGLog role)
+        self.pg_logs: Dict[Tuple[int, int], PGLog] = {}
+
+    def _log(self, pool_id: int, pg: int) -> PGLog:
+        log = self.pg_logs.get((pool_id, pg))
+        if log is None:
+            log = self.pg_logs[(pool_id, pg)] = PGLog()
+        return log
+
+    def _log_write(self, pool_id: int, pg: int, name: str,
+                   stored_osds) -> None:
+        """Append a MODIFY entry and advance last_complete on every
+        OSD that durably applied this write."""
+        e = self._log(pool_id, pg).append(self.osdmap.epoch, name)
+        for o in stored_osds:
+            self.osds[o].last_complete[(pool_id, pg)] = e.version
 
     # ------------------------------------------------------------- pools --
     def create_ec_profile(self, name: str, profile: Dict[str, str]) -> None:
@@ -150,7 +170,14 @@ class ClusterSim:
             for o in self.osds:
                 o.delete((pool_id, pg, name, shard))
             return None
-        self.osds[tgt].put((pool_id, pg, name, shard), payload)
+        try:
+            self.osds[tgt].put((pool_id, pg, name, shard), payload)
+        except IOError:
+            # undetected-dead target: same as homeless — purge stale
+            # copies so no older version can be served
+            for o in self.osds:
+                o.delete((pool_id, pg, name, shard))
+            return None
         # a successful write also supersedes any stray stale copies
         for o in self.osds:
             if o.id != tgt:
@@ -168,9 +195,18 @@ class ClusterSim:
             for o in up:
                 if o == ITEM_NONE:
                     continue
-                self.osds[o].put((pool_id, pg, name, 0), payload)
+                try:
+                    self.osds[o].put((pool_id, pg, name, 0), payload)
+                except IOError:
+                    continue     # undetected-dead OSD (fail_osd state)
                 placed.append(o)
+            # supersede stale replicas (incl. on down OSDs) so a revived
+            # OSD can never serve an older version — see _write_shard
+            for o in self.osds:
+                if o.id not in placed:
+                    o.delete((pool_id, pg, name, 0))
             self.objects[(pool_id, name)] = ObjectInfo(len(data), len(data))
+            self._log_write(pool_id, pg, name, placed)
             return placed
         codec = self.codec_for(pool)
         k, mm = codec.get_data_chunk_count(), codec.get_coding_chunk_count()
@@ -190,6 +226,7 @@ class ClusterSim:
         self.extent_cache.invalidate_object((pool_id, name))
         self.objects[(pool_id, name)] = ObjectInfo(
             len(data), si.chunk_size, n_str)
+        self._log_write(pool_id, pg, name, set(placed))
         return placed
 
     def _gather_stripes(self, pool: PGPool, name: str, info: ObjectInfo,
@@ -299,6 +336,7 @@ class ClusterSim:
                 placed.add(tgt)
         self.objects[(pool_id, name)] = ObjectInfo(
             new_size, si.chunk_size, n_str)
+        self._log_write(pool_id, pg, name, placed)
         return sorted(placed)
 
     # ----------------------------------------------------------- failure --
@@ -307,6 +345,11 @@ class ClusterSim:
         death — store contents are lost to the cluster."""
         self.osds[osd].alive = False
         self.osdmap.mark_down(osd)
+
+    def fail_osd(self, osd: int) -> None:
+        """Process death WITHOUT the map knowing yet: the state the
+        heartbeat/failure-report pipeline exists to detect."""
+        self.osds[osd].alive = False
 
     def out_osd(self, osd: int) -> None:
         self.osdmap.mark_out(osd)
@@ -349,7 +392,7 @@ class ClusterSim:
                 if payload is None:
                     continue
                 for o in up:
-                    if o != ITEM_NONE and \
+                    if o != ITEM_NONE and self.osds[o].alive and \
                             self.osds[o].get((pool_id, pg, name, 0)) is None:
                         self.osds[o].put((pool_id, pg, name, 0), payload)
                         stats["shards_copied"] += 1
@@ -379,7 +422,7 @@ class ClusterSim:
             # re-place surviving shards that are off their new home
             for shard, payload in shard_files.items():
                 tgt = up[shard] if shard < len(up) else ITEM_NONE
-                if tgt != ITEM_NONE and \
+                if tgt != ITEM_NONE and self.osds[tgt].alive and \
                         self.osds[tgt].get((pool_id, pg, name, shard)) is None:
                     self.osds[tgt].put((pool_id, pg, name, shard), payload)
                     stats["shards_copied"] += 1
@@ -411,12 +454,128 @@ class ClusterSim:
                 pos += n_str
                 for i, shard in enumerate(missing):
                     tgt = up[shard] if shard < len(up) else ITEM_NONE
-                    if tgt == ITEM_NONE:
+                    if tgt == ITEM_NONE or not self.osds[tgt].alive:
                         continue
                     self.osds[tgt].put((pool_id, pg, name, shard),
                                        part[:, i].reshape(-1))
                     stats["shards_rebuilt"] += 1
         return stats
+
+    def recover_delta(self, pool_id: int) -> Dict[str, int]:
+        """Log-based delta recovery (the PGLog path the reference
+        prefers over backfill, doc/dev/osd_internals/log_based_pg.rst):
+        for every OSD in a PG's up set whose last_complete lags the
+        authoritative log, recover ONLY the objects the log says
+        changed; fall back to the full scan (`recover_all`-style
+        backfill) only when the log was trimmed past the replica's
+        version.
+        """
+        pool = self.osdmap.pools[pool_id]
+        stats = {"pgs_checked": 0, "delta_objects": 0,
+                 "backfill_pgs": 0, "shards_rebuilt": 0,
+                 "shards_copied": 0}
+        # objects per pg (host index; the real system reads the pg's
+        # collection listing)
+        pg_objects: Dict[int, List[str]] = {}
+        for (pid, name) in self.objects:
+            if pid == pool_id:
+                pg_objects.setdefault(
+                    self.object_pg(pool, name), []).append(name)
+        for (pid, pg), log in list(self.pg_logs.items()):
+            if pid != pool_id:
+                continue
+            stats["pgs_checked"] += 1
+            up = self.pg_up(pool, pg)
+            names: Set[str] = set()
+            backfill = False
+            for o in up:
+                if o == ITEM_NONE:
+                    continue
+                lc = self.osds[o].last_complete.get((pool_id, pg), ZERO)
+                if lc >= log.head:
+                    continue
+                ms = log.missing_since(lc)
+                if ms.backfill:
+                    backfill = True
+                    break
+                names.update(ms.need)
+            if backfill:
+                stats["backfill_pgs"] += 1
+                names = set(pg_objects.get(pg, []))
+            stats["delta_objects"] += len(names)
+            all_ok = True
+            for name in names:
+                if not self._recover_object(pool, pg, name, up, stats):
+                    all_ok = False
+            if not all_ok:
+                continue     # keep the gap visible for the next pass
+            # everyone present (and alive) is now current
+            for o in up:
+                if o != ITEM_NONE and self.osds[o].alive:
+                    self.osds[o].last_complete[(pool_id, pg)] = log.head
+        return stats
+
+    def _recover_object(self, pool: PGPool, pg: int, name: str,
+                        up: List[int], stats: Dict[str, int]) -> bool:
+        """Rebuild/copy one object's shards onto the up set; False when
+        anything could not be recovered (the caller must NOT advance
+        last_complete past it)."""
+        info = self.objects.get((pool.id, name))
+        if info is None:
+            return True
+        if pool.type == POOL_REPLICATED:
+            payload = self._read_shard(pool.id, pg, name, 0, up)
+            if payload is None:
+                return False
+            ok = True
+            for o in up:
+                if o == ITEM_NONE:
+                    continue
+                if not self.osds[o].alive:
+                    ok = False       # undetected-dead member stays stale
+                    continue
+                if self.osds[o].get((pool.id, pg, name, 0)) is None:
+                    self.osds[o].put((pool.id, pg, name, 0), payload)
+                    stats["shards_copied"] += 1
+            return ok
+        codec = self.codec_for(pool)
+        k, mm = codec.get_data_chunk_count(), codec.get_coding_chunk_count()
+        U = info.chunk_size
+        missing = []
+        files: Dict[int, np.ndarray] = {}
+        ok = True
+        for shard in range(k + mm):
+            f = self._read_shard(pool.id, pg, name, shard, up)
+            if f is None or len(f) < info.n_stripes * U:
+                missing.append(shard)
+            else:
+                files[shard] = f
+                tgt = up[shard] if shard < len(up) else ITEM_NONE
+                if tgt != ITEM_NONE and self.osds[tgt].alive and \
+                        self.osds[tgt].get(
+                            (pool.id, pg, name, shard)) is None:
+                    self.osds[tgt].put((pool.id, pg, name, shard), f)
+                    stats["shards_copied"] += 1
+        if not missing:
+            return True
+        try:
+            plan = sorted(codec.minimum_to_decode(set(missing),
+                                                  set(files)))
+        except ErasureCodeError:
+            return False     # unrecoverable NOW; retry when shards return
+        sub = np.stack([
+            np.stack([files[c][s * U:(s + 1) * U] for c in plan])
+            for s in range(info.n_stripes)])
+        dec = np.asarray(codec.decode_chunks_batch(plan, sub, missing))
+        for i, shard in enumerate(missing):
+            tgt = up[shard] if shard < len(up) else ITEM_NONE
+            if tgt == ITEM_NONE or not self.osds[tgt].alive:
+                ok = False
+                continue
+            self.osds[tgt].put((pool.id, pg, name, shard),
+                               dec[:, i].reshape(-1))
+            stats["shards_rebuilt"] += 1
+        return ok
 
     # -------------------------------------------------------------- scrub --
     def scrub(self, pool_id: int) -> List[Tuple[str, int]]:

@@ -49,7 +49,8 @@ class NativeUnavailable(RuntimeError):
 def ensure_built(force: bool = False) -> str:
     """Build the shared object if missing or stale; returns its path."""
     srcs = [os.path.join(_NATIVE_DIR, f)
-            for f in ("crush_native.cpp", "gf_native.cpp", "Makefile")]
+            for f in ("crush_native.cpp", "gf_native.cpp",
+                      "msgqueue.cpp", "Makefile")]
     stale = (not os.path.exists(_SO) or
              any(os.path.getmtime(s) > os.path.getmtime(_SO)
                  for s in srcs if os.path.exists(s)))

@@ -1,0 +1,203 @@
+// Messenger-analog host runtime: batching request queues with
+// backpressure, in C++ behind a flat C ABI (ctypes-loaded).
+//
+// The reference's Messenger (src/msg/Messenger.cc, AsyncMessenger event
+// loops + DispatchQueue + Throttle policies) moves typed messages
+// between daemons over TCP/RDMA.  On this runtime the equivalent hop is
+// host threads feeding a jitted device program: what must be preserved
+// (SURVEY.md §2.4) is typed envelopes, BACKPRESSURE, and fan-out/gather
+// to k+m shard queues — not sockets.  This file implements that core:
+//
+//   * ceph_tpu_mq_create(capacity_items, capacity_bytes)
+//       bounded MPSC queue; producers block (with deadline) when either
+//       throttle is exhausted — the Throttle/Policy role.
+//   * ceph_tpu_mq_push(q, type, id, shard, payload, len, timeout_us)
+//   * ceph_tpu_mq_pop_batch(q, max_items, max_bytes, wait_us, ...)
+//       dispatcher side: waits for the first envelope, then drains up
+//       to max_items/max_bytes or until the linger deadline — the
+//       batch-forming step in front of a device dispatch (the role
+//       DispatchQueue plays in front of ms_fast_dispatch).
+//   * stats: depth, bytes, pushed, popped, throttle_waits.
+//
+// Envelopes are copied in (the queue owns its memory); pop hands out
+// stable pointers freed by ceph_tpu_mq_free_batch.
+
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <deque>
+#include <mutex>
+#include <new>
+
+namespace {
+
+struct Envelope {
+    uint32_t type;
+    uint64_t id;
+    int32_t shard;
+    uint64_t len;
+    uint8_t *payload;
+};
+
+struct Queue {
+    std::mutex mu;
+    std::condition_variable not_empty;
+    std::condition_variable not_full;
+    std::deque<Envelope> items;
+    uint64_t cap_items;
+    uint64_t cap_bytes;
+    uint64_t cur_bytes = 0;
+    uint64_t pushed = 0;
+    uint64_t popped = 0;
+    uint64_t throttle_waits = 0;
+    bool closed = false;
+};
+
+bool has_room(const Queue &q, uint64_t len) {
+    return q.items.size() < q.cap_items &&
+           (q.cur_bytes + len) <= q.cap_bytes;
+}
+
+}  // namespace
+
+extern "C" {
+
+void *ceph_tpu_mq_create(uint64_t capacity_items, uint64_t capacity_bytes) {
+    Queue *q = new (std::nothrow) Queue();
+    if (!q) return nullptr;
+    q->cap_items = capacity_items ? capacity_items : UINT64_MAX;
+    q->cap_bytes = capacity_bytes ? capacity_bytes : UINT64_MAX;
+    return q;
+}
+
+void ceph_tpu_mq_destroy(void *qp) {
+    Queue *q = static_cast<Queue *>(qp);
+    {
+        std::lock_guard<std::mutex> lk(q->mu);
+        q->closed = true;
+        for (auto &e : q->items) delete[] e.payload;
+        q->items.clear();
+    }
+    q->not_empty.notify_all();
+    q->not_full.notify_all();
+    delete q;
+}
+
+void ceph_tpu_mq_close(void *qp) {
+    Queue *q = static_cast<Queue *>(qp);
+    {
+        std::lock_guard<std::mutex> lk(q->mu);
+        q->closed = true;
+    }
+    q->not_empty.notify_all();
+    q->not_full.notify_all();
+}
+
+// rc: 0 ok, -1 timeout (throttle full), -2 closed, -3 oversized
+int ceph_tpu_mq_push(void *qp, uint32_t type, uint64_t id, int32_t shard,
+                     const uint8_t *payload, uint64_t len,
+                     int64_t timeout_us) {
+    Queue *q = static_cast<Queue *>(qp);
+    std::unique_lock<std::mutex> lk(q->mu);
+    if (len > q->cap_bytes) return -3;
+    if (!has_room(*q, len)) {
+        q->throttle_waits++;
+        auto pred = [&] { return q->closed || has_room(*q, len); };
+        if (timeout_us < 0) {
+            q->not_full.wait(lk, pred);
+        } else if (!q->not_full.wait_for(
+                       lk, std::chrono::microseconds(timeout_us), pred)) {
+            return -1;
+        }
+    }
+    if (q->closed) return -2;
+    Envelope e{type, id, shard, len, nullptr};
+    if (len) {
+        e.payload = new (std::nothrow) uint8_t[len];
+        if (!e.payload) return -4;  // allocation failure != throttle timeout
+        std::memcpy(e.payload, payload, len);
+    }
+    q->items.push_back(e);
+    q->cur_bytes += len;
+    q->pushed++;
+    lk.unlock();
+    q->not_empty.notify_one();
+    return 0;
+}
+
+// Drain up to max_items (and max_bytes) envelopes.  Blocks up to
+// wait_first_us for the FIRST envelope, then keeps draining whatever
+// is immediately available plus anything arriving within linger_us
+// (the batch-forming window).  Returns item count (0 on timeout/close).
+// Caller owns the returned payload pointers until mq_free_batch.
+int64_t ceph_tpu_mq_pop_batch(void *qp, int64_t max_items,
+                              uint64_t max_bytes, int64_t wait_first_us,
+                              int64_t linger_us, uint32_t *types,
+                              uint64_t *ids, int32_t *shards,
+                              uint8_t **payloads, uint64_t *lens) {
+    Queue *q = static_cast<Queue *>(qp);
+    std::unique_lock<std::mutex> lk(q->mu);
+    if (q->items.empty()) {
+        auto pred = [&] { return q->closed || !q->items.empty(); };
+        if (wait_first_us < 0) {
+            q->not_empty.wait(lk, pred);
+        } else {
+            q->not_empty.wait_for(
+                lk, std::chrono::microseconds(wait_first_us), pred);
+        }
+    }
+    auto deadline = std::chrono::steady_clock::now() +
+                    std::chrono::microseconds(linger_us > 0 ? linger_us : 0);
+    int64_t n = 0;
+    uint64_t bytes = 0;
+    bool byte_capped = false;
+    for (;;) {
+        while (n < max_items && !q->items.empty()) {
+            Envelope &e = q->items.front();
+            if (n > 0 && bytes + e.len > max_bytes) {
+                byte_capped = true;  // next envelope won't fit this batch
+                break;
+            }
+            types[n] = e.type;
+            ids[n] = e.id;
+            shards[n] = e.shard;
+            payloads[n] = e.payload;
+            lens[n] = e.len;
+            bytes += e.len;
+            q->cur_bytes -= e.len;
+            q->items.pop_front();
+            q->popped++;
+            n++;
+        }
+        if (n >= max_items || bytes >= max_bytes || byte_capped ||
+            q->closed || linger_us <= 0)
+            break;
+        auto now = std::chrono::steady_clock::now();
+        if (now >= deadline) break;
+        if (q->items.empty()) {
+            q->not_empty.wait_until(lk, deadline, [&] {
+                return q->closed || !q->items.empty();
+            });
+            if (q->items.empty()) break;
+        }
+    }
+    lk.unlock();
+    if (n) q->not_full.notify_all();
+    return n;
+}
+
+void ceph_tpu_mq_free_payload(uint8_t *p) { delete[] p; }
+
+void ceph_tpu_mq_stats(void *qp, uint64_t *depth, uint64_t *bytes,
+                       uint64_t *pushed, uint64_t *popped,
+                       uint64_t *throttle_waits) {
+    Queue *q = static_cast<Queue *>(qp);
+    std::lock_guard<std::mutex> lk(q->mu);
+    *depth = q->items.size();
+    *bytes = q->cur_bytes;
+    *pushed = q->pushed;
+    *popped = q->popped;
+    *throttle_waits = q->throttle_waits;
+}
+
+}  // extern "C"

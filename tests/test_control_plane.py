@@ -1,0 +1,179 @@
+"""Control plane: Paxos-like consensus, mon map service + config db +
+health, heartbeat failure detection, Objecter retry-on-map-change.
+
+Reference surfaces: src/mon/Paxos.{h,cc}, OSDMonitor (map publication,
+prepare_failure), ConfigMonitor, HealthMonitor, OSD heartbeats
+(OSD.cc:5327), Objecter (_calc_target/resend, Objecter.cc:2688)."""
+import numpy as np
+import pytest
+
+from ceph_tpu.cluster.heartbeat import HeartbeatConfig, HeartbeatMonitor
+from ceph_tpu.cluster.monitor import Monitor, PaxosLog
+from ceph_tpu.cluster.objecter import Objecter, TooManyRetries
+from ceph_tpu.cluster.osdmap import Incremental
+from tests.test_simulator import make_sim
+
+
+# --------------------------------------------------------------- paxos ----
+
+def test_paxos_commits_with_majority():
+    p = PaxosLog(n_ranks=3)
+    assert p.propose("a") and p.propose("b")
+    assert p.committed == ["a", "b"]
+    assert p.version == 2
+
+
+def test_paxos_minority_cannot_commit():
+    p = PaxosLog(n_ranks=3)
+    p.reachable[1] = False
+    assert p.propose("ok")              # 2/3 is still a quorum
+    p.reachable[2] = False
+    assert not p.propose("nope")        # 1/3 is not
+    assert p.committed == ["ok"]
+
+
+def test_paxos_new_leader_supersedes():
+    p = PaxosLog(n_ranks=3)
+    p.propose("v1")
+    old_pn = p.accepted_pn[0]
+    p.elect(leader=1)
+    assert p.propose("v2")
+    assert p.accepted_pn[0] > old_pn
+    assert p.committed == ["v1", "v2"]
+
+
+def test_paxos_single_rank():
+    p = PaxosLog(n_ranks=1)
+    assert p.propose("solo")
+
+
+# ------------------------------------------------------------- monitor ----
+
+def test_mon_map_service_incrementals():
+    sim = make_sim()
+    mon = Monitor(sim.osdmap)
+    e0 = sim.osdmap.epoch
+    inc = mon.next_incremental()
+    inc.new_up[5] = False
+    assert mon.commit_incremental(inc)
+    inc2 = mon.next_incremental()
+    inc2.new_weight[4] = 0
+    assert mon.commit_incremental(inc2)
+    assert sim.osdmap.epoch == e0 + 2
+    got = mon.get_incrementals(e0)
+    assert [i.epoch for i in got] == [e0 + 1, e0 + 2]
+    assert mon.get_incrementals(e0 + 2) == []
+    # consensus log recorded both commits
+    assert mon.paxos.version == 2
+
+
+def test_mon_config_db():
+    from ceph_tpu.common import config
+    sim = make_sim()
+    mon = Monitor(sim.osdmap)
+    assert mon.config_set("fastmap_extra_tries", 12)
+    assert mon.config_get("fastmap_extra_tries") == 12
+    try:
+        assert config().get("fastmap_extra_tries") == 12
+    finally:
+        from ceph_tpu.common.options import LEVEL_FILE
+        config().clear("fastmap_extra_tries", LEVEL_FILE)
+    # unknown keys commit mon-side without poisoning the registry
+    assert mon.config_set("osd_special_knob", "on")
+    assert mon.config_get("osd_special_knob") == "on"
+
+
+def test_mon_health_checks():
+    sim = make_sim()
+    mon = Monitor(sim.osdmap)
+    assert mon.health_status(sim) == "HEALTH_OK"
+    sim.kill_osd(0)
+    sim.out_osd(1)
+    checks = {c.code for c in mon.health(sim)}
+    assert "OSD_DOWN" in checks and "OSD_OUT" in checks
+    assert mon.health_status(sim) == "HEALTH_WARN"
+
+
+def test_failure_reports_need_quorum():
+    sim = make_sim()
+    mon = Monitor(sim.osdmap, failure_reports_needed=2)
+    sim.fail_osd(3)                       # dead, map doesn't know
+    assert sim.osdmap.is_up(3)
+    assert not mon.report_failure(3, reporter=1)   # one report: no
+    assert mon.report_failure(3, reporter=2)       # second: marked down
+    assert not sim.osdmap.is_up(3)
+    # duplicate reporters don't double-count
+    sim.fail_osd(4)
+    assert not mon.report_failure(4, reporter=7)
+    assert not mon.report_failure(4, reporter=7)
+    assert sim.osdmap.is_up(4)
+
+
+# ------------------------------------------------------------ heartbeat ---
+
+def test_heartbeat_detects_and_marks_down():
+    sim = make_sim()
+    mon = Monitor(sim.osdmap, failure_reports_needed=2)
+    hb = HeartbeatMonitor(sim, mon, HeartbeatConfig(n_peers=3,
+                                                    grace_ticks=2))
+    sim.fail_osd(6)
+    down = []
+    for _ in range(5):
+        down += hb.tick()
+    assert down == [6]
+    assert not sim.osdmap.is_up(6)
+    # detection recorded an epoch consumers can fetch
+    assert any(6 in i.new_up and i.new_up[6] is False
+               for i in mon.incrementals)
+
+
+def test_heartbeat_ignores_healthy():
+    sim = make_sim()
+    mon = Monitor(sim.osdmap)
+    hb = HeartbeatMonitor(sim, mon)
+    for _ in range(4):
+        assert hb.tick() == []
+
+
+# ------------------------------------------------------------- objecter ---
+
+def test_objecter_plain_io():
+    sim = make_sim()
+    mon = Monitor(sim.osdmap)
+    client = Objecter(sim, mon)
+    data = bytes(range(256)) * 40
+    client.put(2, "obj", data)
+    assert client.get(2, "obj") == data
+
+
+def test_objecter_resends_after_map_change():
+    sim = make_sim()
+    mon = Monitor(sim.osdmap, failure_reports_needed=1)
+    client = Objecter(sim, mon)
+    data = np.random.default_rng(3).integers(0, 256, 20000) \
+        .astype(np.uint8).tobytes()
+    placed = client.put(2, "hot", data)
+    e0 = client.osdmap.epoch
+    # primary dies; mon learns via a failure report; client is stale
+    victim = placed[0]
+    sim.fail_osd(victim)
+    mon.report_failure(victim, reporter=placed[1])
+    assert client.osdmap.epoch == e0          # still stale
+    got = client.get(2, "hot")                # resend path catches up
+    assert got == data
+    assert client.osdmap.epoch > e0
+    assert (_ := client._pc.get("op_resends") or 0) >= 0
+
+
+def test_objecter_gives_up_without_map_progress():
+    sim = make_sim()
+    mon = Monitor(sim.osdmap)
+    client = Objecter(sim, mon, max_retries=3)
+    client.put(2, "x", b"payload")
+    pool = sim.osdmap.pools[2]
+    pg = sim.object_pg(pool, "x")
+    # kill the real primary but never tell the mon: the op cannot land
+    real_up = sim.pg_up(pool, pg)
+    sim.fail_osd(real_up[0])
+    with pytest.raises(TooManyRetries):
+        client.put(2, "x", b"payload2")

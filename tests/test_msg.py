@@ -1,0 +1,171 @@
+"""Messenger-analog: native batching queues, backpressure, typed
+envelopes, dispatcher loop, shard fan-out/gather.
+
+Reference roles: src/msg/Messenger.cc policies + Throttle.h
+(backpressure), DispatchQueue (batch forming), src/messages/ (typed
+envelopes), ECBackend sub-op fan-out/ack-gather."""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from ceph_tpu.msg import (BatchingDispatcher, Envelope, MessageQueue,
+                          MSG_EC_SUB_WRITE, MSG_OSD_OP, MSG_OSD_OP_REPLY,
+                          QueueClosed, QueueFull, ShardFanout)
+
+
+def test_push_pop_roundtrip():
+    q = MessageQueue()
+    q.push(Envelope(MSG_OSD_OP, 7, 2, b"hello"))
+    q.push(Envelope(MSG_OSD_OP, 8, -1, b""))
+    batch = q.pop_batch(wait_first=1.0)
+    assert batch == [Envelope(MSG_OSD_OP, 7, 2, b"hello"),
+                     Envelope(MSG_OSD_OP, 8, -1, b"")]
+
+
+def test_batch_caps_items_and_bytes():
+    q = MessageQueue()
+    for i in range(10):
+        q.push(Envelope(MSG_OSD_OP, i, 0, b"x" * 100))
+    b1 = q.pop_batch(max_items=4, wait_first=0.2)
+    assert [e.id for e in b1] == [0, 1, 2, 3]
+    b2 = q.pop_batch(max_bytes=250, wait_first=0.2)
+    assert len(b2) == 2            # 2 x 100B fit under the 250B cap
+    rest = q.pop_batch(wait_first=0.2)
+    assert len(rest) == 4
+
+
+def test_backpressure_blocks_and_unblocks():
+    q = MessageQueue(capacity_items=2)
+    q.push(Envelope(MSG_OSD_OP, 0, 0, b"a"))
+    q.push(Envelope(MSG_OSD_OP, 1, 0, b"b"))
+    with pytest.raises(QueueFull):
+        q.push(Envelope(MSG_OSD_OP, 2, 0, b"c"), timeout=0.05)
+    assert q.stats()["throttle_waits"] >= 1
+
+    def consumer():
+        time.sleep(0.1)
+        q.pop_batch(max_items=1, wait_first=1.0)
+
+    t = threading.Thread(target=consumer)
+    t.start()
+    q.push(Envelope(MSG_OSD_OP, 2, 0, b"c"), timeout=2.0)  # unblocks
+    t.join()
+    assert q.stats()["pushed"] == 3
+
+
+def test_byte_throttle():
+    q = MessageQueue(capacity_bytes=100)
+    q.push(Envelope(MSG_OSD_OP, 0, 0, b"x" * 80))
+    with pytest.raises(QueueFull):
+        q.push(Envelope(MSG_OSD_OP, 1, 0, b"y" * 30), timeout=0.05)
+    with pytest.raises(ValueError):
+        q.push(Envelope(MSG_OSD_OP, 2, 0, b"z" * 200))  # oversized
+
+
+def test_close_wakes_producers():
+    q = MessageQueue(capacity_items=1)
+    q.push(Envelope(MSG_OSD_OP, 0, 0, b"a"))
+    err = []
+
+    def producer():
+        try:
+            q.push(Envelope(MSG_OSD_OP, 1, 0, b"b"), timeout=None)
+        except QueueClosed as e:
+            err.append(e)
+
+    t = threading.Thread(target=producer)
+    t.start()
+    time.sleep(0.05)
+    q.close()
+    t.join(2.0)
+    assert err and not t.is_alive()
+    # close() drains: already-queued envelopes stay poppable
+    assert [e.id for e in q.pop_batch(wait_first=0.05)] == [0]
+    assert q.pop_batch(wait_first=0.05) == []
+
+
+def test_linger_forms_bigger_batches():
+    q = MessageQueue()
+
+    def slow_producer():
+        for i in range(5):
+            q.push(Envelope(MSG_OSD_OP, i, 0, b"p"))
+            time.sleep(0.002)
+
+    t = threading.Thread(target=slow_producer)
+    t.start()
+    batch = q.pop_batch(wait_first=1.0, linger=0.2)
+    t.join()
+    assert len(batch) == 5          # linger window caught stragglers
+
+
+def test_dispatcher_batches_to_handler():
+    in_q, out_q = MessageQueue(), MessageQueue()
+    seen_batches = []
+
+    def handler(batch):
+        seen_batches.append(len(batch))
+        # numpy "device work": sum payload bytes per envelope
+        return [Envelope(MSG_OSD_OP_REPLY, e.id, e.shard,
+                         bytes([sum(e.payload) & 0xFF]))
+                for e in batch]
+
+    d = BatchingDispatcher(in_q, handler, reply_q=out_q,
+                           linger=0.01).start()
+    try:
+        for i in range(20):
+            in_q.push(Envelope(MSG_OSD_OP, i, 0, bytes([i, i])))
+        got = {}
+        deadline = time.time() + 5
+        while len(got) < 20 and time.time() < deadline:
+            for e in out_q.pop_batch(wait_first=0.2):
+                got[e.id] = e.payload[0]
+        assert len(got) == 20
+        assert got[3] == 6
+        assert sum(seen_batches) == 20
+    finally:
+        d.stop()
+
+
+def test_shard_fanout_gather():
+    k_plus_m = 5
+    shard_qs = [MessageQueue() for _ in range(k_plus_m)]
+    ack_q = MessageQueue()
+    fan = ShardFanout(shard_qs, ack_q)
+    # shard servers: echo an ack for every sub-write
+    servers = [BatchingDispatcher(
+        q, lambda b: [Envelope(MSG_OSD_OP_REPLY, e.id, e.shard, b"\0")
+                      for e in b],
+        reply_q=ack_q, name=f"shard{i}").start()
+        for i, q in enumerate(shard_qs)]
+    try:
+        fan.submit(99, MSG_EC_SUB_WRITE, [b"chunk%d" % i
+                                          for i in range(k_plus_m)])
+        assert fan.wait(99, timeout=5.0)
+    finally:
+        for s in servers:
+            s.stop()
+
+
+def test_shard_fanout_failure():
+    shard_qs = [MessageQueue() for _ in range(3)]
+    ack_q = MessageQueue()
+    fan = ShardFanout(shard_qs, ack_q)
+    fan.submit(5, MSG_EC_SUB_WRITE, [b"a", b"b", b"c"])
+    ack_q.push(Envelope(MSG_OSD_OP_REPLY, 5, 0, b"\0"))
+    ack_q.push(Envelope(MSG_OSD_OP_REPLY, 5, 1, b"\x01"))  # nack
+    ack_q.push(Envelope(MSG_OSD_OP_REPLY, 5, 2, b"\0"))
+    with pytest.raises(IOError):
+        fan.wait(5, timeout=2.0)
+
+
+def test_queue_stats():
+    q = MessageQueue()
+    q.push(Envelope(MSG_OSD_OP, 1, 0, b"abc"))
+    s = q.stats()
+    assert s["depth"] == 1 and s["bytes"] == 3 and s["pushed"] == 1
+    q.pop_batch(wait_first=0.1)
+    s = q.stats()
+    assert s["depth"] == 0 and s["popped"] == 1

@@ -1,0 +1,147 @@
+"""PGLog delta recovery + incremental OSDMap epochs.
+
+Reference: src/osd/PGLog.{h,cc} (bounded log, missing sets,
+delta-vs-backfill), doc/dev/osd_internals/log_based_pg.rst,
+OSDMap::Incremental."""
+import numpy as np
+import pytest
+
+from ceph_tpu.cluster.osdmap import Incremental
+from ceph_tpu.cluster.pglog import (MissingSet, OP_DELETE, PGLog, ZERO)
+from tests.test_simulator import make_sim
+
+
+# ------------------------------------------------------------- unit: log ---
+
+def test_log_append_and_versions():
+    log = PGLog()
+    e1 = log.append(1, "a")
+    e2 = log.append(1, "b")
+    e3 = log.append(2, "a")
+    assert e1.version < e2.version < e3.version
+    assert log.head == e3.version
+    assert log.tail == ZERO
+
+
+def test_missing_since_dedupes_latest():
+    log = PGLog()
+    log.append(1, "a")
+    v = log.append(1, "b").version
+    log.append(2, "a")
+    ms = log.missing_since(v)
+    assert set(ms.need) == {"a"}          # only ops after v; a deduped
+    assert not ms.backfill
+    ms0 = log.missing_since(ZERO)
+    assert set(ms0.need) == {"a", "b"}
+
+
+def test_missing_since_delete_wins():
+    log = PGLog()
+    start = log.append(1, "x").version
+    log.append(1, "doomed")
+    log.append(2, "doomed", op=OP_DELETE)
+    ms = log.missing_since(start)
+    assert "doomed" not in ms.need
+    assert "doomed" in ms.deleted
+
+
+def test_trim_forces_backfill():
+    log = PGLog(max_entries=4)
+    v0 = log.append(1, "o0").version
+    for i in range(1, 8):
+        log.append(1, f"o{i}")
+    assert len(log.entries) == 4
+    assert not log.covers(v0)
+    assert log.missing_since(v0).backfill
+    # a fresh replica at head needs nothing
+    assert log.missing_since(log.head).need == {}
+
+
+# ------------------------------------------------- unit: map incrementals --
+
+def test_incremental_apply():
+    sim = make_sim(n_hosts=4, osds_per_host=2)
+    om = sim.osdmap
+    e0 = om.epoch
+    inc = Incremental(epoch=e0 + 1, new_up={3: False},
+                      new_weight={2: 0},
+                      new_pg_upmap_items={(1, 0): [(0, 1)]})
+    om.apply_incremental(inc)
+    assert om.epoch == e0 + 1
+    assert not om.osd_up[3] and om.osd_weight[2] == 0
+    assert om.pg_upmap_items[(1, 0)] == [(0, 1)]
+    # wrong sequence rejected
+    with pytest.raises(ValueError):
+        om.apply_incremental(Incremental(epoch=e0 + 5))
+    # removal entry
+    om.apply_incremental(Incremental(epoch=e0 + 2,
+                                     new_pg_upmap_items={(1, 0): None}))
+    assert (1, 0) not in om.pg_upmap_items
+
+
+# ----------------------------------------------------- sim: delta recovery --
+
+def test_delta_recovery_only_touches_changed_objects():
+    sim = make_sim()
+    rng = np.random.default_rng(17)
+    blobs = {f"d{i}": rng.integers(0, 256, size=20000).astype(np.uint8)
+             .tobytes() for i in range(12)}
+    for name, data in blobs.items():
+        sim.put(2, name, data)
+    # take an OSD down, modify a FEW objects, bring it back
+    victim = sim.put(2, "d0", blobs["d0"])[0]
+    sim.kill_osd(victim)
+    changed = {}
+    for name in ("d1", "d2"):
+        blob = rng.integers(0, 256, size=500).astype(np.uint8).tobytes()
+        sim.write(2, name, 100, blob)
+        changed[name] = blob
+    sim.revive_osd(victim)
+    stats = sim.recover_delta(2)
+    # the log names only the objects written while the OSD was down
+    # (put of d0 happened before the kill)
+    assert stats["backfill_pgs"] == 0
+    assert 0 < stats["delta_objects"] <= 4
+    # everything reads back
+    for name, data in blobs.items():
+        got = sim.get(2, name)
+        if name in changed:
+            assert got[100:600] == changed[name]
+        else:
+            assert got == data
+    # second pass: nothing left to do
+    stats2 = sim.recover_delta(2)
+    assert stats2["delta_objects"] == 0
+
+
+def test_delta_recovery_backfill_after_trim():
+    sim = make_sim()
+    rng = np.random.default_rng(19)
+    sim.put(2, "bf", rng.integers(0, 256, size=9000).astype(np.uint8)
+            .tobytes())
+    placed = sim.put(2, "bf", rng.integers(0, 256, size=9000)
+                     .astype(np.uint8).tobytes())
+    victim = placed[0]
+    sim.kill_osd(victim)
+    # churn way past the log bound so the victim's version is trimmed
+    for log in sim.pg_logs.values():
+        log.max_entries = 4
+    for i in range(30):
+        sim.write(2, "bf", 10 * i, b"!")
+    sim.revive_osd(victim)
+    stats = sim.recover_delta(2)
+    assert stats["backfill_pgs"] >= 1
+    assert sim.scrub(2) == []
+
+
+def test_replicated_delta_recovery():
+    sim = make_sim()
+    sim.put(1, "r0", b"alpha" * 100)
+    placed = sim.put(1, "r1", b"beta" * 100)
+    victim = placed[0]
+    sim.kill_osd(victim)
+    sim.write(1, "r1", 0, b"BETA")
+    sim.revive_osd(victim)
+    stats = sim.recover_delta(1)
+    assert stats["delta_objects"] >= 1
+    assert sim.get(1, "r1")[:4] == b"BETA"
