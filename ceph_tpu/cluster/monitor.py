@@ -188,15 +188,32 @@ class Monitor:
             checks.append(HealthCheck(
                 "OSD_OUT", "HEALTH_WARN", f"{out} osds out"))
         degraded = 0
+        for pid in om.pools:
+            up, _ = om.map_pgs_batch(pid)
+            holes = (up == ITEM_NONE).any(axis=1)
+            degraded += int(holes.sum())
+        stale = 0
         if sim is not None:
-            for pid, pool in om.pools.items():
-                up, _ = om.map_pgs_batch(pid)
-                holes = (up == ITEM_NONE).any(axis=1)
-                degraded += int(holes.sum())
-        if degraded:
+            # real shard-state input: PGs whose log is ahead of some
+            # up member's last applied version (objects there are
+            # degraded even though the map shows a full up set)
+            from .pglog import ZERO
+            for (pid, pg), log in sim.pg_logs.items():
+                pool = om.pools.get(pid)
+                if pool is None or log.head == ZERO:
+                    continue
+                for o in sim.pg_up(pool, pg):
+                    if o == ITEM_NONE:
+                        continue
+                    lc = sim.osds[o].last_complete.get((pid, pg), ZERO)
+                    if lc < log.head:
+                        stale += 1
+                        break
+        if degraded or stale:
             checks.append(HealthCheck(
                 "PG_DEGRADED", "HEALTH_WARN",
-                f"{degraded} pgs with unfilled slots"))
+                f"{degraded} pgs with unfilled slots, "
+                f"{stale} pgs with stale replicas"))
         return checks
 
     def health_status(self, sim=None) -> str:

@@ -34,7 +34,7 @@ from ..ops import hashing
 from ..placement.crush_map import ITEM_NONE
 from .ec_rmw import ExtentCache, RmwPipeline, StripeInfo
 from .osdmap import OSDMap, PGPool, POOL_ERASURE, POOL_REPLICATED
-from .pglog import PGLog, Version, ZERO
+from .pglog import OP_DELETE, PGLog, Version, ZERO
 
 ShardKey = Tuple[int, int, str, int]   # (pool, pg, object, shard)
 
@@ -200,6 +200,10 @@ class ClusterSim:
                 except IOError:
                     continue     # undetected-dead OSD (fail_osd state)
                 placed.append(o)
+            if not placed:
+                # nothing landed: the write FAILED — do not destroy the
+                # previous version or record the new one
+                raise IOError(f"object {name}: no replica writable")
             # supersede stale replicas (incl. on down OSDs) so a revived
             # OSD can never serve an older version — see _write_shard
             for o in self.osds:
@@ -338,6 +342,26 @@ class ClusterSim:
             new_size, si.chunk_size, n_str)
         self._log_write(pool_id, pg, name, placed)
         return sorted(placed)
+
+    def delete(self, pool_id: int, name: str) -> None:
+        """Remove an object: shards purged from live OSDs, an OP_DELETE
+        log entry recorded so lagging replicas apply it on delta
+        recovery."""
+        pool = self.osdmap.pools[pool_id]
+        if self.objects.pop((pool_id, name), None) is None:
+            return
+        pg = self.object_pg(pool, name)
+        up = self.pg_up(pool, pg)
+        for osd in self.osds:
+            if osd.alive:
+                for shard in range(pool.size):
+                    osd.delete((pool_id, pg, name, shard))
+        self.extent_cache.invalidate_object((pool_id, name))
+        e = self._log(pool_id, pg).append(self.osdmap.epoch, name,
+                                          op=OP_DELETE)
+        for o in up:
+            if o != ITEM_NONE and self.osds[o].alive:
+                self.osds[o].last_complete[(pool_id, pg)] = e.version
 
     # ----------------------------------------------------------- failure --
     def kill_osd(self, osd: int) -> None:
@@ -487,6 +511,7 @@ class ClusterSim:
             stats["pgs_checked"] += 1
             up = self.pg_up(pool, pg)
             names: Set[str] = set()
+            deleted: Set[str] = set()
             backfill = False
             for o in up:
                 if o == ITEM_NONE:
@@ -499,9 +524,22 @@ class ClusterSim:
                     backfill = True
                     break
                 names.update(ms.need)
+                deleted.update(ms.deleted)
             if backfill:
                 stats["backfill_pgs"] += 1
                 names = set(pg_objects.get(pg, []))
+                deleted = set()
+            # deletes the lagging replica missed: purge its shards so a
+            # stale-map read can never resurrect the object
+            for name in deleted:
+                if (pool_id, name) in self.objects:
+                    continue          # recreated after the delete
+                for osd in self.osds:
+                    if osd.alive:
+                        for shard in range(pool.size):
+                            osd.delete((pool_id, pg, name, shard))
+                stats["deletes_applied"] = \
+                    stats.get("deletes_applied", 0) + 1
             stats["delta_objects"] += len(names)
             all_ok = True
             for name in names:

@@ -155,10 +155,20 @@ class MessageQueue:
         if self._q:
             self._lib.ceph_tpu_mq_close(self._q)
 
+    def destroy(self) -> None:
+        """Free the native queue.  ONLY safe after every producer and
+        consumer thread has stopped: a thread still blocked in push or
+        pop_batch would relock a destroyed mutex (UB)."""
+        if self._q:
+            self._lib.ceph_tpu_mq_destroy(self._q)
+            self._q = None
+
     def __del__(self):
+        # close (wakes waiters) but deliberately LEAK the native queue:
+        # destroying while a dispatcher thread is parked in a condvar
+        # wait is a use-after-free; callers with known-quiesced queues
+        # use destroy() explicitly
         try:
-            if getattr(self, "_q", None):
-                self._lib.ceph_tpu_mq_destroy(self._q)
-                self._q = None
+            self.close()
         except Exception:
             pass

@@ -222,3 +222,18 @@ def jx_hash3(a, b, c):
     b, x, h = _jx_mix(b, x, h)
     y, c, h = _jx_mix(y, c, h)
     return h
+
+
+def jx_hash4(a, b, c, d):
+    jnp = _jx()
+    a = a.astype(jnp.uint32); b = b.astype(jnp.uint32)
+    c = c.astype(jnp.uint32); d = d.astype(jnp.uint32)
+    h = jnp.uint32(SEED) ^ a ^ b ^ c ^ d
+    x = jnp.full_like(h, MIX_X); y = jnp.full_like(h, MIX_Y)
+    a, b, h = _jx_mix(a, b, h)
+    c, d, h = _jx_mix(c, d, h)
+    a, x, h = _jx_mix(a, x, h)
+    y, b, h = _jx_mix(y, b, h)
+    c, x, h = _jx_mix(c, x, h)
+    y, d, h = _jx_mix(y, d, h)
+    return h

@@ -824,6 +824,10 @@ class FastMapper:
                  extra_tries: Optional[int] = None):
         self.cmap = cmap
         self.compiled = compile_map(cmap, choose_args_key, n_positions=1)
+        if not self.compiled.all_straw2:
+            raise UnsupportedMapError(
+                "fast mapper vectorizes straw2 buckets only; legacy "
+                "algs run through the general mapper")
         if strategy is None:
             cfg = _config().get("lookup_strategy")
             strategy = None if cfg == "auto" else cfg

@@ -48,7 +48,8 @@ from ..common.perf_counters import perf as _perf  # noqa: E402
 from ..ops import hashing  # noqa: E402
 from . import lntable  # noqa: E402
 from .crush_map import (  # noqa: E402
-    BUCKET_STRAW2, ITEM_NONE, ITEM_UNDEF,
+    BUCKET_LIST, BUCKET_STRAW, BUCKET_STRAW2, BUCKET_TREE, BUCKET_UNIFORM,
+    ITEM_NONE, ITEM_UNDEF,
     RULE_CHOOSELEAF_FIRSTN, RULE_CHOOSELEAF_INDEP, RULE_CHOOSE_FIRSTN,
     RULE_CHOOSE_INDEP, RULE_EMIT, RULE_SET_CHOOSELEAF_STABLE,
     RULE_SET_CHOOSELEAF_TRIES, RULE_SET_CHOOSELEAF_VARY_R,
@@ -67,17 +68,24 @@ class UnsupportedMapError(Exception):
 
 @dataclass(frozen=True)
 class CompiledMap:
-    """Dense, device-ready view of a CrushMap (straw2-only subset)."""
+    """Dense, device-ready view of a CrushMap (all 5 bucket algs)."""
     items: np.ndarray        # i32 [B, S] child ids (pad 0)
     hash_ids: np.ndarray     # i32 [B, S] ids hashed by straw2 (choose_args)
     weight_sets: np.ndarray  # i32 [B, P, S] per-position weights
     sizes: np.ndarray        # i32 [B]
     types: np.ndarray        # i32 [B]
+    algs: np.ndarray         # i32 [B] bucket algorithm
+    bucket_ids: np.ndarray   # i32 [B] original (negative) bucket ids
+    sum_weights: np.ndarray  # i32 [B, S]  LIST prefix sums
+    straws: np.ndarray       # i32 [B, S]  STRAW v1 scalers
+    node_weights: np.ndarray  # i64 [B, 2S] TREE interior-node weights
+    num_nodes: np.ndarray    # i32 [B]
     n_buckets: int
     max_size: int
     n_positions: int
     max_devices: int
     max_depth: int
+    all_straw2: bool
 
     def tables(self, strategy: str) -> "DeviceTables":
         return DeviceTables(self, strategy)
@@ -98,13 +106,15 @@ def compile_map(cmap: CrushMap, choose_args_key: object = None,
     if B == 0:
         raise UnsupportedMapError("map has no buckets")
     S = 1
+    all_straw2 = True
     for b in cmap.buckets:
         if b is None:
             continue
         if b.alg != BUCKET_STRAW2:
-            raise UnsupportedMapError(
-                f"bucket {b.id} alg {b.alg} != straw2; scalar fallback")
+            all_straw2 = False
         S = max(S, b.size)
+        if b.alg == BUCKET_TREE and b.num_nodes:
+            S = max(S, (b.num_nodes + 1) // 2)
     choose_args = cmap.choose_args.get(choose_args_key) \
         if choose_args_key is not None else None
     P = 1
@@ -119,17 +129,37 @@ def compile_map(cmap: CrushMap, choose_args_key: object = None,
     ws = np.zeros((B, P, S), dtype=np.int32)
     sizes = np.zeros(B, dtype=np.int32)
     types = np.zeros(B, dtype=np.int32)
+    algs = np.full(B, BUCKET_STRAW2, dtype=np.int32)
+    bucket_ids = np.zeros(B, dtype=np.int32)
+    sum_weights = np.zeros((B, S), dtype=np.int32)
+    straws = np.zeros((B, S), dtype=np.int32)
+    node_weights = np.zeros((B, 2 * S), dtype=np.int64)
+    num_nodes = np.zeros(B, dtype=np.int32)
     for idx, b in enumerate(cmap.buckets):
         if b is None:
             continue
         n = b.size
         sizes[idx] = n
         types[idx] = b.type
+        algs[idx] = b.alg
+        bucket_ids[idx] = b.id
         items[idx, :n] = b.items
         hash_ids[idx, :n] = b.items
+        w_row = ([b.weights[0]] * n if b.alg == BUCKET_UNIFORM and
+                 len(b.weights) == 1 and n > 1 else b.weights[:n])
         for p in range(P):
-            ws[idx, p, :n] = b.weights
-        if choose_args is not None:
+            ws[idx, p, :len(w_row)] = w_row
+        if b.alg == BUCKET_LIST and b.sum_weights:
+            sum_weights[idx, :n] = b.sum_weights
+        if b.alg == BUCKET_STRAW and b.straws:
+            straws[idx, :n] = b.straws
+        if b.alg == BUCKET_TREE and b.node_weights:
+            node_weights[idx, :len(b.node_weights)] = b.node_weights
+            num_nodes[idx] = b.num_nodes
+        if choose_args is not None and b.alg == BUCKET_STRAW2:
+            # choose_args are consumed ONLY by straw2 selection
+            # (mapper.c:309-326 via bucket_straw2_choose); legacy algs
+            # keep their native weights, matching the scalar oracle
             arg = choose_args[idx] if idx < len(choose_args) else None
             if arg is not None:
                 if arg.ids is not None:
@@ -157,8 +187,12 @@ def compile_map(cmap: CrushMap, choose_args_key: object = None,
             break
     return CompiledMap(
         items=items, hash_ids=hash_ids, weight_sets=ws, sizes=sizes,
-        types=types, n_buckets=B, max_size=S, n_positions=P,
-        max_devices=max(cmap.max_devices, 1), max_depth=int(depth.max()))
+        types=types, algs=algs, bucket_ids=bucket_ids,
+        sum_weights=sum_weights, straws=straws,
+        node_weights=node_weights, num_nodes=num_nodes,
+        n_buckets=B, max_size=S, n_positions=P,
+        max_devices=max(cmap.max_devices, 1), max_depth=int(depth.max()),
+        all_straw2=all_straw2)
 
 
 # ------------------------------------------------------------- primitives --
@@ -199,7 +233,19 @@ class DeviceTables:
             self.weight_sets = jnp.asarray(cm.weight_sets)
             self.numer_lut = jnp.asarray(
                 (-lntable.straw2_ln_lut()).astype(np.float64))
+            if not cm.all_straw2:
+                self.algs = jnp.asarray(cm.algs)
+                self.bucket_ids = jnp.asarray(
+                    cm.bucket_ids.astype(np.uint32))
+                self.sum_weights = jnp.asarray(cm.sum_weights)
+                self.straws = jnp.asarray(cm.straws)
+                self.node_weights = jnp.asarray(cm.node_weights)
+                self.num_nodes = jnp.asarray(cm.num_nodes)
             return
+        if not cm.all_straw2:
+            raise UnsupportedMapError(
+                "onehot strategy vectorizes straw2 buckets only; "
+                "legacy algs use the gather tables")
         if cm.max_devices >= (1 << 24):
             raise UnsupportedMapError(
                 "onehot strategy requires device ids < 2^24 (f32-exact)")
@@ -339,6 +385,133 @@ def _straw2_choose(dt: DeviceTables, bidx, x, r, pos):
     return dt.item_at(items_row, jnp.argmin(q))
 
 
+def _uniform_choose(dt: DeviceTables, bidx, x, r):
+    """bucket_perm_choose (mapper.c:74-133): the r-th element of an
+    incrementally built pseudo-random permutation.  The cross-call perm
+    cache reconstructs as a pure function of (x, r): starting from the
+    identity, step p swaps perm[p] with perm[p + hash(x,id,p) %% (n-p)]
+    for p = 0..pr-1 (the pr==0 shortcut and its 0xFFFF expansion
+    produce exactly this state, verified against the scalar oracle)."""
+    S = dt.S
+    n = jnp.maximum(dt.bucket_size(bidx), 1)
+    bid = dt.bucket_ids[bidx]
+    pr = _u32(r).astype(jnp.int32) % n
+
+    # the reference's while loop runs steps p = 0..pr INCLUSIVE
+    # (while perm_n <= pr), and the pr==0 shortcut + its 0xFFFF
+    # expansion reduce to exactly step p=0, so one loop covers all
+    def step(p, perm):
+        gap = jnp.maximum(n - p, 1)
+        i = (hashing.jx_hash3(_u32(x), bid, _u32(p)) % _u32(gap)) \
+            .astype(jnp.int32)
+        do = (p < n - 1) & (i != 0)
+        pi = perm[jnp.clip(p, 0, S - 1)]
+        pj = perm[jnp.clip(p + i, 0, S - 1)]
+        perm = perm.at[jnp.clip(p, 0, S - 1)].set(
+            jnp.where(do, pj, pi))
+        perm = perm.at[jnp.clip(p + i, 0, S - 1)].set(
+            jnp.where(do, pi, pj))
+        return perm
+
+    perm = lax.fori_loop(0, pr + 1, step,
+                         jnp.arange(S, dtype=jnp.int32))
+    items_row, _, _, _ = dt.bucket_row(bidx, jnp.int32(0))
+    return dt.item_at(items_row, jnp.clip(perm[jnp.clip(pr, 0, S - 1)],
+                                          0, S - 1))
+
+
+def _list_choose(dt: DeviceTables, bidx, x, r):
+    """bucket_list_choose (mapper.c:139-160): scan from the list tail;
+    take the highest index whose 16-bit draw scaled by the prefix sum
+    undercuts the item weight, else items[0]."""
+    S = dt.S
+    items_row, _, w, size = dt.bucket_row(bidx, jnp.int32(0))
+    sums = dt.sum_weights[bidx].astype(jnp.int64)
+    h = hashing.jx_hash4(
+        jnp.broadcast_to(_u32(x), (S,)),
+        items_row.astype(jnp.uint32),
+        jnp.broadcast_to(_u32(r), (S,)),
+        jnp.broadcast_to(dt.bucket_ids[bidx], (S,))) & jnp.uint32(0xFFFF)
+    draw = (h.astype(jnp.int64) * sums) >> 16
+    ok = (draw < w.astype(jnp.int64)) & (jnp.arange(S) < size)
+    idx = jnp.max(jnp.where(ok, jnp.arange(S), -1))
+    return dt.item_at(items_row, jnp.maximum(idx, 0))
+
+
+def _tree_choose(dt: DeviceTables, bidx, x, r):
+    """bucket_tree_choose (mapper.c:180-219): descend the interior
+    weight tree; at node n draw 32.32-scaled t against the left child's
+    weight."""
+    nw = dt.node_weights[bidx]
+    n0 = (dt.num_nodes[bidx] >> 1).astype(jnp.int32)
+    NW = nw.shape[0]
+    bid = dt.bucket_ids[bidx]
+
+    def height(n):
+        # trailing zeros of n (n > 0, n < 2S)
+        h = jnp.int32(0)
+        m = n
+
+        def hb(i, carry):
+            h, m = carry
+            is_even = (m & 1) == 0
+            return (jnp.where(is_even, h + 1, h),
+                    jnp.where(is_even, m >> 1, m))
+        bits = max(1, NW.bit_length())
+        h, m = lax.fori_loop(0, bits, hb, (h, m))
+        return h
+
+    def cond(n):
+        return (n & 1) == 0
+
+    def body(n):
+        w = nw[jnp.clip(n, 0, NW - 1)]
+        t = (hashing.jx_hash4(_u32(x), _u32(n), _u32(r), bid)
+             .astype(jnp.int64) * w) >> 32
+        h = height(n)
+        step = jnp.int32(1) << jnp.maximum(h - 1, 0)
+        left = n - step
+        right = n + step
+        lw = nw[jnp.clip(left, 0, NW - 1)]
+        return jnp.where(t < lw, left, right)
+
+    n = lax.while_loop(cond, body, n0)
+    items_row, _, _, _ = dt.bucket_row(bidx, jnp.int32(0))
+    return dt.item_at(items_row, jnp.clip(n >> 1, 0, dt.S - 1))
+
+
+def _straw_choose(dt: DeviceTables, bidx, x, r):
+    """bucket_straw_choose (mapper.c:224-241): 16-bit draw times the
+    precomputed straw scaler, argmax with first-index tie-break."""
+    S = dt.S
+    items_row, _, _, size = dt.bucket_row(bidx, jnp.int32(0))
+    straws = dt.straws[bidx].astype(jnp.int64)
+    h = hashing.jx_hash3(
+        jnp.broadcast_to(_u32(x), (S,)),
+        items_row.astype(jnp.uint32),
+        jnp.broadcast_to(_u32(r), (S,))) & jnp.uint32(0xFFFF)
+    draw = h.astype(jnp.int64) * straws            # <= 2^48, exact
+    draw = jnp.where(jnp.arange(S) < size, draw, jnp.int64(-1))
+    return dt.item_at(items_row, jnp.argmax(draw))
+
+
+def _bucket_choose(dt: DeviceTables, bidx, x, r, pos):
+    """Per-algorithm dispatch (crush_bucket_choose, mapper.c:387-418).
+    Static fast path when the whole map is straw2 (no switch emitted)."""
+    if dt.cm.all_straw2:
+        return _straw2_choose(dt, bidx, x, r, pos)
+    alg = dt.algs[bidx]
+    branches = [
+        lambda: _uniform_choose(dt, bidx, x, r),       # BUCKET_UNIFORM=1
+        lambda: _list_choose(dt, bidx, x, r),          # BUCKET_LIST=2
+        lambda: _tree_choose(dt, bidx, x, r),          # BUCKET_TREE=3
+        lambda: _straw_choose(dt, bidx, x, r),         # BUCKET_STRAW=4
+        lambda: _straw2_choose(dt, bidx, x, r, pos),   # BUCKET_STRAW2=5
+    ]
+    return lax.switch(jnp.clip(alg - 1, 0, 4),
+                      [lambda _, f=f: f() for f in branches], 0)
+
+
 def _is_out(weights, item, x):
     """Device overload rejection (mapper.c:424-438); item must be >= 0."""
     n = weights.shape[0]
@@ -367,7 +540,7 @@ def _descend(cm: CompiledMap, dt: DeviceTables, start_bidx,
     def body(carry, _):
         cur, done, status, result = carry
         empty = dt.bucket_size(cur) == 0
-        item = _straw2_choose(dt, cur, x, r, pos)
+        item = _bucket_choose(dt, cur, x, r, pos)
         is_dev = item >= 0
         bad_dev = is_dev & (item >= cm.max_devices)
         bidx = jnp.where(is_dev, 0, -1 - item)

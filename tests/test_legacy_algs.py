@@ -1,0 +1,174 @@
+"""Legacy bucket algorithms in the batched mapper — bit-exactness.
+
+uniform / list / tree / straw buckets (mapper.c:74-241) now vectorize
+in the general XlaMapper (per-bucket lax.switch dispatch); mixed-alg
+hierarchies must match the scalar oracle element-for-element, and the
+fast mapper must cleanly refuse them so dispatch falls through.
+"""
+import numpy as np
+import pytest
+
+from ceph_tpu.placement import scalar_mapper
+from ceph_tpu.placement.crush_map import (
+    BUCKET_LIST, BUCKET_STRAW, BUCKET_STRAW2, BUCKET_TREE, BUCKET_UNIFORM,
+    ITEM_NONE, RULE_CHOOSELEAF_FIRSTN, RULE_CHOOSELEAF_INDEP,
+    RULE_CHOOSE_FIRSTN, RULE_CHOOSE_INDEP, RULE_EMIT, RULE_TAKE,
+    Bucket, CrushMap, Rule, Tunables, WEIGHT_ONE)
+from ceph_tpu.placement.fast_mapper import FastMapper
+from ceph_tpu.placement.xla_mapper import UnsupportedMapError, XlaMapper
+
+TYPE_OSD, TYPE_HOST, TYPE_ROOT = 0, 1, 10
+
+
+def build_alg_map(alg, n_hosts=5, osds_per_host=4, jitter=True, seed=0):
+    """Hosts of the given algorithm under a straw2 root."""
+    rng = np.random.default_rng(seed)
+    m = CrushMap(tunables=Tunables.profile("jewel"))
+    host_ids, host_weights = [], []
+    dev = 0
+    for h in range(n_hosts):
+        items = list(range(dev, dev + osds_per_host))
+        dev += osds_per_host
+        if alg == BUCKET_UNIFORM:
+            weights = [WEIGHT_ONE]          # one weight for all items
+            bucket_w = WEIGHT_ONE * osds_per_host
+        else:
+            weights = [int(WEIGHT_ONE * (0.5 + rng.random()))
+                       if jitter else WEIGHT_ONE
+                       for _ in items]
+            bucket_w = sum(weights)
+        m.add_bucket(Bucket(id=-(h + 1), alg=alg, type=TYPE_HOST,
+                            items=items, weights=weights))
+        host_ids.append(-(h + 1))
+        host_weights.append(bucket_w)
+    root = -(n_hosts + 1)
+    m.add_bucket(Bucket(id=root, alg=BUCKET_STRAW2, type=TYPE_ROOT,
+                        items=host_ids, weights=host_weights))
+    m.finalize()
+    return m, root
+
+
+def assert_exact(cmap, ruleno, result_max, xs):
+    weights = [WEIGHT_ONE] * cmap.max_devices
+    mapper = XlaMapper(cmap)
+    got = mapper.map_batch(ruleno, xs, result_max, weights)
+    for i, x in enumerate(xs):
+        want = scalar_mapper.do_rule(cmap, ruleno, int(x), result_max,
+                                     weights)
+        want = want + [ITEM_NONE] * (result_max - len(want))
+        assert list(got[i]) == want, \
+            f"x={x}: xla={list(got[i])} scalar={want}"
+
+
+ALGS = [(BUCKET_UNIFORM, "uniform"), (BUCKET_LIST, "list"),
+        (BUCKET_TREE, "tree"), (BUCKET_STRAW, "straw")]
+
+
+@pytest.mark.parametrize("alg,name", ALGS, ids=[n for _, n in ALGS])
+def test_chooseleaf_firstn_over_legacy_hosts(alg, name):
+    cmap, root = build_alg_map(alg)
+    cmap.add_rule(Rule(steps=[(RULE_TAKE, root, 0),
+                              (RULE_CHOOSELEAF_FIRSTN, 0, TYPE_HOST),
+                              (RULE_EMIT, 0, 0)]))
+    assert_exact(cmap, 0, 3, np.arange(192))
+
+
+@pytest.mark.parametrize("alg,name", ALGS, ids=[n for _, n in ALGS])
+def test_choose_indep_direct_legacy_root(alg, name):
+    """A single legacy bucket as the choose target root."""
+    rng = np.random.default_rng(3)
+    m = CrushMap(tunables=Tunables.profile("jewel"))
+    n = 9
+    weights = [WEIGHT_ONE] if alg == BUCKET_UNIFORM else \
+        [int(WEIGHT_ONE * (0.5 + rng.random())) for _ in range(n)]
+    m.add_bucket(Bucket(id=-1, alg=alg, type=TYPE_ROOT,
+                        items=list(range(n)), weights=weights))
+    m.finalize()
+    m.add_rule(Rule(steps=[(RULE_TAKE, -1, 0),
+                           (RULE_CHOOSE_INDEP, 4, TYPE_OSD),
+                           (RULE_EMIT, 0, 0)]))
+    assert_exact(m, 0, 4, np.arange(160))
+
+
+def test_mixed_alg_hierarchy():
+    """Every algorithm at once: hosts alternate algs under one root."""
+    rng = np.random.default_rng(7)
+    m = CrushMap(tunables=Tunables.profile("jewel"))
+    algs = [BUCKET_UNIFORM, BUCKET_LIST, BUCKET_TREE, BUCKET_STRAW,
+            BUCKET_STRAW2, BUCKET_LIST]
+    host_ids, host_w = [], []
+    dev = 0
+    for h, alg in enumerate(algs):
+        items = list(range(dev, dev + 3))
+        dev += 3
+        if alg == BUCKET_UNIFORM:
+            w = [WEIGHT_ONE]
+            bw = 3 * WEIGHT_ONE
+        else:
+            w = [int(WEIGHT_ONE * (0.5 + rng.random())) for _ in items]
+            bw = sum(w)
+        m.add_bucket(Bucket(id=-(h + 1), alg=alg, type=TYPE_HOST,
+                            items=items, weights=w))
+        host_ids.append(-(h + 1))
+        host_w.append(bw)
+    m.add_bucket(Bucket(id=-7, alg=BUCKET_STRAW2, type=TYPE_ROOT,
+                        items=host_ids, weights=host_w))
+    m.finalize()
+    m.add_rule(Rule(steps=[(RULE_TAKE, -7, 0),
+                           (RULE_CHOOSELEAF_FIRSTN, 0, TYPE_HOST),
+                           (RULE_EMIT, 0, 0)]))
+    m.add_rule(Rule(steps=[(RULE_TAKE, -7, 0),
+                           (RULE_CHOOSELEAF_INDEP, 0, TYPE_HOST),
+                           (RULE_EMIT, 0, 0)]))
+    assert_exact(m, 0, 3, np.arange(160))
+    assert_exact(m, 1, 4, np.arange(160))
+
+
+def test_uniform_many_reps_exercise_perm():
+    """numrep deep into the permutation (r up to ~size)."""
+    m = CrushMap(tunables=Tunables.profile("jewel"))
+    m.add_bucket(Bucket(id=-1, alg=BUCKET_UNIFORM, type=TYPE_ROOT,
+                        items=list(range(7)), weights=[WEIGHT_ONE]))
+    m.finalize()
+    m.add_rule(Rule(steps=[(RULE_TAKE, -1, 0),
+                           (RULE_CHOOSE_FIRSTN, 0, TYPE_OSD),
+                           (RULE_EMIT, 0, 0)]))
+    assert_exact(m, 0, 6, np.arange(256))
+
+
+def test_fast_mapper_refuses_legacy():
+    cmap, root = build_alg_map(BUCKET_LIST)
+    cmap.add_rule(Rule(steps=[(RULE_TAKE, root, 0),
+                              (RULE_CHOOSELEAF_FIRSTN, 0, TYPE_HOST),
+                              (RULE_EMIT, 0, 0)]))
+    with pytest.raises(UnsupportedMapError):
+        FastMapper(cmap)
+    # ...but the XlaMapper dispatch transparently covers it (above)
+
+
+def test_choose_args_ignored_by_legacy_algs():
+    """choose_args weight sets apply ONLY to straw2 selection
+    (mapper.c:309-326); legacy buckets keep native weights."""
+    from ceph_tpu.placement.crush_map import ChooseArg
+    cmap, root = build_alg_map(BUCKET_LIST, n_hosts=4, osds_per_host=3)
+    rng = np.random.default_rng(5)
+    args = []
+    for b in cmap.buckets:
+        if b is None:
+            args.append(None)
+            continue
+        ws = [[max(1, int(w * (0.5 + rng.random()))) for w in b.weights]]
+        args.append(ChooseArg(ids=None, weight_set=ws))
+    cmap.choose_args["p"] = args
+    cmap.add_rule(Rule(steps=[(RULE_TAKE, root, 0),
+                              (RULE_CHOOSELEAF_FIRSTN, 0, TYPE_HOST),
+                              (RULE_EMIT, 0, 0)]))
+    weights = [WEIGHT_ONE] * cmap.max_devices
+    mapper = XlaMapper(cmap, choose_args_key="p")
+    got = mapper.map_batch(0, np.arange(128), 3, weights)
+    ca = cmap.choose_args["p"]
+    for x in range(128):
+        want = scalar_mapper.do_rule(cmap, 0, x, 3, weights,
+                                     choose_args=ca)
+        want = want + [ITEM_NONE] * (3 - len(want))
+        assert list(got[x]) == want, f"x={x}"

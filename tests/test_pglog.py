@@ -145,3 +145,38 @@ def test_replicated_delta_recovery():
     stats = sim.recover_delta(1)
     assert stats["delta_objects"] >= 1
     assert sim.get(1, "r1")[:4] == b"BETA"
+
+
+def test_delete_applied_on_delta_recovery():
+    """A replica that missed an OP_DELETE purges the object instead of
+    resurrecting it via the stale-read fallback."""
+    sim = make_sim()
+    data = b"to-be-deleted" * 500
+    placed = sim.put(2, "doomed", data)
+    victim = placed[0]
+    sim.kill_osd(victim)
+    sim.delete(2, "doomed")
+    assert ("doomed" not in
+            {k[2] for o in sim.osds if o.alive for k in o.store})
+    sim.revive_osd(victim)
+    # the revived OSD still holds its stale shard
+    assert any(k[2] == "doomed" for k in sim.osds[victim].store)
+    stats = sim.recover_delta(2)
+    assert stats.get("deletes_applied", 0) >= 1
+    assert not any(k[2] == "doomed" for k in sim.osds[victim].store)
+
+
+def test_replicated_put_total_failure_preserves_old_version():
+    sim = make_sim()
+    import pytest as _pytest
+    sim.put(1, "keep", b"version-1")
+    pool = sim.osdmap.pools[1]
+    pg = sim.object_pg(pool, "keep")
+    up = sim.pg_up(pool, pg)
+    for o in up:
+        sim.fail_osd(o)              # undetected: map still routes here
+    with _pytest.raises(IOError):
+        sim.put(1, "keep", b"version-2")
+    # old version intact on the (currently dead) up set
+    sim.revive_osd(up[0])
+    assert sim.get(1, "keep") == b"version-1"

@@ -10,10 +10,10 @@ import pytest
 
 from ceph_tpu.placement import scalar_mapper
 from ceph_tpu.placement.crush_map import (
-    BUCKET_STRAW2, BUCKET_UNIFORM, ITEM_NONE, RULE_CHOOSELEAF_FIRSTN,
+    ITEM_NONE, RULE_CHOOSELEAF_FIRSTN,
     RULE_CHOOSELEAF_INDEP, RULE_CHOOSE_FIRSTN, RULE_CHOOSE_INDEP, RULE_EMIT,
     RULE_SET_CHOOSELEAF_STABLE, RULE_SET_CHOOSELEAF_VARY_R, RULE_TAKE,
-    Bucket, ChooseArg, CrushMap, Rule, Tunables, WEIGHT_ONE,
+    ChooseArg, Rule, Tunables, WEIGHT_ONE,
 )
 from ceph_tpu.placement.builder import (TYPE_HOST, TYPE_OSD, TYPE_RACK,
                                         TYPE_ROOT, build_flat_cluster)
@@ -206,12 +206,8 @@ def test_choose_args_weight_set_indep():
 
 
 def test_unsupported_map_raises():
-    m = CrushMap(tunables=Tunables.profile("jewel"))
-    m.add_bucket(Bucket(id=-1, alg=BUCKET_UNIFORM, type=TYPE_HOST,
-                        items=[0, 1], weights=[WEIGHT_ONE]))
-    m.finalize()
-    with pytest.raises(UnsupportedMapError):
-        XlaMapper(m)
+    """Legacy local-retry tunables stay outside the vectorized subset
+    (legacy bucket ALGORITHMS are supported — see test_legacy_algs)."""
     m2, _ = build_cluster(tunables=Tunables.profile("argonaut"))
     with pytest.raises(UnsupportedMapError):
         XlaMapper(m2)
