@@ -1,0 +1,3 @@
+from .rados import IoCtx, Rados, ObjectNotFound
+
+__all__ = ["IoCtx", "Rados", "ObjectNotFound"]

@@ -1,0 +1,124 @@
+"""librados-style client API — the L9 surface.
+
+Role of src/librados/ (the `rados_*` C API / C++ `Rados`/`IoCtx`
+classes every client program uses) and the async AIO surface: a
+cluster handle that connects to the mon, per-pool I/O contexts doing
+object read/write/remove/stat/list, and futures-based AIO, all routed
+through the Objecter (cached map + resend) so clients behave correctly
+across map changes.
+"""
+from __future__ import annotations
+
+import concurrent.futures
+import threading
+from dataclasses import dataclass
+from typing import Dict, List, Optional
+
+from ..cluster.monitor import Monitor
+from ..cluster.objecter import Objecter
+from ..cluster.simulator import ClusterSim
+
+
+class ObjectNotFound(KeyError):
+    pass
+
+
+@dataclass
+class ObjectStat:
+    size: int
+    n_stripes: int
+
+
+class Rados:
+    """Cluster handle (librados `rados_t`): connect() attaches to the
+    mon + cluster, then open_ioctx() per pool."""
+
+    def __init__(self, sim: ClusterSim, mon: Monitor):
+        self._sim = sim
+        self._mon = mon
+        self._objecter: Optional[Objecter] = None
+        self._pool = concurrent.futures.ThreadPoolExecutor(
+            max_workers=4, thread_name_prefix="rados-aio")
+
+    def connect(self) -> "Rados":
+        self._objecter = Objecter(self._sim, self._mon)
+        return self
+
+    @property
+    def connected(self) -> bool:
+        return self._objecter is not None
+
+    def open_ioctx(self, pool_name: str) -> "IoCtx":
+        if not self.connected:
+            raise RuntimeError("connect() first")
+        for pid, pool in self._sim.osdmap.pools.items():
+            if pool.name == pool_name or str(pid) == pool_name:
+                return IoCtx(self, pid)
+        raise KeyError(f"no pool {pool_name!r}")
+
+    def pool_list(self) -> List[str]:
+        return [p.name or str(pid)
+                for pid, p in sorted(self._sim.osdmap.pools.items())]
+
+    def cluster_stat(self) -> Dict[str, int]:
+        objs = len(self._sim.objects)
+        bytes_ = sum(i.size for i in self._sim.objects.values())
+        return {"num_objects": objs, "kb": bytes_ // 1024,
+                "num_osds": self._sim.osdmap.max_osd,
+                "epoch": self._sim.osdmap.epoch}
+
+    def health(self) -> str:
+        return self._mon.health_status(self._sim)
+
+    def shutdown(self) -> None:
+        self._pool.shutdown(wait=False)
+        self._objecter = None
+
+
+class IoCtx:
+    """Per-pool I/O context (librados `rados_ioctx_t`)."""
+
+    def __init__(self, rados: Rados, pool_id: int):
+        self._rados = rados
+        self.pool_id = pool_id
+
+    # ------------------------------------------------------------- sync --
+    def write_full(self, oid: str, data: bytes) -> None:
+        self._rados._objecter.put(self.pool_id, oid, data)
+
+    def write(self, oid: str, data: bytes, offset: int = 0) -> None:
+        self._rados._objecter.write(self.pool_id, oid, offset, data)
+
+    def read(self, oid: str, length: Optional[int] = None,
+             offset: int = 0) -> bytes:
+        sim = self._rados._sim
+        if (self.pool_id, oid) not in sim.objects:
+            raise ObjectNotFound(oid)
+        data = self._rados._objecter.get(self.pool_id, oid)
+        if length is None:
+            return data[offset:]
+        return data[offset:offset + length]
+
+    def remove(self, oid: str) -> None:
+        sim = self._rados._sim
+        if (self.pool_id, oid) not in sim.objects:
+            raise ObjectNotFound(oid)
+        sim.delete(self.pool_id, oid)
+
+    def stat(self, oid: str) -> ObjectStat:
+        info = self._rados._sim.objects.get((self.pool_id, oid))
+        if info is None:
+            raise ObjectNotFound(oid)
+        return ObjectStat(size=info.size, n_stripes=info.n_stripes)
+
+    def list_objects(self) -> List[str]:
+        return sorted(name for (pid, name) in self._rados._sim.objects
+                      if pid == self.pool_id)
+
+    # -------------------------------------------------------------- aio --
+    def aio_write_full(self, oid: str, data: bytes
+                       ) -> "concurrent.futures.Future":
+        return self._rados._pool.submit(self.write_full, oid, data)
+
+    def aio_read(self, oid: str) -> "concurrent.futures.Future":
+        return self._rados._pool.submit(self.read, oid)

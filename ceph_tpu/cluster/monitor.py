@@ -188,21 +188,31 @@ class Monitor:
             checks.append(HealthCheck(
                 "OSD_OUT", "HEALTH_WARN", f"{out} osds out"))
         degraded = 0
+        ups = {}
         for pid in om.pools:
             up, _ = om.map_pgs_batch(pid)
+            ups[pid] = up
             holes = (up == ITEM_NONE).any(axis=1)
             degraded += int(holes.sum())
         stale = 0
         if sim is not None:
-            # real shard-state input: PGs whose log is ahead of some
-            # up member's last applied version (objects there are
-            # degraded even though the map shows a full up set)
+            # real shard-state input: PGs whose log is ahead of some up
+            # member's last applied version — reusing the batched up
+            # arrays computed above (one scalar do_rule per PG would be
+            # exactly the cost the batched mapper exists to remove);
+            # the sparse pg_temp overlay still takes the scalar path
             from .pglog import ZERO
             for (pid, pg), log in sim.pg_logs.items():
                 pool = om.pools.get(pid)
                 if pool is None or log.head == ZERO:
                     continue
-                for o in sim.pg_up(pool, pg):
+                if (pid, pg) in om.pg_temp:
+                    members = sim.pg_up(pool, pg)
+                elif pid in ups and pg < len(ups[pid]):
+                    members = [int(o) for o in ups[pid][pg]]
+                else:
+                    continue
+                for o in members:
                     if o == ITEM_NONE:
                         continue
                     lc = sim.osds[o].last_complete.get((pid, pg), ZERO)

@@ -18,6 +18,7 @@ from dataclasses import dataclass
 from typing import Dict, List, Optional, Tuple
 
 from ..common.perf_counters import perf as _perf
+from ..common.tracer import tracer as _tracer
 from ..placement.crush_map import ITEM_NONE
 from .monitor import Monitor
 from .osdmap import OSDMap
@@ -73,24 +74,31 @@ class Objecter:
     # -------------------------------------------------------------- ops --
     def _submit(self, op, pool_id: int, name: str):
         """op_submit: compute target, send; on stale target refresh the
-        map and resend (bounded)."""
+        map and resend (bounded).  Traced (the jspan threaded through
+        ops, src/osd/PrimaryLogPG.cc:11060 role)."""
         self._pc.inc("op_submit")
-        for attempt in range(self.max_retries):
-            if self._target_current(pool_id, name):
-                try:
-                    return op()
-                except IOError:
-                    self._pc.inc("op_eio_retries")
-            else:
-                self._pc.inc("op_resends")
-            got = self.maybe_update_map()
-            if not got and attempt:
-                # nothing new from the mon and still failing
-                raise TooManyRetries(
-                    f"{name}: no usable target at epoch "
-                    f"{self.osdmap.epoch}")
-        raise TooManyRetries(f"{name}: gave up after "
-                             f"{self.max_retries} resends")
+        with _tracer().start_span("objecter.op", pool=pool_id,
+                                  obj=name) as span:
+            for attempt in range(self.max_retries):
+                if self._target_current(pool_id, name):
+                    try:
+                        result = op()
+                        span.set_tag("attempts", attempt + 1)
+                        return result
+                    except IOError:
+                        self._pc.inc("op_eio_retries")
+                else:
+                    self._pc.inc("op_resends")
+                got = self.maybe_update_map()
+                if not got and attempt:
+                    # nothing new from the mon and still failing
+                    span.set_tag("error", "no_usable_target")
+                    raise TooManyRetries(
+                        f"{name}: no usable target at epoch "
+                        f"{self.osdmap.epoch}")
+            span.set_tag("error", "retries_exhausted")
+            raise TooManyRetries(f"{name}: gave up after "
+                                 f"{self.max_retries} resends")
 
     def put(self, pool_id: int, name: str, data: bytes) -> List[int]:
         return self._submit(

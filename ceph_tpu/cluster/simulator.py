@@ -357,10 +357,16 @@ class ClusterSim:
                 for shard in range(pool.size):
                     osd.delete((pool_id, pg, name, shard))
         self.extent_cache.invalidate_object((pool_id, name))
-        e = self._log(pool_id, pg).append(self.osdmap.epoch, name,
-                                          op=OP_DELETE)
+        log = self._log(pool_id, pg)
+        prev_head = log.head
+        e = log.append(self.osdmap.epoch, name, op=OP_DELETE)
         for o in up:
-            if o != ITEM_NONE and self.osds[o].alive:
+            if o == ITEM_NONE or not self.osds[o].alive:
+                continue
+            # only replicas that were CURRENT advance: bumping a lagging
+            # replica to head would hide every entry it never applied
+            if self.osds[o].last_complete.get((pool_id, pg),
+                                              ZERO) >= prev_head:
                 self.osds[o].last_complete[(pool_id, pg)] = e.version
 
     # ----------------------------------------------------------- failure --
@@ -494,10 +500,15 @@ class ClusterSim:
         backfill) only when the log was trimmed past the replica's
         version.
         """
+        from ..common.tracer import tracer
         pool = self.osdmap.pools[pool_id]
         stats = {"pgs_checked": 0, "delta_objects": 0,
                  "backfill_pgs": 0, "shards_rebuilt": 0,
                  "shards_copied": 0}
+        with tracer().start_span("recover_delta", pool=pool_id):
+            return self._recover_delta_inner(pool, pool_id, stats)
+
+    def _recover_delta_inner(self, pool, pool_id, stats):
         # objects per pg (host index; the real system reads the pg's
         # collection listing)
         pg_objects: Dict[int, List[str]] = {}

@@ -37,6 +37,10 @@ class AdminServer:
         self.register("config set", self._config_set)
         self.register("perf dump", lambda a: perf().dump())
         self.register("perf reset", self._perf_reset)
+        from .tracer import tracer
+        self.register("trace dump", lambda a: tracer().dump())
+        self.register("trace reset",
+                      lambda a: (tracer().reset(), {"success": True})[1])
         self.register("help", lambda a: sorted(self._handlers))
 
     @staticmethod

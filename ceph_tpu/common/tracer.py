@@ -1,0 +1,103 @@
+"""Tracing spans — the distributed-tracing substrate.
+
+Roles of the reference's tracer (src/common/tracer.{h,cc}: jspan /
+child_span wrappers over Jaeger/OpenTracing, threaded through ops e.g.
+PrimaryLogPG.cc:11060) and the LTTng tracepoints in hot paths
+(src/tracing/*.tp).  TPU-native shape: spans wrap host-side stages
+around device dispatches (map sweep, encode, recovery) with parent /
+child links and wall-time, collected in a bounded in-process buffer
+dumped as JSON (the role the Jaeger agent plays).
+"""
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+_ids = itertools.count(1)
+
+
+@dataclass
+class Span:
+    trace_id: int
+    span_id: int
+    parent_id: Optional[int]
+    name: str
+    start: float
+    end: Optional[float] = None
+    tags: Dict[str, Any] = field(default_factory=dict)
+
+    def set_tag(self, key: str, value: Any) -> None:
+        self.tags[key] = value
+
+    @property
+    def duration(self) -> Optional[float]:
+        return None if self.end is None else self.end - self.start
+
+
+class Tracer:
+    """Span factory + bounded finished-span buffer."""
+
+    def __init__(self, max_spans: int = 10000):
+        self.max_spans = max_spans
+        self._lock = threading.Lock()
+        self._finished: List[Span] = []
+        self._tls = threading.local()
+
+    # ------------------------------------------------------------- spans --
+    def _current(self) -> Optional[Span]:
+        stack = getattr(self._tls, "stack", None)
+        return stack[-1] if stack else None
+
+    @contextmanager
+    def start_span(self, name: str, **tags):
+        """Root span, or child of the active span on this thread
+        (child_span semantics, src/common/tracer.h:10-30)."""
+        parent = self._current()
+        span = Span(
+            trace_id=parent.trace_id if parent else next(_ids),
+            span_id=next(_ids),
+            parent_id=parent.span_id if parent else None,
+            name=name, start=time.perf_counter(), tags=dict(tags))
+        stack = getattr(self._tls, "stack", None)
+        if stack is None:
+            stack = self._tls.stack = []
+        stack.append(span)
+        try:
+            yield span
+        finally:
+            span.end = time.perf_counter()
+            stack.pop()
+            with self._lock:
+                self._finished.append(span)
+                if len(self._finished) > self.max_spans:
+                    del self._finished[:len(self._finished) // 2]
+
+    # -------------------------------------------------------------- dump --
+    def dump(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            spans = list(self._finished)
+        return [{
+            "trace_id": s.trace_id, "span_id": s.span_id,
+            "parent_id": s.parent_id, "name": s.name,
+            "duration_s": round(s.duration or 0.0, 9), "tags": s.tags,
+        } for s in spans]
+
+    def reset(self) -> None:
+        with self._lock:
+            self._finished.clear()
+
+
+_tracer: Optional[Tracer] = None
+_tracer_lock = threading.Lock()
+
+
+def tracer() -> Tracer:
+    global _tracer
+    with _tracer_lock:
+        if _tracer is None:
+            _tracer = Tracer()
+        return _tracer

@@ -81,6 +81,7 @@ class ErasureCodeLrc(ErasureCodeBase):
 
     def init(self, profile: ErasureCodeProfile) -> None:
         prof = dict(profile)
+        self._crush_profile = dict(profile)
         if "mapping" not in prof:
             k = self.profile_int(prof, "k", 4, minimum=1)
             m = self.profile_int(prof, "m", 2, minimum=1)
@@ -240,6 +241,77 @@ class ErasureCodeLrc(ErasureCodeBase):
             raise ErasureCodeError(
                 f"lrc unrecoverable chunk {e} from {sorted(available_ids)}"
             ) from e
+
+
+def lrc_crush_rule(codec: "ErasureCodeLrc", cmap, root_name: str = None):
+    """Generate the locality-aware CRUSH rule for an LRC pool
+    (ErasureCodeLrc::create_rule semantics, ErasureCodeLrc.h:127 /
+    ErasureCodeLrc.cc create_rule): place one local group per
+    `crush-locality` bucket, spreading the group's chunks across
+    `crush-failure-domain` buckets inside it — so a local repair never
+    leaves its locality domain.
+
+    Profile keys (reference names): `crush-root` (default "default"),
+    `crush-locality` (e.g. "rack"; omitted -> flat rule),
+    `crush-failure-domain` (default "host").  Returns the ruleno added
+    to ``cmap``.
+    """
+    from ..placement.crush_map import (
+        Rule, RULE_CHOOSELEAF_INDEP, RULE_CHOOSE_INDEP, RULE_EMIT,
+        RULE_TAKE)
+    prof = getattr(codec, "_crush_profile", {})
+    type_by_name = {v: k for k, v in cmap.type_names.items()}
+    root_name = root_name or prof.get("crush-root", "default")
+    name_to_id = {v: k for k, v in cmap.bucket_names.items()}
+    if root_name not in name_to_id:
+        raise ErasureCodeError(f"crush-root {root_name!r} not in map")
+    root = name_to_id[root_name]
+    fd_name = prof.get("crush-failure-domain", "host")
+    if fd_name not in type_by_name:
+        raise ErasureCodeError(
+            f"crush-failure-domain {fd_name!r} not a map type")
+    fd_type = type_by_name[fd_name]
+    locality = prof.get("crush-locality")
+    n = codec.get_chunk_count()
+    steps = [(RULE_TAKE, root, 0)]
+    if locality:
+        if locality not in type_by_name:
+            raise ErasureCodeError(
+                f"crush-locality {locality!r} not a map type")
+        # group structure comes from the k/m/l profile (the generated
+        # layout guarantees one local group per (k+m)/l slice); custom
+        # layer JSONs have no inferable grouping — layer-list
+        # arithmetic would mislabel extra global layers as groups
+        if not all(key in prof for key in ("k", "m", "l")):
+            raise ErasureCodeError(
+                "lrc locality rule needs the k/m/l profile; custom "
+                "layer JSONs must supply their own crush rule")
+        k = int(prof["k"])
+        m = int(prof["m"])
+        l = int(prof["l"])
+        if l <= 0 or (k + m) % l:
+            raise ErasureCodeError(
+                f"lrc: k+m={k + m} not a multiple of l={l}")
+        groups = (k + m) // l
+        if groups <= 0 or n % groups:
+            raise ErasureCodeError(
+                f"lrc: {n} chunks not divisible into {groups} groups")
+        per_group = n // groups
+        # sanity: every local layer must sit inside one group slice
+        for L in codec.layers[1:]:
+            lo = min(L.chunks_as_set)
+            hi = max(L.chunks_as_set)
+            if lo // per_group != hi // per_group:
+                raise ErasureCodeError(
+                    "lrc: a local layer spans group boundaries; "
+                    "cannot generate a locality rule")
+        steps.append((RULE_CHOOSE_INDEP, groups,
+                      type_by_name[locality]))
+        steps.append((RULE_CHOOSELEAF_INDEP, per_group, fd_type))
+    else:
+        steps.append((RULE_CHOOSELEAF_INDEP, 0, fd_type))
+    steps.append((RULE_EMIT, 0, 0))
+    return cmap.add_rule(Rule(steps=steps, name="lrc_rule", type=3))
 
 
 def _factory(profile: ErasureCodeProfile):

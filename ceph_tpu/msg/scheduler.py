@@ -1,0 +1,123 @@
+"""Op scheduler — dmClock-style QoS queues.
+
+Role of the OSD's OpScheduler (src/osd/scheduler/OpScheduler.{h,cc},
+mClockScheduler.cc over the dmclock library): classify incoming ops
+(client / background-recovery / background-best-effort, the reference's
+op_scheduler_class) and dequeue by mClock tags so every class gets its
+RESERVATION (minimum rate), shares leftover capacity by WEIGHT, and
+never exceeds its LIMIT.
+
+Compact single-server dmClock: per class (r, w, l) in ops/sec; each op
+gets reservation/proportion/limit tags from the class's previous tags;
+dequeue picks (1) the earliest eligible reservation tag, else (2) the
+smallest proportion tag among classes under their limit.  Virtual time
+is a monotonic counter advanced per dequeue, so the scheduler is
+deterministic under test while preserving the dmClock invariants.
+"""
+from __future__ import annotations
+
+import heapq
+import itertools
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+CLASS_CLIENT = "client"
+CLASS_RECOVERY = "background_recovery"
+CLASS_BEST_EFFORT = "background_best_effort"
+
+
+@dataclass(frozen=True)
+class QoS:
+    """Per-class service parameters (osd_mclock_scheduler_*_res/wgt/lim)."""
+    reservation: float           # guaranteed ops per unit time (0 = none)
+    weight: float                # share of leftover capacity
+    limit: float = float("inf")  # hard cap, ops per unit time
+
+
+DEFAULT_QOS: Dict[str, QoS] = {
+    CLASS_CLIENT: QoS(reservation=1.0, weight=2.0),
+    CLASS_RECOVERY: QoS(reservation=0.25, weight=1.0, limit=2.0),
+    CLASS_BEST_EFFORT: QoS(reservation=0.0, weight=0.5, limit=1.0),
+}
+
+
+@dataclass
+class _Tagged:
+    seq: int
+    op: Any
+    r_tag: float
+    p_tag: float
+    l_tag: float
+
+
+class MClockScheduler:
+    """enqueue(op, class) / dequeue() with dmClock tag selection."""
+
+    def __init__(self, qos: Optional[Dict[str, QoS]] = None):
+        self.qos = dict(DEFAULT_QOS)
+        if qos:
+            self.qos.update(qos)
+        self._queues: Dict[str, List[_Tagged]] = {
+            c: [] for c in self.qos}
+        self._last: Dict[str, _Tagged] = {}
+        self._seq = itertools.count()
+        self._vt = 0.0                    # virtual time
+        self.stats = {c: 0 for c in self.qos}
+
+    def enqueue(self, op: Any, klass: str = CLASS_CLIENT) -> None:
+        q = self.qos.get(klass)
+        if q is None:
+            raise KeyError(f"unknown scheduler class {klass!r}")
+        prev = self._last.get(klass)
+        now = self._vt
+        r_tag = now if q.reservation <= 0 else max(
+            now, (prev.r_tag + 1.0 / q.reservation) if prev else now)
+        p_tag = max(now, (prev.p_tag + 1.0 / q.weight) if prev else now)
+        l_tag = now if q.limit == float("inf") else max(
+            now, (prev.l_tag + 1.0 / q.limit) if prev else now)
+        t = _Tagged(next(self._seq), op, r_tag, p_tag, l_tag)
+        self._last[klass] = t
+        self._queues[klass].append(t)
+
+    def __len__(self) -> int:
+        return sum(len(q) for q in self._queues.values())
+
+    def dequeue(self) -> Optional[Tuple[str, Any]]:
+        """One op by dmClock selection; None when idle."""
+        if not len(self):
+            return None
+        self._vt += 1.0
+        now = self._vt
+        # phase 1: earliest ELIGIBLE reservation tag (tag <= now)
+        best = None
+        for klass, q in self._queues.items():
+            if not q or self.qos[klass].reservation <= 0:
+                continue
+            head = q[0]
+            if head.r_tag <= now and (
+                    best is None or head.r_tag < best[1].r_tag):
+                best = (klass, head)
+        if best is None:
+            # phase 2: smallest proportion tag among under-limit classes
+            for klass, q in self._queues.items():
+                if not q:
+                    continue
+                head = q[0]
+                if head.l_tag > now:
+                    continue             # over limit
+                if best is None or head.p_tag < best[1].p_tag:
+                    best = (klass, head)
+        if best is None:
+            # everything over limit: take the earliest limit tag so the
+            # queue still drains (work-conserving fallback)
+            for klass, q in self._queues.items():
+                if not q:
+                    continue
+                head = q[0]
+                if best is None or head.l_tag < best[1].l_tag:
+                    best = (klass, head)
+        klass, head = best
+        self._queues[klass].pop(0)
+        self.stats[klass] += 1
+        return klass, head.op

@@ -110,6 +110,10 @@ def compile_map(cmap: CrushMap, choose_args_key: object = None,
     for b in cmap.buckets:
         if b is None:
             continue
+        if b.alg not in (BUCKET_UNIFORM, BUCKET_LIST, BUCKET_TREE,
+                         BUCKET_STRAW, BUCKET_STRAW2):
+            raise UnsupportedMapError(
+                f"bucket {b.id}: unknown algorithm {b.alg}")
         if b.alg != BUCKET_STRAW2:
             all_straw2 = False
         S = max(S, b.size)

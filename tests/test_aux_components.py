@@ -1,0 +1,261 @@
+"""Aux components: compressor plugins, tree dumper / CrushLocation,
+tracer spans, librados-style client, mClock scheduler, peering machine.
+
+Reference surfaces: src/compressor/ + PluginRegistry.cc,
+src/crush/CrushTreeDumper.h + CrushLocation.cc, src/common/tracer.h,
+src/librados/, src/osd/scheduler/mClockScheduler.cc,
+src/osd/PeeringState.h."""
+import numpy as np
+import pytest
+
+from tests.test_simulator import make_sim
+
+
+# ------------------------------------------------------------ compressor ---
+
+def test_compressor_roundtrip_all():
+    from ceph_tpu.common.compressor import CompressorError, compressors
+    reg = compressors()
+    payload = b"the quick brown fox " * 500
+    for name in ("zlib", "lzma", "bz2"):
+        c = reg.factory(name)
+        z = c.compress(payload)
+        assert len(z) < len(payload)
+        assert c.decompress(z) == payload
+    with pytest.raises(CompressorError):
+        reg.factory("nope")
+    with pytest.raises(CompressorError):
+        reg.factory("zlib").decompress(b"garbage!")
+
+
+def test_compressor_registry_rejects_dupes():
+    from ceph_tpu.common.compressor import (CompressorError,
+                                            CompressorRegistry)
+    r = CompressorRegistry()
+    with pytest.raises(CompressorError):
+        r.add("zlib", lambda: None)
+
+
+# ------------------------------------------------- tree dump / location ----
+
+def test_crush_location_and_tree_dump():
+    from ceph_tpu.placement.compiler import compile_crushmap
+    from ceph_tpu.placement.treedump import crush_location, tree_dump
+    text = open("tests/cli/basic.crush").read()
+    m = compile_crushmap(text)
+    loc = crush_location(m, 0)
+    assert loc == {"host": "host-a", "root": "default"}
+    loc4 = crush_location(m, 5)
+    assert loc4["host"] == "host-c"
+    out = tree_dump(m)
+    assert "root default" in out and "host host-a" in out
+    assert "osd.5" in out
+    # children indented under parents
+    lines = out.splitlines()
+    root_i = next(i for i, l in enumerate(lines) if "root default" in l)
+    host_i = next(i for i, l in enumerate(lines) if "host host-a" in l)
+    assert host_i > root_i
+
+
+def test_tree_dump_skips_class_shadows():
+    from ceph_tpu.placement.compiler import compile_crushmap
+    from ceph_tpu.placement.treedump import tree_dump
+    m = compile_crushmap(open("tests/cli/classes.crush").read())
+    out = tree_dump(m)
+    assert "~ssd" not in out and "~hdd" not in out
+
+
+# ----------------------------------------------------------------- tracer --
+
+def test_tracer_spans_nest():
+    from ceph_tpu.common.tracer import tracer
+    t = tracer()
+    t.reset()
+    with t.start_span("op", pool=1) as root:
+        with t.start_span("encode") as child:
+            pass
+        with t.start_span("fanout"):
+            pass
+    spans = t.dump()
+    assert len(spans) == 3
+    by_name = {s["name"]: s for s in spans}
+    assert by_name["encode"]["parent_id"] == root.span_id
+    assert by_name["fanout"]["trace_id"] == root.trace_id
+    assert by_name["op"]["parent_id"] is None
+    assert by_name["op"]["tags"] == {"pool": 1}
+    assert all(s["duration_s"] >= 0 for s in spans)
+
+
+# ----------------------------------------------------------------- client --
+
+def test_rados_client_api():
+    from ceph_tpu.client import IoCtx, ObjectNotFound, Rados
+    from ceph_tpu.cluster.monitor import Monitor
+    sim = make_sim()
+    mon = Monitor(sim.osdmap)
+    cluster = Rados(sim, mon).connect()
+    assert set(cluster.pool_list()) == {"rep", "ec"}
+    io = cluster.open_ioctx("ec")
+    data = bytes(range(256)) * 64
+    io.write_full("obj1", data)
+    assert io.read("obj1") == data
+    assert io.read("obj1", length=16, offset=256) == data[256:272]
+    io.write("obj1", b"patch", offset=100)
+    assert io.read("obj1", length=5, offset=100) == b"patch"
+    st = io.stat("obj1")
+    assert st.size == len(data)
+    assert io.list_objects() == ["obj1"]
+    # aio
+    f = io.aio_write_full("obj2", b"async-bytes")
+    f.result(timeout=10)
+    assert io.aio_read("obj2").result(timeout=10) == b"async-bytes"
+    io.remove("obj2")
+    with pytest.raises(ObjectNotFound):
+        io.read("obj2")
+    with pytest.raises(ObjectNotFound):
+        io.stat("missing")
+    assert cluster.cluster_stat()["num_objects"] == 1
+    assert cluster.health() in ("HEALTH_OK", "HEALTH_WARN")
+    cluster.shutdown()
+
+
+# -------------------------------------------------------------- scheduler --
+
+def test_mclock_classes_share_by_weight():
+    from ceph_tpu.msg.scheduler import (CLASS_BEST_EFFORT, CLASS_CLIENT,
+                                        CLASS_RECOVERY, MClockScheduler)
+    s = MClockScheduler()
+    for i in range(60):
+        s.enqueue(("c", i), CLASS_CLIENT)
+        s.enqueue(("r", i), CLASS_RECOVERY)
+        s.enqueue(("b", i), CLASS_BEST_EFFORT)
+    drained = [s.dequeue() for _ in range(120)]
+    assert all(d is not None for d in drained)
+    counts = {}
+    for klass, _ in drained:
+        counts[klass] = counts.get(klass, 0) + 1
+    # client (weight 2, res 1) must dominate; best-effort (limit 1) least
+    assert counts[CLASS_CLIENT] > counts[CLASS_RECOVERY] \
+        >= counts.get(CLASS_BEST_EFFORT, 0)
+    # full drain leaves nothing
+    while s.dequeue() is not None:
+        pass
+    assert len(s) == 0 and s.dequeue() is None
+
+
+def test_mclock_reservation_floors_starved_class():
+    from ceph_tpu.msg.scheduler import (CLASS_CLIENT, CLASS_RECOVERY,
+                                        MClockScheduler, QoS)
+    s = MClockScheduler({CLASS_RECOVERY: QoS(reservation=0.5, weight=0.1,
+                                             limit=10.0)})
+    for i in range(200):
+        s.enqueue(("c", i), CLASS_CLIENT)
+    for i in range(20):
+        s.enqueue(("r", i), CLASS_RECOVERY)
+    got_r = sum(1 for _ in range(100)
+                if (s.dequeue() or ("", 0))[0] == CLASS_RECOVERY)
+    # reservation 0.5/vt guarantees recovery service despite weight 0.1
+    assert got_r >= 10
+
+
+def test_mclock_unknown_class():
+    from ceph_tpu.msg.scheduler import MClockScheduler
+    s = MClockScheduler()
+    with pytest.raises(KeyError):
+        s.enqueue("x", "warp-speed")
+
+
+# ---------------------------------------------------------------- peering --
+
+def test_peering_clean_path():
+    from ceph_tpu.cluster.peering import (CLEAN, GET_INFO, GET_LOG,
+                                          GET_MISSING, PGStateMachine)
+    sim = make_sim()
+    sim.put(2, "obj", b"payload" * 100)
+    pool = sim.osdmap.pools[2]
+    pg = sim.object_pg(pool, "obj")
+    m = PGStateMachine(sim, 2, pg)
+    res = m.peer()
+    assert res.state == CLEAN
+    for st in (GET_INFO, GET_LOG, GET_MISSING):
+        assert st in res.history
+    assert res.missing_osds == []
+
+
+def test_peering_recovers_after_failure():
+    from ceph_tpu.cluster.peering import (CLEAN, RECOVERING,
+                                          PeeringCoordinator)
+    sim = make_sim()
+    rng = np.random.default_rng(23)
+    for i in range(6):
+        sim.put(2, f"p{i}", rng.integers(0, 256, 20000)
+                .astype(np.uint8).tobytes())
+    placed = sim.put(2, "p0", rng.integers(0, 256, 20000)
+                     .astype(np.uint8).tobytes())
+    victim = placed[0]
+    sim.kill_osd(victim)
+    # write to p0 itself: the victim IS in its up set, so its replica
+    # lags the PG log while down
+    sim.write(2, "p0", 10, b"while-down")
+    sim.revive_osd(victim)
+    coord = PeeringCoordinator(sim, 2)
+    results = coord.handle_map_change()
+    states = coord.states()
+    assert states.get(CLEAN, 0) == len(results)
+    assert any(RECOVERING in r.history or "Backfilling" in r.history
+               for r in results.values())
+    # data still reads after the full re-peer
+    assert sim.get(2, "p0")[10:20] == b"while-down"
+    assert sim.scrub(2) == []
+
+
+# -------------------------------------------------------- lrc crush rule ---
+
+def test_lrc_locality_rule_generation():
+    """LRC generates a locality-aware CRUSH rule: each local group
+    lands inside one locality bucket, chunks across failure domains
+    within it — local repairs never cross localities."""
+    from ceph_tpu.ec import instance as ec_registry
+    from ceph_tpu.ec.plugin_lrc import lrc_crush_rule
+    from ceph_tpu.placement import scalar_mapper
+    from ceph_tpu.placement.builder import build_flat_cluster
+    from ceph_tpu.placement.crush_map import ITEM_NONE, WEIGHT_ONE
+    # 4 racks x 5 hosts x 2 osds; LRC k=4 m=2 l=3 -> 8 chunks, 2 groups
+    # of 4 chunks each (needs >= 4 hosts per rack)
+    cmap, root = build_flat_cluster(n_racks=4, n_hosts=20,
+                                    osds_per_host=2, seed=9,
+                                    weight_jitter=False)
+    cmap.type_names.update({0: "osd", 1: "host", 2: "rack", 10: "root"})
+    cmap.bucket_names.setdefault(root, "default")
+    codec = ec_registry().factory(
+        "lrc", {"k": "4", "m": "2", "l": "3",
+                "crush-locality": "rack", "crush-failure-domain": "host"})
+    ruleno = lrc_crush_rule(codec, cmap)
+    weights = [WEIGHT_ONE] * cmap.max_devices
+    # host->rack index so we can check group locality
+    host_rack = {}
+    for b in cmap.buckets:
+        if b is not None and b.type == 2:
+            for it in b.items:
+                host_rack[it] = b.id
+    osd_host = {}
+    for b in cmap.buckets:
+        if b is not None and b.type == 1:
+            for it in b.items:
+                osd_host[it] = b.id
+    n = codec.get_chunk_count()
+    groups = len(codec.layers) - 1
+    per_group = n // groups
+    placed_any = 0
+    for x in range(64):
+        out = scalar_mapper.do_rule(cmap, ruleno, x, n, weights)
+        if len(out) != n or any(o == ITEM_NONE for o in out):
+            continue
+        placed_any += 1
+        for g in range(groups):
+            chunk_osds = out[g * per_group:(g + 1) * per_group]
+            racks = {host_rack[osd_host[o]] for o in chunk_osds}
+            assert len(racks) == 1, f"group {g} spans racks {racks}"
+            hosts = [osd_host[o] for o in chunk_osds]
+            assert len(set(hosts)) == len(hosts), "hosts collide"
+    assert placed_any > 48          # rule actually places
