@@ -1,0 +1,190 @@
+"""RBD-style block images — striped virtual block devices over objects.
+
+Role of src/librbd/ (block images striped across RADOS objects: image
+metadata in a header object, data in `<prefix>.<objectno>` objects,
+random-offset read/write, resize) built on the striper math
+(FileLayout/file_to_extents — the same layout librbd's default
+striping v1 uses: stripe_unit == object_size, stripe_count == 1,
+order=22 -> 4 MiB objects) and the IoCtx client surface.
+
+Kept behaviors: create/open/remove/list, size/resize (shrink discards
+whole objects past the boundary), offset read/write crossing object
+boundaries, sparse reads of never-written ranges as zeros.
+"""
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass
+from typing import List, Optional
+
+from ..cluster.striper import FileLayout, file_to_extents
+from .rados import IoCtx, ObjectNotFound
+
+_DIR_OID = "rbd_directory"
+
+
+class ImageExists(ValueError):
+    pass
+
+
+class ImageNotFound(KeyError):
+    pass
+
+
+@dataclass
+class ImageInfo:
+    name: str
+    size: int
+    order: int                   # object size = 1 << order
+    object_prefix: str
+
+    @property
+    def layout(self) -> FileLayout:
+        osize = 1 << self.order
+        return FileLayout(stripe_unit=osize, stripe_count=1,
+                          object_size=osize)
+
+
+class RBD:
+    """Image directory ops (librbd `RBD` class)."""
+
+    def __init__(self, ioctx: IoCtx):
+        self.ioctx = ioctx
+
+    def _dir(self) -> dict:
+        try:
+            return json.loads(self.ioctx.read(_DIR_OID).decode())
+        except ObjectNotFound:
+            return {}
+
+    def _write_dir(self, d: dict) -> None:
+        self.ioctx.write_full(_DIR_OID, json.dumps(d).encode())
+
+    def create(self, name: str, size: int, order: int = 22) -> None:
+        d = self._dir()
+        if name in d:
+            raise ImageExists(name)
+        info = {"size": size, "order": order,
+                "object_prefix": f"rbd_data.{name}"}
+        d[name] = info
+        self.ioctx.write_full(f"rbd_header.{name}",
+                              json.dumps(info).encode())
+        self._write_dir(d)
+
+    def list(self) -> List[str]:
+        return sorted(self._dir())
+
+    def remove(self, name: str) -> None:
+        d = self._dir()
+        if name not in d:
+            raise ImageNotFound(name)
+        img = Image(self.ioctx, name)
+        for objno in img._written_objects():
+            try:
+                self.ioctx.remove(img._oid(objno))
+            except ObjectNotFound:
+                pass
+        self.ioctx.remove(f"rbd_header.{name}")
+        del d[name]
+        self._write_dir(d)
+
+
+class Image:
+    """One open image (librbd `Image`)."""
+
+    def __init__(self, ioctx: IoCtx, name: str):
+        self.ioctx = ioctx
+        self.name = name
+        try:
+            raw = ioctx.read(f"rbd_header.{name}")
+        except ObjectNotFound:
+            raise ImageNotFound(name) from None
+        meta = json.loads(raw.decode())
+        self.info = ImageInfo(name=name, size=meta["size"],
+                              order=meta["order"],
+                              object_prefix=meta["object_prefix"])
+
+    # ------------------------------------------------------------ layout --
+    def _oid(self, objno: int) -> str:
+        return f"{self.info.object_prefix}.{objno:016x}"
+
+    def _written_objects(self) -> List[int]:
+        prefix = self.info.object_prefix + "."
+        out = []
+        for oid in self.ioctx.list_objects():
+            if not oid.startswith(prefix):
+                continue
+            suffix = oid[len(prefix):]
+            # another image's name may extend this prefix ('a' vs
+            # 'a.b'): only exact 16-hex-digit suffixes are ours
+            if len(suffix) == 16:
+                try:
+                    out.append(int(suffix, 16))
+                except ValueError:
+                    pass
+        return sorted(out)
+
+    def size(self) -> int:
+        return self.info.size
+
+    def _save_header(self) -> None:
+        self.ioctx.write_full(
+            f"rbd_header.{self.name}",
+            json.dumps({"size": self.info.size,
+                        "order": self.info.order,
+                        "object_prefix": self.info.object_prefix})
+            .encode())
+
+    # --------------------------------------------------------------- i/o --
+    def write(self, offset: int, data: bytes) -> int:
+        if offset + len(data) > self.info.size:
+            raise ValueError("write past image size")
+        pos = 0
+        for objno, ooff, olen in file_to_extents(
+                self.info.layout, offset, len(data)):
+            self.ioctx.write(self._oid(objno), data[pos:pos + olen],
+                             offset=ooff)
+            pos += olen
+        return len(data)
+
+    def read(self, offset: int, length: int) -> bytes:
+        if offset + length > self.info.size:
+            length = max(0, self.info.size - offset)
+        out = bytearray(length)
+        pos = 0
+        for objno, ooff, olen in file_to_extents(
+                self.info.layout, offset, length):
+            try:
+                piece = self.ioctx.read(self._oid(objno), length=olen,
+                                        offset=ooff)
+            except ObjectNotFound:
+                piece = b""                 # sparse: zeros
+            out[pos:pos + len(piece)] = piece
+            pos += olen
+        return bytes(out)
+
+    def resize(self, new_size: int) -> None:
+        """Grow is metadata-only; shrink discards objects wholly past
+        the boundary AND zero-truncates the boundary object (librbd
+        trim semantics — stale bytes must not reappear after a later
+        grow)."""
+        if new_size < self.info.size:
+            osize = 1 << self.info.order
+            first_dead = -(-new_size // osize)
+            for objno in self._written_objects():
+                if objno >= first_dead:
+                    try:
+                        self.ioctx.remove(self._oid(objno))
+                    except ObjectNotFound:
+                        pass
+            cut = new_size % osize
+            if cut:
+                bno = new_size // osize
+                try:
+                    cur = self.ioctx.read(self._oid(bno))
+                except ObjectNotFound:
+                    cur = b""
+                if len(cur) > cut:
+                    self.ioctx.write_full(self._oid(bno), cur[:cut])
+        self.info.size = new_size
+        self._save_header()

@@ -104,16 +104,43 @@ class HealthCheck:
 
 
 class Monitor:
-    """Single logical mon cluster (PaxosLog-backed) owning the OSDMap."""
+    """Single logical mon cluster (PaxosLog-backed) owning the OSDMap.
+    Committed state persists into a KeyValueDB (the MonitorDBStore
+    role, src/mon/MonitorDBStore.h over src/kv/): prefixes `osdmap`
+    (per-epoch incrementals), `config` (central options), `paxos`
+    (commit markers)."""
 
     def __init__(self, osdmap: OSDMap, n_ranks: int = 3,
-                 failure_reports_needed: int = 2):
+                 failure_reports_needed: int = 2, db=None):
+        from .kv import MemDB
         self.osdmap = osdmap
         self.paxos = PaxosLog(n_ranks)
         self.incrementals: List[Incremental] = []
         self.config_db: Dict[str, Any] = {}
         self.failure_reports_needed = failure_reports_needed
         self._failure_reports: Dict[int, set] = {}
+        self.db = db if db is not None else MemDB()
+
+    @staticmethod
+    def _inc_json(inc: Incremental) -> bytes:
+        """Complete serialization — a lossy record would replay into a
+        wrong acting set."""
+        import json
+        return json.dumps({
+            "epoch": inc.epoch,
+            "new_up": {str(k): v for k, v in inc.new_up.items()},
+            "new_weight": {str(k): int(v)
+                           for k, v in inc.new_weight.items()},
+            "new_primary_affinity": {
+                str(k): int(v)
+                for k, v in inc.new_primary_affinity.items()},
+            "new_pg_upmap_items": {
+                f"{p}.{g}": items
+                for (p, g), items in inc.new_pg_upmap_items.items()},
+            "new_pg_temp": {
+                f"{p}.{g}": temp
+                for (p, g), temp in inc.new_pg_temp.items()},
+        }).encode()
 
     # ------------------------------------------------------- map service --
     def commit_incremental(self, inc: Incremental) -> bool:
@@ -129,6 +156,12 @@ class Monitor:
             return False
         self.osdmap.apply_incremental(inc)
         self.incrementals.append(inc)
+        from .kv import WriteBatch
+        self.db.submit(WriteBatch()
+                       .set("osdmap", f"{inc.epoch:010d}",
+                            self._inc_json(inc))
+                       .set("paxos", f"{self.paxos.version:010d}",
+                            b"osdmap"))
         return True
 
     def next_incremental(self) -> Incremental:
@@ -145,6 +178,12 @@ class Monitor:
         if not self.paxos.propose(("config", key, value)):
             return False
         self.config_db[key] = value
+        import json
+        from .kv import WriteBatch
+        self.db.submit(WriteBatch()
+                       .set("config", key, json.dumps(value).encode())
+                       .set("paxos", f"{self.paxos.version:010d}",
+                            b"config"))
         try:
             config().set(key, value, level=LEVEL_FILE)
         except OptionError:
@@ -171,6 +210,21 @@ class Monitor:
             del self._failure_reports[target]
             return True
         return False
+
+    def osd_boot(self, osd: int, weight: int = 0x10000) -> bool:
+        """An OSD announces itself up (the MOSDBoot path,
+        OSDMonitor::prepare_boot): commits a map epoch marking it up
+        and restoring its in-weight, so subscribed clients catch up."""
+        inc = self.next_incremental()
+        inc.new_up[osd] = True
+        inc.new_weight[osd] = weight
+        if not self.commit_incremental(inc):
+            return False
+        # a boot cancels pending failure reports (prepare_boot):
+        # otherwise stale pre-boot reporters count toward marking the
+        # fresh OSD down again
+        self._failure_reports.pop(osd, None)
+        return True
 
     # ------------------------------------------------------------ health --
     def health(self, sim=None) -> List[HealthCheck]:

@@ -33,35 +33,98 @@ from ..ec.interface import ErasureCodeError
 from ..ops import hashing
 from ..placement.crush_map import ITEM_NONE
 from .ec_rmw import ExtentCache, RmwPipeline, StripeInfo
+from .objectstore import (ChecksumError, MemStore, ObjectStoreError,
+                          Transaction)
 from .osdmap import OSDMap, PGPool, POOL_ERASURE, POOL_REPLICATED
 from .pglog import OP_DELETE, PGLog, Version, ZERO
 
 ShardKey = Tuple[int, int, str, int]   # (pool, pg, object, shard)
 
 
+class _StoreView:
+    """Dict-style view of a SimOSD's shards (test/debug surface):
+    iteration, lookup and raw assignment mapped onto the transactional
+    ObjectStore underneath."""
+
+    def __init__(self, osd: "SimOSD"):
+        self._osd = osd
+
+    def _keys(self):
+        st = self._osd.objectstore
+        for coll in st.list_collections():
+            for oid in st.list_objects(coll):
+                shard_s, name = oid.split(":", 1)
+                yield (coll[0], coll[1], name, int(shard_s))
+
+    def __iter__(self):
+        return self._keys()
+
+    def __contains__(self, key: ShardKey) -> bool:
+        return self._osd.objectstore.exists(*SimOSD._split(key))
+
+    def __getitem__(self, key: ShardKey) -> np.ndarray:
+        try:
+            data = self._osd.objectstore.read(*SimOSD._split(key))
+        except ChecksumError:
+            raise                             # corruption stays loud
+        except ObjectStoreError:
+            raise KeyError(key) from None     # dict contract
+        return np.frombuffer(data, dtype=np.uint8).copy()
+
+    def __setitem__(self, key: ShardKey, data: np.ndarray) -> None:
+        # raw store poke (tests/debug): no liveness check, like the
+        # plain dict this view replaces
+        coll, oid = SimOSD._split(key)
+        self._osd.objectstore.apply_transaction(
+            Transaction().write_full(
+                coll, oid, np.asarray(data, dtype=np.uint8).tobytes()))
+
+
 class SimOSD:
-    """A fake OSD: a dict object store (memstore) plus liveness."""
+    """A fake OSD: a transactional checksummed ObjectStore (memstore
+    backend, src/os/memstore/ + ObjectStore.h roles) plus liveness."""
 
     def __init__(self, osd_id: int):
         self.id = osd_id
-        self.store: Dict[ShardKey, np.ndarray] = {}
+        self.objectstore = MemStore()
+        self.store = _StoreView(self)
         self.alive = True
         # last applied PG version per (pool, pg) — the replica-side
         # state delta recovery compares against the authoritative log
         self.last_complete: Dict[Tuple[int, int], Version] = {}
 
+    @staticmethod
+    def _split(key: ShardKey):
+        pool, pg, name, shard = key
+        return (pool, pg), f"{shard}:{name}"
+
     def put(self, key: ShardKey, data: np.ndarray) -> None:
         if not self.alive:
             raise IOError(f"osd.{self.id} is dead")
-        self.store[key] = np.asarray(data, dtype=np.uint8).copy()
+        coll, oid = self._split(key)
+        self.objectstore.apply_transaction(
+            Transaction().write_full(
+                coll, oid, np.asarray(data, dtype=np.uint8).tobytes()))
 
     def get(self, key: ShardKey) -> Optional[np.ndarray]:
         if not self.alive:
             return None
-        return self.store.get(key)
+        coll, oid = self._split(key)
+        try:
+            data = self.objectstore.read(coll, oid)
+        except ChecksumError:
+            return None      # EIO: serve nothing, not bad bytes
+        except ObjectStoreError:
+            return None
+        # read-only view over the immutable bytes: shard readers never
+        # mutate in place, and skipping the copy halves read traffic
+        return np.frombuffer(data, dtype=np.uint8)
 
     def delete(self, key: ShardKey) -> None:
-        self.store.pop(key, None)
+        coll, oid = self._split(key)
+        if self.objectstore.exists(coll, oid):
+            self.objectstore.apply_transaction(
+                Transaction().remove(coll, oid))
 
 
 @dataclass
@@ -385,10 +448,17 @@ class ClusterSim:
         self.osdmap.mark_out(osd)
 
     def revive_osd(self, osd: int) -> None:
+        """Direct map mutation (standalone-sim flows).  Clusters with a
+        Monitor should use restart_osd() + Monitor.osd_boot() so the
+        epoch change reaches subscribed clients as an incremental."""
         self.osds[osd].alive = True
         self.osdmap.osd_up[osd] = True
         self.osdmap.osd_weight[osd] = 0x10000
         self.osdmap.bump_epoch()
+
+    def restart_osd(self, osd: int) -> None:
+        """Process back up, map untouched — pair with Monitor.osd_boot."""
+        self.osds[osd].alive = True
 
     # ---------------------------------------------------------- recovery --
     def remap_diff(self, pool_id: int, old_up: np.ndarray

@@ -177,3 +177,38 @@ def test_objecter_gives_up_without_map_progress():
     sim.fail_osd(real_up[0])
     with pytest.raises(TooManyRetries):
         client.put(2, "x", b"payload2")
+
+
+def test_osd_boot_reaches_clients():
+    """fail -> report -> restart -> boot: every map change flows as an
+    incremental, so a cached-map client keeps working end to end."""
+    sim = make_sim()
+    mon = Monitor(sim.osdmap, failure_reports_needed=1)
+    client = Objecter(sim, mon)
+    data = b"lifecycle" * 300
+    placed = client.put(2, "lc", data)
+    victim = placed[0]
+    sim.fail_osd(victim)
+    mon.report_failure(victim, reporter=placed[1])
+    assert client.get(2, "lc") == data        # degraded, via catch-up
+    sim.restart_osd(victim)
+    assert mon.osd_boot(victim)
+    assert sim.osdmap.is_up(victim)
+    sim.recover_delta(2)
+    assert client.get(2, "lc") == data        # post-boot, via catch-up
+    assert client.osdmap.epoch == sim.osdmap.epoch
+
+
+def test_boot_cancels_pending_failure_reports():
+    sim = make_sim()
+    mon = Monitor(sim.osdmap, failure_reports_needed=2)
+    sim.fail_osd(5)
+    mon.report_failure(5, reporter=1)      # 1/2 pending
+    sim.restart_osd(5)
+    assert mon.osd_boot(5)
+    sim.fail_osd(5)
+    # one NEW report must not tip a threshold of two
+    assert not mon.report_failure(5, reporter=2)
+    assert sim.osdmap.is_up(5)
+    assert mon.report_failure(5, reporter=3)
+    assert not sim.osdmap.is_up(5)

@@ -1,0 +1,139 @@
+"""KeyValueDB abstraction + RBD-style block images.
+
+Reference surfaces: src/kv/KeyValueDB.h + memdb, src/librbd/ (image
+directory, header objects, striped data objects, resize/trim)."""
+import numpy as np
+import pytest
+
+from ceph_tpu.client import Rados
+from ceph_tpu.client.rbd import RBD, Image, ImageExists, ImageNotFound
+from ceph_tpu.cluster.kv import MemDB, WriteBatch
+from ceph_tpu.cluster.monitor import Monitor
+from tests.test_simulator import make_sim
+
+
+# ------------------------------------------------------------------- kv ----
+
+def test_kv_batch_and_iterate():
+    db = MemDB()
+    db.submit(WriteBatch().set("osdmap", "3", b"e3")
+              .set("osdmap", "1", b"e1").set("osdmap", "2", b"e2")
+              .set("config", "a", b"x"))
+    assert db.get("osdmap", "2") == b"e2"
+    assert db.keys("osdmap") == ["1", "2", "3"]       # ordered
+    assert [k for k, _ in db.iterate("osdmap", start="2")] == ["2", "3"]
+    db.submit(WriteBatch().rm("osdmap", "1"))
+    assert not db.exists("osdmap", "1")
+    db.submit(WriteBatch().rm_prefix("osdmap"))
+    assert db.keys("osdmap") == []
+    assert db.get("config", "a") == b"x"              # other prefix safe
+
+
+def test_kv_prefixes_isolated():
+    db = MemDB()
+    db.set("p1", "k", b"1")
+    db.set("p2", "k", b"2")
+    assert db.get("p1", "k") == b"1" and db.get("p2", "k") == b"2"
+
+
+# ------------------------------------------------------------------ rbd ----
+
+@pytest.fixture()
+def ioctx():
+    sim = make_sim()
+    mon = Monitor(sim.osdmap)
+    return Rados(sim, mon).connect().open_ioctx("ec")
+
+
+def test_rbd_create_list_remove(ioctx):
+    rbd = RBD(ioctx)
+    rbd.create("img1", size=1 << 20, order=16)    # 64 KiB objects
+    rbd.create("img2", size=1 << 18, order=16)
+    assert rbd.list() == ["img1", "img2"]
+    with pytest.raises(ImageExists):
+        rbd.create("img1", size=1)
+    rbd.remove("img2")
+    assert rbd.list() == ["img1"]
+    with pytest.raises(ImageNotFound):
+        rbd.remove("img2")
+    with pytest.raises(ImageNotFound):
+        Image(ioctx, "img2")
+
+
+def test_rbd_io_across_object_boundaries(ioctx):
+    rbd = RBD(ioctx)
+    rbd.create("disk", size=1 << 20, order=16)
+    img = Image(ioctx, "disk")
+    rng = np.random.default_rng(3)
+    blob = rng.integers(0, 256, size=200_000).astype(np.uint8).tobytes()
+    off = (1 << 16) - 777                # straddles several 64K objects
+    img.write(off, blob)
+    assert img.read(off, len(blob)) == blob
+    # sparse region reads as zeros
+    assert img.read(0, 100) == b"\0" * 100
+    # overwrite inside
+    img.write(off + 1000, b"PATCH")
+    got = img.read(off, len(blob))
+    want = bytearray(blob)
+    want[1000:1005] = b"PATCH"
+    assert got == bytes(want)
+    with pytest.raises(ValueError):
+        img.write((1 << 20) - 2, b"toolong")
+
+
+def test_rbd_resize(ioctx):
+    rbd = RBD(ioctx)
+    rbd.create("vol", size=1 << 18, order=16)     # 4 x 64K objects
+    img = Image(ioctx, "vol")
+    img.write(0, b"head")
+    img.write((1 << 18) - 8, b"tail-end")
+    img.resize(1 << 16)                           # shrink to 1 object
+    assert img.size() == 1 << 16
+    img2 = Image(ioctx, "vol")                    # reopen: persisted
+    assert img2.size() == 1 << 16
+    assert img2.read(0, 4) == b"head"
+    img2.resize(1 << 18)                          # grow again
+    # trimmed range is sparse zeros now
+    assert img2.read((1 << 18) - 8, 8) == b"\0" * 8
+
+
+def test_monitor_persists_to_kv():
+    """Monitor commits land in the MonitorDBStore prefixes."""
+    import json as _json
+    sim = make_sim()
+    mon = Monitor(sim.osdmap)
+    inc = mon.next_incremental()
+    inc.new_up[3] = False
+    assert mon.commit_incremental(inc)
+    mon.config_set("fastmap_extra_tries", 5)
+    from ceph_tpu.common import config
+    from ceph_tpu.common.options import LEVEL_FILE
+    config().clear("fastmap_extra_tries", LEVEL_FILE)
+    epochs = mon.db.keys("osdmap")
+    assert len(epochs) == 1
+    rec = _json.loads(mon.db.get("osdmap", epochs[0]).decode())
+    assert rec["new_up"] == {"3": False}
+    assert _json.loads(mon.db.get("config",
+                                  "fastmap_extra_tries").decode()) == 5
+    assert len(mon.db.keys("paxos")) == mon.paxos.version
+
+
+def test_rbd_prefix_overlap_and_unaligned_shrink(ioctx):
+    """Image names where one is a dot-prefix of another must not
+    interfere, and a non-aligned shrink zero-truncates the boundary
+    object (no stale bytes after a later grow)."""
+    rbd = RBD(ioctx)
+    rbd.create("a", size=1 << 18, order=16)
+    rbd.create("a.b", size=1 << 18, order=16)
+    img_ab = Image(ioctx, "a.b")
+    img_ab.write(0, b"dotted")
+    rbd.remove("a")                     # must not trip over a.b's oids
+    assert Image(ioctx, "a.b").read(0, 6) == b"dotted"
+    # unaligned shrink
+    rbd.create("v", size=1 << 18, order=16)
+    img = Image(ioctx, "v")
+    img.write((1 << 16), b"X" * 5000)   # object 1 bytes 0..5000
+    img.resize((1 << 16) + 100)         # keep 100 bytes of object 1
+    img.resize(1 << 18)
+    assert img.read((1 << 16) + 100, 200) == b"\0" * 200
+    assert img.read(1 << 16, 100) == b"X" * 100
