@@ -1,0 +1,107 @@
+"""EC benchmark sweep — the plot-harness role.
+
+The reference drives `ceph_erasure_code_benchmark` over a plugin ×
+technique × size matrix and emits plottable series
+(qa/workunits/erasure-code/bench.sh:38-57, defaults SIZE=4096,
+plugins isa/jerasure, techniques vandermonde/cauchy).  Same idea:
+sweep (plugin, technique, k, m, object size) through the registry's
+encode/decode paths and print one CSV row per cell —
+`plugin,technique,k,m,size,workload,gbps`.
+
+Usage:
+    python -m ceph_tpu.tools.bench_sweep [--plugins jax,isa]
+        [--k 4,8] [--m 2,3] [--sizes 4096,1048576]
+        [--workloads encode,decode] [--iters 4] [--batch 16]
+
+Note: ec_bench.py times the single-config reference-CLI contract
+(`seconds\tKB`); this sweep shares the registry but intentionally keeps
+its own minimal timing cell — if the two drift further, extract one
+shared timing helper.
+"""
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+import numpy as np
+
+TECHNIQUES = {
+    "jax": ["reed_sol_van", "cauchy"],
+    "jerasure": ["reed_sol_van", "cauchy_good"],
+    "isa": ["reed_sol_van", "cauchy"],
+}
+
+
+def bench_cell(plugin: str, technique: str, k: int, m: int, size: int,
+               workload: str, iters: int, batch: int) -> float:
+    from ..ec import instance as ec_registry
+    prof = {"k": str(k), "m": str(m)}
+    if technique:
+        prof["technique"] = technique
+    codec = ec_registry().factory(plugin, prof)
+    chunk = codec.get_chunk_size(size)
+    rng = np.random.default_rng(1)
+    data = rng.integers(0, 256, size=(batch, k, chunk), dtype=np.uint8)
+    if workload == "encode":
+        # warm with the FULL batch shape: jit executables are
+        # shape-specialized, a [1,...] warm-up leaves the real compile
+        # inside the timing window
+        codec.encode_chunks_batch(data)
+        t0 = time.perf_counter()
+        out = None
+        for _ in range(iters):
+            out = codec.encode_chunks_batch(data)
+        np.asarray(out).sum()                            # force
+        dt = time.perf_counter() - t0
+    else:
+        parity = np.asarray(codec.encode_chunks_batch(data))
+        full = np.concatenate([data, parity], axis=1)
+        erased = sorted(rng.choice(k + m, size=min(m, 2),
+                                   replace=False).tolist())
+        avail = [c for c in range(k + m) if c not in erased]
+        plan = sorted(codec.minimum_to_decode(set(range(k)), set(avail)))
+        sub = full[:, plan]
+        codec.decode_chunks_batch(plan, sub, erased)      # warm (full)
+        t0 = time.perf_counter()
+        out = None
+        for _ in range(iters):
+            out = codec.decode_chunks_batch(plan, sub, erased)
+        np.asarray(out).sum()
+        dt = time.perf_counter() - t0
+    return iters * batch * k * chunk / dt / 1e9
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="bench_sweep")
+    ap.add_argument("--plugins", default="jax")
+    ap.add_argument("--k", default="4,8")
+    ap.add_argument("--m", default="2,3")
+    ap.add_argument("--sizes", default="4096,1048576")
+    ap.add_argument("--workloads", default="encode,decode")
+    ap.add_argument("--iters", type=int, default=4)
+    ap.add_argument("--batch", type=int, default=16)
+    args = ap.parse_args(argv)
+    print("plugin,technique,k,m,size,workload,gbps")
+    for plugin in args.plugins.split(","):
+        for technique in TECHNIQUES.get(plugin, [None]):
+            for k in (int(v) for v in args.k.split(",")):
+                for m in (int(v) for v in args.m.split(",")):
+                    for size in (int(v) for v in args.sizes.split(",")):
+                        for wl in args.workloads.split(","):
+                            try:
+                                gbps = bench_cell(
+                                    plugin, technique, k, m, size, wl,
+                                    args.iters, args.batch)
+                            except Exception as e:
+                                print(f"# {plugin}/{technique} k={k} "
+                                      f"m={m} {wl}: {e}",
+                                      file=sys.stderr)
+                                continue
+                            print(f"{plugin},{technique},{k},{m},"
+                                  f"{size},{wl},{gbps:.3f}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

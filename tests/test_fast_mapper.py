@@ -228,3 +228,17 @@ def test_incomplete_lanes_resolved_by_dispatch():
         want = scalar_mapper.do_rule(cmap, 0, int(x), 4, weights)
         want = want + [ITEM_NONE] * (4 - len(want))
         assert list(got[i]) == want, f"x={x}"
+
+
+def test_indep_respects_choose_tries_budget():
+    """A rule with a SMALL set_choose_tries: the grid must never run
+    rounds the reference wouldn't (a slot filled in round 5 of a
+    4-try rule would be a silent divergence, not a flagged lane)."""
+    from ceph_tpu.placement.crush_map import RULE_SET_CHOOSE_TRIES
+    cmap, root = build_flat_cluster(n_hosts=8, osds_per_host=3, seed=47)
+    cmap.add_rule(Rule(steps=[(RULE_SET_CHOOSE_TRIES, 4, 0),
+                              (RULE_TAKE, root, 0),
+                              (RULE_CHOOSELEAF_INDEP, 0, TYPE_HOST),
+                              (RULE_EMIT, 0, 0)]))
+    check_fast(cmap, 0, 6, [WEIGHT_ONE] * cmap.max_devices,
+               np.arange(384), max_incomplete_frac=1.0)
