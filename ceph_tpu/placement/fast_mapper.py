@@ -523,8 +523,12 @@ class _FastChoose:
             # indep reuses slot-r candidates across rounds, and late
             # slots collide with probability ~(numrep/domains) per
             # round: the round budget needs a floor independent of the
-            # firstn extra (P(unresolved) ~ 0.6^rounds on tight maps)
-            self.rounds = max(5, 1 + extra // 2)
+            # firstn extra (P(unresolved) ~ 0.6^rounds on tight maps) —
+            # but never beyond the rule's try budget (a round the
+            # reference would not attempt could fill a slot it leaves
+            # NONE), and capping HERE also keeps the candidate grid
+            # from descending rounds the resolve loop would discard
+            self.rounds = min(spec.tries, max(5, 1 + extra // 2))
             self.R = spec.numrep * self.rounds
         par_pos = list(range(spec.numrep)) if self.per_rep else [0]
         self.levels = {p: [_DevLevel(h, p, strategy) for h in levels_h]
@@ -771,10 +775,7 @@ class _FastChoose:
         out = jnp.where(active, UNDEF, NONE)
         out2 = jnp.where(active, UNDEF, NONE)
         dummy_pos = jnp.zeros((N,), dtype=jnp.int32)
-        # never run past the rule's try budget: a round the reference
-        # would not attempt could fill a slot it leaves NONE — a silent
-        # divergence rather than an incomplete-flagged lane
-        for f in range(min(self.rounds, spec.tries)):
+        for f in range(self.rounds):      # already capped at spec.tries
             for rep in range(min(spec.numrep, limit)):
                 r = rep + spec.numrep * f
                 if r >= self.R:

@@ -94,11 +94,11 @@ def main(argv=None) -> int:
                                     plugin, technique, k, m, size, wl,
                                     args.iters, args.batch)
                             except Exception as e:
-                                print(f"# {plugin}/{technique} k={k} "
-                                      f"m={m} {wl}: {e}",
+                                print(f"# {plugin}/{technique or ''} "
+                                      f"k={k} m={m} {wl}: {e}",
                                       file=sys.stderr)
                                 continue
-                            print(f"{plugin},{technique},{k},{m},"
+                            print(f"{plugin},{technique or ''},{k},{m},"
                                   f"{size},{wl},{gbps:.3f}")
     return 0
 

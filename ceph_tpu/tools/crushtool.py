@@ -127,6 +127,8 @@ def main(argv=None) -> int:
                     metavar=("OSD", "W"), type=float, default=None)
     ap.add_argument("--dump", action="store_true",
                     help="print the parsed map spec")
+    ap.add_argument("--tree", action="store_true",
+                    help="print the hierarchy (ceph osd tree style)")
     args = ap.parse_args(argv)
     if args.weight:
         args.weight = [(int(o), w) for o, w in args.weight]
@@ -151,6 +153,10 @@ def main(argv=None) -> int:
     if not args.infn:
         ap.error("need --infn (or -c/-d)")
     cmap = load_map(args.infn)
+    if args.tree:
+        from ..placement.treedump import tree_dump
+        emit(tree_dump(cmap))          # honors -o like -c/-d
+        return 0
     if args.dump:
         json.dump(cmap.to_spec(), sys.stdout, indent=2)
         print()

@@ -1,0 +1,25 @@
+  $ python -m ceph_tpu.tools.crushtool -i basic.crush --tree
+  ID    CLASS  WEIGHT    TYPE NAME
+    -4          7.00000  root default
+    -1          2.00000      host host-a
+     0          1.00000          osd.0
+     1          1.00000          osd.1
+    -2          2.00000      host host-b
+     2          1.00000          osd.2
+     3          1.00000          osd.3
+    -3          3.00000      host host-c
+     4          1.00000          osd.4
+     5          2.00000          osd.5
+
+  $ python -m ceph_tpu.tools.crushtool -i classes.crush --tree
+  ID    CLASS  WEIGHT    TYPE NAME
+    -4          6.00000  root default
+    -1          2.00000      host h1
+     0  hdd     1.00000          osd.0
+     1  ssd     1.00000          osd.1
+    -2          2.00000      host h2
+     2  hdd     1.00000          osd.2
+     3  ssd     1.00000          osd.3
+    -3          2.00000      host h3
+     4  hdd     1.00000          osd.4
+     5  ssd     1.00000          osd.5
