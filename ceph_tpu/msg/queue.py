@@ -156,18 +156,24 @@ class MessageQueue:
             self._lib.ceph_tpu_mq_close(self._q)
 
     def destroy(self) -> None:
-        """Free the native queue.  ONLY safe after every producer and
-        consumer thread has stopped: a thread still blocked in push or
-        pop_batch would relock a destroyed mutex (UB)."""
+        """Free the native queue.  The native side closes the queue,
+        wakes all waiters, and defers the delete until every REGISTERED
+        in-flight push/pop_batch/stats call has drained (Queue::inflight
+        covers the call from its first instruction), so destroying with
+        parked waiter threads is safe.  A thread that has called into an
+        entry point but not yet executed its first instruction is
+        indistinguishable from a new call — callers must ensure no calls
+        can START once destroy begins (stop producers/consumers first;
+        threads already blocked inside the queue need no joining)."""
         if self._q:
             self._lib.ceph_tpu_mq_destroy(self._q)
             self._q = None
 
     def __del__(self):
         # close (wakes waiters) but deliberately LEAK the native queue:
-        # destroying while a dispatcher thread is parked in a condvar
-        # wait is a use-after-free; callers with known-quiesced queues
-        # use destroy() explicitly
+        # a racing push/pop entered AFTER interpreter teardown began
+        # could still touch a freed Queue header; callers with
+        # known-quiesced queues use destroy() explicitly
         try:
             self.close()
         except Exception:

@@ -149,12 +149,17 @@ class NativeMapper:
             self.sizes[i] = n
             self.types[i] = b.type
             self.algs[i] = b.alg
+            # derived tables are u32 (wrapped in finalize_derived);
+            # reinterpret as i32 for the C ABI, which zero-extends back
             if b.alg == BUCKET_LIST and b.sum_weights:
-                self.sum_weights[i, :n] = b.sum_weights
+                self.sum_weights[i, :n] = np.asarray(
+                    b.sum_weights, dtype=np.uint32).view(np.int32)
             if b.alg == BUCKET_STRAW and b.straws:
-                self.straws[i, :n] = b.straws
+                self.straws[i, :n] = np.asarray(
+                    b.straws, dtype=np.uint32).view(np.int32)
             if b.alg == BUCKET_TREE and b.node_weights:
-                self.node_weights[i, :len(b.node_weights)] = b.node_weights
+                self.node_weights[i, :len(b.node_weights)] = np.asarray(
+                    b.node_weights, dtype=np.uint32).view(np.int32)
                 self.num_nodes[i] = b.num_nodes
         self.max_size = S
         self.ln_table = np.ascontiguousarray(

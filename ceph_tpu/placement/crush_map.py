@@ -219,10 +219,15 @@ class Bucket:
         return self.weights[pos]
 
     def finalize_derived(self, straw_calc_version: int) -> None:
+        # derived tables are __u32 in the reference (crush.h
+        # crush_bucket_list::sum_weights, crush_bucket_tree::node_weights,
+        # crush_bucket_straw::straws, filled by builder.c) — wrap to
+        # mod-2^32 HERE so every consumer (scalar oracle, xla mapper,
+        # native bridge) sees identical u32 semantics
         if self.alg == BUCKET_LIST:
             acc, sums = 0, []
             for w in self.weights:
-                acc += w
+                acc = (acc + w) & 0xFFFFFFFF
                 sums.append(acc)
             self.sum_weights = sums
         elif self.alg == BUCKET_TREE:
@@ -231,13 +236,15 @@ class Bucket:
             nw = [0] * self.num_nodes
             for i, w in enumerate(self.weights):
                 node = ((i + 1) << 1) - 1
-                nw[node] = w
+                nw[node] = w & 0xFFFFFFFF
                 for _ in range(1, depth):
                     node = _tree_parent(node)
-                    nw[node] += w
+                    nw[node] = (nw[node] + w) & 0xFFFFFFFF
             self.node_weights = nw
         elif self.alg == BUCKET_STRAW:
-            self.straws = calc_straws(self.weights, straw_calc_version)
+            self.straws = [s & 0xFFFFFFFF
+                           for s in calc_straws(self.weights,
+                                                straw_calc_version)]
 
 
 @dataclass

@@ -100,10 +100,11 @@ def _approx_numer_f32(u):
 
 
 @functools.lru_cache(maxsize=None)
-def _approx_error_bound() -> float:
+def _approx_error_bound(backend: str) -> float:
     """Max |approx - LUT| of THIS backend's poly evaluation, measured by
     running the device computation over every u at init (one [65536]
-    dispatch, cached per backend).
+    dispatch, cached per backend — callers pass jax.default_backend()
+    as the key so a CPU-then-TPU process re-measures per platform).
 
     The bound is irreducible at ~2^29.5: the reference LUT is built from
     128-segment fixed-point tables (src/crush/crush_ln_table.h) and
@@ -226,7 +227,7 @@ class _DevLevel:
         # per-row margin: 2*bound/wmin bounds a candidate-pair gap; a
         # small relative term for f32 division rounding is added at
         # select time
-        bound = _approx_error_bound()
+        bound = _approx_error_bound(jax.default_backend())
         valid = (w > 0) & (np.arange(self.Sl)[None, :] < hl.sizes[:, None])
         wmin = np.where(valid, w, np.int64(1) << 40).min(
             axis=1, initial=np.int64(1) << 40)

@@ -76,8 +76,8 @@ class CompiledMap:
     types: np.ndarray        # i32 [B]
     algs: np.ndarray         # i32 [B] bucket algorithm
     bucket_ids: np.ndarray   # i32 [B] original (negative) bucket ids
-    sum_weights: np.ndarray  # i32 [B, S]  LIST prefix sums
-    straws: np.ndarray       # i32 [B, S]  STRAW v1 scalers
+    sum_weights: np.ndarray  # i64 [B, S]  LIST prefix sums (u32 values)
+    straws: np.ndarray       # i64 [B, S]  STRAW v1 scalers (u32 values)
     node_weights: np.ndarray  # i64 [B, 2S] TREE interior-node weights
     num_nodes: np.ndarray    # i32 [B]
     n_buckets: int
@@ -135,8 +135,12 @@ def compile_map(cmap: CrushMap, choose_args_key: object = None,
     types = np.zeros(B, dtype=np.int32)
     algs = np.full(B, BUCKET_STRAW2, dtype=np.int32)
     bucket_ids = np.zeros(B, dtype=np.int32)
-    sum_weights = np.zeros((B, S), dtype=np.int32)
-    straws = np.zeros((B, S), dtype=np.int32)
+    # u32 in the reference (crush_bucket_list::sum_weights,
+    # crush_bucket_straw::straws); kept as int64 holding the mod-2^32
+    # value so prefix sums >= 2^31 neither overflow the table dtype nor
+    # lose the reference's u32 wrap semantics
+    sum_weights = np.zeros((B, S), dtype=np.int64)
+    straws = np.zeros((B, S), dtype=np.int64)
     node_weights = np.zeros((B, 2 * S), dtype=np.int64)
     num_nodes = np.zeros(B, dtype=np.int32)
     for idx, b in enumerate(cmap.buckets):
@@ -154,9 +158,9 @@ def compile_map(cmap: CrushMap, choose_args_key: object = None,
         for p in range(P):
             ws[idx, p, :len(w_row)] = w_row
         if b.alg == BUCKET_LIST and b.sum_weights:
-            sum_weights[idx, :n] = b.sum_weights
+            sum_weights[idx, :n] = [w & 0xFFFFFFFF for w in b.sum_weights]
         if b.alg == BUCKET_STRAW and b.straws:
-            straws[idx, :n] = b.straws
+            straws[idx, :n] = [w & 0xFFFFFFFF for w in b.straws]
         if b.alg == BUCKET_TREE and b.node_weights:
             node_weights[idx, :len(b.node_weights)] = b.node_weights
             num_nodes[idx] = b.num_nodes
@@ -469,14 +473,18 @@ def _tree_choose(dt: DeviceTables, bidx, x, r):
         return (n & 1) == 0
 
     def body(n):
-        w = nw[jnp.clip(n, 0, NW - 1)]
+        # the 32.32 draw is u64 in the reference (bucket_tree_choose,
+        # mapper.c:180-219): hash (< 2^32) * node weight overflows
+        # SIGNED int64 once a node weight reaches 2^31, so the multiply,
+        # shift and left-weight compare all stay in uint64
+        w = nw[jnp.clip(n, 0, NW - 1)].astype(jnp.uint64)
         t = (hashing.jx_hash4(_u32(x), _u32(n), _u32(r), bid)
-             .astype(jnp.int64) * w) >> 32
+             .astype(jnp.uint64) * w) >> jnp.uint64(32)
         h = height(n)
         step = jnp.int32(1) << jnp.maximum(h - 1, 0)
         left = n - step
         right = n + step
-        lw = nw[jnp.clip(left, 0, NW - 1)]
+        lw = nw[jnp.clip(left, 0, NW - 1)].astype(jnp.uint64)
         return jnp.where(t < lw, left, right)
 
     n = lax.while_loop(cond, body, n0)

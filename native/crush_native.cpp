@@ -164,8 +164,10 @@ int32_t list_choose(const Row& bk, uint32_t x, uint32_t r) {
   for (int32_t i = bk.size() - 1; i >= 0; --i) {
     uint64_t w = hash4(x, (uint32_t)bk.item(i), r, (uint32_t)bk.id());
     w &= 0xFFFF;
-    w = (w * (uint64_t)sums[i]) >> 16;
-    if ((int64_t)w < (int64_t)bk.weight(i)) return bk.item(i);
+    // tables hold u32 values reinterpreted as i32: zero-extend, never
+    // sign-extend, and compare unsigned (mapper.c bucket_list_choose)
+    w = (w * (uint64_t)(uint32_t)sums[i]) >> 16;
+    if (w < (uint64_t)(uint32_t)bk.weight(i)) return bk.item(i);
   }
   return bk.item(0);
 }
@@ -176,11 +178,11 @@ int32_t tree_choose(const Row& bk, uint32_t x, uint32_t r) {
   while (!(n & 1)) {
     uint64_t t =
         ((uint64_t)hash4(x, (uint32_t)n, r, (uint32_t)bk.id()) *
-         (uint64_t)nw[n]) >> 32;
+         (uint64_t)(uint32_t)nw[n]) >> 32;   // u32 weight, zero-extended
     int32_t h = 0, tn = n;
     while ((tn & 1) == 0) { h++; tn >>= 1; }
     int32_t left = n - (1 << (h - 1));
-    n = ((int64_t)t < (int64_t)nw[left]) ? left : (n + (1 << (h - 1)));
+    n = (t < (uint64_t)(uint32_t)nw[left]) ? left : (n + (1 << (h - 1)));
   }
   return bk.item(n >> 1);
 }
@@ -191,7 +193,7 @@ int32_t straw_choose(const Row& bk, uint32_t x, uint32_t r) {
   uint64_t high_draw = 0;
   for (int32_t i = 0; i < bk.size(); ++i) {
     uint64_t draw = (hash3(x, (uint32_t)bk.item(i), r) & 0xFFFF) *
-                    (uint64_t)straws[i];
+                    (uint64_t)(uint32_t)straws[i];
     if (i == 0 || draw > high_draw) { high = i; high_draw = draw; }
   }
   return bk.item(high);

@@ -22,12 +22,14 @@
 // Envelopes are copied in (the queue owns its memory); pop hands out
 // stable pointers freed by ceph_tpu_mq_free_batch.
 
+#include <atomic>
 #include <condition_variable>
 #include <cstdint>
 #include <cstring>
 #include <deque>
 #include <mutex>
 #include <new>
+#include <thread>
 
 namespace {
 
@@ -50,7 +52,22 @@ struct Queue {
     uint64_t pushed = 0;
     uint64_t popped = 0;
     uint64_t throttle_waits = 0;
+    // every thread currently inside ANY queue entry point (including
+    // those still blocked acquiring mu, parked in a condvar, or
+    // notifying after unlock) — destroy spins on this before delete
+    std::atomic<int> inflight{0};
     bool closed = false;
+};
+
+// RAII in-flight counter taken at entry-point scope, BEFORE the mutex
+// is acquired, so destroy cannot free the Queue while any thread can
+// still touch its mutex/condvars.
+struct CallScope {
+    Queue &q;
+    explicit CallScope(Queue &queue) : q(queue) {
+        q.inflight.fetch_add(1, std::memory_order_acquire);
+    }
+    ~CallScope() { q.inflight.fetch_sub(1, std::memory_order_release); }
 };
 
 bool has_room(const Queue &q, uint64_t len) {
@@ -70,6 +87,15 @@ void *ceph_tpu_mq_create(uint64_t capacity_items, uint64_t capacity_bytes) {
     return q;
 }
 
+// Safe against concurrent users already REGISTERED inside
+// push/pop_batch/stats (CallScope taken as the call's first action):
+// closes the queue, wakes every blocked producer/consumer under the
+// lock, then spins until the in-flight call count drains before
+// deleting.  A call that has entered but not yet reached its CallScope
+// fetch_add is indistinguishable from a new call — preventing calls
+// from STARTING once destroy begins is the caller's responsibility
+// (the Python wrapper nulls its handle; dispatch threads must be
+// stopped, not joined-while-parked).
 void ceph_tpu_mq_destroy(void *qp) {
     Queue *q = static_cast<Queue *>(qp);
     {
@@ -77,14 +103,18 @@ void ceph_tpu_mq_destroy(void *qp) {
         q->closed = true;
         for (auto &e : q->items) delete[] e.payload;
         q->items.clear();
+        q->cur_bytes = 0;
+        q->not_empty.notify_all();
+        q->not_full.notify_all();
     }
-    q->not_empty.notify_all();
-    q->not_full.notify_all();
+    while (q->inflight.load(std::memory_order_acquire) != 0)
+        std::this_thread::yield();
     delete q;
 }
 
 void ceph_tpu_mq_close(void *qp) {
     Queue *q = static_cast<Queue *>(qp);
+    CallScope cs(*q);
     {
         std::lock_guard<std::mutex> lk(q->mu);
         q->closed = true;
@@ -93,11 +123,13 @@ void ceph_tpu_mq_close(void *qp) {
     q->not_full.notify_all();
 }
 
-// rc: 0 ok, -1 timeout (throttle full), -2 closed, -3 oversized
+// rc: 0 ok, -1 timeout (throttle full), -2 closed, -3 oversized,
+//     -4 payload allocation failure
 int ceph_tpu_mq_push(void *qp, uint32_t type, uint64_t id, int32_t shard,
                      const uint8_t *payload, uint64_t len,
                      int64_t timeout_us) {
     Queue *q = static_cast<Queue *>(qp);
+    CallScope cs(*q);
     std::unique_lock<std::mutex> lk(q->mu);
     if (len > q->cap_bytes) return -3;
     if (!has_room(*q, len)) {
@@ -136,6 +168,7 @@ int64_t ceph_tpu_mq_pop_batch(void *qp, int64_t max_items,
                               uint64_t *ids, int32_t *shards,
                               uint8_t **payloads, uint64_t *lens) {
     Queue *q = static_cast<Queue *>(qp);
+    CallScope cs(*q);
     std::unique_lock<std::mutex> lk(q->mu);
     if (q->items.empty()) {
         auto pred = [&] { return q->closed || !q->items.empty(); };
@@ -146,6 +179,7 @@ int64_t ceph_tpu_mq_pop_batch(void *qp, int64_t max_items,
                 lk, std::chrono::microseconds(wait_first_us), pred);
         }
     }
+    if (q->closed && q->items.empty()) return 0;  // destroy-safe exit
     auto deadline = std::chrono::steady_clock::now() +
                     std::chrono::microseconds(linger_us > 0 ? linger_us : 0);
     int64_t n = 0;
@@ -192,6 +226,7 @@ void ceph_tpu_mq_stats(void *qp, uint64_t *depth, uint64_t *bytes,
                        uint64_t *pushed, uint64_t *popped,
                        uint64_t *throttle_waits) {
     Queue *q = static_cast<Queue *>(qp);
+    CallScope cs(*q);
     std::lock_guard<std::mutex> lk(q->mu);
     *depth = q->items.size();
     *bytes = q->cur_bytes;
