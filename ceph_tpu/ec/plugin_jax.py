@@ -1,14 +1,30 @@
 """The 'jax' codec — TPU-batched erasure coding (north-star loop #2).
 
 Same profile surface as the jerasure/isa RS techniques, but the data path
-is the XLA bit-plane matmul (ceph_tpu.ops.gf_jax): encode and decode run
-as single compiled calls batched over stripes, with matrix preparation and
-the erasure-signature cache on host.  Single-stripe calls reuse the same
-kernel with batch 1, so every ErasureCodeInterface entry point is served
-by the device path.
+runs as single compiled calls batched over stripes, with matrix
+preparation and the erasure-signature cache on host.  Single-stripe
+calls reuse the same kernel with batch 1, so every ErasureCodeInterface
+entry point is served by the device path.
+
+Two chunk layouts, selected by the ``layout`` profile key:
+
+  * ``layout=bytes`` (default): classic byte-symbol layout — chunk byte
+    t is one GF(2^8) symbol; parity bytes match jerasure/ISA-L matrix
+    techniques.  Data path: the XLA/Pallas bit-plane MXU matmul
+    (ceph_tpu.ops.gf_jax / gf_pallas).
+  * ``layout=bitsliced``: jerasure-packet layout — each chunk is 8
+    plane regions and one GF(2^8) symbol is bit-sliced across them
+    (exactly how the reference's bitmatrix/schedule techniques lay out
+    chunks: jerasure_schedule_encode packets,
+    src/erasure-code/jerasure/ErasureCodeJerasure.cc:162,274).  The
+    data path is the flagship masked-XOR region kernel
+    (ceph_tpu.ops.xor_kernel): no bit unpacking, 32 GF(2) lanes per
+    int32 ALU op, ~70% of HBM roofline on v5e.  Parity BYTES differ
+    from layout=bytes (as cauchy_good differs from reed_sol_van in the
+    reference) but the code is the same MDS RS code per symbols.
 
 Matches the BASELINE north star: ErasureCodeInterface::encode_chunks /
-decode_chunks as batched GF(2^8) matrix multiplies compiled by XLA, behind
+decode_chunks as batched GF(2) programs compiled for the TPU, behind
 the registry seam (reference: src/erasure-code/ErasureCodeInterface.h:370,
 :411; src/erasure-code/ErasureCodePlugin.cc:86).
 """
@@ -25,6 +41,7 @@ DEFAULT_K = 8
 DEFAULT_M = 3
 
 TECHNIQUES = ("reed_sol_van", "cauchy", "cauchy_good", "isa_rs")
+LAYOUTS = ("bytes", "bitsliced")
 
 
 def _pallas_ok() -> bool:
@@ -55,11 +72,16 @@ class ErasureCodeJax(MatrixCodec):
         else:
             raise ErasureCodeError(
                 f"technique={technique!r} not in {TECHNIQUES}")
+        layout = profile.get("layout", "bytes")
+        if layout not in LAYOUTS:
+            raise ErasureCodeError(f"layout={layout!r} not in {LAYOUTS}")
+        self.layout = layout
         self.set_matrix(parity, 8)
         self._pc = _perf("ec.jax")       # cached group handle (hot path)
         self._profile = dict(profile)
         self._profile.setdefault("plugin", "jax")
         self._profile["technique"] = technique
+        self._profile["layout"] = layout
         self._profile.update(k=str(k), m=str(m))
 
     # ----------------------------------------------------------- encode ---
@@ -82,6 +104,22 @@ class ErasureCodeJax(MatrixCodec):
                 gf_jax.matrix_to_device(matrix), data)
         return gf_jax.gf8_matmul(matrix, data)
 
+    def _plane_matmul(self, gf_matrix, data):
+        """Bitsliced path: [..., n, L] chunks -> [..., rows, L] chunks
+        via the masked-XOR region kernel (reshape-only layout moves)."""
+        import jax.numpy as jnp
+        from ..ops import xor_kernel
+        masks = xor_kernel.masks_to_device(gf.gf8_bitmatrix(gf_matrix))
+        d = jnp.asarray(data)
+        n, L = d.shape[-2], d.shape[-1]
+        if L % 32:
+            raise ErasureCodeError(
+                f"bitsliced layout needs chunk size % 32 == 0, got {L}")
+        planes = d.reshape(d.shape[:-2] + (8 * n, L // 8))
+        out = xor_kernel.xor_matmul(masks, planes)
+        r = out.shape[-2] // 8
+        return out.reshape(out.shape[:-2] + (r, L))
+
     def encode_chunks_device(self, data):
         """[..., k, L] -> [..., m, L]; stays on device (jax.Array out)."""
         if data.shape[-2] != self.k:
@@ -90,6 +128,8 @@ class ErasureCodeJax(MatrixCodec):
         pc = self._pc
         pc.inc("encode_dispatches")
         pc.inc("encode_bytes", int(np.prod(data.shape)))
+        if self.layout == "bitsliced":
+            return self._plane_matmul(self.parity, data)
         return self._matmul(self.parity, data)
 
     # ----------------------------------------------------------- decode ---
@@ -127,6 +167,8 @@ class ErasureCodeJax(MatrixCodec):
             # gather lowers to ~0.1 G elem/s serial loops on TPU
             # (measured 60x slower than the encode matmul it feeds)
             rows = jnp.stack([dev[..., i, :] for i in sel], axis=-2)
+        if self.layout == "bitsliced":
+            return self._plane_matmul(R, rows)
         return self._matmul(R, rows)
 
 

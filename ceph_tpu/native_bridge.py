@@ -97,6 +97,10 @@ def lib() -> ctypes.CDLL:
             _LIB.ceph_tpu_gf_region_mul_xor.restype = None
             _LIB.ceph_tpu_gf_region_mul_xor.argtypes = [
                 _U8P, _U8P, ctypes.c_uint8, ctypes.c_int64]
+            _LIB.ceph_tpu_gf2_xor_regions.restype = ctypes.c_int
+            _LIB.ceph_tpu_gf2_xor_regions.argtypes = [
+                _U8P, ctypes.c_int32, ctypes.c_int32, _U8P, _U8P,
+                ctypes.c_int64]
             _LIB.ceph_tpu_has_avx2.restype = ctypes.c_int
             _LIB.ceph_tpu_hash2.restype = ctypes.c_uint32
             _LIB.ceph_tpu_hash2.argtypes = [ctypes.c_uint32, ctypes.c_uint32]
@@ -269,3 +273,40 @@ def region_mul_xor(dst: np.ndarray, src: np.ndarray, c: int) -> None:
     lib().ceph_tpu_gf_region_mul_xor(
         dst.ctypes.data_as(_U8P), src.ctypes.data_as(_U8P),
         np.uint8(c), np.int64(dst.size))
+
+
+def gf2_xor_regions(bitmat: np.ndarray, planes: np.ndarray) -> np.ndarray:
+    """AVX2 bit-sliced codec: out[R, P] planes = bitmat [R, C] ∘
+    planes [C, P] over GF(2) (region XOR — jerasure schedule role)."""
+    bitmat = np.ascontiguousarray(bitmat, dtype=np.uint8)
+    planes = np.ascontiguousarray(planes, dtype=np.uint8)
+    R, C = bitmat.shape
+    if planes.shape[0] != C:
+        raise ValueError(
+            f"bitmat {bitmat.shape} needs {C} planes, got {planes.shape}")
+    P = planes.shape[1]
+    out = np.empty((R, P), dtype=np.uint8)
+    lib().ceph_tpu_gf2_xor_regions(
+        bitmat.ctypes.data_as(_U8P), np.int32(R), np.int32(C),
+        planes.ctypes.data_as(_U8P), out.ctypes.data_as(_U8P), np.int64(P))
+    return out
+
+
+def gf2_xor_regions_batch(bitmat: np.ndarray,
+                          planes: np.ndarray) -> np.ndarray:
+    """Batched bit-sliced codec: planes [B, C, P] → [B, R, P]."""
+    bitmat = np.ascontiguousarray(bitmat, dtype=np.uint8)
+    planes = np.ascontiguousarray(planes, dtype=np.uint8)
+    B, C, P = planes.shape
+    R = bitmat.shape[0]
+    if bitmat.shape[1] != C:
+        raise ValueError(
+            f"bitmat {bitmat.shape} needs {bitmat.shape[1]} planes, "
+            f"got {C}")
+    out = np.empty((B, R, P), dtype=np.uint8)
+    fn = lib().ceph_tpu_gf2_xor_regions
+    bp = bitmat.ctypes.data_as(_U8P)
+    for i in range(B):
+        fn(bp, np.int32(R), np.int32(C), planes[i].ctypes.data_as(_U8P),
+           out[i].ctypes.data_as(_U8P), np.int64(P))
+    return out

@@ -1,0 +1,173 @@
+"""Batched GF(2) region-XOR "matmul" — the flagship TPU erasure kernel.
+
+Computes, for the bit-sliced plane layout of ops/gf2.py,
+
+    out[b, r, :] = XOR_c ( planes[b, c, :] & masks[., r, c] )
+
+i.e. a masked-XOR matrix product over byte regions.  The masks operand
+(0 / -1 int32 words from gf2.bitmatrix_masks) is DATA, not program:
+new erasure signatures reuse the same compiled kernel, and the masks
+may carry a batch axis so every stripe in a recovery batch can decode
+under its own signature in one dispatch.
+
+Why this beats the bit-plane MXU matmul (ops/gf_pallas.py): the byte
+layout forces an 8x bit unpack/pack on the VPU around a tiny
+[8m, 8k] matmul (~2% MXU utilization for RS(8,3)); here the planes stay
+packed — every int32 word carries 32 independent GF(2) lanes and the
+whole contraction is R*C AND+XOR vector ops per tile, bound by the
+~TB/s VPU and HBM rather than matmul shape.  Reference roles:
+jerasure_schedule_encode / jerasure_schedule_decode_lazy
+(src/erasure-code/jerasure/ErasureCodeJerasure.cc:162,274), ISA-L
+ec_encode_data (src/erasure-code/isa/ErasureCodeIsa.cc:129).
+
+Two backends, bit-identical (tests/test_gf2.py):
+  * Pallas TPU kernel: grid (batch, lane-tiles); each program holds a
+    [C, T] int32 tile in VMEM and unrolls the masked-XOR contraction.
+  * XLA fallback (CPU/GPU/interpret): same unrolled graph under vmap.
+
+Byte views: uint8 planes are bitcast to int32 words (4 bytes/word) at
+the boundary; XOR commutes with any byte order, so the round trip is
+exact whatever the platform endianness.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# int32 lanes per pallas program (4 KiB of bytes per plane row).  Swept
+# on v5e with the chained-marginal methodology over 134 MB RS(8,3)
+# batches: 512 -> 227 GB/s, 1024 -> 413 GB/s, 2048 -> 258 GB/s.
+_TILE = 1024
+
+
+# ------------------------------------------------------------- conversions --
+
+def _u8_to_i32(x: jax.Array) -> jax.Array:
+    """[..., P] uint8 -> [..., P//4] int32 (P % 4 == 0)."""
+    s = x.shape
+    return jax.lax.bitcast_convert_type(
+        x.reshape(s[:-1] + (s[-1] // 4, 4)), jnp.int32)
+
+
+def _i32_to_u8(x: jax.Array) -> jax.Array:
+    """[..., W] int32 -> [..., 4W] uint8 (inverse of _u8_to_i32)."""
+    y = jax.lax.bitcast_convert_type(x, jnp.uint8)
+    return y.reshape(y.shape[:-2] + (y.shape[-2] * 4,))
+
+
+# -------------------------------------------------------------- contraction --
+
+def _combine(mk, d):
+    """masks [R, C] i32, words [C, T] i32 -> [R, T] i32.  Static unroll
+    over the contraction axis (C <= a few hundred) — identical code
+    feeds both the Pallas kernel body and the XLA fallback."""
+    R, C = mk.shape
+    acc = mk[:, 0:1] & d[0:1, :]
+    for c in range(1, C):
+        acc = acc ^ (mk[:, c:c + 1] & d[c:c + 1, :])
+    return acc
+
+
+def _kernel(masks_ref, data_ref, out_ref):
+    out_ref[0] = _combine(masks_ref[0], data_ref[0])
+
+
+@functools.partial(jax.jit, static_argnames=("per_batch",))
+def _xor_matmul_pallas(masks, words, per_batch):
+    """masks [Bm, R, C] i32, words [B, C, W] i32 -> [B, R, W] i32.
+    W must be a multiple of _TILE (caller pads)."""
+    from jax.experimental import pallas as pl
+    B, C, W = words.shape
+    R = masks.shape[1]
+    grid = (B, W // _TILE)
+    # i32 index maps (Mosaic rejects i64 traces under jax_enable_x64)
+    with jax.enable_x64(False):
+        return pl.pallas_call(
+            _kernel,
+            out_shape=jax.ShapeDtypeStruct((B, R, W), jnp.int32),
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((1, R, C),
+                             (lambda b, l: (b, 0, 0)) if per_batch
+                             else (lambda b, l: (0, 0, 0))),
+                pl.BlockSpec((1, C, _TILE), lambda b, l: (b, 0, l)),
+            ],
+            out_specs=pl.BlockSpec((1, R, _TILE), lambda b, l: (b, 0, l)),
+        )(masks, words)
+
+
+@functools.partial(jax.jit, static_argnames=("per_batch",))
+def _xor_matmul_xla(masks, words, per_batch):
+    """Fallback: same contraction as one fused XLA graph."""
+    if per_batch:
+        return jax.vmap(_combine)(masks, words)
+    return jax.vmap(lambda d: _combine(masks[0], d))(words)
+
+
+def use_pallas() -> bool:
+    try:
+        return jax.devices()[0].platform == "tpu"
+    except Exception:  # pragma: no cover - no backend at all
+        return False
+
+
+# ------------------------------------------------------------------ public --
+
+def xor_matmul_w32(masks, words) -> jax.Array:
+    """int32-domain entry: masks [R, C] or [..., R, C], words
+    [..., C, W] int32 -> [..., R, W] int32 (device array).
+
+    A leading batch axis on ``masks`` must match ``words``'s leading
+    axes elementwise (per-stripe decode signatures).
+    """
+    words = jnp.asarray(words, jnp.int32)
+    masks = jnp.asarray(masks, jnp.int32)
+    lead = words.shape[:-2]
+    C, W = words.shape[-2:]
+    per_batch = masks.ndim > 2
+    if per_batch and masks.shape[:-2] != lead:
+        raise ValueError(
+            f"mask batch {masks.shape[:-2]} != data batch {lead}")
+    if masks.shape[-1] != C:
+        raise ValueError(
+            f"masks contract {masks.shape[-1]} columns, data has {C} planes")
+    B = int(np.prod(lead)) if lead else 1
+    w3 = words.reshape(B, C, W)
+    R = masks.shape[-2]
+    m3 = masks.reshape(B if per_batch else 1, R, masks.shape[-1])
+    if use_pallas():
+        pad = (-W) % _TILE
+        if pad:
+            w3 = jnp.pad(w3, ((0, 0), (0, 0), (0, pad)))
+        out = _xor_matmul_pallas(m3, w3, per_batch)
+        if pad:
+            out = out[..., :W]
+    else:
+        out = _xor_matmul_xla(m3, w3, per_batch)
+    return out.reshape(lead + (R, W))
+
+
+def xor_matmul(masks, planes) -> jax.Array:
+    """uint8-domain entry: planes [..., C, P] uint8 (P % 4 == 0) ->
+    [..., R, P] uint8 on device."""
+    planes = jnp.asarray(planes)
+    out = xor_matmul_w32(masks, _u8_to_i32(planes))
+    return _i32_to_u8(out)
+
+
+@functools.lru_cache(maxsize=4096)
+def _masks_device(key: bytes, R: int, C: int) -> jax.Array:
+    from . import gf2
+    bm = np.frombuffer(key, dtype=np.uint8).reshape(R, C)
+    return jnp.asarray(gf2.bitmatrix_masks(bm))
+
+
+def masks_to_device(bitmat: np.ndarray) -> jax.Array:
+    """Host GF(2) bit-matrix [R, C] 0/1 -> cached device mask operand
+    [R, C] int32 (0 / -1), keyed by content (the ISA-L table-cache role,
+    src/erasure-code/isa/ErasureCodeIsaTableCache.h:35)."""
+    bm = np.ascontiguousarray(bitmat, dtype=np.uint8)
+    return _masks_device(bm.tobytes(), *bm.shape)

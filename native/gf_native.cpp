@@ -115,6 +115,37 @@ void ceph_tpu_gf_region_mul_xor(uint8_t* dst, const uint8_t* src,
   region_mul_xor(dst, src, c, len);
 }
 
+// Bit-sliced (jerasure-packet) region-XOR codec: out plane r = XOR of
+// input planes where bitmat[r][c] == 1.  The CPU counterpart of the TPU
+// masked-XOR kernel and the role of jerasure's schedule execution
+// (jerasure_schedule_encode, src/erasure-code/jerasure/
+// ErasureCodeJerasure.cc:162) — pure wide XOR, no table lookups, i.e.
+// the FASTEST possible CPU formulation of the same technique, which
+// keeps the TPU-vs-CPU comparison honest for bitsliced layouts.
+// bitmat [R, C] 0/1; planes [C, P] contiguous; out [R, P] zeroed here.
+int ceph_tpu_gf2_xor_regions(const uint8_t* bitmat, int32_t R, int32_t C,
+                             const uint8_t* planes, uint8_t* out,
+                             int64_t P) {
+  std::memset(out, 0, (size_t)R * P);
+  for (int32_t r = 0; r < R; ++r) {
+    uint8_t* dst = out + (int64_t)r * P;
+    for (int32_t c = 0; c < C; ++c) {
+      if (!bitmat[r * C + c]) continue;
+      const uint8_t* src = planes + (int64_t)c * P;
+      int64_t i = 0;
+#if defined(__AVX2__)
+      for (; i + 32 <= P; i += 32) {
+        __m256i d = _mm256_loadu_si256((const __m256i*)(dst + i));
+        __m256i s = _mm256_loadu_si256((const __m256i*)(src + i));
+        _mm256_storeu_si256((__m256i*)(dst + i), _mm256_xor_si256(d, s));
+      }
+#endif
+      for (; i < P; ++i) dst[i] ^= src[i];
+    }
+  }
+  return 0;
+}
+
 int ceph_tpu_has_avx2(void) {
 #if defined(__AVX2__)
   return 1;
