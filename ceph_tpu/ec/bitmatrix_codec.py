@@ -1,0 +1,192 @@
+"""Generic GF(2) bitmatrix codec over packet/plane chunk layout.
+
+The codec space of jerasure's schedule techniques: a [m*w, k*w] 0/1
+parity bitmatrix acts on chunks divided into w plane regions
+(ops/gf2.py layout).  Encode and decode are masked region XOR — on
+device via ops/xor_kernel.py, on host via the native AVX2 region codec
+or the NumPy oracle.  Decode matrices are GF(2) inversions of the
+surviving generator rows, LRU-cached per erasure signature (the ISA
+table-cache role).
+
+Reference roles: jerasure_schedule_encode / jerasure_schedule_decode_lazy
+(src/erasure-code/jerasure/ErasureCodeJerasure.cc:162,274),
+jerasure bitmatrix decode construction (ErasureCodeJerasure.cc decode).
+"""
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import numpy as np
+
+from ..ops import gf2
+from .base import ErasureCodeBase
+from .interface import ErasureCodeError
+from .table_cache import DecodeTableCache
+
+
+class BitmatrixCodec(ErasureCodeBase):
+    """Holds a parity bitmatrix B [m*w, k*w]; chunks carry w planes."""
+
+    def __init__(self) -> None:
+        super().__init__()
+        self.w = 8
+        self.bitmatrix: np.ndarray | None = None
+        from ..common.options import config
+        self._cache = DecodeTableCache(
+            capacity=int(config().get("ec_table_cache_size")))
+
+    # -------------------------------------------------------------- setup --
+    def set_bitmatrix(self, bm: np.ndarray, k: int, m: int, w: int) -> None:
+        bm = np.asarray(bm, dtype=np.uint8) & 1
+        if bm.shape != (m * w, k * w):
+            raise ErasureCodeError(
+                f"bitmatrix shape {bm.shape} != ({m * w}, {k * w})")
+        self.bitmatrix = bm
+        self.k, self.m, self.w = k, m, w
+
+    def generator_bitmatrix(self) -> np.ndarray:
+        """[(k+m)w, kw]: identity rows for data planes, then parity."""
+        kw = self.k * self.w
+        return np.concatenate(
+            [np.eye(kw, dtype=np.uint8), self.bitmatrix], axis=0)
+
+    def get_chunk_size(self, stripe_width: int) -> int:
+        """Chunks must split into w planes whose byte count is 32-bit
+        aligned for the packed-word kernels."""
+        align = self.k * self.w * 4
+        padded = -(-stripe_width // align) * align
+        return padded // self.k
+
+    # ---------------------------------------------------------- data path --
+    def _planes(self, chunks: np.ndarray, n: int) -> np.ndarray:
+        a = np.asarray(chunks, dtype=np.uint8)
+        L = a.shape[-1]
+        if L % (self.w * 4):
+            raise ErasureCodeError(
+                f"chunk size {L} not divisible by {self.w * 4}")
+        return a.reshape(a.shape[:-2] + (n * self.w, L // self.w))
+
+    def _chunks(self, planes: np.ndarray, L: int) -> np.ndarray:
+        n = planes.shape[-2] // self.w
+        return planes.reshape(planes.shape[:-2] + (n, L))
+
+    _native_ok: bool | None = None   # probed once per process
+
+    def _combine_host(self, bitmat: np.ndarray,
+                      planes: np.ndarray) -> np.ndarray:
+        cls = BitmatrixCodec
+        if cls._native_ok is None:
+            try:
+                from .. import native_bridge as nb
+                nb.lib()
+                cls._native_ok = True
+            except Exception:       # no toolchain: NumPy oracle path
+                cls._native_ok = False
+        if cls._native_ok:
+            from .. import native_bridge as nb
+            if planes.ndim == 2:
+                return nb.gf2_xor_regions(bitmat, planes)
+            flat = planes.reshape((-1,) + planes.shape[-2:])
+            out = nb.gf2_xor_regions_batch(bitmat, flat)
+            return out.reshape(planes.shape[:-2] + out.shape[-2:])
+        return gf2.region_xor_matmul_np(bitmat, planes)
+
+    def encode_chunks(self, data_chunks: np.ndarray) -> np.ndarray:
+        data = np.asarray(data_chunks, dtype=np.uint8)
+        if data.shape[-2] != self.k:
+            raise ErasureCodeError(
+                f"expected {self.k} data chunks, got {data.shape[-2]}")
+        L = data.shape[-1]
+        out = self._combine_host(self.bitmatrix, self._planes(data, self.k))
+        return self._chunks(out, L)
+
+    def encode_chunks_batch(self, data: np.ndarray) -> np.ndarray:
+        return np.asarray(self.encode_chunks_device(data))
+
+    def encode_chunks_device(self, data):
+        """Batched device path: [..., k, L] -> [..., m, L] jax.Array."""
+        import jax.numpy as jnp
+        from ..ops import xor_kernel
+        d = jnp.asarray(np.asarray(data, dtype=np.uint8))
+        if d.shape[-2] != self.k:
+            raise ErasureCodeError(
+                f"expected {self.k} data chunks, got {d.shape[-2]}")
+        L = d.shape[-1]
+        if L % (self.w * 4):
+            raise ErasureCodeError(
+                f"chunk size {L} not divisible by {self.w * 4}")
+        planes = d.reshape(d.shape[:-2] + (self.k * self.w, L // self.w))
+        out = xor_kernel.xor_matmul(
+            xor_kernel.masks_to_device(self.bitmatrix), planes)
+        return out.reshape(out.shape[:-2] + (self.m, L))
+
+    # -------------------------------------------------------------- decode --
+    def decode_bitmatrix(self, available_ids: Sequence[int],
+                         erased_ids: Sequence[int]
+                         ) -> Tuple[np.ndarray, list]:
+        """[e*w, k*w] GF(2) recovery bitmatrix R with
+        erased_planes = R @ planes(avail_used), plus the used ids."""
+        avail = sorted(set(available_ids))[:self.k]
+        if len(avail) < self.k:
+            raise ErasureCodeError(
+                f"need {self.k} chunks, have {len(set(available_ids))}")
+        key = (tuple(avail), tuple(sorted(erased_ids)))
+        hit = self._cache.get(key)
+        if hit is not None:
+            return hit, avail
+        G = self.generator_bitmatrix()
+        w = self.w
+        rows = np.concatenate(
+            [np.arange(c * w, (c + 1) * w) for c in avail])
+        try:
+            inv = gf2.gf2_inverse(G[rows])
+        except ValueError as e:
+            raise ErasureCodeError(
+                f"singular GF(2) sub-generator for chunks {avail}") from e
+        er_rows = np.concatenate(
+            [np.arange(c * w, (c + 1) * w) for c in sorted(erased_ids)])
+        R = gf2.gf2_matmul(G[er_rows], inv)
+        self._cache.put(key, R)
+        return R, avail
+
+    def decode_chunks(self, available_ids: Sequence[int],
+                      chunks: np.ndarray, erased_ids: Sequence[int]
+                      ) -> np.ndarray:
+        erased = sorted(erased_ids)
+        if not erased:
+            return np.zeros((0,) + tuple(np.asarray(chunks).shape[1:]),
+                            dtype=np.uint8)
+        R, used = self.decode_bitmatrix(available_ids, erased)
+        order = list(available_ids)
+        rows = np.stack([np.asarray(chunks[order.index(c)], dtype=np.uint8)
+                         for c in used])
+        L = rows.shape[-1]
+        out = self._combine_host(R, self._planes(rows, self.k))
+        return self._chunks(out, L)
+
+    def decode_chunks_batch(self, available_ids, chunks, erased_ids):
+        import numpy as _np
+        return _np.asarray(self.decode_chunks_device(
+            available_ids, chunks, erased_ids))
+
+    def decode_chunks_device(self, available_ids, chunks, erased_ids):
+        """Batched device decode for one shared signature; the recovery
+        bitmatrix is a mask operand, so new signatures don't recompile."""
+        import jax.numpy as jnp
+        from ..ops import xor_kernel
+        erased = sorted(erased_ids)
+        if not erased:
+            return np.zeros(tuple(np.asarray(chunks).shape[:-2]) +
+                            (0, np.asarray(chunks).shape[-1]),
+                            dtype=np.uint8)
+        R, used = self.decode_bitmatrix(available_ids, erased)
+        order = list(available_ids)
+        sel = [order.index(c) for c in used]
+        dev = jnp.asarray(np.asarray(chunks, dtype=np.uint8))
+        if sel != list(range(len(order))):
+            dev = jnp.stack([dev[..., i, :] for i in sel], axis=-2)
+        L = dev.shape[-1]
+        planes = dev.reshape(dev.shape[:-2] + (self.k * self.w,
+                                               L // self.w))
+        out = xor_kernel.xor_matmul(xor_kernel.masks_to_device(R), planes)
+        return out.reshape(out.shape[:-2] + (len(erased), L))

@@ -10,15 +10,24 @@ checkout; ceph_tpu.ops.gf re-derives the math):
   * cauchy_orig     — Cauchy generator 1/(i ^ (m+j))
   * cauchy_good     — normalized Cauchy
 
-The bitmatrix-only techniques (liberation, blaum_roth, liber8tion) are
-CPU XOR-schedule optimizations of the same code space; they are not yet
-implemented here and fail loudly at init.
+The bitmatrix techniques run on the GF(2) plane layout:
+
+  * liberation     — RAID-6 minimal-density bitmatrix (m=2, prime w)
+  * blaum_roth     — RAID-6 ring construction (m=2, w+1 prime)
+  * liber8tion     — RAID-6 search-built bitmatrix (m=2, w=8)
+
+(constructions in ec/bitmatrix_raid6.py; data path is the masked
+region-XOR kernel over packet planes, the layout jerasure's schedules
+use — src/erasure-code/jerasure/ErasureCodeJerasure.cc:162,274.)
 """
 from __future__ import annotations
 
 import numpy as np
 
 from ..ops import gf
+from .bitmatrix_codec import BitmatrixCodec
+from .bitmatrix_raid6 import (blaum_roth_bitmatrix, liber8tion_bitmatrix,
+                              liberation_bitmatrix)
 from .interface import ErasureCodeError, ErasureCodeProfile
 from .matrix_codec import MatrixCodec
 
@@ -33,9 +42,6 @@ DEFAULT_W = 8
 class ErasureCodeJerasure(MatrixCodec):
     def init(self, profile: ErasureCodeProfile) -> None:
         technique = profile.get("technique", "reed_sol_van")
-        if technique not in TECHNIQUES:
-            raise ErasureCodeError(
-                f"technique={technique!r} not in {TECHNIQUES}")
         k = self.profile_int(profile, "k", DEFAULT_K, minimum=1)
         m = self.profile_int(profile, "m", DEFAULT_M, minimum=1)
         w = self.profile_int(profile, "w", DEFAULT_W)
@@ -72,10 +78,8 @@ class ErasureCodeJerasure(MatrixCodec):
                 parity = gf.cauchy_good_parity(k, m, w)
             except ValueError as e:
                 raise ErasureCodeError(str(e)) from e
-        else:
-            raise ErasureCodeError(
-                f"technique {technique!r} is a CPU bitmatrix XOR-schedule "
-                "variant not yet provided by this backend")
+        else:  # pragma: no cover - _factory validates technique names
+            raise ErasureCodeError(f"not a matrix technique: {technique}")
         self.set_matrix(parity, w)
         self._profile = dict(profile)
         self._profile.setdefault("plugin", "jerasure")
@@ -83,9 +87,48 @@ class ErasureCodeJerasure(MatrixCodec):
         self._profile.update(k=str(k), m=str(m), w=str(w))
 
 
+BITMATRIX_TECHNIQUES = ("liberation", "blaum_roth", "liber8tion")
+# per-technique default w, matching jerasure's common usage
+_BITMATRIX_DEFAULT_W = {"liberation": 7, "blaum_roth": 6, "liber8tion": 8}
+
+
+class ErasureCodeJerasureBitmatrix(BitmatrixCodec):
+    """The three RAID-6 bitmatrix techniques (m forced to 2)."""
+
+    def init(self, profile: ErasureCodeProfile) -> None:
+        technique = profile["technique"]
+        k = self.profile_int(profile, "k", DEFAULT_K, minimum=1)
+        m = self.profile_int(profile, "m", 2)
+        w = self.profile_int(profile, "w",
+                             _BITMATRIX_DEFAULT_W[technique])
+        if m != 2:
+            raise ErasureCodeError(f"{technique} requires m=2, got {m}")
+        try:
+            if technique == "liberation":
+                bm = liberation_bitmatrix(k, w)
+            elif technique == "blaum_roth":
+                bm = blaum_roth_bitmatrix(k, w)
+            else:
+                bm = liber8tion_bitmatrix(k, w)
+        except ValueError as e:
+            raise ErasureCodeError(str(e)) from e
+        self.set_bitmatrix(bm, k, m, w)
+        self._profile = dict(profile)
+        self._profile.setdefault("plugin", "jerasure")
+        self._profile["technique"] = technique
+        self._profile.update(k=str(k), m=str(m), w=str(w))
+
+
 def _factory(profile: ErasureCodeProfile):
-    codec = ErasureCodeJerasure()
-    codec.init(profile)
+    """Single validation point for the technique whitelist; bitmatrix
+    techniques dispatch to the GF(2) codec class."""
+    technique = profile.get("technique", "reed_sol_van")
+    if technique not in TECHNIQUES:
+        raise ErasureCodeError(
+            f"technique={technique!r} not in {TECHNIQUES}")
+    codec = (ErasureCodeJerasureBitmatrix()
+             if technique in BITMATRIX_TECHNIQUES else ErasureCodeJerasure())
+    codec.init(dict(profile, technique=technique))
     return codec
 
 

@@ -107,6 +107,50 @@ def bitsliced_symbols(chunks: np.ndarray) -> np.ndarray:
     return sym.reshape(n, 8 * P)
 
 
+# ------------------------------------------------------- GF(2) matrix ops --
+
+def gf2_matmul(A: np.ndarray, B: np.ndarray) -> np.ndarray:
+    """(A @ B) mod 2 for 0/1 uint8 matrices."""
+    return (np.asarray(A, dtype=np.int64) @
+            np.asarray(B, dtype=np.int64) & 1).astype(np.uint8)
+
+
+def gf2_inverse(M: np.ndarray) -> np.ndarray:
+    """Invert a square 0/1 matrix over GF(2) (Gauss-Jordan).
+
+    Raises ValueError if singular.  Decode-matrix construction for
+    bitmatrix codes (jerasure_invert_bitmatrix role,
+    src/erasure-code/jerasure/ErasureCodeJerasure.cc decode paths).
+    """
+    M = np.array(M, dtype=np.uint8) & 1
+    n = M.shape[0]
+    if M.shape != (n, n):
+        raise ValueError("square matrix required")
+    aug = np.concatenate([M, np.eye(n, dtype=np.uint8)], axis=1)
+    for col in range(n):
+        pivot = -1
+        for r in range(col, n):
+            if aug[r, col]:
+                pivot = r
+                break
+        if pivot < 0:
+            raise ValueError("singular matrix over GF(2)")
+        if pivot != col:
+            aug[[col, pivot]] = aug[[pivot, col]]
+        rows = np.flatnonzero(aug[:, col])
+        rows = rows[rows != col]
+        aug[rows] ^= aug[col]
+    return aug[:, n:].copy()
+
+
+def gf2_invertible(M: np.ndarray) -> bool:
+    try:
+        gf2_inverse(M)
+        return True
+    except ValueError:
+        return False
+
+
 def bitmatrix_masks(bitmat: np.ndarray) -> np.ndarray:
     """[R, C] 0/1 -> [R, C] int32 full-width masks (0 / -1) — the device
     operand layout of ops/xor_kernel.py (same orientation as the

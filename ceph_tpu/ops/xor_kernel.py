@@ -75,14 +75,14 @@ def _kernel(masks_ref, data_ref, out_ref):
     out_ref[0] = _combine(masks_ref[0], data_ref[0])
 
 
-@functools.partial(jax.jit, static_argnames=("per_batch",))
-def _xor_matmul_pallas(masks, words, per_batch):
+@functools.partial(jax.jit, static_argnames=("per_batch", "tile"))
+def _xor_matmul_pallas(masks, words, per_batch, tile=_TILE):
     """masks [Bm, R, C] i32, words [B, C, W] i32 -> [B, R, W] i32.
-    W must be a multiple of _TILE (caller pads)."""
+    W must be a multiple of ``tile`` (caller pads)."""
     from jax.experimental import pallas as pl
     B, C, W = words.shape
     R = masks.shape[1]
-    grid = (B, W // _TILE)
+    grid = (B, W // tile)
     # i32 index maps (Mosaic rejects i64 traces under jax_enable_x64)
     with jax.enable_x64(False):
         return pl.pallas_call(
@@ -93,9 +93,9 @@ def _xor_matmul_pallas(masks, words, per_batch):
                 pl.BlockSpec((1, R, C),
                              (lambda b, l: (b, 0, 0)) if per_batch
                              else (lambda b, l: (0, 0, 0))),
-                pl.BlockSpec((1, C, _TILE), lambda b, l: (b, 0, l)),
+                pl.BlockSpec((1, C, tile), lambda b, l: (b, 0, l)),
             ],
-            out_specs=pl.BlockSpec((1, R, _TILE), lambda b, l: (b, 0, l)),
+            out_specs=pl.BlockSpec((1, R, tile), lambda b, l: (b, 0, l)),
         )(masks, words)
 
 
@@ -139,10 +139,14 @@ def xor_matmul_w32(masks, words) -> jax.Array:
     R = masks.shape[-2]
     m3 = masks.reshape(B if per_batch else 1, R, masks.shape[-1])
     if use_pallas():
-        pad = (-W) % _TILE
+        # small chunks don't pad out to the full tile: clamp to the
+        # next 128-word multiple so a 16-word plane costs 128 lanes,
+        # not 1024 (the jit/pallas executable is shape-keyed anyway)
+        tile = min(_TILE, -(-W // 128) * 128)
+        pad = (-W) % tile
         if pad:
             w3 = jnp.pad(w3, ((0, 0), (0, 0), (0, pad)))
-        out = _xor_matmul_pallas(m3, w3, per_batch)
+        out = _xor_matmul_pallas(m3, w3, per_batch, tile)
         if pad:
             out = out[..., :W]
     else:

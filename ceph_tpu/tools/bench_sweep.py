@@ -26,9 +26,12 @@ import time
 
 import numpy as np
 
+# "technique+bitsliced" runs the same matrix under the packet-plane
+# layout (the flagship region-XOR kernel)
 TECHNIQUES = {
-    "jax": ["reed_sol_van", "cauchy"],
-    "jerasure": ["reed_sol_van", "cauchy_good"],
+    "jax": ["reed_sol_van", "cauchy", "reed_sol_van+bitsliced"],
+    "jerasure": ["reed_sol_van", "cauchy_good",
+                 "liberation", "blaum_roth", "liber8tion"],
     "isa": ["reed_sol_van", "cauchy"],
 }
 
@@ -38,6 +41,9 @@ def bench_cell(plugin: str, technique: str, k: int, m: int, size: int,
     from ..ec import instance as ec_registry
     prof = {"k": str(k), "m": str(m)}
     if technique:
+        if "+" in technique:
+            technique, layout = technique.split("+", 1)
+            prof["layout"] = layout
         prof["technique"] = technique
     codec = ec_registry().factory(plugin, prof)
     chunk = codec.get_chunk_size(size)

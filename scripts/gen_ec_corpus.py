@@ -40,6 +40,11 @@ CONFIGS = [
     ("jerasure", "cauchy_orig", 4, 2), ("jerasure", "cauchy_good", 6, 3),
     ("isa", "reed_sol_van", 4, 2), ("isa", "cauchy", 6, 2),
     ("shec", None, 4, 3), ("lrc", None, 4, 2), ("clay", None, 4, 2),
+    # RAID-6 bitmatrix techniques (packet layout; w pinned per technique)
+    ("jerasure", "liberation", 5, 2), ("jerasure", "liberation", 7, 2),
+    ("jerasure", "blaum_roth", 6, 2), ("jerasure", "liber8tion", 8, 2),
+    # flagship bitsliced layout of the jax codec
+    ("jax", "bitsliced", 8, 3), ("jax", "bitsliced", 4, 2),
 ]
 
 
@@ -52,6 +57,16 @@ def profile_for(plugin, technique, k, m):
     if plugin == "lrc":
         prof["l"] = "3"
         prof.pop("technique", None)
+    if technique == "liberation":
+        prof["w"] = "7"
+    elif technique == "blaum_roth":
+        prof["w"] = "6"
+    elif technique == "liber8tion":
+        prof["w"] = "8"
+    elif technique == "bitsliced":
+        # jax codec: default RS technique under the bitsliced layout
+        prof["technique"] = "reed_sol_van"
+        prof["layout"] = "bitsliced"
     return prof
 
 

@@ -164,9 +164,11 @@ def test_profile_validation():
         _codec("jerasure", technique="reed_sol_r6_op", m=3)
     with pytest.raises(ErasureCodeError):
         _codec("jax", k=200, m=100)
-    # liberation family: declared but not implemented -> loud failure
+    # liberation family: implemented as bitmatrix codecs (m=2 only)
+    codec = _codec("jerasure", technique="liberation", k=4, m=2)
+    assert codec.get_chunk_count() == 6
     with pytest.raises(ErasureCodeError):
-        _codec("jerasure", technique="liberation", k=4, m=2)
+        _codec("jerasure", technique="liberation", k=4, m=3)
 
 
 def test_chunk_size_alignment():
