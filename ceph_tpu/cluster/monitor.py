@@ -142,6 +142,61 @@ class Monitor:
                 for (p, g), temp in inc.new_pg_temp.items()},
         }).encode()
 
+    @staticmethod
+    def _inc_from_json(blob: bytes) -> Incremental:
+        import json
+        d = json.loads(blob.decode())
+        return Incremental(
+            epoch=d["epoch"],
+            new_up={int(k): v for k, v in d["new_up"].items()},
+            new_weight={int(k): int(v)
+                        for k, v in d["new_weight"].items()},
+            new_primary_affinity={
+                int(k): int(v)
+                for k, v in d["new_primary_affinity"].items()},
+            new_pg_upmap_items={
+                (int(s.split(".")[0]), int(s.split(".")[1])): items
+                for s, items in d["new_pg_upmap_items"].items()},
+            new_pg_temp={
+                (int(s.split(".")[0]), int(s.split(".")[1])): temp
+                for s, temp in d["new_pg_temp"].items()},
+        )
+
+    @classmethod
+    def open(cls, base_osdmap: OSDMap, db, n_ranks: int = 3,
+             failure_reports_needed: int = 2) -> "Monitor":
+        """Mount a monitor from its durable store: replay every
+        committed osdmap incremental beyond the base map's epoch and
+        reload the config db (MonitorDBStore recovery,
+        src/mon/MonitorDBStore.h + Monitor::preinit's map load)."""
+        import json
+        mon = cls(base_osdmap, n_ranks=n_ranks,
+                  failure_reports_needed=failure_reports_needed, db=db)
+        for _, blob in db.iterate("osdmap"):
+            inc = cls._inc_from_json(blob)
+            if inc.epoch <= base_osdmap.epoch:
+                continue                    # already in the base map
+            if inc.epoch != base_osdmap.epoch + 1:
+                raise ValueError(
+                    f"mon store gap: incremental epoch {inc.epoch} "
+                    f"against map epoch {base_osdmap.epoch} — wrong "
+                    "base map for this store")
+            base_osdmap.apply_incremental(inc)
+            mon.incrementals.append(inc)
+        for key, blob in db.iterate("config"):
+            value = json.loads(blob.decode())
+            mon.config_db[key] = value
+            try:
+                config().set(key, value, level=LEVEL_FILE)
+            except OptionError:
+                pass
+        # consensus log resumes after the highest committed version
+        # (decree payloads are not re-read; markers hold the positions)
+        versions = db.keys("paxos")
+        if versions:
+            mon.paxos.committed = [("recovered",)] * int(versions[-1])
+        return mon
+
     # ------------------------------------------------------- map service --
     def commit_incremental(self, inc: Incremental) -> bool:
         """Propose a map mutation through consensus, then apply.

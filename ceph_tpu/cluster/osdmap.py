@@ -405,27 +405,58 @@ class OSDMap:
                 row[:len(up_i)] = up_i
                 up[i] = row
         if pool.can_shift_osds():
-            # compact NONE holes leftward, preserving order
-            out = np.full_like(up, ITEM_NONE)
-            for i in range(N):   # vectorized enough for control use
-                vals = up[i][up[i] != ITEM_NONE]
-                out[i, :len(vals)] = vals
-            up = out
+            # compact NONE holes leftward, preserving order: a stable
+            # argsort on the hole mask is the whole permutation
+            order = np.argsort(up == ITEM_NONE, axis=1, kind="stable")
+            up = np.take_along_axis(up, order, axis=1)
         # primary: first non-NONE (affinity overlay for the non-default case)
         primary = np.full(N, -1, dtype=np.int64)
         has = (up != ITEM_NONE)
         anyrow = has.any(axis=1)
         primary[anyrow] = up[anyrow, has[anyrow].argmax(axis=1)]
         if np.any(self.osd_primary_affinity != MAX_PRIMARY_AFFINITY):
-            for i in range(N):
-                u, p = self._apply_primary_affinity(
-                    int(pps[i]), pool, [int(v) for v in up[i]],
-                    int(primary[i]))
-                row = np.full(R, ITEM_NONE, dtype=np.int64)
-                row[:len(u)] = u
-                up[i] = row
-                primary[i] = p
+            up, primary = self._apply_primary_affinity_batch(
+                pool, pps, up, primary)
         return up.astype(np.int32), primary.astype(np.int32)
+
+    def _apply_primary_affinity_batch(self, pool: PGPool, pps, up, primary):
+        """Array form of _apply_primary_affinity (OSDMap.cc:2537-2590):
+        position-ordered scan becomes accept/reject masks + one gather.
+
+        Scalar semantics per row: walking non-NONE entries left to
+        right, an entry with affinity a < MAX is REJECTED when
+        hash(pps, osd) >> 16 >= a; the first accepted entry becomes
+        primary (breaking the scan), else the first rejected one; for
+        shifting pools the winner rotates to the front."""
+        from ..ops import hashing
+        N, R = up.shape
+        valid = up != ITEM_NONE
+        ids = np.clip(up, 0, self.max_osd - 1)
+        aff = np.where(valid, self.osd_primary_affinity[ids],
+                       MAX_PRIMARY_AFFINITY).astype(np.int64)
+        h = hashing.np_hash2(
+            np.broadcast_to(np.asarray(pps, dtype=np.uint32)[:, None],
+                            (N, R)),
+            ids.astype(np.uint32)).astype(np.int64) >> 16
+        rejected = valid & (aff < MAX_PRIMARY_AFFINITY) & (h >= aff)
+        accepted = valid & ~rejected
+        any_acc = accepted.any(axis=1)
+        any_rej = rejected.any(axis=1)
+        first_acc = accepted.argmax(axis=1)
+        first_rej = rejected.argmax(axis=1)
+        pos = np.where(any_acc, first_acc,
+                       np.where(any_rej, first_rej, -1))
+        rows = np.arange(N)
+        picked = pos >= 0
+        primary = np.where(picked, up[rows, np.maximum(pos, 0)], primary)
+        if pool.can_shift_osds():
+            # rotate the winner to the front of each picked row
+            idx = np.broadcast_to(np.arange(R), (N, R)).copy()
+            p = np.maximum(pos, 0)[:, None]
+            src = np.where(idx == 0, p, np.where(idx <= p, idx - 1, idx))
+            rotated = np.take_along_axis(up, src, axis=1)
+            up = np.where((picked & (pos > 0))[:, None], rotated, up)
+        return up, primary
 
     # ---------------------------------------------------------- analytics --
     def pg_counts_per_osd(self, pool_ids: Optional[Sequence[int]] = None

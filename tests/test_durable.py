@@ -1,0 +1,343 @@
+"""Durable tier: WalDB + FileStore crash consistency.
+
+The VERDICT r2 Missing-#1 contract: crash (including kill -9) at any
+point leaves both stores mountable with exactly the committed batches,
+fsck clean, zero loss of acknowledged writes.  Reference roles:
+RocksDBStore WAL (src/kv/RocksDBStore.cc), MonitorDBStore
+(src/mon/MonitorDBStore.h), BlueStore fsck/csum
+(src/os/bluestore/BlueStore.cc).
+"""
+import os
+import signal
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+from ceph_tpu.cluster.filestore import FileStore
+from ceph_tpu.cluster.kv import WriteBatch
+from ceph_tpu.cluster.objectstore import (ChecksumError, ObjectStoreError,
+                                          Transaction)
+from ceph_tpu.cluster.wal_kv import WalDB
+
+
+# ------------------------------------------------------------------ WalDB --
+
+def test_waldb_basic_persistence(tmp_path):
+    p = str(tmp_path / "kv")
+    db = WalDB(p, fsync=False)
+    db.submit(WriteBatch().set("a", "k1", b"v1").set("b", "k2", b"v2"))
+    db.submit(WriteBatch().rm("a", "k1").set("a", "k3", b"v3"))
+    db.close()
+    db2 = WalDB(p, fsync=False)
+    assert db2.get("a", "k1") is None
+    assert db2.get("b", "k2") == b"v2"
+    assert db2.get("a", "k3") == b"v3"
+    assert db2.keys("a") == ["k3"]
+
+
+def test_waldb_torn_tail_discarded(tmp_path):
+    p = str(tmp_path / "kv")
+    db = WalDB(p, fsync=False)
+    db.submit(WriteBatch().set("p", "good", b"yes"))
+    db.close()
+    # simulate a crash mid-append: garbage partial record at the tail
+    with open(os.path.join(p, "wal.log"), "ab") as f:
+        f.write(b"\x31\x4c\x41\x57" + b"partial-record-no-crc")
+    db2 = WalDB(p, fsync=False)
+    assert db2.get("p", "good") == b"yes"
+    # the store keeps working after tail truncation
+    db2.submit(WriteBatch().set("p", "more", b"data"))
+    db2.close()
+    db3 = WalDB(p, fsync=False)
+    assert db3.get("p", "more") == b"data"
+
+
+def test_waldb_batch_atomicity_in_log(tmp_path):
+    """A batch is one WAL record: either every op replays or none."""
+    p = str(tmp_path / "kv")
+    db = WalDB(p, fsync=False)
+    db.submit(WriteBatch().set("x", "a", b"1"))
+    db.close()
+    wal = os.path.join(p, "wal.log")
+    size_one = os.path.getsize(wal)
+    db = WalDB(p, fsync=False)
+    db.submit(WriteBatch().set("x", "b", b"2").set("x", "c", b"3"))
+    db.close()
+    # cut the second record in half
+    with open(wal, "r+b") as f:
+        f.truncate(size_one + (os.path.getsize(wal) - size_one) // 2)
+    db2 = WalDB(p, fsync=False)
+    assert db2.get("x", "a") == b"1"
+    assert db2.get("x", "b") is None and db2.get("x", "c") is None
+
+
+def test_waldb_compaction_preserves_state(tmp_path):
+    p = str(tmp_path / "kv")
+    db = WalDB(p, fsync=False, compact_bytes=1 << 10)
+    for i in range(200):
+        db.submit(WriteBatch().set("n", f"k{i:04d}", bytes([i % 256]) * 50))
+    db.submit(WriteBatch().rm("n", "k0000"))
+    db.close()
+    db2 = WalDB(p, fsync=False)
+    assert db2.get("n", "k0000") is None
+    assert db2.get("n", "k0199") == bytes([199]) * 50
+    assert len(db2.keys("n")) == 199
+    # compaction actually ran (wal restarted small)
+    assert os.path.getsize(os.path.join(p, "wal.log")) < (1 << 11)
+
+
+def test_waldb_rm_prefix_replay(tmp_path):
+    p = str(tmp_path / "kv")
+    db = WalDB(p, fsync=False)
+    db.submit(WriteBatch().set("a", "1", b"x").set("b", "1", b"y"))
+    db.submit(WriteBatch().rm_prefix("a"))
+    db.close()
+    db2 = WalDB(p, fsync=False)
+    assert db2.keys("a") == [] and db2.keys("b") == ["1"]
+
+
+# --------------------------------------------------------------- FileStore --
+
+def test_filestore_basic_roundtrip(tmp_path):
+    p = str(tmp_path / "store")
+    fs = FileStore(p, fsync=False)
+    txn = Transaction()
+    txn.write((1, 0), "obj1", 0, b"hello world")
+    txn.setattr((1, 0), "obj1", "ver", b"1")
+    txn.omap_set((1, 0), "obj1", "snap", b"0")
+    fs.apply_transaction(txn)
+    fs.close()
+    fs2 = FileStore(p, fsync=False)
+    assert fs2.read((1, 0), "obj1") == b"hello world"
+    assert fs2.getattr((1, 0), "obj1", "ver") == b"1"
+    assert fs2.omap_get((1, 0), "obj1", "snap") == b"0"
+    assert fs2.list_objects((1, 0)) == ["obj1"]
+    assert fs2.list_collections() == [(1, 0)]
+    assert fs2.fsck() == []
+    fs2.close()
+
+
+def test_filestore_partial_writes_overlay(tmp_path):
+    fs = FileStore(str(tmp_path / "s"), fsync=False)
+    rng = np.random.default_rng(3)
+    ref = bytearray(1000)
+    fs.apply_transaction(Transaction().write((1, 1), "o", 0, bytes(1000)))
+    for _ in range(30):
+        off = int(rng.integers(0, 900))
+        ln = int(rng.integers(1, 100))
+        data = bytes(rng.integers(0, 256, ln, dtype=np.uint8))
+        ref[off:off + ln] = data
+        fs.apply_transaction(Transaction().write((1, 1), "o", off, data))
+    assert fs.read((1, 1), "o") == bytes(ref)
+    # extent chains were compacted along the way
+    assert len(fs._get_meta((1, 1), "o").extents) <= fs.compact_extents + 1
+    assert fs.read((1, 1), "o", 100, 50) == bytes(ref[100:150])
+    fs.close()
+
+
+def test_filestore_truncate_remove_write_full(tmp_path):
+    fs = FileStore(str(tmp_path / "s"), fsync=False)
+    c = (2, 3)
+    fs.apply_transaction(Transaction().write(c, "o", 0, b"x" * 100))
+    fs.apply_transaction(Transaction().truncate(c, "o", 40))
+    assert fs.read(c, "o") == b"x" * 40
+    fs.apply_transaction(Transaction().truncate(c, "o", 60))
+    assert fs.read(c, "o") == b"x" * 40 + b"\0" * 20
+    fs.apply_transaction(Transaction().write_full(c, "o", b"new"))
+    assert fs.read(c, "o") == b"new"
+    fs.apply_transaction(Transaction().remove(c, "o"))
+    assert not fs.exists(c, "o")
+    with pytest.raises(ObjectStoreError):
+        fs.read(c, "o")
+    fs.close()
+
+
+def test_filestore_txn_rollback_on_invalid_op(tmp_path):
+    """A failing op aborts the WHOLE transaction (nothing hits disk)."""
+    fs = FileStore(str(tmp_path / "s"), fsync=False)
+    c = (1, 0)
+    fs.apply_transaction(Transaction().write(c, "keep", 0, b"base"))
+    txn = Transaction()
+    txn.write(c, "keep", 0, b"MUTATED")
+    txn.truncate(c, "missing", 10)       # invalid: no such object
+    with pytest.raises(ObjectStoreError):
+        fs.apply_transaction(txn)
+    assert fs.read(c, "keep") == b"base"
+    fs.close()
+
+
+def test_filestore_corruption_detected(tmp_path):
+    fs = FileStore(str(tmp_path / "s"), fsync=False)
+    c = (1, 0)
+    fs.apply_transaction(Transaction().write(c, "o", 0, b"A" * 256))
+    fs.corrupt(c, "o", offset=17)
+    with pytest.raises(ChecksumError):
+        fs.read(c, "o")
+    assert fs.fsck() == [(c, "o")]
+    fs.close()
+
+
+_CRASH_CHILD = textwrap.dedent("""
+    import os, sys, signal
+    sys.path.insert(0, {repo!r})
+    from ceph_tpu.cluster.filestore import FileStore
+    from ceph_tpu.cluster.objectstore import Transaction
+    fs = FileStore({path!r}, fsync=True, fsck_on_mount=False)
+    i = 0
+    while True:
+        txn = Transaction()
+        txn.write((1, 0), f"obj{{i % 7}}", (i % 13) * 64,
+                  bytes([i % 256]) * 256)
+        txn.omap_set((1, 0), f"obj{{i % 7}}", "last", str(i).encode()) \\
+            if i % 3 == 0 and i > 0 else txn.touch((1, 0), f"obj{{i % 7}}")
+        fs.apply_transaction(txn)
+        print(i, flush=True)          # ack AFTER the commit returned
+        i += 1
+""")
+
+
+def test_filestore_survives_kill9(tmp_path):
+    """kill -9 mid-write-storm: remount sees every ACKNOWLEDGED txn,
+    fsck is clean, and the store keeps serving writes — the crash
+    contract MemStore could never provide."""
+    path = str(tmp_path / "crash_store")
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.Popen(
+        [sys.executable, "-c",
+         _CRASH_CHILD.format(repo=repo, path=path)],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True)
+    acked = -1
+    for line in proc.stdout:
+        acked = int(line.strip())
+        if acked >= 25:
+            break
+    os.kill(proc.pid, signal.SIGKILL)
+    proc.wait()
+    assert acked >= 25
+    fs = FileStore(path, fsync=True)          # fsck_on_mount=True default
+    # every acknowledged transaction must be present: replay the child's
+    # write pattern and check the final acknowledged state per object
+    for i in range(acked + 1):
+        oid = f"obj{i % 7}"
+        assert fs.exists((1, 0), oid), (i, oid)
+    # the highest acked write to each object is intact
+    by_obj = {}
+    for i in range(acked + 1):
+        by_obj[f"obj{i % 7}"] = i
+    for oid, i in by_obj.items():
+        off = (i % 13) * 64
+        got = fs.read((1, 0), oid, off, 256)
+        assert got == bytes([i % 256]) * 256, (oid, i)
+    assert fs.fsck() == []
+    # still writable after the crash
+    fs.apply_transaction(Transaction().write((1, 0), "post", 0, b"ok"))
+    assert fs.read((1, 0), "post") == b"ok"
+    fs.close()
+
+
+def test_waldb_survives_kill9(tmp_path):
+    """Same contract for the raw KV (the mon store's seam)."""
+    path = str(tmp_path / "crash_kv")
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    child = textwrap.dedent(f"""
+        import sys
+        sys.path.insert(0, {repo!r})
+        from ceph_tpu.cluster.wal_kv import WalDB
+        from ceph_tpu.cluster.kv import WriteBatch
+        db = WalDB({path!r}, fsync=True, compact_bytes=1 << 14)
+        i = 0
+        while True:
+            db.submit(WriteBatch().set("epoch", f"e{{i:06d}}",
+                                       str(i).encode() * 20))
+            print(i, flush=True)
+            i += 1
+    """)
+    proc = subprocess.Popen([sys.executable, "-c", child],
+                            stdout=subprocess.PIPE,
+                            stderr=subprocess.DEVNULL, text=True)
+    acked = -1
+    for line in proc.stdout:
+        acked = int(line.strip())
+        if acked >= 60:                  # crosses >=1 compaction
+            break
+    os.kill(proc.pid, signal.SIGKILL)
+    proc.wait()
+    assert acked >= 60
+    db = WalDB(path, fsync=True)
+    for i in range(acked + 1):
+        assert db.get("epoch", f"e{i:06d}") == str(i).encode() * 20, i
+    db.close()
+
+
+# ------------------------------------------------------- durable monitor --
+
+def test_monitor_state_survives_restart(tmp_path):
+    """Mon commits map epochs + config into WalDB; a fresh process
+    mounts the store and recovers the same cluster state
+    (MonitorDBStore role, src/mon/MonitorDBStore.h)."""
+    from ceph_tpu.cluster.monitor import Monitor
+    from ceph_tpu.cluster.osdmap import OSDMap, PGPool, POOL_REPLICATED
+    from ceph_tpu.placement.builder import build_flat_cluster
+    from ceph_tpu.placement.crush_map import (
+        RULE_CHOOSELEAF_FIRSTN, RULE_EMIT, RULE_TAKE, Rule)
+    from ceph_tpu.placement.builder import TYPE_HOST
+
+    def base_map():
+        cmap, root = build_flat_cluster(n_hosts=4, osds_per_host=2)
+        cmap.add_rule(Rule(steps=[(RULE_TAKE, root, 0),
+                                  (RULE_CHOOSELEAF_FIRSTN, 0, TYPE_HOST),
+                                  (RULE_EMIT, 0, 0)]))
+        m = OSDMap(cmap)
+        m.mark_all_in_up()
+        m.add_pool(PGPool(id=1, name="p", type=POOL_REPLICATED, size=3,
+                          pg_num=16, crush_rule=0))
+        return m
+
+    p = str(tmp_path / "monstore")
+    db = WalDB(p, fsync=False)
+    mon = Monitor(base_map(), db=db)
+    inc = mon.next_incremental()
+    inc.new_up[3] = False
+    assert mon.commit_incremental(inc)
+    inc2 = mon.next_incremental()
+    inc2.new_weight[5] = 0
+    assert mon.commit_incremental(inc2)
+    assert mon.config_set("fastmap_extra_tries", 6)
+    epoch_before = mon.osdmap.epoch
+    up_before, prim_before = mon.osdmap.map_pgs_batch(1)
+    db.close()
+
+    db2 = WalDB(p, fsync=False)
+    mon2 = Monitor.open(base_map(), db2)
+    assert mon2.osdmap.epoch == epoch_before
+    assert not mon2.osdmap.osd_up[3]
+    assert mon2.osdmap.osd_weight[5] == 0
+    assert mon2.config_get("fastmap_extra_tries") == 6
+    assert mon2.paxos.version >= 3
+    up_after, prim_after = mon2.osdmap.map_pgs_batch(1)
+    assert (up_before == up_after).all()
+    assert (prim_before == prim_after).all()
+    db2.close()
+
+
+def test_filestore_remove_kills_same_txn_rows(tmp_path):
+    """setattr/omap_set staged earlier in the SAME txn must die with a
+    later remove — no phantom metadata on recreation."""
+    fs = FileStore(str(tmp_path / "s"), fsync=False)
+    c = (1, 0)
+    txn = Transaction()
+    txn.write(c, "o", 0, b"x")
+    txn.setattr(c, "o", "k", b"phantom")
+    txn.omap_set(c, "o", "mk", b"phantom2")
+    txn.remove(c, "o")
+    fs.apply_transaction(txn)
+    assert not fs.exists(c, "o")
+    assert fs.kv.get("xattr", "1.0/o\x00k") is None
+    assert fs.kv.get("omap", "1.0/o\x00mk") is None
+    fs.apply_transaction(Transaction().write(c, "o", 0, b"fresh"))
+    with pytest.raises(KeyError):
+        fs.getattr(c, "o", "k")
+    fs.close()

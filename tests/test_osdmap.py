@@ -191,3 +191,22 @@ def test_pg_counts_balance():
     counts = m.pg_counts_per_osd([1])
     assert counts.sum() == 256 * 3
     assert counts.min() > 0
+
+
+def test_primary_affinity_mixed_batch_matches_scalar():
+    """Randomized mixed affinities + down OSDs: the vectorized
+    accept/reject/rotate path must equal the scalar walk on both pool
+    families (replicated shifts holes, EC keeps them)."""
+    import numpy as np
+    m = make_osdmap()
+    rng = np.random.default_rng(31)
+    m.osd_primary_affinity[:] = rng.integers(
+        0, MAX_PRIMARY_AFFINITY + 1, size=m.max_osd)
+    for o in rng.choice(m.max_osd, size=3, replace=False):
+        m.osd_up[o] = False
+    for pid in (1, 2):
+        up_b, prim_b = m.map_pgs_batch(pid)
+        for ps in range(m.pools[pid].pg_num):
+            up, upp, _, _ = m.pg_to_up_acting_osds(pid, ps)
+            assert list(up_b[ps][:len(up)]) == up, (pid, ps)
+            assert prim_b[ps] == upp, (pid, ps)
