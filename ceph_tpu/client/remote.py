@@ -1,0 +1,237 @@
+"""RemoteCluster — client for the process cluster (librados-over-wire).
+
+Connects to the mon with the client keyring (cephx secret mode), pulls
+the cluster map (crush text recompiled through the CrushCompiler — the
+same map the daemons trust), computes placement locally with the real
+CRUSH pipeline, obtains per-OSD tickets, and performs object I/O
+against the OSD daemons:
+
+  * replicated pools: PUT goes to the PRIMARY, which persists locally
+    and fans out to its replicas daemon-to-daemon (the
+    ReplicatedBackend shape); GET walks the up set.
+  * EC pools: the client is the TPU-attached primary — stripes are
+    encoded on device, shards written per OSD; reads gather
+    minimum_to_decode shards and decode on device
+    (the ECBackend primary role).
+
+Map refreshes on epoch bump; op failures trigger a refresh + retry
+(the Objecter resend-on-map-change contract).
+"""
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..common import auth as cx
+from ..cluster.daemon import WireClient
+from ..cluster.osdmap import OSDMap, PGPool, POOL_ERASURE
+from ..ec import instance as ec_registry
+from ..ops import hashing
+from ..placement.compiler import compile_crushmap
+from ..placement.crush_map import ITEM_NONE
+
+
+class RemoteCluster:
+    def __init__(self, cluster_dir: str, entity: str = "client.admin",
+                 ec_profiles: Optional[Dict[str, Dict[str, str]]] = None):
+        self.dir = cluster_dir
+        self.entity = entity
+        ring = cx.Keyring.load(os.path.join(cluster_dir,
+                                            "keyring.client"))
+        self.secret = ring.secret(entity)
+        self.mon = WireClient(os.path.join(cluster_dir, "mon.sock"),
+                              entity, secret=self.secret)
+        self._osd_clients: Dict[int, WireClient] = {}
+        self.ec_profiles = ec_profiles or {}
+        self._codecs: Dict[int, object] = {}
+        self.refresh_map()
+
+    # ---------------------------------------------------------------- map --
+    def refresh_map(self) -> None:
+        blob = self.mon.call({"cmd": "get_map"})
+        cmap = compile_crushmap(blob["crush_text"])
+        m = OSDMap(cmap, epoch=blob["epoch"])
+        m.mark_all_in_up()
+        for i, up in enumerate(blob["osd_up"]):
+            m.osd_up[i] = up
+        for i, w in enumerate(blob["osd_weight"]):
+            m.osd_weight[i] = w
+        for p in blob["pools"]:
+            m.add_pool(PGPool(**p))
+        self.osdmap = m
+        self.addrs = {int(k): v for k, v in blob["addrs"].items()}
+
+    def osd_client(self, osd: int) -> WireClient:
+        c = self._osd_clients.get(osd)
+        if c is not None:
+            return c
+        grant = self.mon.call({"cmd": "get_ticket",
+                               "service": f"osd.{osd}"})
+        key = cx.open_key_box(self.secret, grant["key_box"])
+        c = WireClient(self.addrs[osd], self.entity,
+                       ticket=grant["ticket"], session_key=key,
+                       timeout=10.0)
+        self._osd_clients[osd] = c
+        return c
+
+    def drop_osd_client(self, osd: int) -> None:
+        c = self._osd_clients.pop(osd, None)
+        if c:
+            c.close()
+
+    # ---------------------------------------------------------- placement --
+    def _pg_for(self, pool: PGPool, name: str) -> int:
+        """object -> pg (the ceph_stable_mod hash pipeline, same as the
+        in-process simulator so placements agree)."""
+        ps = hashing.str_hash_rjenkins(name.encode())
+        return pool.raw_pg_to_pg(ps)
+
+    def _up(self, pool: PGPool, pg: int) -> List[int]:
+        up, _, acting, _ = self.osdmap.pg_to_up_acting_osds(pool.id, pg)
+        return acting or up
+
+    def codec_for(self, pool: PGPool):
+        codec = self._codecs.get(pool.id)
+        if codec is None:
+            prof = self.ec_profiles.get(pool.erasure_code_profile,
+                                        {"plugin": "jax", "k": "4",
+                                         "m": "2"})
+            plugin = prof.get("plugin", "jax")
+            codec = ec_registry().factory(plugin, dict(prof))
+            self._codecs[pool.id] = codec
+        return codec
+
+    # ----------------------------------------------------------------- IO --
+    def put(self, pool_id: int, name: str, data: bytes) -> int:
+        """Returns the number of shard/replica writes acknowledged."""
+        pool = self.osdmap.pools[pool_id]
+        pg = self._pg_for(pool, name)
+        up = self._up(pool, pg)
+        coll = [pool_id, pg]
+        if pool.type != POOL_ERASURE:
+            replicas = [o for o in up if o != ITEM_NONE]
+            if not replicas:
+                raise IOError(f"{name}: no live replica target")
+            primary = replicas[0]
+            try:
+                r = self.osd_client(primary).call({
+                    "cmd": "put_object", "coll": coll,
+                    "oid": f"0:{name}", "data": data,
+                    "replicas": replicas})
+                return int(r["acks"])
+            except (OSError, IOError):
+                self.drop_osd_client(primary)
+                raise
+        codec = self.codec_for(pool)
+        k = codec.get_data_chunk_count()
+        n = codec.get_chunk_count()
+        chunks = codec.encode(set(range(n)), data)
+        acks = 0
+        for shard in range(n):
+            tgt = up[shard] if shard < len(up) else ITEM_NONE
+            if tgt == ITEM_NONE:
+                continue
+            try:
+                self.osd_client(tgt).call({
+                    "cmd": "put_shard", "coll": coll,
+                    "oid": f"{shard}:{name}",
+                    "data": np.asarray(chunks[shard]).tobytes()})
+                acks += 1
+            except (OSError, IOError):
+                self.drop_osd_client(tgt)
+        if acks < k:
+            raise IOError(f"{name}: only {acks} shards stored (< k={k})")
+        self._sizes = getattr(self, "_sizes", {})
+        self._sizes[(pool_id, name)] = len(data)
+        return acks
+
+    def get(self, pool_id: int, name: str,
+            size: Optional[int] = None) -> bytes:
+        pool = self.osdmap.pools[pool_id]
+        pg = self._pg_for(pool, name)
+        up = self._up(pool, pg)
+        coll = [pool_id, pg]
+        if pool.type != POOL_ERASURE:
+            last_err = None
+            for o in [x for x in up if x != ITEM_NONE] + \
+                    [x for x in self.addrs if x not in up]:
+                try:
+                    data = self.osd_client(o).call({
+                        "cmd": "get_shard", "coll": coll,
+                        "oid": f"0:{name}"})
+                except (OSError, IOError) as e:
+                    self.drop_osd_client(o)
+                    last_err = e
+                    continue
+                if data is not None:
+                    return data
+            raise IOError(f"{name}: no replica served ({last_err})")
+        codec = self.codec_for(pool)
+        k, n = codec.get_data_chunk_count(), codec.get_chunk_count()
+        shards: Dict[int, bytes] = {}
+        for shard in range(n):
+            srcs = [up[shard]] if shard < len(up) and \
+                up[shard] != ITEM_NONE else []
+            srcs += [o for o in self.addrs if o not in srcs]
+            for o in srcs:
+                try:
+                    d = self.osd_client(o).call({
+                        "cmd": "get_shard", "coll": coll,
+                        "oid": f"{shard}:{name}"})
+                except (OSError, IOError):
+                    self.drop_osd_client(o)
+                    continue
+                if d is not None:
+                    shards[shard] = d
+                    break
+        if len(shards) < k:
+            raise IOError(f"{name}: only {len(shards)} shards (< k)")
+        want = set(range(k))
+        plan = sorted(codec.minimum_to_decode(want, set(shards)))
+        stack = np.stack([np.frombuffer(shards[c], dtype=np.uint8)
+                          for c in plan])
+        missing = sorted(want - set(shards))
+        if missing:
+            dec = np.asarray(codec.decode_chunks(plan, stack, missing))
+        data_chunks = []
+        for c in range(k):
+            if c in shards:
+                data_chunks.append(np.frombuffer(shards[c],
+                                                 dtype=np.uint8))
+            else:
+                data_chunks.append(dec[missing.index(c)])
+        buf = np.concatenate(data_chunks).tobytes()
+        size = size if size is not None else \
+            getattr(self, "_sizes", {}).get((pool_id, name), len(buf))
+        return buf[:size]
+
+    # ------------------------------------------------------------ recovery --
+    def recover_pool(self, pool_id: int) -> Dict[str, int]:
+        """Replicated pools: primary-driven list/pull/push per PG."""
+        pool = self.osdmap.pools[pool_id]
+        totals = {"objects": 0, "copied": 0}
+        for pg in range(pool.pg_num):
+            up = self._up(pool, pg)
+            members = [o for o in up if o != ITEM_NONE]
+            if not members:
+                continue
+            try:
+                r = self.osd_client(members[0]).call({
+                    "cmd": "recover_pg", "coll": [pool_id, pg],
+                    "members": members})
+            except (OSError, IOError):
+                self.drop_osd_client(members[0])
+                continue
+            totals["objects"] += r["objects"]
+            totals["copied"] += r["copied"]
+        return totals
+
+    def status(self) -> Dict:
+        return self.mon.call({"cmd": "status"})
+
+    def close(self) -> None:
+        for c in self._osd_clients.values():
+            c.close()
+        self.mon.close()

@@ -13,8 +13,11 @@ lives only in RAM:
     KV batch is the atomic commit point;
   * mount() rebuilds from disk alone; ``fsck()`` verifies every
     extent's bounds and checksum (fsck-on-mount is the constructor
-    default), and orphan data-log space from a crash between data
-    append and KV commit is reported and reclaimed by compaction.
+    default); orphan data-log space (crashes, overwrites, removes) is
+    reclaimed by generation GC — ``gc_data_log`` rewrites live objects
+    into a fresh log and flips extents + generation pointer in one
+    atomic KV batch (auto-triggered when the log outgrows live data by
+    ``gc_factor``).
 
 Crash model (kill -9 anywhere):
   - crash before data fsync  -> txn absent, store = pre-txn state
@@ -83,21 +86,50 @@ class FileStore:
     """Durable ObjectStore on a directory (data.log + WalDB metadata)."""
 
     def __init__(self, path: str, *, fsync: bool = True,
-                 compact_extents: int = 16, fsck_on_mount: bool = True):
+                 compact_extents: int = 16, fsck_on_mount: bool = True,
+                 gc_factor: int = 4, gc_min_bytes: int = 1 << 22):
         self.path = path
         self.fsync = fsync
         self.compact_extents = compact_extents
+        self.gc_factor = gc_factor
+        self.gc_min_bytes = gc_min_bytes
         os.makedirs(path, exist_ok=True)
         self.kv = WalDB(os.path.join(path, "kv"), fsync=fsync)
-        self._data_path = os.path.join(path, "data.log")
+        # the live data log is generation-named; the current generation
+        # lives in the KV so a GC flips extents AND generation in one
+        # atomic batch (see gc_data_log)
+        gen_blob = self.kv.get("meta", "data_gen")
+        self._gen = int(gen_blob) if gen_blob else 0
+        self._data_path = self._gen_path(self._gen)
         self._data = open(self._data_path, "ab")
         self._rfd = os.open(self._data_path, os.O_RDONLY)
         self._lock = threading.RLock()
         self.txns_applied = 0
+        self._drop_stale_generations()
         if fsck_on_mount:
-            bad = self.fsck()
+            try:
+                bad = self.fsck()
+            except Exception:
+                self.close()
+                raise
             if bad:
-                raise ObjectStoreError(f"fsck on mount: bad objects {bad}")
+                self.close()
+                raise ObjectStoreError(
+                    f"fsck on mount: bad objects {bad}")
+
+    def _gen_path(self, gen: int) -> str:
+        return os.path.join(self.path, f"data.{gen}.log")
+
+    def _drop_stale_generations(self) -> None:
+        """Crash leftovers: a half-written next-gen log whose KV flip
+        never committed, or a previous-gen log already superseded."""
+        for name in os.listdir(self.path):
+            if name.startswith("data.") and name.endswith(".log") and \
+                    name != f"data.{self._gen}.log":
+                try:
+                    os.unlink(os.path.join(self.path, name))
+                except OSError:
+                    pass
 
     # ---------------------------------------------------------- data log --
     def _append_data(self, payloads: List[bytes]) -> List[Tuple[int, int]]:
@@ -134,8 +166,6 @@ class FileStore:
             omaps: Dict[Tuple[Coll, str, str], Optional[bytes]] = {}
             touched_colls: List[Coll] = []
             payloads: List[bytes] = []          # pending data-log appends
-            pending: List[Tuple[Tuple[Coll, str], int, int]] = []
-            # (objkey, payload index, obj_off) to fix up after append
 
             def stage(coll: Coll, oid: str, create: bool) -> Optional[_Meta]:
                 key = (coll, oid)
@@ -184,7 +214,13 @@ class FileStore:
                     else:
                         o.size = max(o.size, offset + len(data))
                     if len(data):
-                        pending.append(((coll, oid), len(payloads), offset))
+                        # placeholder extent (log_off = -1-payload_idx)
+                        # so later same-txn ops (truncate clips,
+                        # write_full resets) see this write; patched to
+                        # the real log offset after the append below
+                        o.extents.append((offset, len(data),
+                                          -1 - len(payloads), 0,
+                                          len(data)))
                         payloads.append(bytes(data))
                 elif kind == OP_TRUNCATE:
                     _, coll, oid, size = op
@@ -236,15 +272,18 @@ class FileStore:
                 else:
                     raise ObjectStoreError(f"unknown txn op {kind!r}")
 
-            # object-level compaction: overlong extent chains rewrite as
-            # one payload (reads the CURRENT committed bytes + staged)
+            # append all payloads, then patch surviving placeholders
+            # (placeholders dropped by remove/write_full/truncate simply
+            # leave orphan log bytes, reclaimed by gc)
             spans = self._append_data(payloads) if payloads else []
-            for (key, pidx, obj_off) in pending:
-                o = staged[key]
-                if o is not None:
-                    off, crc = spans[pidx]
-                    ln = len(payloads[pidx])
-                    o.extents.append((obj_off, ln, off, crc, ln))
+            for o in staged.values():
+                if o is None:
+                    continue
+                o.extents = [
+                    (obj_off, vlen, *spans[-1 - log_off], plen)
+                    if log_off < 0 else
+                    (obj_off, vlen, log_off, crc, plen)
+                    for (obj_off, vlen, log_off, crc, plen) in o.extents]
             batch = WriteBatch()
             for (coll, oid), o in staged.items():
                 if o is None:
@@ -268,6 +307,59 @@ class FileStore:
                     else batch.rm("omap", kk)
             self.kv.submit(batch)               # atomic commit point
             self.txns_applied += 1
+            self._maybe_gc()
+
+    # ---------------------------------------------------------------- gc --
+    def _maybe_gc(self) -> None:
+        """Reclaim orphaned log space when the log outgrows the live
+        data by gc_factor (checked cheaply on size only)."""
+        size = self._data.tell()
+        if size < self.gc_min_bytes:
+            return
+        live = 0
+        for _k, blob in self.kv.iterate("obj"):
+            live += _Meta.decode(blob).size
+        if size > self.gc_factor * max(live, 1):
+            self.gc_data_log()
+
+    def gc_data_log(self) -> int:
+        """Rewrite every live object contiguously into a NEW generation
+        data log; extents and the generation pointer flip in ONE KV
+        batch, so a crash at any instruction leaves a consistent store
+        (old gen + old extents, or new gen + new extents; stray files
+        are dropped on mount).  Returns bytes reclaimed."""
+        with self._lock:
+            old_size = self._data.tell()
+            new_gen = self._gen + 1
+            new_path = self._gen_path(new_gen)
+            batch = WriteBatch()
+            with open(new_path, "wb") as f:
+                for k, blob in self.kv.iterate("obj"):
+                    m = _Meta.decode(blob)
+                    data = bytes(self._materialize(m))
+                    off = f.tell()
+                    f.write(data)
+                    m.extents = [(0, m.size, off, zlib.crc32(data),
+                                  m.size)] if m.size else []
+                    batch.set("obj", k, m.encode())
+                f.flush()
+                if self.fsync:
+                    os.fsync(f.fileno())
+                new_size = f.tell()
+            batch.set("meta", "data_gen", str(new_gen).encode())
+            self.kv.submit(batch)               # the atomic flip
+            self._data.close()
+            os.close(self._rfd)
+            old_path = self._data_path
+            self._gen = new_gen
+            self._data_path = new_path
+            self._data = open(new_path, "ab")
+            self._rfd = os.open(new_path, os.O_RDONLY)
+            try:
+                os.unlink(old_path)
+            except OSError:
+                pass
+            return max(0, old_size - new_size)
 
     def _materialize(self, meta: _Meta) -> bytearray:
         data = bytearray(meta.size)

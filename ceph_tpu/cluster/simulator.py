@@ -141,6 +141,16 @@ class ClusterSim:
     def __init__(self, osdmap: OSDMap):
         self.osdmap = osdmap
         self.osds = [SimOSD(i) for i in range(osdmap.max_osd)]
+        # every shard op flows queue -> mClock -> dispatch (the
+        # ms_fast_dispatch/OpScheduler wiring; see osd_service.py);
+        # services stop when the sim is dropped (finalizer) or
+        # shutdown() is called — dispatcher threads must not accumulate
+        # across many sims in one process
+        from .osd_service import OSDService
+        self.services = [OSDService(o) for o in self.osds]
+        import weakref
+        self._finalizer = weakref.finalize(
+            self, ClusterSim._stop_services, self.services)
         self.codecs: Dict[int, object] = {}
         self.objects: Dict[Tuple[int, str], ObjectInfo] = {}
         self.ec_profiles: Dict[str, Dict[str, str]] = {}
@@ -148,6 +158,26 @@ class ClusterSim:
         self._rmw: Dict[int, RmwPipeline] = {}
         # authoritative per-PG op logs (PGLog role)
         self.pg_logs: Dict[Tuple[int, int], PGLog] = {}
+
+    @staticmethod
+    def _stop_services(services) -> None:
+        # signal every dispatcher + close queues first (wakes blocked
+        # pops), then join — teardown stays O(50ms), not O(N * 50ms)
+        for s in services:
+            try:
+                s.dispatcher._stop.set()
+                s.in_q.close()
+            except Exception:
+                pass
+        for s in services:
+            try:
+                s.dispatcher._thread.join(0.5)
+            except Exception:
+                pass
+
+    def shutdown(self) -> None:
+        """Stop dispatcher threads and close queues (idempotent)."""
+        self._finalizer()
 
     def _log(self, pool_id: int, pg: int) -> PGLog:
         log = self.pg_logs.get((pool_id, pg))
@@ -213,9 +243,10 @@ class ClusterSim:
 
     def _read_shard(self, pool_id: int, pg: int, name: str, shard: int,
                     up: List[int]) -> Optional[np.ndarray]:
-        """Up set first, then any live OSD (stale-map/pre-recovery)."""
+        """Up set first, then any live OSD (stale-map/pre-recovery).
+        Reads travel through the OSD's queue/scheduler front end."""
         for o in self._shard_sources(up, shard):
-            p = self.osds[o].get((pool_id, pg, name, shard))
+            p = self.services[o].get((pool_id, pg, name, shard))
             if p is not None:
                 return p
         return None
@@ -234,7 +265,10 @@ class ClusterSim:
                 o.delete((pool_id, pg, name, shard))
             return None
         try:
-            self.osds[tgt].put((pool_id, pg, name, shard), payload)
+            # the op enters through the target's queue -> mClock ->
+            # dispatch (stale-purge sweeps below stay direct: they model
+            # peering-time supersession, not messenger traffic)
+            self.services[tgt].put((pool_id, pg, name, shard), payload)
         except IOError:
             # undetected-dead target: same as homeless — purge stale
             # copies so no older version can be served
@@ -259,7 +293,7 @@ class ClusterSim:
                 if o == ITEM_NONE:
                     continue
                 try:
-                    self.osds[o].put((pool_id, pg, name, 0), payload)
+                    self.services[o].put((pool_id, pg, name, 0), payload)
                 except IOError:
                     continue     # undetected-dead OSD (fail_osd state)
                 placed.append(o)
@@ -347,7 +381,7 @@ class ClusterSim:
             sources = [o for o in up if o != ITEM_NONE] + \
                 [o.id for o in self.osds]
             for o in sources:
-                payload = self.osds[o].get((pool_id, pg, name, 0))
+                payload = self.services[o].get((pool_id, pg, name, 0))
                 if payload is not None:
                     return payload.tobytes()[:info.size]
             raise IOError(f"object {name}: no replica available")
@@ -494,7 +528,8 @@ class ClusterSim:
                 for o in up:
                     if o != ITEM_NONE and self.osds[o].alive and \
                             self.osds[o].get((pool_id, pg, name, 0)) is None:
-                        self.osds[o].put((pool_id, pg, name, 0), payload)
+                        self.services[o].put_recovery(
+                            (pool_id, pg, name, 0), payload)
                         stats["shards_copied"] += 1
             return stats
 
@@ -524,7 +559,8 @@ class ClusterSim:
                 tgt = up[shard] if shard < len(up) else ITEM_NONE
                 if tgt != ITEM_NONE and self.osds[tgt].alive and \
                         self.osds[tgt].get((pool_id, pg, name, shard)) is None:
-                    self.osds[tgt].put((pool_id, pg, name, shard), payload)
+                    self.services[tgt].put_recovery(
+                        (pool_id, pg, name, shard), payload)
                     stats["shards_copied"] += 1
             if not missing:
                 continue
@@ -556,8 +592,8 @@ class ClusterSim:
                     tgt = up[shard] if shard < len(up) else ITEM_NONE
                     if tgt == ITEM_NONE or not self.osds[tgt].alive:
                         continue
-                    self.osds[tgt].put((pool_id, pg, name, shard),
-                                       part[:, i].reshape(-1))
+                    self.services[tgt].put_recovery(
+                        (pool_id, pg, name, shard), part[:, i].reshape(-1))
                     stats["shards_rebuilt"] += 1
         return stats
 
@@ -654,7 +690,8 @@ class ClusterSim:
                     ok = False       # undetected-dead member stays stale
                     continue
                 if self.osds[o].get((pool.id, pg, name, 0)) is None:
-                    self.osds[o].put((pool.id, pg, name, 0), payload)
+                    self.services[o].put_recovery(
+                        (pool.id, pg, name, 0), payload)
                     stats["shards_copied"] += 1
             return ok
         codec = self.codec_for(pool)
@@ -673,7 +710,8 @@ class ClusterSim:
                 if tgt != ITEM_NONE and self.osds[tgt].alive and \
                         self.osds[tgt].get(
                             (pool.id, pg, name, shard)) is None:
-                    self.osds[tgt].put((pool.id, pg, name, shard), f)
+                    self.services[tgt].put_recovery(
+                        (pool.id, pg, name, shard), f)
                     stats["shards_copied"] += 1
         if not missing:
             return True
@@ -691,8 +729,8 @@ class ClusterSim:
             if tgt == ITEM_NONE or not self.osds[tgt].alive:
                 ok = False
                 continue
-            self.osds[tgt].put((pool.id, pg, name, shard),
-                               dec[:, i].reshape(-1))
+            self.services[tgt].put_recovery((pool.id, pg, name, shard),
+                                            dec[:, i].reshape(-1))
             stats["shards_rebuilt"] += 1
         return ok
 

@@ -1,0 +1,82 @@
+"""Socket wire protocol — typed envelopes between daemon processes.
+
+The process-boundary transport of the messenger (the AsyncMessenger /
+Protocol V2 role, src/msg/async/ProtocolV2.cc): length-prefixed,
+CRC-protected frames carrying the same typed envelopes the in-process
+queues move, over unix-domain or TCP sockets.  Kept deliberately small:
+banner exchange, an authentication frame (ceph_tpu.common.auth — the
+cephx handshake role), then framed request/reply.
+
+Frame:  u32 magic | u32 type | u64 id | i32 shard | u32 len |
+        u32 crc(payload) | payload
+Every frame after the auth handshake additionally carries a 32-byte
+HMAC-SHA256 trailer keyed by the session key (Protocol V2's
+per-message authentication role); frames failing the MAC are rejected.
+"""
+from __future__ import annotations
+
+import hmac
+import socket
+import struct
+import zlib
+from typing import Optional
+
+from .queue import Envelope
+
+MAGIC = 0x43455054        # "CEPT"
+BANNER = b"ceph-tpu v1\n"
+_FHDR = struct.Struct("<IIQiII")
+_MAC_LEN = 32
+
+
+class WireError(IOError):
+    pass
+
+
+class WireClosed(WireError):
+    pass
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise WireClosed("peer closed")
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def send_frame(sock: socket.socket, env: Envelope,
+               session_key: Optional[bytes] = None) -> None:
+    payload = env.payload or b""
+    hdr = _FHDR.pack(MAGIC, env.type, env.id, env.shard, len(payload),
+                     zlib.crc32(payload))
+    mac = b""
+    if session_key is not None:
+        mac = hmac.new(session_key, hdr + payload, "sha256").digest()
+    sock.sendall(hdr + payload + mac)
+
+
+def recv_frame(sock: socket.socket,
+               session_key: Optional[bytes] = None) -> Envelope:
+    hdr = _recv_exact(sock, _FHDR.size)
+    magic, typ, mid, shard, ln, crc = _FHDR.unpack(hdr)
+    if magic != MAGIC:
+        raise WireError(f"bad magic {magic:#x}")
+    payload = _recv_exact(sock, ln) if ln else b""
+    if zlib.crc32(payload) != crc:
+        raise WireError("payload crc mismatch")
+    if session_key is not None:
+        mac = _recv_exact(sock, _MAC_LEN)
+        want = hmac.new(session_key, hdr + payload, "sha256").digest()
+        if not hmac.compare_digest(mac, want):
+            raise WireError("frame MAC rejected")
+    return Envelope(typ, mid, shard, payload)
+
+
+def exchange_banners(sock: socket.socket) -> None:
+    sock.sendall(BANNER)
+    got = _recv_exact(sock, len(BANNER))
+    if got != BANNER:
+        raise WireError(f"bad banner {got!r}")

@@ -1,0 +1,170 @@
+"""vstart — dev cluster launcher (the src/vstart.sh role).
+
+Builds a cluster directory (crushmap text, pool spec, cephx keyrings,
+per-daemon durable stores), then launches ONE mon process and N OSD
+processes (``python -m ceph_tpu.cluster.daemon``) talking authenticated
+typed envelopes over unix sockets.  The chaos tier kills these with
+real SIGKILL and restarts them against the same stores.
+
+Usage (also importable as a library — tests drive Vstart directly):
+    python -m ceph_tpu.tools.vstart --dir /tmp/c1 --osds 6 start
+    python -m ceph_tpu.tools.vstart --dir /tmp/c1 status
+    python -m ceph_tpu.tools.vstart --dir /tmp/c1 stop
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+from typing import Dict, List, Optional
+
+from ..common import auth as cx
+
+
+def build_cluster_dir(cluster_dir: str, n_osds: int = 6,
+                      osds_per_host: int = 2,
+                      pools: Optional[List[dict]] = None,
+                      fsync: bool = True) -> None:
+    """Write crushmap.txt, cluster.json and keyrings."""
+    os.makedirs(cluster_dir, exist_ok=True)
+    from ..placement.builder import TYPE_HOST, build_flat_cluster
+    from ..placement.compiler import decompile_crushmap
+    from ..placement.crush_map import (
+        RULE_CHOOSELEAF_FIRSTN, RULE_CHOOSELEAF_INDEP, RULE_EMIT,
+        RULE_TAKE, Rule)
+    n_hosts = -(-n_osds // osds_per_host)
+    cmap, root = build_flat_cluster(n_hosts=n_hosts,
+                                    osds_per_host=osds_per_host)
+    cmap.add_rule(Rule(steps=[(RULE_TAKE, root, 0),
+                              (RULE_CHOOSELEAF_FIRSTN, 0, TYPE_HOST),
+                              (RULE_EMIT, 0, 0)], name="replicated"))
+    cmap.add_rule(Rule(steps=[(RULE_TAKE, root, 0),
+                              (RULE_CHOOSELEAF_INDEP, 0, TYPE_HOST),
+                              (RULE_EMIT, 0, 0)], name="ec"))
+    with open(os.path.join(cluster_dir, "crushmap.txt"), "w") as f:
+        f.write(decompile_crushmap(cmap))
+    if pools is None:
+        pools = [{"id": 1, "name": "rep", "type": 1, "size": 3,
+                  "pg_num": 16, "crush_rule": 0}]
+    json.dump({"pools": pools, "fsync": fsync, "n_osds": n_osds},
+              open(os.path.join(cluster_dir, "cluster.json"), "w"))
+    names = ["mon.", "client.admin"] + [f"osd.{i}" for i in range(n_osds)]
+    ring = cx.Keyring.generate(names)
+    ring.save(os.path.join(cluster_dir, "keyring.mon"))
+    ring.subset("client.admin").save(
+        os.path.join(cluster_dir, "keyring.client"))
+    for i in range(n_osds):
+        ring.subset(f"osd.{i}").save(
+            os.path.join(cluster_dir, f"keyring.osd.{i}"))
+
+
+class Vstart:
+    """Process supervisor for one dev cluster."""
+
+    def __init__(self, cluster_dir: str):
+        self.dir = cluster_dir
+        self.procs: Dict[str, subprocess.Popen] = {}
+
+    def _spawn(self, *args: str) -> subprocess.Popen:
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"      # daemons never touch the TPU
+        return subprocess.Popen(
+            [sys.executable, "-m", "ceph_tpu.cluster.daemon", *args],
+            env=env, stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL,
+            cwd=os.path.dirname(os.path.dirname(
+                os.path.dirname(os.path.abspath(__file__)))))
+
+    def start_mon(self, timeout: float = 30.0) -> None:
+        self.procs["mon"] = self._spawn(
+            "mon", "--cluster-dir", self.dir)
+        self._wait_sock(os.path.join(self.dir, "mon.sock"), timeout)
+
+    def start_osd(self, osd_id: int, timeout: float = 30.0,
+                  hb_interval: float = 0.5) -> None:
+        self.procs[f"osd.{osd_id}"] = self._spawn(
+            "osd", "--cluster-dir", self.dir, "--id", str(osd_id),
+            "--hb-interval", str(hb_interval))
+        self._wait_sock(os.path.join(self.dir, f"osd.{osd_id}.sock"),
+                        timeout)
+
+    @staticmethod
+    def _wait_sock(path: str, timeout: float) -> None:
+        t_end = time.monotonic() + timeout
+        while time.monotonic() < t_end:
+            if os.path.exists(path):
+                return
+            time.sleep(0.05)
+        raise TimeoutError(f"daemon socket {path} never appeared")
+
+    def start(self, n_osds: int, hb_interval: float = 0.5) -> None:
+        self.start_mon()
+        for i in range(n_osds):
+            self.start_osd(i, hb_interval=hb_interval)
+
+    def kill9(self, name: str) -> None:
+        """Real SIGKILL — the Thrasher's kill_osd."""
+        p = self.procs.get(name)
+        if p and p.poll() is None:
+            os.kill(p.pid, signal.SIGKILL)
+            p.wait()
+
+    def stop(self) -> None:
+        for name, p in self.procs.items():
+            if p.poll() is None:
+                p.terminate()
+        for p in self.procs.values():
+            try:
+                p.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                p.kill()
+        self.procs.clear()
+
+    def alive(self, name: str) -> bool:
+        p = self.procs.get(name)
+        return p is not None and p.poll() is None
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="vstart")
+    ap.add_argument("--dir", required=True)
+    ap.add_argument("--osds", type=int, default=6)
+    ap.add_argument("action", choices=["start", "stop", "status"])
+    args = ap.parse_args(argv)
+    if args.action == "start":
+        if not os.path.exists(os.path.join(args.dir, "cluster.json")):
+            build_cluster_dir(args.dir, n_osds=args.osds)
+        v = Vstart(args.dir)
+        v.start(args.osds)
+        pids = {n: p.pid for n, p in v.procs.items()}
+        json.dump(pids, open(os.path.join(args.dir, "pids.json"), "w"))
+        print(json.dumps(pids))
+        # detach: daemons keep running
+        return 0
+    if args.action == "stop":
+        try:
+            pids = json.load(open(os.path.join(args.dir, "pids.json")))
+        except FileNotFoundError:
+            return 0
+        for name, pid in pids.items():
+            try:
+                os.kill(pid, signal.SIGTERM)
+            except ProcessLookupError:
+                pass
+        print("stopped")
+        return 0
+    # status
+    from ..cluster.daemon import WireClient
+    ring = cx.Keyring.load(os.path.join(args.dir, "keyring.client"))
+    mon = WireClient(os.path.join(args.dir, "mon.sock"), "client.admin",
+                     secret=ring.secret("client.admin"))
+    print(json.dumps(mon.call({"cmd": "status"})))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

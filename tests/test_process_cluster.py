@@ -1,0 +1,169 @@
+"""Process-model cluster: real daemons, real SIGKILL, cephx auth.
+
+The VERDICT r2 Missing-#2/#3 contract: a vstart-analog launches mon +
+N OSD *processes* exchanging typed envelopes (authenticated, MAC'd);
+the chaos tier kills >=2 OSD processes with SIGKILL, the mon detects
+the failures through peer heartbeat reports, and restarted daemons
+recover against their durable stores with zero acknowledged-write
+loss.  Reference roles: src/vstart.sh, src/ceph_osd.cc:540-551,
+qa/tasks/ceph_manager.py (Thrasher), src/auth/cephx/CephxProtocol.h.
+"""
+import os
+import time
+
+import numpy as np
+import pytest
+
+from ceph_tpu.common import auth as cx
+from ceph_tpu.tools.vstart import Vstart, build_cluster_dir
+
+N_OSDS = 6
+
+
+@pytest.fixture
+def cluster(tmp_path):
+    d = str(tmp_path / "cluster")
+    build_cluster_dir(d, n_osds=N_OSDS, osds_per_host=2, fsync=False)
+    v = Vstart(d)
+    v.start(N_OSDS, hb_interval=0.25)
+    yield d, v
+    v.stop()
+
+
+def _client(d):
+    from ceph_tpu.client.remote import RemoteCluster
+    return RemoteCluster(d)
+
+
+def test_replicated_io_and_sigkill_recovery(cluster):
+    d, v = cluster
+    rc = _client(d)
+    rng = np.random.default_rng(1)
+    blobs = {f"obj{i}": rng.integers(0, 256, 4000,
+                                     dtype=np.uint8).tobytes()
+             for i in range(12)}
+    for name, data in blobs.items():
+        assert rc.put(1, name, data) >= 2
+    # SIGKILL two OSD processes (the Thrasher kill_osd)
+    v.kill9("osd.1")
+    v.kill9("osd.3")
+    assert not v.alive("osd.1") and not v.alive("osd.3")
+    # peers' heartbeat reports drive the mon to mark them down
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        st = rc.status()
+        if st["n_up"] <= N_OSDS - 2:
+            break
+        time.sleep(0.3)
+    assert rc.status()["n_up"] <= N_OSDS - 2, \
+        "mon never marked SIGKILLed OSDs down"
+    # degraded reads: every object still served
+    rc.refresh_map()
+    for name, data in blobs.items():
+        assert rc.get(1, name) == data
+    # degraded writes keep flowing
+    for i in range(6):
+        assert rc.put(1, f"degraded{i}", blobs["obj0"]) >= 1
+    # restart the killed daemons against their durable stores
+    v.start_osd(1, hb_interval=0.25)
+    v.start_osd(3, hb_interval=0.25)
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        if rc.status()["n_up"] == N_OSDS:
+            break
+        time.sleep(0.3)
+    assert rc.status()["n_up"] == N_OSDS
+    rc.refresh_map()
+    # primary-driven recovery re-replicates everything
+    stats = rc.recover_pool(1)
+    assert stats["objects"] > 0
+    for name, data in blobs.items():
+        assert rc.get(1, name) == data
+    for i in range(6):
+        assert rc.get(1, f"degraded{i}") == blobs["obj0"]
+    rc.close()
+
+
+def test_ec_io_across_processes(tmp_path):
+    d = str(tmp_path / "ec_cluster")
+    build_cluster_dir(
+        d, n_osds=6, osds_per_host=1, fsync=False,
+        pools=[{"id": 1, "name": "rep", "type": 1, "size": 3,
+                "pg_num": 8, "crush_rule": 0},
+               {"id": 2, "name": "ec", "type": 3, "size": 6,
+                "pg_num": 8, "crush_rule": 1,
+                "erasure_code_profile": "default"}])
+    v = Vstart(d)
+    v.start(6, hb_interval=0.25)
+    try:
+        from ceph_tpu.client.remote import RemoteCluster
+        rc = RemoteCluster(d, ec_profiles={
+            "default": {"plugin": "jax", "k": "4", "m": "2",
+                        "layout": "bitsliced"}})
+        rng = np.random.default_rng(2)
+        data = rng.integers(0, 256, 30000, dtype=np.uint8).tobytes()
+        assert rc.put(2, "big", data) == 6
+        assert rc.get(2, "big") == data
+        # kill two shard holders: k=4 survivors still decode
+        v.kill9("osd.0")
+        v.kill9("osd.5")
+        assert rc.get(2, "big") == data
+        rc.close()
+    finally:
+        v.stop()
+
+
+def test_auth_rejections(cluster):
+    d, v = cluster
+    from ceph_tpu.cluster.daemon import WireClient
+    # 1. unknown entity: mon refuses the secret handshake
+    with pytest.raises(cx.AuthError):
+        WireClient(os.path.join(d, "mon.sock"), "client.evil",
+                   secret=b"\x00" * 32)
+    # 2. wrong secret for a real entity
+    with pytest.raises(cx.AuthError):
+        WireClient(os.path.join(d, "mon.sock"), "client.admin",
+                   secret=b"\x00" * 32)
+    # 3. forged ticket: an OSD rejects a ticket not sealed by its key
+    ring = cx.Keyring.load(os.path.join(d, "keyring.client"))
+    fake_ring = cx.Keyring.generate(["osd.0", "client.admin"])
+    forged, box = cx.TicketServer(fake_ring).grant("client.admin",
+                                                   "osd.0")
+    key = cx.open_key_box(fake_ring.secret("client.admin"), box)
+    with pytest.raises((cx.AuthError, IOError)):
+        WireClient(os.path.join(d, "osd.0.sock"), "client.admin",
+                   ticket=forged, session_key=key)
+    # 4. the real path still works afterwards
+    rc = _client(d)
+    rc.put(1, "authed", b"ticket holders only")
+    assert rc.get(1, "authed") == b"ticket holders only"
+    rc.close()
+
+
+def test_ticket_cannot_cross_services(cluster):
+    """A ticket granted for osd.0 must be rejected by osd.1 (sealed
+    under the wrong service secret)."""
+    d, v = cluster
+    ring = cx.Keyring.load(os.path.join(d, "keyring.client"))
+    from ceph_tpu.cluster.daemon import WireClient
+    mon = WireClient(os.path.join(d, "mon.sock"), "client.admin",
+                     secret=ring.secret("client.admin"))
+    grant = mon.call({"cmd": "get_ticket", "service": "osd.0"})
+    key = cx.open_key_box(ring.secret("client.admin"), grant["key_box"])
+    with pytest.raises((cx.AuthError, IOError)):
+        WireClient(os.path.join(d, "osd.1.sock"), "client.admin",
+                   ticket=grant["ticket"], session_key=key)
+    mon.close()
+
+
+def test_osd_cannot_boot_another_osd(cluster):
+    """Entity checks on mon commands: osd.2's session may not announce
+    osd.4 up."""
+    d, v = cluster
+    ring = cx.Keyring.load(os.path.join(d, "keyring.mon"))
+    from ceph_tpu.cluster.daemon import WireClient
+    c = WireClient(os.path.join(d, "mon.sock"), "osd.2",
+                   secret=ring.secret("osd.2"))
+    with pytest.raises((cx.AuthError, PermissionError)):
+        c.call({"cmd": "osd_boot", "osd": 4})
+    c.close()
