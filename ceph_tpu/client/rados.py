@@ -90,14 +90,47 @@ class IoCtx:
         self._rados._objecter.write(self.pool_id, oid, offset, data)
 
     def read(self, oid: str, length: Optional[int] = None,
-             offset: int = 0) -> bytes:
+             offset: int = 0, snap: Optional[int] = None) -> bytes:
+        """``snap``: read the object's state AT that pool snapshot
+        (librados snap_read context role)."""
         sim = self._rados._sim
-        if (self.pool_id, oid) not in sim.objects:
-            raise ObjectNotFound(oid)
-        data = self._rados._objecter.get(self.pool_id, oid)
+        if snap is not None:
+            try:
+                data = sim.get_snap(self.pool_id, oid, snap)
+            except KeyError:
+                raise ObjectNotFound(f"{oid}@{snap}") from None
+        else:
+            if (self.pool_id, oid) not in sim.objects:
+                raise ObjectNotFound(oid)
+            data = self._rados._objecter.get(self.pool_id, oid)
         if length is None:
             return data[offset:]
         return data[offset:offset + length]
+
+    # ------------------------------------------------------- snapshots --
+    def snap_create(self, snap_name: str) -> int:
+        return self._rados._sim.snap_create(self.pool_id, snap_name)
+
+    def snap_lookup(self, snap_name: str) -> int:
+        return self._rados._sim.snap_lookup(self.pool_id, snap_name)
+
+    def snap_remove(self, snap_name: str) -> int:
+        sid = self.snap_lookup(snap_name)
+        return self._rados._sim.snap_remove(self.pool_id, sid)
+
+    def snap_rollback(self, oid: str, snap_name: str) -> None:
+        sid = self.snap_lookup(snap_name)
+        self._rados._sim.snap_rollback(self.pool_id, oid, sid)
+
+    # ----------------------------------------------------- watch/notify --
+    def watch(self, oid: str, callback) -> int:
+        return self._rados._sim.watch(self.pool_id, oid, callback)
+
+    def unwatch(self, oid: str, watch_id: int) -> None:
+        self._rados._sim.unwatch(self.pool_id, oid, watch_id)
+
+    def notify(self, oid: str, payload: bytes = b"") -> dict:
+        return self._rados._sim.notify(self.pool_id, oid, payload)
 
     def remove(self, oid: str) -> None:
         sim = self._rados._sim

@@ -90,9 +90,11 @@ class RBD:
 
 
 class Image:
-    """One open image (librbd `Image`)."""
+    """One open image (librbd `Image`); ``snapshot`` opens it read-only
+    at a named snap (librbd open-at-snap)."""
 
-    def __init__(self, ioctx: IoCtx, name: str):
+    def __init__(self, ioctx: IoCtx, name: str,
+                 snapshot: Optional[str] = None):
         self.ioctx = ioctx
         self.name = name
         try:
@@ -103,6 +105,13 @@ class Image:
         self.info = ImageInfo(name=name, size=meta["size"],
                               order=meta["order"],
                               object_prefix=meta["object_prefix"])
+        self.snaps: dict = meta.get("snaps", {})
+        self.snap_id: Optional[int] = None
+        if snapshot is not None:
+            if snapshot not in self.snaps:
+                raise KeyError(f"image {name} has no snap {snapshot!r}")
+            self.snap_id = self.snaps[snapshot]["id"]
+            self.info.size = self.snaps[snapshot]["size"]
 
     # ------------------------------------------------------------ layout --
     def _oid(self, objno: int) -> str:
@@ -132,11 +141,88 @@ class Image:
             f"rbd_header.{self.name}",
             json.dumps({"size": self.info.size,
                         "order": self.info.order,
-                        "object_prefix": self.info.object_prefix})
+                        "object_prefix": self.info.object_prefix,
+                        "snaps": self.snaps})
             .encode())
+        # header watchers learn about metadata changes (librbd's
+        # ImageWatcher header_update notifications)
+        self.ioctx.notify(f"rbd_header.{self.name}", b"header_update")
+
+    # ---------------------------------------------------------- snapshots --
+    def snap_create(self, snap_name: str) -> int:
+        """Image snapshot: a pool snap + a header record, so data
+        objects COW lazily on the next write (librbd snap_create)."""
+        if self.snap_id is not None:
+            raise IOError("image opened at a snapshot is read-only")
+        if snap_name in self.snaps:
+            raise ValueError(f"snap {snap_name!r} exists")
+        sid = self.ioctx.snap_create(
+            f"rbd.{self.name}@{snap_name}")
+        self.snaps[snap_name] = {"id": sid, "size": self.info.size}
+        self._save_header()
+        return sid
+
+    def snap_list(self) -> List[str]:
+        return sorted(self.snaps)
+
+    def snap_rollback(self, snap_name: str) -> None:
+        """Roll every data object in the SNAPPED extent range back to
+        the snap state and restore the snapped size (librbd
+        snap_rollback) — including objects deleted since the snap
+        (e.g. by a shrink), whose clones the cluster still holds."""
+        if self.snap_id is not None:
+            raise IOError("image opened at a snapshot is read-only")
+        if snap_name not in self.snaps:
+            raise KeyError(snap_name)
+        rec = self.snaps[snap_name]
+        sid = rec["id"]
+        sim = self.ioctx._rados._sim
+        osize = 1 << self.info.order
+        snap_objs = -(-rec["size"] // osize)
+        covered = set(range(snap_objs)) | set(self._written_objects())
+        for objno in sorted(covered):
+            oid = self._oid(objno)
+            try:
+                sim.snap_rollback(self.ioctx.pool_id, oid, sid)
+            except KeyError:
+                # no state at the snap: rolls back to absent
+                try:
+                    self.ioctx.remove(oid)
+                except ObjectNotFound:
+                    pass
+        self.info.size = rec["size"]
+        self._save_header()
+
+    def snap_remove(self, snap_name: str) -> None:
+        if self.snap_id is not None:
+            raise IOError("image opened at a snapshot is read-only")
+        if snap_name not in self.snaps:
+            raise KeyError(snap_name)
+        rec = self.snaps.pop(snap_name)
+        self.ioctx._rados._sim.snap_remove(self.ioctx.pool_id,
+                                           rec["id"])
+        self._save_header()
+
+    # -------------------------------------------------------------- watch --
+    def watch_header(self, callback) -> int:
+        """Watch the header object (ImageWatcher role): fires on
+        resize/snap operations from ANY handle of this image."""
+        return self.ioctx.watch(f"rbd_header.{self.name}", callback)
+
+    def unwatch_header(self, watch_id: int) -> None:
+        self.ioctx.unwatch(f"rbd_header.{self.name}", watch_id)
+
+    def refresh(self) -> None:
+        """Re-read the header (what a watcher callback triggers)."""
+        meta = json.loads(
+            self.ioctx.read(f"rbd_header.{self.name}").decode())
+        self.info.size = meta["size"]
+        self.snaps = meta.get("snaps", {})
 
     # --------------------------------------------------------------- i/o --
     def write(self, offset: int, data: bytes) -> int:
+        if self.snap_id is not None:
+            raise IOError("image opened at a snapshot is read-only")
         if offset + len(data) > self.info.size:
             raise ValueError("write past image size")
         pos = 0
@@ -156,7 +242,7 @@ class Image:
                 self.info.layout, offset, length):
             try:
                 piece = self.ioctx.read(self._oid(objno), length=olen,
-                                        offset=ooff)
+                                        offset=ooff, snap=self.snap_id)
             except ObjectNotFound:
                 piece = b""                 # sparse: zeros
             out[pos:pos + len(piece)] = piece

@@ -137,14 +137,15 @@ class RemoteCluster:
                 self.osd_client(tgt).call({
                     "cmd": "put_shard", "coll": coll,
                     "oid": f"{shard}:{name}",
-                    "data": np.asarray(chunks[shard]).tobytes()})
+                    "data": np.asarray(chunks[shard]).tobytes(),
+                    # logical object size travels as shard metadata so
+                    # ANY client can unpad reads (object_info_t role)
+                    "attrs": {"size": str(len(data)).encode()}})
                 acks += 1
             except (OSError, IOError):
                 self.drop_osd_client(tgt)
         if acks < k:
             raise IOError(f"{name}: only {acks} shards stored (< k={k})")
-        self._sizes = getattr(self, "_sizes", {})
-        self._sizes[(pool_id, name)] = len(data)
         return acks
 
     def get(self, pool_id: int, name: str,
@@ -171,6 +172,7 @@ class RemoteCluster:
         codec = self.codec_for(pool)
         k, n = codec.get_data_chunk_count(), codec.get_chunk_count()
         shards: Dict[int, bytes] = {}
+        obj_size: Optional[int] = None
         for shard in range(n):
             srcs = [up[shard]] if shard < len(up) and \
                 up[shard] != ITEM_NONE else []
@@ -185,6 +187,16 @@ class RemoteCluster:
                     continue
                 if d is not None:
                     shards[shard] = d
+                    if obj_size is None:
+                        try:
+                            sz = self.osd_client(o).call({
+                                "cmd": "getattr_shard", "coll": coll,
+                                "oid": f"{shard}:{name}",
+                                "key": "size"})
+                            if sz is not None:
+                                obj_size = int(sz)
+                        except (OSError, IOError):
+                            pass
                     break
         if len(shards) < k:
             raise IOError(f"{name}: only {len(shards)} shards (< k)")
@@ -203,8 +215,8 @@ class RemoteCluster:
             else:
                 data_chunks.append(dec[missing.index(c)])
         buf = np.concatenate(data_chunks).tobytes()
-        size = size if size is not None else \
-            getattr(self, "_sizes", {}).get((pool_id, name), len(buf))
+        if size is None:
+            size = obj_size if obj_size is not None else len(buf)
         return buf[:size]
 
     # ------------------------------------------------------------ recovery --

@@ -136,8 +136,11 @@ class WireServer:
                     pass
                 return
             try:
-                wire.send_frame(conn, Envelope(MSG_AUTH_OK, 0, -1, b""),
-                                session_key=key)
+                # the handshake-completion ack is un-MAC'd so a
+                # rejected client can still read MSG_AUTH_FAIL's reason;
+                # integrity comes from the authorizer + every
+                # subsequent frame being MAC'd
+                wire.send_frame(conn, Envelope(MSG_AUTH_OK, 0, -1, b""))
             except OSError:
                 return
             while not self._stop.is_set():
@@ -210,7 +213,9 @@ class WireClient:
             self.key = cx.unseal(secret, env.payload)
         else:
             raise ValueError("need secret or ticket")
-        env = wire.recv_frame(self.sock, session_key=self.key)
+        env = wire.recv_frame(self.sock)      # un-MAC'd completion ack
+        if env.type == MSG_AUTH_FAIL:
+            raise cx.AuthError(env.payload.decode(errors="replace"))
         if env.type != MSG_AUTH_OK:
             raise cx.AuthError("handshake rejected")
         self._id = 0
@@ -419,11 +424,24 @@ class OSDDaemon:
         if cmd == "put_shard":
             coll = tuple(req["coll"])
             from .objectstore import Transaction
-            return self._run_sched(
-                lambda: self.store.apply_transaction(
-                    Transaction().write_full(coll, req["oid"],
-                                             req["data"])) or True,
-                klass)
+
+            def put():
+                txn = Transaction().write_full(coll, req["oid"],
+                                               req["data"])
+                for ak, av in (req.get("attrs") or {}).items():
+                    txn.setattr(coll, req["oid"], ak, av)
+                self.store.apply_transaction(txn)
+                return True
+            return self._run_sched(put, klass)
+        if cmd == "getattr_shard":
+            coll = tuple(req["coll"])
+            def rd():
+                try:
+                    return self.store.getattr(coll, req["oid"],
+                                              req["key"])
+                except (IOError, KeyError):
+                    return None
+            return self._run_sched(rd, klass)
         if cmd == "get_shard":
             coll = tuple(req["coll"])
             def read():

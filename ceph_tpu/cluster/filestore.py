@@ -100,6 +100,12 @@ class FileStore:
         # atomic batch (see gc_data_log)
         gen_blob = self.kv.get("meta", "data_gen")
         self._gen = int(gen_blob) if gen_blob else 0
+        # migrate pre-generation stores: their extents reference the
+        # bytes now living in data.0.log
+        legacy = os.path.join(path, "data.log")
+        if self._gen == 0 and os.path.exists(legacy) and \
+                not os.path.exists(self._gen_path(0)):
+            os.replace(legacy, self._gen_path(0))
         self._data_path = self._gen_path(self._gen)
         self._data = open(self._data_path, "ab")
         self._rfd = os.open(self._data_path, os.O_RDONLY)
@@ -124,12 +130,17 @@ class FileStore:
         """Crash leftovers: a half-written next-gen log whose KV flip
         never committed, or a previous-gen log already superseded."""
         for name in os.listdir(self.path):
-            if name.startswith("data.") and name.endswith(".log") and \
-                    name != f"data.{self._gen}.log":
-                try:
-                    os.unlink(os.path.join(self.path, name))
-                except OSError:
-                    pass
+            if name == f"data.{self._gen}.log" or \
+                    not (name.startswith("data.") and
+                         name.endswith(".log")):
+                continue
+            gen_part = name[len("data."):-len(".log")]
+            if not gen_part.isdigit():
+                continue               # never touch non-generation files
+            try:
+                os.unlink(os.path.join(self.path, name))
+            except OSError:
+                pass
 
     # ---------------------------------------------------------- data log --
     def _append_data(self, payloads: List[bytes]) -> List[Tuple[int, int]]:
@@ -310,11 +321,15 @@ class FileStore:
             self._maybe_gc()
 
     # ---------------------------------------------------------------- gc --
+    _GC_CHECK_EVERY = 64
+
     def _maybe_gc(self) -> None:
         """Reclaim orphaned log space when the log outgrows the live
-        data by gc_factor (checked cheaply on size only)."""
+        data by gc_factor.  The live-bytes scan is O(objects), so it
+        runs every _GC_CHECK_EVERY transactions, not per commit."""
         size = self._data.tell()
-        if size < self.gc_min_bytes:
+        if size < self.gc_min_bytes or \
+                self.txns_applied % self._GC_CHECK_EVERY:
             return
         live = 0
         for _k, blob in self.kv.iterate("obj"):

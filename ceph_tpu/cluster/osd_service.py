@@ -67,8 +67,9 @@ class OSDService:
             except Exception as e:         # surfaced to the waiter
                 result = e
             with self._lock:
-                self._results[op_id] = result
                 ev = self._events.get(op_id)
+                if ev is not None:         # waiter gone (timeout): drop
+                    self._results[op_id] = result
             if ev is not None:
                 ev.set()
 

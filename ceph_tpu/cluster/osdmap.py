@@ -88,6 +88,9 @@ class PGPool:
     # EC stripe unit (reference: osd_pool_erasure_code_stripe_unit,
     # default 4 KiB); chunk size of every stripe in the pool
     stripe_unit: int = 4096
+    # pool snapshot context (pg_pool_t::snap_seq / snaps)
+    snap_seq: int = 0
+    snaps: Dict[int, str] = field(default_factory=dict)
 
     def __post_init__(self):
         if not self.pgp_num:

@@ -133,6 +133,12 @@ class ObjectInfo:
     size: int
     chunk_size: int          # per-stripe chunk bytes (EC) / size (rep)
     n_stripes: int = 1
+    # --- SnapSet role (src/osd/osd_types.h SnapSet + SnapMapper) ---
+    born_seq: int = 0        # pool snap_seq when the object appeared
+    snap_seq: int = 0        # pool snap_seq at the last write
+    clones: List[int] = field(default_factory=list)   # ascending ids
+    clone_snaps: Dict[int, List[int]] = field(default_factory=dict)
+    clone_sizes: Dict[int, int] = field(default_factory=dict)
 
 
 class ClusterSim:
@@ -158,6 +164,13 @@ class ClusterSim:
         self._rmw: Dict[int, RmwPipeline] = {}
         # authoritative per-PG op logs (PGLog role)
         self.pg_logs: Dict[Tuple[int, int], PGLog] = {}
+        # snap -> object names reverse index (SnapMapper role)
+        self.snap_index: Dict[Tuple[int, int], Set[str]] = {}
+        # SnapSets of deleted heads (whiteouts): clones outlive them
+        self.snapsets: Dict[Tuple[int, str], ObjectInfo] = {}
+        # per-object watch registrations (Watch/Notify role)
+        self._watches: Dict[Tuple[int, str], Dict[int, object]] = {}
+        self._next_watch = 1
 
     @staticmethod
     def _stop_services(services) -> None:
@@ -281,9 +294,172 @@ class ClusterSim:
                 o.delete((pool_id, pg, name, shard))
         return tgt
 
+    def _new_info(self, pool: PGPool, name: str, size: int, chunk: int,
+                  n_str: int = 1) -> ObjectInfo:
+        """Fresh ObjectInfo carrying over snapshot lineage (SnapSet) —
+        including from a deleted head's whiteout record."""
+        prev = self.objects.get((pool.id, name))
+        reborn = prev is None and \
+            (pool.id, name) in self.snapsets
+        if prev is None:
+            prev = self.snapsets.pop((pool.id, name), None)
+        # a recreated object's birth moves to NOW: snaps taken during
+        # the deletion interval must read as absent, while older clones
+        # stay resolvable (get_snap checks clones before born_seq)
+        info = ObjectInfo(size, chunk, n_str,
+                          born_seq=pool.snap_seq if (prev is None or
+                                                     reborn)
+                          else prev.born_seq,
+                          snap_seq=pool.snap_seq)
+        if prev is not None:
+            info.clones = prev.clones
+            info.clone_snaps = prev.clone_snaps
+            info.clone_sizes = prev.clone_sizes
+        return info
+
+    # ---------------------------------------------------------- snapshots --
+    def snap_create(self, pool_id: int, snap_name: str) -> int:
+        """Pool snapshot: bump the pool's snap context
+        (pg_pool_t::snap_seq + snaps; OSDMonitor prepare_pool_op).
+        Clones appear lazily on the next write per object."""
+        pool = self.osdmap.pools[pool_id]
+        pool.snap_seq += 1
+        pool.snaps[pool.snap_seq] = snap_name
+        return pool.snap_seq
+
+    def snap_lookup(self, pool_id: int, snap_name: str) -> int:
+        pool = self.osdmap.pools[pool_id]
+        for sid, nm in pool.snaps.items():
+            if nm == snap_name:
+                return sid
+        raise KeyError(f"no snapshot {snap_name!r} in pool {pool_id}")
+
+    def _maybe_clone(self, pool: PGPool, name: str) -> None:
+        """Copy-on-write: before the first mutation after a snapshot,
+        preserve the head as a clone object (PrimaryLogPG
+        make_writeable role) and index it in the SnapMapper."""
+        info = self.objects.get((pool.id, name))
+        if info is None or info.snap_seq >= pool.snap_seq:
+            return
+        covered = [s for s in sorted(pool.snaps)
+                   if info.snap_seq < s <= pool.snap_seq]
+        if not covered:
+            info.snap_seq = pool.snap_seq
+            return
+        cid = pool.snap_seq
+        data = self.get(pool.id, name)
+        self.put(pool.id, f"{name}@{cid}", data)   # clone shards placed
+        info.clones.append(cid)
+        info.clone_snaps[cid] = covered
+        info.clone_sizes[cid] = info.size
+        info.snap_seq = pool.snap_seq
+        pg = self.object_pg(pool, name)
+        up = self.pg_up(pool, pg)
+        prim = next((o for o in up if o != ITEM_NONE), None)
+        for s in covered:
+            self.snap_index.setdefault((pool.id, s), set()).add(name)
+        if prim is not None:
+            # omap mirror of the SnapMapper rows on the primary
+            # (src/osd/SnapMapper.cc "SNA_" keyspace)
+            st = self.osds[prim].objectstore
+            txn = Transaction()
+            meta_oid = "meta:snapmapper"
+            if not st.exists((pool.id, pg), meta_oid):
+                txn.touch((pool.id, pg), meta_oid)
+            for s in covered:
+                txn.omap_set((pool.id, pg), meta_oid,
+                             f"SNA_{s:016x}_{name}", b"")
+            st.apply_transaction(txn)
+
+    def get_snap(self, pool_id: int, name: str, snap_id: int) -> bytes:
+        """Read an object's state AT a snapshot: resolve through the
+        SnapSet (clone covering the snap, else the unchanged head)."""
+        pool = self.osdmap.pools[pool_id]
+        info = self.objects.get((pool_id, name)) or \
+            self.snapsets.get((pool_id, name))
+        if info is None:
+            raise KeyError(f"object {name} has no state at all")
+        # clones first: they can cover snaps older than a rebirth
+        for c in info.clones:
+            if snap_id in info.clone_snaps.get(c, ()):
+                return self.get(pool_id, f"{name}@{c}")
+        if snap_id <= info.born_seq:
+            raise KeyError(
+                f"object {name} did not exist at snap {snap_id}")
+        if (pool_id, name) not in self.objects:
+            raise KeyError(f"object {name} deleted before snap "
+                           f"{snap_id} saw further writes")
+        return self.get(pool_id, name)
+
+    def snap_rollback(self, pool_id: int, name: str, snap_id: int) -> None:
+        """Restore the head to its state at the snapshot (rollback op;
+        the current head is itself preserved by COW first)."""
+        data = self.get_snap(pool_id, name, snap_id)
+        self.put(pool_id, name, data)
+
+    def snap_objects(self, pool_id: int, snap_id: int) -> List[str]:
+        """SnapMapper query surface: objects with a clone for snap."""
+        return sorted(self.snap_index.get((pool_id, snap_id), ()))
+
+    def snap_remove(self, pool_id: int, snap_id: int) -> int:
+        """Delete a pool snapshot and TRIM: clones covering no
+        remaining snap are purged (the snap-trimmer role).  Returns
+        the number of clone objects removed."""
+        pool = self.osdmap.pools[pool_id]
+        pool.snaps.pop(snap_id, None)
+        trimmed = 0
+        for name in self.snap_index.pop((pool_id, snap_id), set()):
+            info = self.objects.get((pool_id, name)) or \
+                self.snapsets.get((pool_id, name))
+            if info is None:
+                continue
+            for c in list(info.clones):
+                snaps = info.clone_snaps.get(c, [])
+                if snap_id in snaps:
+                    snaps.remove(snap_id)
+                if not snaps:
+                    info.clones.remove(c)
+                    info.clone_snaps.pop(c, None)
+                    info.clone_sizes.pop(c, None)
+                    self.delete(pool_id, f"{name}@{c}")
+                    trimmed += 1
+            if not info.clones and \
+                    (pool_id, name) not in self.objects:
+                self.snapsets.pop((pool_id, name), None)
+        return trimmed
+
+    # -------------------------------------------------------- watch/notify --
+    def watch(self, pool_id: int, name: str, callback) -> int:
+        """Register interest in an object (Watch role,
+        src/osd/Watch.cc); ``callback(notify_id, payload) -> ack``."""
+        wid = self._next_watch
+        self._next_watch += 1
+        self._watches.setdefault((pool_id, name), {})[wid] = callback
+        return wid
+
+    def unwatch(self, pool_id: int, name: str, watch_id: int) -> None:
+        self._watches.get((pool_id, name), {}).pop(watch_id, None)
+
+    def notify(self, pool_id: int, name: str,
+               payload: bytes = b"") -> Dict[int, object]:
+        """Deliver to every watcher, gather acks (Notify role); a
+        raising watcher is recorded as a timeout (None ack)."""
+        nid = self._next_watch
+        self._next_watch += 1
+        acks: Dict[int, object] = {}
+        for wid, cb in list(self._watches.get((pool_id, name),
+                                              {}).items()):
+            try:
+                acks[wid] = cb(nid, payload)
+            except Exception:
+                acks[wid] = None
+        return acks
+
     # --------------------------------------------------------------- I/O --
     def put(self, pool_id: int, name: str, data: bytes) -> List[int]:
         pool = self.osdmap.pools[pool_id]
+        if "@" not in name:
+            self._maybe_clone(pool, name)
         pg = self.object_pg(pool, name)
         up = self.pg_up(pool, pg)
         if pool.type == POOL_REPLICATED:
@@ -306,7 +482,8 @@ class ClusterSim:
             for o in self.osds:
                 if o.id not in placed:
                     o.delete((pool_id, pg, name, 0))
-            self.objects[(pool_id, name)] = ObjectInfo(len(data), len(data))
+            self.objects[(pool_id, name)] = self._new_info(
+                pool, name, len(data), len(data))
             self._log_write(pool_id, pg, name, placed)
             return placed
         codec = self.codec_for(pool)
@@ -325,8 +502,8 @@ class ClusterSim:
             if tgt is not None:
                 placed.append(tgt)
         self.extent_cache.invalidate_object((pool_id, name))
-        self.objects[(pool_id, name)] = ObjectInfo(
-            len(data), si.chunk_size, n_str)
+        self.objects[(pool_id, name)] = self._new_info(
+            pool, name, len(data), si.chunk_size, n_str)
         self._log_write(pool_id, pg, name, set(placed))
         return placed
 
@@ -395,6 +572,8 @@ class ClusterSim:
         """Partial overwrite.  EC pools run the RMW pipeline (requires
         FLAG_EC_OVERWRITES semantics); replicated pools splice bytes."""
         pool = self.osdmap.pools[pool_id]
+        if "@" not in name:
+            self._maybe_clone(pool, name)
         info = self.objects.get((pool_id, name))
         if pool.type == POOL_REPLICATED:
             old = self.get(pool_id, name) if info else b""
@@ -404,7 +583,9 @@ class ClusterSim:
             buf[offset:offset + len(data)] = data
             return self.put(pool_id, name, bytes(buf))
         if info is None:
-            info = ObjectInfo(0, pool.stripe_unit, 0)
+            info = ObjectInfo(0, pool.stripe_unit, 0,
+                              born_seq=pool.snap_seq,
+                              snap_seq=pool.snap_seq)
         pg = self.object_pg(pool, name)
         up = self.pg_up(pool, pg)
         codec = self.codec_for(pool)
@@ -443,10 +624,18 @@ class ClusterSim:
     def delete(self, pool_id: int, name: str) -> None:
         """Remove an object: shards purged from live OSDs, an OP_DELETE
         log entry recorded so lagging replicas apply it on delta
-        recovery."""
+        recovery.  Snapshotted state survives as clones (the head
+        whiteout semantics: clones trim with their snaps, not here)."""
         pool = self.osdmap.pools[pool_id]
-        if self.objects.pop((pool_id, name), None) is None:
+        if "@" not in name:
+            self._maybe_clone(pool, name)
+        info = self.objects.pop((pool_id, name), None)
+        if info is None:
             return
+        if info.clones:
+            # whiteout: the SnapSet outlives the head so clones stay
+            # readable/trimmable
+            self.snapsets[(pool_id, name)] = info
         pg = self.object_pg(pool, name)
         up = self.pg_up(pool, pg)
         for osd in self.osds:

@@ -1,0 +1,215 @@
+"""Snapshots (SnapSet/SnapMapper/COW clones) + watch/notify + RBD snaps.
+
+VERDICT r2 item 7: SnapMapper-style reverse index, per-object snap
+sets with copy-on-write clones, rbd snap create/rollback, and a
+watch/notify round trip.  Reference roles: src/osd/SnapMapper.cc,
+PrimaryLogPG make_writeable, src/osd/Watch.cc, librbd snapshots.
+"""
+import numpy as np
+import pytest
+
+from ceph_tpu.cluster.osdmap import OSDMap, PGPool, POOL_ERASURE, \
+    POOL_REPLICATED
+from ceph_tpu.cluster.simulator import ClusterSim
+from tests.test_xla_mapper import TYPE_HOST, build_cluster
+from ceph_tpu.placement.crush_map import (
+    RULE_CHOOSELEAF_FIRSTN, RULE_CHOOSELEAF_INDEP, RULE_EMIT, RULE_TAKE,
+    Rule)
+
+
+def make_sim(k=2, m=1):
+    cmap, root = build_cluster(n_hosts=4, osds_per_host=2, seed=3)
+    cmap.add_rule(Rule(steps=[(RULE_TAKE, root, 0),
+                              (RULE_CHOOSELEAF_FIRSTN, 0, TYPE_HOST),
+                              (RULE_EMIT, 0, 0)]))
+    cmap.add_rule(Rule(steps=[(RULE_TAKE, root, 0),
+                              (RULE_CHOOSELEAF_INDEP, 0, TYPE_HOST),
+                              (RULE_EMIT, 0, 0)]))
+    om = OSDMap(cmap)
+    om.mark_all_in_up()
+    om.add_pool(PGPool(id=1, name="rep", type=POOL_REPLICATED, size=3,
+                       pg_num=16, crush_rule=0))
+    om.add_pool(PGPool(id=2, name="ec", type=POOL_ERASURE, size=k + m,
+                       pg_num=16, crush_rule=1,
+                       erasure_code_profile="p"))
+    sim = ClusterSim(om)
+    sim.create_ec_profile("p", {"plugin": "jax", "k": str(k),
+                                "m": str(m)})
+    return sim
+
+
+@pytest.fixture(scope="module")
+def sim():
+    return make_sim()
+
+
+def test_snapshot_cow_and_read_at_snap(sim):
+    sim.put(1, "doc", b"version one")
+    s1 = sim.snap_create(1, "s1")
+    # unchanged since snap: head serves the snap read (no clone yet)
+    assert sim.get_snap(1, "doc", s1) == b"version one"
+    assert sim.snap_objects(1, s1) == []
+    # first write after the snap clones the head
+    sim.put(1, "doc", b"version two")
+    assert sim.get(1, "doc") == b"version two"
+    assert sim.get_snap(1, "doc", s1) == b"version one"
+    assert sim.snap_objects(1, s1) == ["doc"]
+    # second snap; overwrite again; both snaps resolve
+    s2 = sim.snap_create(1, "s2")
+    sim.put(1, "doc", b"version three")
+    assert sim.get_snap(1, "doc", s1) == b"version one"
+    assert sim.get_snap(1, "doc", s2) == b"version two"
+    assert sim.get(1, "doc") == b"version three"
+
+
+def test_snapshot_ec_pool(sim):
+    rng = np.random.default_rng(5)
+    old = rng.integers(0, 256, 9000, dtype=np.uint8).tobytes()
+    new = rng.integers(0, 256, 12000, dtype=np.uint8).tobytes()
+    sim.put(2, "blob", old)
+    sid = sim.snap_create(2, "ecsnap")
+    sim.put(2, "blob", new)
+    assert sim.get(2, "blob") == new
+    assert sim.get_snap(2, "blob", sid) == old
+
+
+def test_snapshot_object_not_born_yet(sim):
+    sid = sim.snap_create(1, "early")
+    sim.put(1, "latecomer", b"hi")
+    with pytest.raises(KeyError):
+        sim.get_snap(1, "latecomer", sid)
+
+
+def test_snapshot_survives_head_delete(sim):
+    sim.put(1, "doomed", b"keep me at the snap")
+    sid = sim.snap_create(1, "predelete")
+    sim.delete(1, "doomed")
+    assert sim.get_snap(1, "doomed", sid) == b"keep me at the snap"
+
+
+def test_snap_rollback(sim):
+    sim.put(1, "rb", b"good state")
+    sid = sim.snap_create(1, "rollback-point")
+    sim.put(1, "rb", b"bad state")
+    sim.snap_rollback(1, "rb", sid)
+    assert sim.get(1, "rb") == b"good state"
+    # rollback preserved the pre-rollback head as a clone lineage:
+    # reading the snap still works afterwards
+    assert sim.get_snap(1, "rb", sid) == b"good state"
+
+
+def test_snap_remove_trims_clones(sim):
+    sim.put(1, "trim", b"alpha")
+    sid = sim.snap_create(1, "trimsnap")
+    sim.put(1, "trim", b"beta")
+    assert sim.snap_objects(1, sid) == ["trim"]
+    removed = sim.snap_remove(1, sid)
+    assert removed >= 1
+    with pytest.raises(KeyError):
+        sim.snap_lookup(1, "trimsnap")
+    assert sim.get(1, "trim") == b"beta"
+
+
+def test_snapmapper_omap_rows(sim):
+    """The reverse index is mirrored as SNA_ omap rows on the primary
+    (the SnapMapper keyspace)."""
+    sim.put(1, "indexed", b"x")
+    sid = sim.snap_create(1, "idx")
+    sim.put(1, "indexed", b"y")
+    pool = sim.osdmap.pools[1]
+    pg = sim.object_pg(pool, "indexed")
+    up = sim.pg_up(pool, pg)
+    st = sim.osds[up[0]].objectstore
+    key = f"SNA_{sid:016x}_indexed"
+    assert st.omap_get((1, pg), "meta:snapmapper", key) == b""
+
+
+def test_watch_notify_roundtrip(sim):
+    got = []
+    wid = sim.watch(1, "watched", lambda nid, p: got.append(p) or b"ack")
+    acks = sim.notify(1, "watched", b"hello watchers")
+    assert got == [b"hello watchers"]
+    assert acks == {wid: b"ack"}
+    sim.unwatch(1, "watched", wid)
+    assert sim.notify(1, "watched", b"again") == {}
+    assert got == [b"hello watchers"]
+
+
+# ------------------------------------------------------------------- RBD --
+
+def test_rbd_snapshot_rollback_and_watch():
+    from ceph_tpu.client.rados import Rados
+    from ceph_tpu.client.rbd import RBD, Image
+    from ceph_tpu.cluster.monitor import Monitor
+    sim2 = make_sim()
+    rados = Rados(sim2, Monitor(sim2.osdmap)).connect()
+    ioctx = rados.open_ioctx("rep")
+    RBD(ioctx).create("vol", size=1 << 20, order=16)
+    img = Image(ioctx, "vol")
+    img.write(0, b"AAAA" * 1000)
+    img.write(1 << 16, b"BBBB" * 1000)
+    img.snap_create("v1")
+    img.write(0, b"CCCC" * 1000)
+    assert img.read(0, 4000) == b"CCCC" * 1000
+    # read-only open at the snap sees the old data
+    at_snap = Image(ioctx, "vol", snapshot="v1")
+    assert at_snap.read(0, 4000) == b"AAAA" * 1000
+    assert at_snap.read(1 << 16, 4000) == b"BBBB" * 1000
+    with pytest.raises(IOError):
+        at_snap.write(0, b"nope")
+    # header watch: another handle observes the resize notification
+    events = []
+    other = Image(ioctx, "vol")
+    wid = other.watch_header(
+        lambda nid, p: (events.append(p), other.refresh())[0] or b"ok")
+    img.resize(1 << 19)
+    assert events and events[-1] == b"header_update"
+    assert other.info.size == 1 << 19
+    other.unwatch_header(wid)
+    # rollback restores data AND size
+    img.snap_rollback("v1")
+    img.refresh()
+    assert img.size() == 1 << 20
+    assert img.read(0, 4000) == b"AAAA" * 1000
+    assert img.read(1 << 16, 4000) == b"BBBB" * 1000
+    # snap bookkeeping surfaces
+    assert img.snap_list() == ["v1"]
+    img.snap_remove("v1")
+    assert img.snap_list() == []
+
+
+def test_snapshot_deletion_interval_not_fabricated(sim):
+    """A snap taken while the object was deleted reads as absent even
+    after the object is recreated (no fabricated data)."""
+    sim.put(1, "phoenix", b"first life")
+    s_alive = sim.snap_create(1, "alive")
+    sim.put(1, "phoenix", b"still alive")      # clone for s_alive
+    sim.delete(1, "phoenix")
+    s_dead = sim.snap_create(1, "dead")
+    sim.put(1, "phoenix", b"second life")
+    assert sim.get_snap(1, "phoenix", s_alive) == b"first life"
+    with pytest.raises(KeyError):
+        sim.get_snap(1, "phoenix", s_dead)
+    assert sim.get(1, "phoenix") == b"second life"
+
+
+def test_rbd_rollback_after_shrink():
+    """Objects deleted by a shrink are restored by rollback (their
+    snapped clones survive the delete)."""
+    from ceph_tpu.client.rados import Rados
+    from ceph_tpu.client.rbd import RBD, Image
+    from ceph_tpu.cluster.monitor import Monitor
+    sim2 = make_sim()
+    rados = Rados(sim2, Monitor(sim2.osdmap)).connect()
+    ioctx = rados.open_ioctx("rep")
+    RBD(ioctx).create("shr", size=1 << 18, order=16)   # 4 objects
+    img = Image(ioctx, "shr")
+    img.write(0, b"HEAD" * 1000)
+    img.write(3 << 16, b"TAIL" * 1000)          # last object
+    img.snap_create("before-shrink")
+    img.resize(1 << 16)                          # drops objects 1..3
+    assert img.read(0, 4000) == b"HEAD" * 1000
+    img.snap_rollback("before-shrink")
+    img.refresh()
+    assert img.size() == 1 << 18
+    assert img.read(3 << 16, 4000) == b"TAIL" * 1000
