@@ -140,6 +140,8 @@ class Monitor:
             "new_pg_temp": {
                 f"{p}.{g}": temp
                 for (p, g), temp in inc.new_pg_temp.items()},
+            "new_pool_pg_num": {str(k): int(v)
+                                for k, v in inc.new_pool_pg_num.items()},
         }).encode()
 
     @staticmethod
@@ -160,6 +162,9 @@ class Monitor:
             new_pg_temp={
                 (int(s.split(".")[0]), int(s.split(".")[1])): temp
                 for s, temp in d["new_pg_temp"].items()},
+            new_pool_pg_num={int(k): int(v)
+                             for k, v in d.get("new_pool_pg_num",
+                                               {}).items()},
         )
 
     @classmethod

@@ -144,6 +144,8 @@ class Incremental:
         field(default_factory=dict)              # None = remove
     new_pg_temp: Dict[Tuple[int, int], Optional[List[int]]] = \
         field(default_factory=dict)
+    # pool mutations (OSDMap::Incremental new_pools subset)
+    new_pool_pg_num: Dict[int, int] = field(default_factory=dict)
 
 
 class OSDMap:
@@ -193,6 +195,11 @@ class OSDMap:
                 self.pg_temp.pop(pgid, None)
             else:
                 self.pg_temp[pgid] = list(temp)
+        for pid, pg_num in inc.new_pool_pg_num.items():
+            pool = self.pools.get(pid)
+            if pool is not None:
+                pool.pg_num = pg_num
+                pool.pgp_num = pg_num
         self.epoch = inc.epoch
 
     def set_osd(self, osd: int, *, exists=True, up=True,

@@ -1,0 +1,4 @@
+"""File-system layer: journaler + metadata server slice (src/journal/
++ src/mds/ roles)."""
+from .journaler import Journaler  # noqa: F401
+from .mds import MDS, CephFSClient, FSError  # noqa: F401

@@ -1,0 +1,2 @@
+"""Object gateway layer (src/rgw/ role)."""
+from .gateway import Bucket, RGWError, RGWGateway  # noqa: F401

@@ -1,0 +1,144 @@
+"""MDS slice (journaled dirtree over RADOS) + RGW slice (S3 gateway).
+
+VERDICT r2 missing #8.  Reference roles: src/mds/ (MDCache/MDLog),
+src/journal/ (Journaler), src/rgw/ (bucket index + S3 list semantics).
+"""
+import hashlib
+
+import numpy as np
+import pytest
+
+from ceph_tpu.client.rados import Rados
+from ceph_tpu.cluster.monitor import Monitor
+from ceph_tpu.fs import MDS, CephFSClient, FSError, Journaler
+from ceph_tpu.rgw import RGWError, RGWGateway
+from tests.test_snaps import make_sim
+
+
+@pytest.fixture(scope="module")
+def rados():
+    sim = make_sim()
+    return Rados(sim, Monitor(sim.osdmap)).connect()
+
+
+@pytest.fixture(scope="module")
+def ioctx(rados):
+    return rados.open_ioctx("rep")
+
+
+# --------------------------------------------------------------- journal --
+
+def test_journaler_append_replay_trim(ioctx):
+    j = Journaler(ioctx, "t1", object_bytes=128)
+    seqs = [j.append(f"entry-{i}".encode() * 4) for i in range(20)]
+    assert seqs == list(range(20))
+    j2 = Journaler(ioctx, "t1", object_bytes=128)    # reopen
+    got = list(j2.replay())
+    assert [s for s, _ in got] == seqs
+    assert got[7][1] == b"entry-7" * 4
+    assert j2.active > 0                     # chained multiple objects
+    removed = j2.trim_to(15)
+    assert removed > 0
+    remaining = [s for s, _ in j2.replay()]
+    assert remaining[-1] == 19 and 15 in remaining
+
+
+# ------------------------------------------------------------------- MDS --
+
+def test_mds_tree_and_file_io(ioctx, rados):
+    data_ioctx = rados.open_ioctx("rep")
+    fs = CephFSClient(MDS(ioctx, data_ioctx))
+    fs.mkdir("/home")
+    fs.mkdir("/home/alice")
+    fs.write("/home/alice/hello.txt", b"hello metadata world")
+    assert fs.read("/home/alice/hello.txt") == b"hello metadata world"
+    assert fs.listdir("/home") == ["alice"]
+    assert fs.listdir("/home/alice") == ["hello.txt"]
+    assert fs.stat("/home/alice/hello.txt")["size"] == 20
+    # offset write crossing the 64 KiB object boundary
+    big = bytes(np.random.default_rng(4).integers(0, 256, 200_000,
+                                                  dtype=np.uint8))
+    fs.write("/home/alice/big.bin", big)
+    assert fs.read("/home/alice/big.bin") == big
+    fs.write("/home/alice/big.bin", b"SPLICE", offset=65530)
+    want = bytearray(big)
+    want[65530:65536] = b"SPLICE"
+    assert fs.read("/home/alice/big.bin") == bytes(want)
+    # rename across directories
+    fs.mkdir("/archive")
+    fs.rename("/home/alice/hello.txt", "/archive/greeting.txt")
+    assert fs.listdir("/archive") == ["greeting.txt"]
+    assert fs.read("/archive/greeting.txt") == b"hello metadata world"
+    # unlink + rmdir with not-empty guard
+    with pytest.raises(FSError):
+        fs.rmdir("/home/alice")
+    fs.unlink("/home/alice/big.bin")
+    fs.rmdir("/home/alice")
+    assert fs.listdir("/home") == []
+
+
+def test_mds_journal_replay_recovers_tree(ioctx, rados):
+    """An MDS that lost its dirfrags (but kept the journal) replays to
+    the same tree — the MDLog write-ahead contract."""
+    data_ioctx = rados.open_ioctx("rep")
+    mds = MDS(ioctx, data_ioctx)
+    fs = CephFSClient(mds)
+    fs.mkdir("/proj")
+    fs.write("/proj/a.txt", b"A")
+    fs.write("/proj/b.txt", b"B")
+    fs.rename("/proj/a.txt", "/proj/c.txt")
+    # simulate dirfrag loss: delete every dirfrag object
+    sim = ioctx._rados._sim
+    for (pid, name) in list(sim.objects):
+        if pid == ioctx.pool_id and name.startswith("dirfrag."):
+            sim.delete(pid, name)
+    mds2 = MDS(ioctx, data_ioctx)                 # replays the journal
+    fs2 = CephFSClient(mds2)
+    assert "proj" in fs2.listdir("/")
+    assert fs2.listdir("/proj") == ["b.txt", "c.txt"]
+    assert fs2.read("/proj/c.txt") == b"A"
+
+
+# ------------------------------------------------------------------- RGW --
+
+def test_rgw_bucket_and_object_flow(ioctx):
+    gw = RGWGateway(ioctx)
+    gw.create_bucket("photos")
+    with pytest.raises(RGWError):
+        gw.create_bucket("photos")
+    b = gw.bucket("photos")
+    payload = b"JPEGJPEG" * 512
+    etag = b.put_object("2024/01/cat.jpg", payload,
+                        metadata={"content-type": "image/jpeg"})
+    assert etag == hashlib.md5(payload).hexdigest()
+    data, ent = b.get_object("2024/01/cat.jpg")
+    assert data == payload
+    assert ent["meta"]["content-type"] == "image/jpeg"
+    with pytest.raises(RGWError):
+        b.get_object("missing.jpg")
+    with pytest.raises(RGWError):
+        gw.delete_bucket("photos")          # not empty
+    b.delete_object("2024/01/cat.jpg")
+    gw.delete_bucket("photos")
+    assert "photos" not in gw.list_buckets()
+
+
+def test_rgw_list_semantics(ioctx):
+    gw = RGWGateway(ioctx)
+    b = gw.create_bucket("listing")
+    for k in ["a/1", "a/2", "b/1", "b/sub/2", "top"]:
+        b.put_object(k, k.encode())
+    # prefix + delimiter rolls common prefixes like S3
+    r = b.list_objects(prefix="", delimiter="/")
+    assert [c["key"] for c in r["contents"]] == ["top"]
+    assert r["common_prefixes"] == ["a/", "b/"]
+    r = b.list_objects(prefix="b/", delimiter="/")
+    assert [c["key"] for c in r["contents"]] == ["b/1"]
+    assert r["common_prefixes"] == ["b/sub/"]
+    # pagination with marker + truncation flag
+    r1 = b.list_objects(max_keys=2)
+    assert r1["is_truncated"] and len(r1["contents"]) == 2
+    r2 = b.list_objects(marker=r1["contents"][-1]["key"], max_keys=10)
+    assert not r2["is_truncated"]
+    assert [c["key"] for c in r1["contents"] + r2["contents"]] == \
+        ["a/1", "a/2", "b/1", "b/sub/2", "top"]

@@ -1,0 +1,129 @@
+"""Chunked reservation-gated scrub statechart (scrub_machine.cc role)."""
+import numpy as np
+import pytest
+
+from ceph_tpu.cluster.scrub_machine import (
+    BUILD_MAPS, COMPARE_MAPS, FINISHED, NEW_CHUNK, RESERVING,
+    ScrubMachine, ScrubReservations)
+from tests.test_snaps import make_sim
+
+
+@pytest.fixture(scope="module")
+def loaded_sim():
+    sim = make_sim()
+    rng = np.random.default_rng(11)
+    for i in range(24):
+        sim.put(2, f"e{i}", rng.integers(0, 256, 6000,
+                                         dtype=np.uint8).tobytes())
+        sim.put(1, f"r{i}", rng.integers(0, 256, 3000,
+                                         dtype=np.uint8).tobytes())
+    return sim
+
+
+def _pgs_with_objects(sim, pool_id):
+    pool = sim.osdmap.pools[pool_id]
+    pgs = set()
+    for (pid, name) in sim.objects:
+        if pid == pool_id and "@" not in name:
+            pgs.add(sim.object_pg(pool, name))
+    return sorted(pgs)
+
+
+def test_state_sequence_and_chunking(loaded_sim):
+    sim = loaded_sim
+    pg = _pgs_with_objects(sim, 2)[0]
+    m = ScrubMachine(sim, 2, pg, chunk_objects=1)
+    m.start()
+    assert m.state == RESERVING
+    states = []
+    while m.state != FINISHED:
+        states.append(m.tick())
+    assert states[0] == NEW_CHUNK              # reservation granted
+    assert BUILD_MAPS in states and COMPARE_MAPS in states
+    # chunk_objects=1 forces one chunk per object
+    assert m.result.chunks == m.result.objects_scrubbed >= 1
+    assert m.result.inconsistent == []
+
+
+def test_reservations_serialize_overlapping_scrubs(loaded_sim):
+    sim = loaded_sim
+    pgs = _pgs_with_objects(sim, 2)
+    res = ScrubReservations(max_scrubs=1)
+    a = ScrubMachine(sim, 2, pgs[0], reservations=res)
+    a.start()
+    a.tick()                                   # holds its up set
+    overlapping = None
+    for pg in pgs[1:]:
+        if set(a._reserved) & set(
+                ScrubMachine(sim, 2, pg, reservations=res)._up()):
+            overlapping = pg
+            break
+    assert overlapping is not None
+    b = ScrubMachine(sim, 2, overlapping, reservations=res)
+    b.start()
+    b.tick()
+    assert b.state == RESERVING                # blocked on the slots
+    assert b.result.reserve_waits >= 1
+    a.run_to_completion()                      # releases slots
+    b.run_to_completion()
+    assert b.state == FINISHED
+
+
+def test_detects_corrupt_parity(loaded_sim):
+    sim = loaded_sim
+    pool = sim.osdmap.pools[2]
+    name = next(n for (pid, n) in sim.objects
+                if pid == 2 and "@" not in n)
+    pg = sim.object_pg(pool, name)
+    up = sim.pg_up(pool, pg)
+    codec = sim.codec_for(pool)
+    k = codec.get_data_chunk_count()
+    # corrupt a parity shard ON DISK without updating its checksum...
+    # scrub must notice via re-encode compare; use a VALID write of
+    # wrong bytes (checksum-ok, content-wrong) to dodge the EIO path
+    tgt = up[k]
+    key = (2, pg, name, k)
+    cur = sim.osds[tgt].get(key)
+    bad = np.array(cur, dtype=np.uint8).copy()
+    bad[0] ^= 0xFF
+    sim.osds[tgt].put(key, bad)
+    m = ScrubMachine(sim, 2, pg)
+    r = m.run_to_completion()
+    assert (name, k) in r.inconsistent
+    # repair via recovery, then a re-scrub comes back clean
+    sim.osds[tgt].delete(key)
+    sim.recover_all(2)
+    r2 = ScrubMachine(sim, 2, pg).run_to_completion()
+    assert (name, k) not in r2.inconsistent
+
+
+def test_preemption_on_concurrent_write(loaded_sim):
+    sim = loaded_sim
+    pool = sim.osdmap.pools[1]
+    name = next(n for (pid, n) in sim.objects
+                if pid == 1 and "@" not in n)
+    pg = sim.object_pg(pool, name)
+    m = ScrubMachine(sim, 1, pg, chunk_objects=2)
+    m.start()
+    m.tick()                                   # reserve
+    m.tick()                                   # new chunk (snapshot ver)
+    m.tick()                                   # build maps
+    sim.put(1, name, b"concurrent write during scrub")
+    m.tick()                                   # compare -> preempted
+    assert m.result.preemptions == 1
+    r = m.run_to_completion()
+    assert r.inconsistent == []
+    assert r.objects_scrubbed >= 1
+
+
+def test_missing_shard_reported(loaded_sim):
+    sim = loaded_sim
+    pool = sim.osdmap.pools[2]
+    name = next(n for (pid, n) in sim.objects
+                if pid == 2 and "@" not in n and n.startswith("e"))
+    pg = sim.object_pg(pool, name)
+    up = sim.pg_up(pool, pg)
+    sim.osds[up[1]].delete((2, pg, name, 1))
+    r = ScrubMachine(sim, 2, pg).run_to_completion()
+    assert (name, 1) in r.missing
+    sim.recover_all(2)
