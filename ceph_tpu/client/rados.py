@@ -122,6 +122,13 @@ class IoCtx:
         sid = self.snap_lookup(snap_name)
         self._rados._sim.snap_rollback(self.pool_id, oid, sid)
 
+    # ------------------------------------------------------------ exec --
+    def exec(self, oid: str, cls: str, method: str,
+             data: bytes = b"") -> bytes:
+        """Run an in-OSD object-class method (rados_exec role)."""
+        return self._rados._sim.exec_cls(self.pool_id, oid, cls,
+                                         method, data)
+
     # ----------------------------------------------------- watch/notify --
     def watch(self, oid: str, callback) -> int:
         return self._rados._sim.watch(self.pool_id, oid, callback)

@@ -116,11 +116,25 @@ class ScrubMachine:
     def run_to_completion(self, max_ticks: int = 10_000) -> ScrubResult:
         if self.state == INACTIVE:
             self.start()
-        for _ in range(max_ticks):
-            if self.state == FINISHED:
-                return self.result
-            self.tick()
+        try:
+            for _ in range(max_ticks):
+                if self.state == FINISHED:
+                    return self.result
+                self.tick()
+        except Exception:
+            self.abort()
+            raise
+        self.abort()
         raise RuntimeError("scrub did not finish (stuck reservations?)")
+
+    def abort(self) -> None:
+        """Release held reservation slots (idempotent) — abandoned or
+        failed machines must not starve later scrubs."""
+        if self._reserved:
+            self.reservations.release(self._reserved)
+            self._reserved = []
+        if self.state != FINISHED:
+            self.state = INACTIVE
 
     # ------------------------------------------------------------- states --
     def _up(self) -> List[int]:
@@ -154,24 +168,40 @@ class ScrubMachine:
         self.state = BUILD_MAPS
 
     def _tick_build_maps(self) -> None:
-        """Per-object, per-shard digests over the chunk (the replica
-        scrub-map build).  Shard payloads are kept for the chunk's
-        lifetime so the deep compare doesn't re-read them."""
+        """Per-object digests over the chunk (the replica scrub-map
+        build).  EC pools digest per SHARD INDEX; replicated pools
+        digest the shard-0 copy ON EACH REPLICA OSD individually, so
+        divergent replicas are comparable.  Shard payloads are kept for
+        the chunk's lifetime so the deep compare doesn't re-read."""
         import zlib
-        n_shards = self.pool.size
+        from ..placement.crush_map import ITEM_NONE
+        from .osdmap import POOL_ERASURE
         up = self.sim.pg_up(self.pool, self.pg)
+
+        def digest(f):
+            return None if f is None else \
+                zlib.crc32(f.tobytes()).to_bytes(4, "little") + \
+                len(f).to_bytes(8, "little")
+
         self._shards = {}
         for name in self._chunk:
             per_shard: Dict[int, Optional[bytes]] = {}
             payloads = {}
-            for shard in range(n_shards):
-                f = self.sim._read_shard(self.pool.id, self.pg, name,
-                                         shard, up)
-                if f is not None:
-                    payloads[shard] = f
-                per_shard[shard] = None if f is None else \
-                    zlib.crc32(f.tobytes()).to_bytes(4, "little") + \
-                    len(f).to_bytes(8, "little")
+            if self.pool.type == POOL_ERASURE:
+                for shard in range(self.pool.size):
+                    f = self.sim._read_shard(self.pool.id, self.pg,
+                                             name, shard, up)
+                    if f is not None:
+                        payloads[shard] = f
+                    per_shard[shard] = digest(f)
+            else:
+                # replica axis: the same shard-0 object on each up OSD
+                for pos, osd in enumerate(up):
+                    f = None if osd == ITEM_NONE else self.sim.osds[
+                        osd].get((self.pool.id, self.pg, name, 0))
+                    if f is not None and pos not in payloads:
+                        payloads[pos] = f
+                    per_shard[pos] = digest(f)
             self._maps[name] = per_shard
             self._shards[name] = payloads
         self.state = COMPARE_MAPS

@@ -428,6 +428,30 @@ class ClusterSim:
                 self.snapsets.pop((pool_id, name), None)
         return trimmed
 
+    # ------------------------------------------------------ object classes --
+    def exec_cls(self, pool_id: int, name: str, cls: str, method: str,
+                 inp: bytes = b"") -> bytes:
+        """Execute a registered object-class method INSIDE the primary
+        OSD against the object (the CEPH_OSD_OP_CALL path through
+        ClassHandler, src/osd/ClassHandler.cc)."""
+        from ..placement.crush_map import ITEM_NONE
+        if not hasattr(self, "class_handler"):
+            from .class_handler import ClassHandler
+            self.class_handler = ClassHandler()
+        pool = self.osdmap.pools[pool_id]
+        if pool.type == POOL_ERASURE:
+            # the reference likewise rejects class ops needing
+            # omap/xattr state on EC pools (pool requires_*)
+            raise IOError("object classes require a replicated pool")
+        pg = self.object_pg(pool, name)
+        up = self.pg_up(pool, pg)
+        prim = next((o for o in up if o != ITEM_NONE), None)
+        if prim is None:
+            raise IOError(f"{name}: no primary for cls call")
+        return self.class_handler.call(
+            self.osds[prim].objectstore, (pool_id, pg), f"0:{name}",
+            cls, method, inp)
+
     # -------------------------------------------------------- watch/notify --
     def watch(self, pool_id: int, name: str, callback) -> int:
         """Register interest in an object (Watch role,

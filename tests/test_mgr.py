@@ -75,15 +75,24 @@ def test_pg_autoscaler_recommends_and_applies(host):
     for r in recs:
         assert r["target_pg_num"] >= 4
         assert r["target_pg_num"] & (r["target_pg_num"] - 1) == 0
-    # force a huge mismatch: pool 1 at pg_num 4 with all the data
+    # default mode is WARN: huge mismatch recommended but NOT applied
+    # (applying remaps data, which needs PG splitting)
     host.sim.osdmap.pools[1].pg_num = 4
     host.sim.osdmap.pools[1].pgp_num = 4
     rec1 = next(r for r in auto.recommendations() if r["pool_id"] == 1)
-    if rec1["would_adjust"]:
+    auto.serve_tick()
+    assert host.sim.osdmap.pools[1].pg_num == 4
+    host.sim.osdmap.pools[1].pg_num = 16      # restore
+    host.sim.osdmap.pools[1].pgp_num = 16
+    # opt-in mode=on applies to the EMPTY pool 2
+    host.sim.osdmap.pools[2].pg_num = 4
+    host.sim.osdmap.pools[2].pgp_num = 4
+    auto.mode = "on"
+    rec2 = next(r for r in auto.recommendations() if r["pool_id"] == 2)
+    if rec2["would_adjust"]:
         auto.serve_tick()
-        assert host.sim.osdmap.pools[1].pg_num == rec1["target_pg_num"]
-    else:                      # tiny cluster: targets can sit close
-        assert rec1["target_pg_num"] >= 4
+        assert host.sim.osdmap.pools[2].pg_num == rec2["target_pg_num"]
+    auto.mode = "warn"
 
 
 def test_balancer_module(host):
