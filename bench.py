@@ -266,8 +266,10 @@ def bench_recovery(n_pgs=1 << 17, n_out=100, n_stripes=512,
                 masks[s] = sig_cache[er]
                 rebuilt += len(er)
                 n_sigs.add(er)
+        t_dec = time.perf_counter()
         dec = xor_kernel.xor_matmul_w32(jnp.asarray(masks), shards_dev)
         int(np.asarray(dec[0, 0, 0]))                 # one-word readback
+        run_once.decode_s = time.perf_counter() - t_dec
         return moved, dec, rebuilt, len(n_sigs)
 
     moved, dec, rebuilt, n_sigs = run_once()   # warm every executable
@@ -287,17 +289,25 @@ def bench_recovery(n_pgs=1 << 17, n_out=100, n_stripes=512,
     # min over repeated runs: the full-map sweep's wall time swings
     # 2x with driver-tunnel load, and the metric is the pipeline's
     # capability, not the noise floor
-    dt = float("inf")
+    dt, dec_best = float("inf"), None
     for _rep in range(2):
         t0 = time.perf_counter()
         moved, dec, rebuilt, n_sigs = run_once()
-        dt = min(dt, time.perf_counter() - t0)
+        elapsed = time.perf_counter() - t0
+        if elapsed < dt:                   # keep metrics from ONE run
+            dt = elapsed
+            dec_best = getattr(run_once, "decode_s", None)
+    dec_s = dec_best
     return {
         "pgs_remapped": int(moved.sum()),
         "shards_rebuilt": rebuilt,
         "decode_signatures": n_sigs,
         "seconds": round(dt, 3),
         "stripes_per_s": round(n_stripes / dt) if dt else None,
+        # the decode phase alone (masks staged, one dispatch + readback)
+        "decode_seconds": round(dec_s, 3) if dec_s is not None else None,
+        "decode_stripes_per_s": round(n_stripes / dec_s)
+        if dec_s else None,
         "remap_pgs_per_s": round(n_pgs / dt) if dt else None,
     }
 

@@ -79,6 +79,19 @@ class RBD:
         if name not in d:
             raise ImageNotFound(name)
         img = Image(self.ioctx, name)
+        if img.children():
+            raise ValueError(f"image {name} has clone children")
+        if img.parent is not None:
+            # detach from the parent snap's children list so the
+            # parent can later be unprotected/removed
+            try:
+                parent = Image(self.ioctx, img.parent["image"])
+                rec = parent.snaps.get(img.parent["snap"])
+                if rec and name in rec.get("children", []):
+                    rec["children"].remove(name)
+                    parent._save_header()
+            except ImageNotFound:
+                pass
         for objno in img._written_objects():
             try:
                 self.ioctx.remove(img._oid(objno))
@@ -87,6 +100,46 @@ class RBD:
         self.ioctx.remove(f"rbd_header.{name}")
         del d[name]
         self._write_dir(d)
+
+    def clone(self, parent_name: str, parent_snap: str,
+              child_name: str) -> None:
+        """Layering (librbd clone): the child starts as a sparse image
+        whose reads fall through to the parent's PROTECTED snapshot;
+        writes copy-up the touched object first (librbd
+        CopyupRequest role)."""
+        parent = Image(self.ioctx, parent_name)
+        if parent.parent is not None:
+            raise ValueError(
+                f"{parent_name} is itself an unflattened clone — "
+                "flatten it before cloning from it (chains unsupported)")
+        rec = parent.snaps.get(parent_snap)
+        if rec is None:
+            raise KeyError(f"{parent_name} has no snap {parent_snap!r}")
+        if not rec.get("protected"):
+            raise ValueError(
+                f"snap {parent_snap!r} is not protected (librbd "
+                "requires protect before clone)")
+        d = self._dir()
+        if child_name in d:
+            raise ImageExists(child_name)
+        info = {"size": rec["size"], "order": parent.info.order,
+                "object_prefix": f"rbd_data.{child_name}",
+                # parent spec carries everything reads need (librbd
+                # parent_spec): no per-read parent header fetches, and
+                # overlap shrinks with child resizes
+                "parent": {"image": parent_name, "snap": parent_snap,
+                           "snap_id": rec["id"], "size": rec["size"],
+                           "object_prefix": parent.info.object_prefix,
+                           "overlap": rec["size"]}}
+        d[child_name] = {"size": rec["size"],
+                         "order": parent.info.order,
+                         "object_prefix": info["object_prefix"]}
+        self.ioctx.write_full(f"rbd_header.{child_name}",
+                              json.dumps(info).encode())
+        self._write_dir(d)
+        parent.snaps[parent_snap].setdefault("children", []).append(
+            child_name)
+        parent._save_header()
 
 
 class Image:
@@ -106,6 +159,7 @@ class Image:
                               order=meta["order"],
                               object_prefix=meta["object_prefix"])
         self.snaps: dict = meta.get("snaps", {})
+        self.parent: Optional[dict] = meta.get("parent")
         self.snap_id: Optional[int] = None
         if snapshot is not None:
             if snapshot not in self.snaps:
@@ -137,13 +191,14 @@ class Image:
         return self.info.size
 
     def _save_header(self) -> None:
-        self.ioctx.write_full(
-            f"rbd_header.{self.name}",
-            json.dumps({"size": self.info.size,
-                        "order": self.info.order,
-                        "object_prefix": self.info.object_prefix,
-                        "snaps": self.snaps})
-            .encode())
+        blob = {"size": self.info.size,
+                "order": self.info.order,
+                "object_prefix": self.info.object_prefix,
+                "snaps": self.snaps}
+        if self.parent is not None:
+            blob["parent"] = self.parent
+        self.ioctx.write_full(f"rbd_header.{self.name}",
+                              json.dumps(blob).encode())
         # header watchers learn about metadata changes (librbd's
         # ImageWatcher header_update notifications)
         self.ioctx.notify(f"rbd_header.{self.name}", b"header_update")
@@ -198,6 +253,13 @@ class Image:
             raise IOError("image opened at a snapshot is read-only")
         if snap_name not in self.snaps:
             raise KeyError(snap_name)
+        rec = self.snaps[snap_name]
+        if rec.get("protected"):
+            raise ValueError(
+                f"snap {snap_name!r} is protected (unprotect first)")
+        if rec.get("children"):
+            raise ValueError(
+                f"snap {snap_name!r} has clone children")
         rec = self.snaps.pop(snap_name)
         self.ioctx._rados._sim.snap_remove(self.ioctx.pool_id,
                                            rec["id"])
@@ -218,6 +280,75 @@ class Image:
             self.ioctx.read(f"rbd_header.{self.name}").decode())
         self.info.size = meta["size"]
         self.snaps = meta.get("snaps", {})
+        self.parent = meta.get("parent")
+
+    # ---------------------------------------------------------- layering --
+    def _parent_object(self, objno: int) -> Optional[bytes]:
+        """The parent snapshot's bytes for one of OUR objects, clipped
+        to the parent OVERLAP (shrunk by child resizes, so regrown
+        ranges read zeros, not resurrected parent data)."""
+        if self.parent is None:
+            return None
+        overlap = self.parent.get("overlap", self.parent["size"])
+        osize = 1 << self.info.order
+        start = objno * osize
+        if start >= overlap:
+            return None
+        prefix = self.parent.get(
+            "object_prefix", f"rbd_data.{self.parent['image']}")
+        oid = f"{prefix}.{objno:016x}"
+        try:
+            data = self.ioctx.read(oid, snap=self.parent["snap_id"])
+        except ObjectNotFound:
+            return None
+        return data[:max(0, overlap - start)]
+
+    def _copy_up(self, objno: int) -> None:
+        """Before a partial write to an object the child doesn't have,
+        materialize the parent's bytes (CopyupRequest role)."""
+        oid = self._oid(objno)
+        try:
+            self.ioctx.read(oid, length=0)
+            return                       # child already has the object
+        except ObjectNotFound:
+            pass
+        pdata = self._parent_object(objno)
+        if pdata:
+            self.ioctx.write_full(oid, pdata)
+
+    def children(self) -> List[str]:
+        out = []
+        for rec in self.snaps.values():
+            out.extend(rec.get("children", []))
+        return sorted(out)
+
+    def protect_snap(self, snap_name: str) -> None:
+        self.snaps[snap_name]["protected"] = True
+        self._save_header()
+
+    def unprotect_snap(self, snap_name: str) -> None:
+        rec = self.snaps[snap_name]
+        if rec.get("children"):
+            raise ValueError(
+                f"snap {snap_name!r} has clone children")
+        rec["protected"] = False
+        self._save_header()
+
+    def flatten(self) -> None:
+        """Copy every parent-backed object into the child and detach
+        (librbd flatten): the parent can then be unprotected."""
+        if self.parent is None:
+            return
+        osize = 1 << self.info.order
+        for objno in range(-(-self.parent["size"] // osize)):
+            self._copy_up(objno)
+        parent = Image(self.ioctx, self.parent["image"])
+        rec = parent.snaps.get(self.parent["snap"])
+        if rec and self.name in rec.get("children", []):
+            rec["children"].remove(self.name)
+            parent._save_header()
+        self.parent = None
+        self._save_header()
 
     # --------------------------------------------------------------- i/o --
     def write(self, offset: int, data: bytes) -> int:
@@ -228,6 +359,8 @@ class Image:
         pos = 0
         for objno, ooff, olen in file_to_extents(
                 self.info.layout, offset, len(data)):
+            if self.parent is not None:
+                self._copy_up(objno)
             self.ioctx.write(self._oid(objno), data[pos:pos + olen],
                              offset=ooff)
             pos += olen
@@ -244,7 +377,10 @@ class Image:
                 piece = self.ioctx.read(self._oid(objno), length=olen,
                                         offset=ooff, snap=self.snap_id)
             except ObjectNotFound:
-                piece = b""                 # sparse: zeros
+                # clones fall through to the parent snapshot; plain
+                # images read sparse zeros
+                pdata = self._parent_object(objno)
+                piece = pdata[ooff:ooff + olen] if pdata else b""
             out[pos:pos + len(piece)] = piece
             pos += olen
         return bytes(out)
@@ -253,7 +389,14 @@ class Image:
         """Grow is metadata-only; shrink discards objects wholly past
         the boundary AND zero-truncates the boundary object (librbd
         trim semantics — stale bytes must not reappear after a later
-        grow)."""
+        grow).  For clones the parent overlap shrinks with the image,
+        so regrown ranges never resurrect parent bytes."""
+        if self.snap_id is not None:
+            raise IOError("image opened at a snapshot is read-only")
+        if new_size < self.info.size and self.parent is not None:
+            self.parent["overlap"] = min(
+                self.parent.get("overlap", self.parent["size"]),
+                new_size)
         if new_size < self.info.size:
             osize = 1 << self.info.order
             first_dead = -(-new_size // osize)

@@ -213,3 +213,99 @@ def test_rbd_rollback_after_shrink():
     img.refresh()
     assert img.size() == 1 << 18
     assert img.read(3 << 16, 4000) == b"TAIL" * 1000
+
+
+def test_rbd_clone_layering():
+    """librbd layering: protect -> clone -> COW copy-up -> flatten ->
+    unprotect (CopyupRequest / parent fall-through roles)."""
+    from ceph_tpu.client.rados import Rados
+    from ceph_tpu.client.rbd import RBD, Image
+    from ceph_tpu.cluster.monitor import Monitor
+    sim2 = make_sim()
+    ioctx = Rados(sim2, Monitor(sim2.osdmap)).connect().open_ioctx("rep")
+    rbd = RBD(ioctx)
+    rbd.create("golden", size=1 << 18, order=16)
+    base = Image(ioctx, "golden")
+    base.write(0, b"BOOT" * 1000)
+    base.write(1 << 16, b"DATA" * 1000)
+    base.snap_create("v1")
+    # clone requires protection
+    import pytest
+    with pytest.raises(ValueError):
+        rbd.clone("golden", "v1", "vm1")
+    base.protect_snap("v1")
+    rbd.clone("golden", "v1", "vm1")
+    base.refresh()
+    # parent writes after the snap don't leak into the clone
+    base.write(0, b"LATE" * 1000)
+    vm = Image(ioctx, "vm1")
+    assert vm.read(0, 4000) == b"BOOT" * 1000        # parent@snap
+    assert vm.read(1 << 16, 4000) == b"DATA" * 1000
+    # partial write triggers copy-up; untouched bytes stay parent's
+    vm.write(100, b"MINE")
+    got = vm.read(0, 4000)
+    assert got[100:104] == b"MINE"
+    assert got[:100] == (b"BOOT" * 1000)[:100]
+    assert got[104:] == (b"BOOT" * 1000)[104:]
+    # the parent object is unmodified
+    base2 = Image(ioctx, "golden", snapshot="v1")
+    assert base2.read(0, 4000) == b"BOOT" * 1000
+    # unprotect refused while children exist; parent remove refused
+    base.refresh()
+    with pytest.raises(ValueError):
+        base.unprotect_snap("v1")
+    with pytest.raises(ValueError):
+        rbd.remove("golden")
+    # flatten detaches: all parent bytes materialize in the child
+    vm.flatten()
+    assert vm.parent is None
+    assert vm.read(1 << 16, 4000) == b"DATA" * 1000
+    base.refresh()
+    base.unprotect_snap("v1")               # no children left
+    # clone keeps working after the parent snap is dropped
+    base.snap_remove("v1")
+    assert vm.read(0, 4) == b"BOOT"
+    assert vm.read(100, 4) == b"MINE"
+
+
+def test_rbd_clone_lifecycle_guards():
+    """Layering lifecycle: protected snaps can't be removed, removing
+    a clone detaches it from the parent, shrink-then-grow of a clone
+    reads zeros (overlap), clone chains are rejected."""
+    from ceph_tpu.client.rados import Rados
+    from ceph_tpu.client.rbd import RBD, Image
+    from ceph_tpu.cluster.monitor import Monitor
+    import pytest
+    sim2 = make_sim()
+    ioctx = Rados(sim2, Monitor(sim2.osdmap)).connect().open_ioctx("rep")
+    rbd = RBD(ioctx)
+    rbd.create("base", size=1 << 18, order=16)
+    base = Image(ioctx, "base")
+    base.write(1 << 16, b"PB" * 2000)
+    base.snap_create("s1")
+    base.protect_snap("s1")
+    rbd.clone("base", "s1", "child")
+    base.refresh()
+    # protected snap can't be removed out from under the clone
+    with pytest.raises(ValueError):
+        base.snap_remove("s1")
+    # chains rejected until the middle is flattened
+    child = Image(ioctx, "child")
+    child.snap_create("cs")
+    child.protect_snap("cs")
+    with pytest.raises(ValueError):
+        rbd.clone("child", "cs", "grandchild")
+    child.unprotect_snap("cs")
+    child.snap_remove("cs")
+    # shrink then grow: parent bytes must NOT resurrect
+    assert child.read(1 << 16, 4000) == b"PB" * 2000
+    child.resize(1 << 16)
+    child.resize(1 << 18)
+    assert child.read(1 << 16, 4000) == b"\0" * 4000
+    # removing the child detaches it: parent unprotect/remove now works
+    rbd.remove("child")
+    base.refresh()
+    base.unprotect_snap("s1")
+    base.snap_remove("s1")
+    rbd.remove("base")
+    assert rbd.list() == []
