@@ -206,9 +206,16 @@ class Image:
     # ---------------------------------------------------------- snapshots --
     def snap_create(self, snap_name: str) -> int:
         """Image snapshot: a pool snap + a header record, so data
-        objects COW lazily on the next write (librbd snap_create)."""
+        objects COW lazily on the next write (librbd snap_create).
+
+        Header mutators refresh first: another handle may have added
+        clone linkage (children/protected) since this one opened, and
+        a blind save would lose it (librbd serializes this through the
+        exclusive lock + watch/notify; refresh-before-mutate is the
+        single-writer equivalent)."""
         if self.snap_id is not None:
             raise IOError("image opened at a snapshot is read-only")
+        self.refresh()
         if snap_name in self.snaps:
             raise ValueError(f"snap {snap_name!r} exists")
         sid = self.ioctx.snap_create(
@@ -227,6 +234,7 @@ class Image:
         (e.g. by a shrink), whose clones the cluster still holds."""
         if self.snap_id is not None:
             raise IOError("image opened at a snapshot is read-only")
+        self.refresh()
         if snap_name not in self.snaps:
             raise KeyError(snap_name)
         rec = self.snaps[snap_name]
@@ -251,6 +259,7 @@ class Image:
     def snap_remove(self, snap_name: str) -> None:
         if self.snap_id is not None:
             raise IOError("image opened at a snapshot is read-only")
+        self.refresh()
         if snap_name not in self.snaps:
             raise KeyError(snap_name)
         rec = self.snaps[snap_name]
@@ -323,10 +332,16 @@ class Image:
         return sorted(out)
 
     def protect_snap(self, snap_name: str) -> None:
+        if self.snap_id is not None:
+            raise IOError("image opened at a snapshot is read-only")
+        self.refresh()
         self.snaps[snap_name]["protected"] = True
         self._save_header()
 
     def unprotect_snap(self, snap_name: str) -> None:
+        if self.snap_id is not None:
+            raise IOError("image opened at a snapshot is read-only")
+        self.refresh()
         rec = self.snaps[snap_name]
         if rec.get("children"):
             raise ValueError(
@@ -336,9 +351,20 @@ class Image:
 
     def flatten(self) -> None:
         """Copy every parent-backed object into the child and detach
-        (librbd flatten): the parent can then be unprotected."""
+        (librbd flatten): the parent can then be unprotected.  Refused
+        while the clone has snapshots of its own — those snaps were
+        taken over parent-backed objects and would read zeros once the
+        parent detaches (librbd keeps the parent linked per-snap; this
+        slice requires snapshot-free flatten instead)."""
+        if self.snap_id is not None:
+            raise IOError("image opened at a snapshot is read-only")
+        self.refresh()
         if self.parent is None:
             return
+        if self.snaps:
+            raise ValueError(
+                "flatten with clone snapshots is unsupported: remove "
+                f"snaps {sorted(self.snaps)} first")
         osize = 1 << self.info.order
         for objno in range(-(-self.parent["size"] // osize)):
             self._copy_up(objno)
@@ -357,9 +383,13 @@ class Image:
         if offset + len(data) > self.info.size:
             raise ValueError("write past image size")
         pos = 0
+        osize = 1 << self.info.order
         for objno, ooff, olen in file_to_extents(
                 self.info.layout, offset, len(data)):
-            if self.parent is not None:
+            # full-object writes need no copy-up (librbd skips copyup
+            # when the write covers the whole object)
+            if self.parent is not None and not (ooff == 0 and
+                                                olen >= osize):
                 self._copy_up(objno)
             self.ioctx.write(self._oid(objno), data[pos:pos + olen],
                              offset=ooff)
@@ -393,6 +423,7 @@ class Image:
         so regrown ranges never resurrect parent bytes."""
         if self.snap_id is not None:
             raise IOError("image opened at a snapshot is read-only")
+        self.refresh()
         if new_size < self.info.size and self.parent is not None:
             self.parent["overlap"] = min(
                 self.parent.get("overlap", self.parent["size"]),

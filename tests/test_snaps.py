@@ -309,3 +309,40 @@ def test_rbd_clone_lifecycle_guards():
     base.snap_remove("s1")
     rbd.remove("base")
     assert rbd.list() == []
+
+
+def test_rbd_stale_handle_does_not_lose_clone_linkage():
+    """Header mutators refresh-before-save: a snap_create through a
+    pre-clone handle must NOT erase the clone linkage another handle
+    recorded (the lost-update case librbd prevents with its exclusive
+    lock + watch/notify)."""
+    from ceph_tpu.client.rados import Rados
+    from ceph_tpu.client.rbd import RBD, Image
+    from ceph_tpu.cluster.monitor import Monitor
+    import pytest
+    sim2 = make_sim()
+    ioctx = Rados(sim2, Monitor(sim2.osdmap)).connect().open_ioctx("rep")
+    rbd = RBD(ioctx)
+    rbd.create("g", size=1 << 17, order=16)
+    stale = Image(ioctx, "g")            # opened BEFORE the clone
+    stale.write(0, b"SNAPDATA" * 512)
+    stale.snap_create("s1")
+    stale.protect_snap("s1")
+    rbd.clone("g", "s1", "c")
+    # the stale handle mutates the header WITHOUT an explicit refresh
+    stale.write(0, b"NEWDATA!" * 512)
+    stale.snap_create("s2")
+    # linkage survived: the clone still guards the parent snapshot
+    fresh = Image(ioctx, "g")
+    assert fresh.snaps["s1"].get("children") == ["c"]
+    with pytest.raises(ValueError):
+        fresh.unprotect_snap("s1")
+    child = Image(ioctx, "c")
+    assert child.read(0, 8) == b"SNAPDATA"
+    # flatten with clone-own snapshots is refused (zeros hazard)
+    child.snap_create("cs")
+    with pytest.raises(ValueError):
+        child.flatten()
+    child.snap_remove("cs")
+    child.flatten()
+    assert child.read(0, 8) == b"SNAPDATA"
