@@ -19,6 +19,11 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+# int64 byte counters in the sharded steps must not depend on whether
+# some OTHER module (placement.xla_mapper) was imported first to flip
+# this flag — the wrapped-to-int32 trace would stick in the step cache
+jax.config.update("jax_enable_x64", True)
+
 SHARD_AXIS = "shard"
 
 
@@ -69,24 +74,31 @@ def mesh_cache_key(mesh: Mesh):
     return (tuple(mesh.devices.flat), mesh.devices.shape, mesh.axis_names)
 
 
-def _encode_step_fn(mesh: Mesh):
-    """Jitted sharded step, cached per mesh so repeated steps reuse the
-    compiled executable (jit caches by function identity)."""
-    key = mesh_cache_key(mesh)
+def _make_step_fn(mesh: Mesh, key_prefix: str, kernel):
+    """Jitted sharded step, cached per (kind, mesh): replicated operand
+    0, batch-sharded operand 1, plus a genuine cross-shard reduction
+    (XLA lowers the sum to an ICI psum).  The byte counter sums in
+    int64 — mesh import enables x64 (below) so the reduction cannot
+    silently wrap to int32 depending on WHICH module was imported
+    first (jit executables cache per mesh, so a wrapped trace would
+    stick)."""
+    key = (key_prefix,) + mesh_cache_key(mesh)
     if key not in _STEP_CACHE:
-        from ..ops.gf_jax import bitplane_matmul
-
-        def step(bitmat, d):
-            parity = bitplane_matmul(bitmat, d)
-            # genuine cross-shard reduction: XLA lowers it to an ICI psum
+        def step(op, d):
+            out = kernel(op, d)
             total = jnp.sum(d.astype(jnp.int64))
-            return parity, total
+            return out, total
 
         _STEP_CACHE[key] = jax.jit(
             step,
             in_shardings=(replicated_sharding(mesh), batch_sharding(mesh)),
             out_shardings=(batch_sharding(mesh), None))
     return _STEP_CACHE[key]
+
+
+def _encode_step_fn(mesh: Mesh):
+    from ..ops.gf_jax import bitplane_matmul
+    return _make_step_fn(mesh, "bitplane", bitplane_matmul)
 
 
 def distributed_encode_step(mesh: Mesh, bitmat: jax.Array,
@@ -99,3 +111,20 @@ def distributed_encode_step(mesh: Mesh, bitmat: jax.Array,
     """
     sharded = jax.device_put(data, batch_sharding(mesh))
     return _encode_step_fn(mesh)(bitmat, sharded)
+
+
+def _xor_step_fn(mesh: Mesh):
+    from ..ops.xor_kernel import xor_matmul_w32
+    return _make_step_fn(mesh, "xor", xor_matmul_w32)
+
+
+def distributed_xor_encode_step(mesh: Mesh, masks: jax.Array,
+                                words: jax.Array
+                                ) -> Tuple[jax.Array, jax.Array]:
+    """Sharded FLAGSHIP encode: the bit-sliced masked-XOR kernel over a
+    stripe-sharded batch (words [B, C, W] int32 sharded on B), masks
+    replicated — the multi-chip form of the 101x kernel.  Returns
+    (parity planes [B, R, W], cluster-wide psum byte counter)."""
+    sharded = jax.device_put(jnp.asarray(words, jnp.int32),
+                             batch_sharding(mesh))
+    return _xor_step_fn(mesh)(jnp.asarray(masks, jnp.int32), sharded)

@@ -384,3 +384,47 @@ def test_filestore_gc_reclaims_log_space(tmp_path):
     for oid, data in final.items():
         assert fs2.read(c, oid) == data
     fs2.close()
+
+
+def test_objectstore_tool_surgery(tmp_path, capsys):
+    """Offline store surgery (ceph-objectstore-tool role): list, info,
+    export from one store, import into another, remove, fsck rc."""
+    import json as _json
+    from ceph_tpu.tools import objectstore_tool as ot
+    a = str(tmp_path / "osd_a")
+    b = str(tmp_path / "osd_b")
+    fs = FileStore(a, fsync=False)
+    txn = Transaction()
+    txn.write((3, 1), "2:blob", 0, b"surgical payload " * 50)
+    txn.setattr((3, 1), "2:blob", "ver", b"7")
+    txn.omap_set((3, 1), "2:blob", "snap", b"2")
+    fs.apply_transaction(txn)
+    fs.close()
+    FileStore(b, fsync=False).close()          # empty target store
+    assert ot.main(["--store", a, "list-pgs"]) == 0
+    assert capsys.readouterr().out.strip() == "3.1"
+    assert ot.main(["--store", a, "list", "--pg", "3.1"]) == 0
+    assert "2:blob" in capsys.readouterr().out
+    assert ot.main(["--store", a, "info", "--pg", "3.1",
+                    "--oid", "2:blob"]) == 0
+    info = _json.loads(capsys.readouterr().out)
+    assert info["size"] == 850 and info["n_xattrs"] == 1 \
+        and info["n_omap"] == 1
+    exp = str(tmp_path / "obj.json")
+    assert ot.main(["--store", a, "export", "--pg", "3.1",
+                    "--oid", "2:blob", "--file", exp]) == 0
+    capsys.readouterr()
+    assert ot.main(["--store", b, "import", "--pg", "3.1",
+                    "--oid", "2:blob", "--file", exp]) == 0
+    capsys.readouterr()
+    fb = FileStore(b, fsync=False)
+    assert fb.read((3, 1), "2:blob") == b"surgical payload " * 50
+    assert fb.getattr((3, 1), "2:blob", "ver") == b"7"
+    assert fb.omap_get((3, 1), "2:blob", "snap") == b"2"
+    fb.close()
+    assert ot.main(["--store", a, "remove", "--pg", "3.1",
+                    "--oid", "2:blob"]) == 0
+    capsys.readouterr()
+    assert ot.main(["--store", a, "fsck"]) == 0
+    out = _json.loads(capsys.readouterr().out)
+    assert out["bad_objects"] == []
