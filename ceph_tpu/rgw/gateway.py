@@ -37,6 +37,24 @@ class Bucket:
     def __init__(self, gw: "RGWGateway", name: str):
         self.gw = gw
         self.name = name
+        self._bilog = None
+
+    @property
+    def bilog(self):
+        """Bucket index log (the RGW bilog role): every put/delete is
+        recorded for multisite sync (rgw/sync.py replays it)."""
+        if self._bilog is None:
+            from ..fs.journaler import Journaler
+            self._bilog = Journaler(self.gw.ioctx,
+                                    f"rgw.bilog.{self.name}")
+        return self._bilog
+
+    def _log_op(self, op: str, key: str) -> None:
+        # reload the journal header first: another live handle of this
+        # bucket may have appended since ours cached its sequence — a
+        # stale seq would duplicate and sync would drop the entry
+        self.bilog._load_header()
+        self.bilog.append(json.dumps({"op": op, "key": key}).encode())
 
     # ------------------------------------------------------------- index --
     def _index_oid(self) -> str:
@@ -63,6 +81,11 @@ class Bucket:
                    metadata: Optional[Dict[str, str]] = None) -> str:
         """-> ETag.  Data object first, index entry second."""
         etag = hashlib.md5(data).hexdigest()
+        # bilog entry FIRST (the prepare-before-index-transaction
+        # order): a crash between log and index leaves an entry whose
+        # replay finds no object and skips — never a visible object
+        # that multisite would silently miss
+        self._log_op("put", key)
         self.gw.ioctx.write_full(self._data_oid(key), data)
         idx = self._read_index()
         idx[key] = {"size": len(data), "etag": etag,
@@ -89,6 +112,7 @@ class Bucket:
             raise RGWError(f"NoSuchKey: {key}")
         # index entry first, then data: a crash leaves an orphan data
         # object (GC-able), never a dangling index entry
+        self._log_op("delete", key)       # log-ahead, like put
         del idx[key]
         self._write_index(idx)
         try:
@@ -172,6 +196,19 @@ class RGWGateway:
             raise RGWError(f"BucketNotEmpty: {name}")
         try:
             self.ioctx.remove(b._index_oid())
+        except Exception:
+            pass
+        # drop the bilog chain + header so a recreated bucket starts
+        # with a fresh log (sync position objects are per-zone and
+        # owned by their agents)
+        j = b.bilog
+        for idx_no in range(j.first, j.active + 1):
+            try:
+                self.ioctx.remove(j._obj_oid(idx_no))
+            except Exception:
+                pass
+        try:
+            self.ioctx.remove(j._header_oid())
         except Exception:
             pass
         del d[name]

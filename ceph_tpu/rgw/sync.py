@@ -1,0 +1,114 @@
+"""Multisite bucket sync — bilog replay between zones.
+
+The RGW multisite role (rgw data sync: per-bucket index logs consumed
+by the peer zone's sync agent) reduced to its core: every put/delete on
+a bucket lands in its bilog (gateway.py); a BucketSyncAgent on the peer
+side replays entries past its durable committed position, fetching
+object payloads from the source zone and applying them locally.
+Idempotent, incremental, restart-safe — the same consume/commit shape
+as rbd-mirror over the shared Journaler.
+"""
+from __future__ import annotations
+
+import json
+from typing import Dict
+
+from .gateway import Bucket, RGWError, RGWGateway
+
+
+class BucketSyncAgent:
+    def __init__(self, src: RGWGateway, dst: RGWGateway, bucket: str,
+                 zone: str):
+        """``zone`` names the DESTINATION and keys the committed
+        position in the source pool — every destination zone must use
+        a distinct name, or agents would consume each other's cursor
+        and silently skip entries."""
+        self.src_gw = src
+        self.dst_gw = dst
+        self.bucket = bucket
+        self.zone = zone
+        self.src = src.bucket(bucket)
+        self._register_zone()
+
+    def _zones_oid(self) -> str:
+        return f"rgw.zones.{self.bucket}"
+
+    def _register_zone(self) -> None:
+        """Journal-client registration: trim must respect the SLOWEST
+        registered zone, so every destination announces itself."""
+        zones = self._zones()
+        if self.zone not in zones:
+            zones.append(self.zone)
+            self.src_gw.ioctx.write_full(
+                self._zones_oid(), json.dumps(sorted(zones)).encode())
+
+    def _zones(self):
+        try:
+            return json.loads(
+                self.src_gw.ioctx.read(self._zones_oid()).decode())
+        except Exception:
+            return []
+
+    def _dst_bucket(self) -> Bucket:
+        try:
+            return self.dst_gw.bucket(self.bucket)
+        except RGWError:
+            return self.dst_gw.create_bucket(self.bucket)
+
+    # ------------------------------------------------------- positions --
+    def _pos_oid(self) -> str:
+        return f"rgw.sync.{self.bucket}.{self.zone}"
+
+    def committed_position(self) -> int:
+        try:
+            return int(self.src_gw.ioctx.read(self._pos_oid()).decode())
+        except Exception:
+            return -1
+
+    def _commit(self, seq: int) -> None:
+        self.src_gw.ioctx.write_full(self._pos_oid(), str(seq).encode())
+
+    # ----------------------------------------------------------- replay --
+    def sync(self) -> Dict[str, int]:
+        """One sync pass; returns {'puts': n, 'deletes': n}.  The
+        position commits ONCE per pass and consumed journal objects
+        are trimmed (the rbd-mirror consume/commit/trim shape)."""
+        dst = self._dst_bucket()
+        pos = self.committed_position()
+        stats = {"puts": 0, "deletes": 0}
+        last = pos
+        for seq, payload in self.src.bilog.replay():
+            if seq <= pos:
+                continue
+            ent = json.loads(payload.decode())
+            key = ent["key"]
+            if ent["op"] == "put":
+                try:
+                    data, meta = self.src.get_object(key)
+                    dst.put_object(key, data,
+                                   metadata=meta.get("meta") or None)
+                    stats["puts"] += 1
+                except RGWError:
+                    pass          # logged-ahead put that never landed,
+                    # or deleted again later in the log
+            elif ent["op"] == "delete":
+                try:
+                    dst.delete_object(key)
+                    stats["deletes"] += 1
+                except RGWError:
+                    pass          # never synced or already gone
+            last = seq
+        if last > pos:
+            self._commit(last)
+            # trim only what EVERY registered zone has consumed (the
+            # min-commit rule of multi-client journals)
+            mins = []
+            for z in self._zones():
+                try:
+                    mins.append(int(self.src_gw.ioctx.read(
+                        f"rgw.sync.{self.bucket}.{z}").decode()))
+                except Exception:
+                    mins.append(-1)       # registered, never synced
+            if mins:
+                self.src.bilog.trim_to(min(mins) + 1)
+        return stats

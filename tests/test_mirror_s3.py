@@ -146,3 +146,43 @@ def test_process_cluster_ec_recovery(tmp_path):
         rc.close()
     finally:
         v.stop()
+
+
+# ----------------------------------------------------- multisite sync ----
+
+def test_rgw_multisite_bucket_sync():
+    """Bilog-driven zone sync: puts/deletes replay to the peer zone
+    incrementally with a durable committed position."""
+    from ceph_tpu.client.rados import Rados
+    from ceph_tpu.cluster.monitor import Monitor
+    from ceph_tpu.rgw import RGWGateway
+    from ceph_tpu.rgw.sync import BucketSyncAgent
+    zone_a, zone_b = make_sim(), make_sim()
+    gw_a = RGWGateway(Rados(zone_a, Monitor(zone_a.osdmap)).connect()
+                      .open_ioctx("rep"))
+    gw_b = RGWGateway(Rados(zone_b, Monitor(zone_b.osdmap)).connect()
+                      .open_ioctx("rep"))
+    a = gw_a.create_bucket("assets")
+    a.put_object("logo.png", b"PNG" * 500, metadata={"v": "1"})
+    a.put_object("doomed.txt", b"bye")
+    agent = BucketSyncAgent(gw_a, gw_b, "assets", zone="zone-b")
+    s = agent.sync()
+    assert s == {"puts": 2, "deletes": 0}
+    b = gw_b.bucket("assets")
+    data, ent = b.get_object("logo.png")
+    assert data == b"PNG" * 500 and ent["meta"]["v"] == "1"
+    # incremental: nothing new replays twice
+    assert agent.sync() == {"puts": 0, "deletes": 0}
+    a.delete_object("doomed.txt")
+    a.put_object("logo.png", b"PNG2" * 500)
+    s = agent.sync()
+    assert s["deletes"] == 1 and s["puts"] == 1
+    assert b.get_object("logo.png")[0] == b"PNG2" * 500
+    import pytest
+    from ceph_tpu.rgw import RGWError
+    with pytest.raises(RGWError):
+        b.get_object("doomed.txt")
+    # a fresh agent resumes from the durable position
+    assert BucketSyncAgent(gw_a, gw_b, "assets",
+                           zone="zone-b").sync() == \
+        {"puts": 0, "deletes": 0}
