@@ -54,6 +54,8 @@ class MDS:
                 int(self.meta.read("mds_inotable").decode()))
         except Exception:
             pass
+        # advisory file locks (Locker role) — MDS session state
+        self._locks: Dict[int, Dict[str, bool]] = {}
         # root must exist before replay: journaled ops re-apply into it
         if not self._dir_exists(ROOT_INO):
             self._write_dir(ROOT_INO, {})
@@ -222,6 +224,49 @@ class MDS:
             pos += olen
         return bytes(out)
 
+    # ------------------------------------------------------------- locker --
+    # Advisory file locks (the src/mds/Locker.cc setfilelock/flock
+    # role, reduced to its semantics): shared locks coexist, exclusive
+    # locks exclude everything, per-owner release.  Lock state is MDS
+    # session state (the reference's locks live in the MDS's in-memory
+    # lock machine, not the journal) — a failed-over MDS starts with
+    # clean locks, like real clients re-acquiring after reconnect.
+
+    def setlk(self, path: str, owner: str,
+              exclusive: bool = True) -> bool:
+        """Try-lock; False on conflict (the F_SETLK no-wait shape)."""
+        ent = self._lookup(path)
+        ino = ent["ino"]
+        holders = self._locks.setdefault(ino, {})
+        cur = holders.get(owner)
+        if cur is not None and cur == exclusive:
+            return True                      # re-grant, idempotent
+        others = {o: x for o, x in holders.items() if o != owner}
+        if exclusive and others:
+            return False
+        if not exclusive and any(others.values()):
+            return False
+        holders[owner] = exclusive
+        return True
+
+    def getlk(self, path: str) -> Dict[str, bool]:
+        """Current holders: {owner: exclusive} (F_GETLK role)."""
+        ent = self._lookup(path)
+        return dict(self._locks.get(ent["ino"], {}))
+
+    def unlock(self, path: str, owner: str) -> None:
+        ent = self._lookup(path)
+        self._locks.get(ent["ino"], {}).pop(owner, None)
+
+    def release_owner(self, owner: str) -> int:
+        """Drop every lock a (dead) client held — the session-close
+        cleanup the reference's Locker does on client eviction."""
+        n = 0
+        for holders in self._locks.values():
+            if holders.pop(owner, None) is not None:
+                n += 1
+        return n
+
     # ------------------------------------------------------------ the API --
     def mkdir(self, path: str) -> int:
         parent, name = self._resolve(path)
@@ -248,6 +293,7 @@ class MDS:
         ent = self._read_dir(parent).get(name)
         if ent is None or ent["type"] != "file":
             raise FSError(f"no such file: {path}")
+        self._locks.pop(ent["ino"], None)   # locks die with the inode
         # purge every data object the file's size can cover; sparse
         # holes (missing objnos) are skipped, not treated as the end
         n_objs = -(-ent.get("size", 0) // self.layout.object_size)
@@ -266,6 +312,7 @@ class MDS:
             raise FSError(f"no such directory: {path}")
         if self._read_dir(ent["ino"]):
             raise FSError(f"directory not empty: {path}")
+        self._locks.pop(ent["ino"], None)   # locks die with the inode
         self._journal_and_apply({"op": "rmdir", "parent": parent,
                                  "name": name, "ino": ent["ino"]})
 

@@ -79,18 +79,31 @@ class Vstart:
             cwd=os.path.dirname(os.path.dirname(
                 os.path.dirname(os.path.abspath(__file__)))))
 
+    @staticmethod
+    def _clear_stale_sock(path: str) -> None:
+        """A SIGKILLed daemon leaves its socket file behind; remove it
+        so the readiness wait below observes the NEW daemon's bind,
+        not a stale file that refuses connections."""
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
+
     def start_mon(self, timeout: float = 30.0) -> None:
+        sock = os.path.join(self.dir, "mon.sock")
+        self._clear_stale_sock(sock)
         self.procs["mon"] = self._spawn(
             "mon", "--cluster-dir", self.dir)
-        self._wait_sock(os.path.join(self.dir, "mon.sock"), timeout)
+        self._wait_sock(sock, timeout)
 
     def start_osd(self, osd_id: int, timeout: float = 30.0,
                   hb_interval: float = 0.5) -> None:
+        sock = os.path.join(self.dir, f"osd.{osd_id}.sock")
+        self._clear_stale_sock(sock)
         self.procs[f"osd.{osd_id}"] = self._spawn(
             "osd", "--cluster-dir", self.dir, "--id", str(osd_id),
             "--hb-interval", str(hb_interval))
-        self._wait_sock(os.path.join(self.dir, f"osd.{osd_id}.sock"),
-                        timeout)
+        self._wait_sock(sock, timeout)
 
     @staticmethod
     def _wait_sock(path: str, timeout: float) -> None:

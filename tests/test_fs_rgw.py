@@ -142,3 +142,41 @@ def test_rgw_list_semantics(ioctx):
     assert not r2["is_truncated"]
     assert [c["key"] for c in r1["contents"] + r2["contents"]] == \
         ["a/1", "a/2", "b/1", "b/sub/2", "top"]
+
+
+def test_mds_file_locks(ioctx, rados):
+    """Locker slice (src/mds/Locker.cc flock semantics): shared locks
+    coexist, exclusive excludes, per-owner release + session cleanup."""
+    data_ioctx = rados.open_ioctx("rep")
+    mds = MDS(ioctx, data_ioctx)
+    fs = CephFSClient(mds)
+    fs.mkdir("/lk")
+    fs.write("/lk/f", b"locked data")
+    assert mds.setlk("/lk/f", "clientA", exclusive=True)
+    assert not mds.setlk("/lk/f", "clientB", exclusive=True)
+    assert not mds.setlk("/lk/f", "clientB", exclusive=False)
+    assert mds.setlk("/lk/f", "clientA", exclusive=True)   # re-grant
+    mds.unlock("/lk/f", "clientA")
+    # shared holders coexist; exclusive blocked until all release
+    assert mds.setlk("/lk/f", "r1", exclusive=False)
+    assert mds.setlk("/lk/f", "r2", exclusive=False)
+    assert not mds.setlk("/lk/f", "w", exclusive=True)
+    assert mds.getlk("/lk/f") == {"r1": False, "r2": False}
+    # session cleanup drops a dead client's locks everywhere
+    fs.write("/lk/g", b"second")
+    assert mds.setlk("/lk/g", "r1", exclusive=False)
+    assert mds.release_owner("r1") == 2
+    mds.unlock("/lk/f", "r2")
+    assert mds.setlk("/lk/f", "w", exclusive=True)
+
+
+def test_mds_locks_die_with_inode(ioctx, rados):
+    mds = MDS(ioctx, rados.open_ioctx("rep"))
+    fs = CephFSClient(mds)
+    fs.mkdir("/lk2")
+    fs.write("/lk2/gone", b"x")
+    assert mds.setlk("/lk2/gone", "A", exclusive=True)
+    ino = mds._lookup("/lk2/gone")["ino"]
+    fs.unlink("/lk2/gone")
+    assert ino not in mds._locks
+    assert mds.release_owner("A") == 0      # nothing leaked
