@@ -256,15 +256,22 @@ class MDS:
 
     def unlock(self, path: str, owner: str) -> None:
         ent = self._lookup(path)
-        self._locks.get(ent["ino"], {}).pop(owner, None)
+        holders = self._locks.get(ent["ino"])
+        if holders is not None:
+            holders.pop(owner, None)
+            if not holders:
+                del self._locks[ent["ino"]]
 
     def release_owner(self, owner: str) -> int:
         """Drop every lock a (dead) client held — the session-close
         cleanup the reference's Locker does on client eviction."""
         n = 0
-        for holders in self._locks.values():
+        for ino in list(self._locks):
+            holders = self._locks[ino]
             if holders.pop(owner, None) is not None:
                 n += 1
+            if not holders:
+                del self._locks[ino]
         return n
 
     # ------------------------------------------------------------ the API --
@@ -293,7 +300,6 @@ class MDS:
         ent = self._read_dir(parent).get(name)
         if ent is None or ent["type"] != "file":
             raise FSError(f"no such file: {path}")
-        self._locks.pop(ent["ino"], None)   # locks die with the inode
         # purge every data object the file's size can cover; sparse
         # holes (missing objnos) are skipped, not treated as the end
         n_objs = -(-ent.get("size", 0) // self.layout.object_size)
@@ -304,6 +310,9 @@ class MDS:
                 pass
         self._journal_and_apply({"op": "unlink", "parent": parent,
                                  "name": name})
+        # locks die with the inode — only AFTER the unlink committed
+        # (a failed unlink must not release other clients' locks)
+        self._locks.pop(ent["ino"], None)
 
     def rmdir(self, path: str) -> None:
         parent, name = self._resolve(path)
@@ -312,9 +321,9 @@ class MDS:
             raise FSError(f"no such directory: {path}")
         if self._read_dir(ent["ino"]):
             raise FSError(f"directory not empty: {path}")
-        self._locks.pop(ent["ino"], None)   # locks die with the inode
         self._journal_and_apply({"op": "rmdir", "parent": parent,
                                  "name": name, "ino": ent["ino"]})
+        self._locks.pop(ent["ino"], None)   # after the commit, as above
 
     def rename(self, src: str, dst: str) -> None:
         sp, sn = self._resolve(src)

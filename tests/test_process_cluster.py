@@ -202,3 +202,74 @@ def test_mon_sigkill_restart_preserves_cluster_state(cluster):
     assert rc2.get(1, "post-restart") == b"mon is back"
     rc.close()
     rc2.close()
+
+
+@pytest.mark.slow
+def test_process_thrasher_combined(tmp_path):
+    """The process-level Thrasher: randomized OSD SIGKILL/restart plus
+    one mon kill mid-stream, interleaved replicated AND EC writes, and
+    a full verification pass at the end — zero acknowledged-write loss
+    across the whole drill."""
+    import random
+    from ceph_tpu.client.remote import RemoteCluster
+    d = str(tmp_path / "thrash")
+    build_cluster_dir(
+        d, n_osds=6, osds_per_host=2, fsync=False,
+        pools=[{"id": 1, "name": "rep", "type": 1, "size": 3,
+                "pg_num": 8, "crush_rule": 0},
+               {"id": 2, "name": "ec", "type": 3, "size": 5,
+                "pg_num": 8, "crush_rule": 1,
+                "erasure_code_profile": "p"}])
+    v = Vstart(d)
+    v.start(6, hb_interval=0.25)
+    rng = random.Random(7)
+    nprng = np.random.default_rng(7)
+    acked = {}
+    try:
+        rc = RemoteCluster(d, ec_profiles={
+            "p": {"plugin": "jax", "k": "3", "m": "2",
+                  "layout": "bitsliced"}})
+        down = set()
+        for step in range(30):
+            action = rng.random()
+            if action < 0.2 and len(down) < 2:
+                victim = rng.choice([i for i in range(6)
+                                     if i not in down])
+                v.kill9(f"osd.{victim}")
+                down.add(victim)
+            elif action < 0.35 and down:
+                back = down.pop()
+                v.start_osd(back, hb_interval=0.25)
+            if step == 15:                  # unconditional: the mon
+                # kill must actually happen mid-stream
+                v.kill9("mon")
+                v.start_mon()
+                rc.close()
+                rc = RemoteCluster(d, ec_profiles={
+                    "p": {"plugin": "jax", "k": "3", "m": "2",
+                          "layout": "bitsliced"}})
+            pool = 1 if rng.random() < 0.5 else 2
+            name = f"t{step}"
+            data = nprng.integers(0, 256, rng.randrange(500, 8000),
+                                  dtype=np.uint8).tobytes()
+            try:
+                rc.refresh_map()
+                rc.put(pool, name, data)
+                acked[(pool, name)] = data
+            except IOError:
+                pass              # unacked writes carry no promise
+        # heal: restart everything, recover both pools
+        for back in list(down):
+            v.start_osd(back, hb_interval=0.25)
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline and rc.status()["n_up"] < 6:
+            time.sleep(0.3)
+        rc.refresh_map()
+        rc.recover_pool(1)
+        rc.recover_ec_pool(2)
+        assert len(acked) >= 20, f"thrasher acked only {len(acked)}"
+        for (pool, name), data in acked.items():
+            assert rc.get(pool, name) == data, (pool, name)
+        rc.close()
+    finally:
+        v.stop()
