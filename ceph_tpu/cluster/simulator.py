@@ -428,6 +428,113 @@ class ClusterSim:
                 self.snapsets.pop((pool_id, name), None)
         return trimmed
 
+    # ---------------------------------------------------------- pg split --
+    def reshard_pool(self, pool_id: int, new_pg_num: int,
+                     bump_epoch: bool = True,
+                     old_pg_num: Optional[int] = None) -> Dict[str, int]:
+        """PG split/merge: change pg_num and MOVE every object whose
+        placement group changed to its new home (the role of Ceph's
+        incremental PG splitting, pg_num/pgp_num bumps + PastIntervals;
+        collapsed here to one batched reshard pass).  Snapshot clones
+        move with their heads' namespaces.
+
+        Safety: an old-home shard copy is deleted ONLY once its new
+        home durably holds it — a shard whose target is unmapped or
+        dead stays where it is (degraded, recoverable later), never
+        destroyed.  ``old_pg_num`` lets mon-backed callers reshard
+        AFTER the map change committed (the old geometry can no longer
+        be read off the pool then)."""
+        pool = self.osdmap.pools[pool_id]
+        if old_pg_num is None:
+            old_pg_num = pool.pg_num
+        if new_pg_num == old_pg_num and pool.pg_num == new_pg_num:
+            return {"objects_moved": 0, "shards_moved": 0,
+                    "shards_stranded": 0}
+        names = [n for (pid, n) in self.objects if pid == pool_id]
+        # old pgs under the OLD geometry, regardless of current state
+        cur = (pool.pg_num, pool.pgp_num)
+        pool.pg_num = pool.pgp_num = old_pg_num
+        old_pgs = {n: self.object_pg(pool, n) for n in names}
+        pool.pg_num, pool.pgp_num = cur
+        pool.pg_num = new_pg_num
+        pool.pgp_num = new_pg_num
+        if bump_epoch:
+            # standalone sims advance the epoch directly; mon-backed
+            # callers commit an incremental instead (a direct bump
+            # would gap the mon's incremental stream)
+            self.osdmap.bump_epoch()
+        stats = {"objects_moved": 0, "shards_moved": 0,
+                 "shards_stranded": 0}
+        n_shards = pool.size
+        for n in names:
+            new_pg = self.object_pg(pool, n)
+            old_pg = old_pgs[n]
+            if new_pg == old_pg:
+                continue
+            new_up = self.pg_up(pool, new_pg)
+            moved = 0
+            placed_members: Set[int] = set()
+            for shard in range(n_shards):
+                payload = None
+                for osd in self.osds:         # any holder of the shard
+                    p = osd.get((pool_id, old_pg, n, shard))
+                    if p is not None:
+                        payload = p
+                        break
+                if payload is None:
+                    continue
+                placed_this = False
+                if pool.type == POOL_REPLICATED:
+                    for osd_id in [o for o in new_up if o != ITEM_NONE]:
+                        try:
+                            self.services[osd_id].put_recovery(
+                                (pool_id, new_pg, n, shard), payload)
+                        except IOError:
+                            continue          # undetected-dead member
+                        placed_members.add(osd_id)
+                        placed_this = True
+                        moved += 1
+                else:
+                    tgt = new_up[shard] if shard < len(new_up) \
+                        else ITEM_NONE
+                    if tgt != ITEM_NONE and self.osds[tgt].alive:
+                        try:
+                            self.services[tgt].put_recovery(
+                                (pool_id, new_pg, n, shard), payload)
+                            placed_members.add(tgt)
+                            placed_this = True
+                            moved += 1
+                        except IOError:
+                            pass
+                if not placed_this:
+                    # mapped home unavailable: park the shard under its
+                    # NEW pg key on ANY live OSD so the any-live-OSD
+                    # read fallback and recover_all can still find it
+                    # (old-pg keys are invisible to the new geometry)
+                    for osd in self.osds:
+                        if not osd.alive:
+                            continue
+                        try:
+                            self.services[osd.id].put_recovery(
+                                (pool_id, new_pg, n, shard), payload)
+                            placed_this = True
+                            stats["shards_stranded"] += 1
+                            break
+                        except IOError:
+                            continue
+                if placed_this:
+                    for osd in self.osds:      # old copy superseded
+                        osd.delete((pool_id, old_pg, n, shard))
+                # else: NO live OSD anywhere — the old-pg copy is the
+                # only copy; leave it untouched
+            if moved:
+                stats["objects_moved"] += 1
+                stats["shards_moved"] += moved
+                # only members that durably RECEIVED shards advance
+                # (a skipped member must stay delta-recoverable)
+                self._log_write(pool_id, new_pg, n, placed_members)
+        return stats
+
     # ------------------------------------------------------ object classes --
     def exec_cls(self, pool_id: int, name: str, cls: str, method: str,
                  inp: bytes = b"") -> bytes:

@@ -110,13 +110,24 @@ class MgrModuleHost:
 
     # ------------------------------------------------------- mon commands --
     def set_pool_pg_num(self, pool_id: int, pg_num: int) -> None:
-        """Commit a pg_num change — through the mon's consensus +
-        durable incremental when present (never a bare epoch bump,
-        which would leave a gap in the incremental stream)."""
+        """Commit a pg_num change.  With a mon: consensus + durable
+        incremental FIRST (no quorum -> RuntimeError, nothing moves),
+        then the PG-split data movement reshards objects from the old
+        geometry.  Without a mon: the sim reshards and bumps the epoch
+        itself."""
+        old = self.sim.osdmap.pools[pool_id].pg_num
         if self.mon is not None:
             inc = self.mon.next_incremental()
             inc.new_pool_pg_num[pool_id] = pg_num
-            self.mon.commit_incremental(inc)
+            if not self.mon.commit_incremental(inc):
+                raise RuntimeError(
+                    f"pg_num change for pool {pool_id} lost quorum")
+            if hasattr(self.sim, "reshard_pool"):
+                self.sim.reshard_pool(pool_id, pg_num,
+                                      bump_epoch=False, old_pg_num=old)
+            return
+        if hasattr(self.sim, "reshard_pool"):
+            self.sim.reshard_pool(pool_id, pg_num)
             return
         pool = self.sim.osdmap.pools[pool_id]
         pool.pg_num = pg_num

@@ -34,10 +34,11 @@ class PgAutoscaler(MgrModule):
 
     def __init__(self, host):
         super().__init__(host)
-        # default warn: applying a pg_num change REMAPS existing
-        # objects, which needs PG splitting/migration to move data —
-        # operators opt into mode "on" per the reference's
-        # pg_autoscale_mode semantics
+        # mode "on" is safe: set_pool_pg_num reshards the pool's
+        # objects to their new PGs before the map change commits
+        # (ClusterSim.reshard_pool, the PG-split data movement);
+        # default remains "warn" per the reference's conservative
+        # pg_autoscale_mode posture — operators opt in
         self.mode = "warn"           # on | warn (off = module disabled)
         self.last_recommendations: List[Dict] = []
 
