@@ -259,3 +259,40 @@ def test_lrc_locality_rule_generation():
             hosts = [osd_host[o] for o in chunk_osds]
             assert len(set(hosts)) == len(hosts), "hosts collide"
     assert placed_any > 48          # rule actually places
+
+
+def test_cluster_admin_commands():
+    """The `ceph daemon`/`ceph tell` command surface over a live
+    cluster: status/df/osd tree/pg dump/scrub/snap ls/health through
+    the AdminServer registry (admin_socket.cc role)."""
+    import json
+    from ceph_tpu.common.admin import AdminServer
+    from ceph_tpu.cluster.admin_commands import register_cluster_commands
+    from ceph_tpu.cluster.monitor import Monitor
+    from tests.test_snaps import make_sim
+    sim = make_sim()
+    mon = Monitor(sim.osdmap)
+    srv = AdminServer()
+    register_cluster_commands(srv, sim, mon)
+    sim.put(1, "adm1", b"x" * 700)
+    sim.put(2, "adm2", b"y" * 9000)
+    sim.snap_create(1, "s1")
+    st = srv.handle({"prefix": "status"})["result"]
+    assert st["osds"]["up"] == st["osds"]["total"] == 8
+    assert st["objects"] == 2
+    df = srv.handle({"prefix": "df"})["result"]
+    assert df[1]["bytes"] == 700 and df[2]["bytes"] == 9000
+    tree = srv.handle({"prefix": "osd tree"})["result"]
+    assert "host" in tree and "osd.0" in tree
+    pgd = srv.handle({"prefix": "pg dump", "pool": 1})["result"]
+    assert len(pgd["pgs"]) == sim.osdmap.pools[1].pg_num
+    sc = srv.handle({"prefix": "scrub", "pool": 2})["result"]
+    assert sum(r["objects"] for r in sc) == 1
+    assert all(r["inconsistent"] == [] for r in sc)
+    snaps = srv.handle({"prefix": "snap ls", "pool": 1})["result"]
+    assert list(snaps.values()) == ["s1"]
+    health = srv.handle({"prefix": "health"})["result"]
+    assert isinstance(health, list)
+    # full JSON round trip (the socket serving format)
+    out = json.loads(srv.handle_json('{"prefix": "df"}'))
+    assert out["result"]["1"]["objects"] == 1   # JSON keys stringify
