@@ -1,0 +1,265 @@
+"""Device-resident EC shard staging — the HBM tier of the objectstore.
+
+With ``layout=bitsliced`` (the default for jax-plugin EC pools) a shard's
+at-rest bytes ARE the plane words the flagship masked-XOR kernel
+consumes: chunk bytes [L] viewed as [8, L/8] plane regions, packed 32
+GF(2) lanes per int32 word (ops/gf2.py).  This module keeps those words
+resident in device HBM so the whole EC data plane — encode on ingest,
+degraded-read decode, recovery rebuild — runs device-to-device, exactly
+the reference property that ECBackend shard stores hold chunks in the
+layout its codecs consume (jerasure packet layout,
+src/erasure-code/jerasure/ErasureCodeJerasure.cc:162,274; shard store
+src/osd/ECBackend.cc:934,1015).
+
+The durable objectstore (MemStore/FileStore) stays the source of truth
+for *durability*; this cache is the staging tier with two flush modes:
+
+  * eager (default): every device put also writes the identical bytes
+    through to the objectstore in the same op — crash semantics are
+    exactly the non-staged path's, and entries are validated against
+    the store's checksum on read (an external byte poke — corruption
+    tests, objectstore surgery — invalidates the staged copy).
+  * staged: device puts mark entries dirty and defer the host write
+    until ``flush()`` — the BlueStore deferred-write/WAL shape; the
+    dirty entry itself is the authoritative copy until flushed.
+
+Keys are the simulator's ShardKey (pool, pg, object, shard).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, Iterable, Optional, Tuple
+
+ShardKey = Tuple[int, int, str, int]
+
+
+@dataclass(frozen=True)
+class ShardRef:
+    """A staged shard = one row/column of a shared device buffer.
+
+    An object's k+m shard files are views of the buffers the encode
+    dispatch already produced: data shards are columns of the client's
+    [S, k, U] stripe view, parity shards are columns of the [S, m, U]
+    encode output, rebuilt shards are columns of a decode output.
+    Staging k+m shards therefore costs ZERO extra device ops — no
+    pack/slice dispatches — which matters doubly on this driver, where
+    every dispatch pays tens of ms of tunnel latency; on real hardware
+    it is simply the zero-copy layout.
+
+    axis=0: ``buf[idx]`` is the shard file ([n, L] row buffer).
+    axis=1: ``buf[s0:s1, idx]`` flattened is the shard file ([S, n, U]
+    stripewise buffer — the at-rest order of ECUtil stripe_info_t;
+    ``s0/s1`` select one object's stripe range out of a batched
+    multi-object buffer, None = the whole leading axis).
+    """
+    buf: object            # jax.Array uint8 plane words
+    idx: int
+    axis: int = 0
+    s0: int = 0
+    s1: Optional[int] = None
+
+    def _rows(self) -> int:
+        end = self.buf.shape[0] if self.s1 is None else self.s1
+        return int(end - self.s0)
+
+    @property
+    def size(self) -> int:
+        """Shard payload size in BYTES (buffers are int32 plane words
+        on the staged path; u8 only for host-upload wrappers)."""
+        itemsize = int(getattr(self.buf.dtype, "itemsize", 1))
+        if self.axis == 0:
+            return int(self.buf.shape[-1]) * itemsize
+        return self._rows() * int(self.buf.shape[2]) * itemsize
+
+    def materialize(self):
+        """The shard as its own device array (one slice dispatch)."""
+        if self.axis == 0:
+            return self.buf[self.idx]
+        return self.buf[self.s0:self.s0 + self._rows(),
+                        self.idx].reshape(-1)
+
+    def __array__(self, dtype=None):
+        import numpy as np
+        a = np.asarray(self.materialize())
+        return a.astype(dtype) if dtype is not None else a
+
+
+def as_ref(arr) -> ShardRef:
+    """Wrap a bare [L] device array as a single-row ref."""
+    return ShardRef(arr.reshape(1, -1), 0)
+
+
+@dataclass
+class _Entry:
+    arr: ShardRef          # plane words (row of a packed buffer)
+    csum: Optional[int]    # objectstore crc at staging time; None=dirty
+    nbytes: int
+
+
+# --------------------------------------------------- jitted layout ops --
+# Each helper is ONE device dispatch over shared packed buffers; jit
+# instances are created lazily so importing this module needs no jax.
+
+_jits: Dict[str, object] = {}
+
+
+def _jit(name, fn, static):
+    if name not in _jits:
+        import jax
+        _jits[name] = jax.jit(fn, static_argnames=static)
+    return _jits[name]
+
+
+def _dedup(refs, index=None, bufs=None):
+    """Unique buffers + per-ref (buf_index, idx, axis, s0, rows) spec.
+    Shards of one object share buffers; passing each once keeps the
+    XLA argument footprint at one buffer, not k copies."""
+    if bufs is None:
+        bufs, index = [], {}
+    spec = []
+    for r in refs:
+        i = index.get(id(r.buf))
+        if i is None:
+            i = index[id(r.buf)] = len(bufs)
+            bufs.append(r.buf)
+        # axis-0 entries pin the range fields so irrelevant values
+        # don't key extra jit recompiles
+        spec.append((i, r.idx, 1, r.s0, r._rows()) if r.axis
+                    else (i, r.idx, 0, 0, 0))
+    return bufs, index, tuple(spec)
+
+
+def _col(bufs, entry, S, U):
+    """One shard as [S, U] inside a trace.  Row refs slice through a
+    [n_rows, S, U] view so the slice keeps a TPU-friendly (S, U)
+    tiling (a flat 1-row slice pads 4x); column refs index the
+    stripewise buffer directly (zero layout change)."""
+    b, i, axis, s0, rows = entry
+    if axis == 0:
+        return bufs[b].reshape(-1, S, U)[i]
+    return bufs[b][s0:s0 + rows, i]
+
+
+def assemble_refs(refs, S: int, U: int):
+    """[S, n, U] device stack of n shard refs — one dispatch (the
+    gather half of handle_sub_read_reply, src/osd/ECBackend.cc:1183)."""
+    def impl(bufs, spec, S, U):
+        import jax.numpy as jnp
+        return jnp.stack([_col(bufs, e, S, U) for e in spec], axis=1)
+    f = _jit("assemble", impl, ("spec", "S", "U"))
+    bufs, _, spec = _dedup(refs)
+    return f(tuple(bufs), spec=spec, S=S, U=U)
+
+
+def assemble_object(refs_by_col, dec, S: int, U: int):
+    """Object stripe view [S, k, U] on device in one dispatch: column
+    c reads its shard ref, missing columns read decode output
+    dec[:, j].  Returned untrimmed/unflattened: a flat u8 view of a
+    >=2 GiB object would need 64-bit slice indices, which the TPU
+    backend rejects — callers flatten+trim only when small."""
+    def impl(bufs, dec, spec, S, U):
+        import jax.numpy as jnp
+        cols = [dec[:, e[1]] if e[0] < 0 else _col(bufs, e, S, U)
+                for e in spec]
+        return jnp.stack(cols, axis=1)
+    f = _jit("assemble_obj", impl, ("spec", "S", "U"))
+    present = [r for r in refs_by_col if r is not None]
+    bufs, _, pspec = _dedup(present)
+    spec, pi, di = [], 0, 0
+    for ref in refs_by_col:
+        if ref is None:
+            spec.append((-1, di, 0, 0, 0))
+            di += 1
+        else:
+            spec.append(pspec[pi])
+            pi += 1
+    if dec is None:
+        import jax.numpy as jnp
+        dec = jnp.zeros((1, 1, 1), dtype=jnp.uint8)
+    return f(tuple(bufs), dec, spec=tuple(spec), S=S, U=U)
+
+
+def assemble_many(refs_per_object, S: int, U: int):
+    """[N*S, k, U] batched stripe view of N same-geometry objects in
+    ONE dispatch — the read half of the batched client surface
+    (get_many_to_device).  ``refs_per_object`` is a list of per-object
+    column-ref lists (no missing columns; degraded objects go through
+    assemble_object)."""
+    def impl(bufs, spec, n_cols, S, U):
+        import jax.numpy as jnp
+        blocks = []
+        for o in range(len(spec) // n_cols):
+            cols = [_col(bufs, e, S, U)
+                    for e in spec[o * n_cols:(o + 1) * n_cols]]
+            blocks.append(jnp.stack(cols, axis=1))
+        return jnp.concatenate(blocks)
+    f = _jit("assemble_many", impl, ("spec", "n_cols", "S", "U"))
+    bufs, index = [], {}
+    spec = []
+    n_cols = len(refs_per_object[0])
+    for refs in refs_per_object:
+        bufs, index, s = _dedup(refs, index, bufs)
+        spec.extend(s)
+    return f(tuple(bufs), spec=tuple(spec), n_cols=n_cols, S=S, U=U)
+
+
+class DeviceShardCache:
+    """Per-OSD HBM staging of shard plane words."""
+
+    def __init__(self):
+        self._entries: Dict[ShardKey, _Entry] = {}
+        self.hits = 0
+        self.misses = 0
+        self.invalidations = 0
+
+    # ------------------------------------------------------------ writes --
+    def put(self, key: ShardKey, ref: ShardRef,
+            csum: Optional[int]) -> None:
+        """Stage a shard ref; ``csum=None`` marks it dirty (staged
+        flush mode — the device copy is authoritative until flush)."""
+        self._entries[key] = _Entry(ref, csum, int(ref.size))
+
+    def evict(self, key: ShardKey) -> None:
+        if self._entries.pop(key, None) is not None:
+            self.invalidations += 1
+
+    def clear(self) -> None:
+        self._entries.clear()
+
+    # ------------------------------------------------------------- reads --
+    def dirty_get(self, key: ShardKey):
+        """The staged array IF the entry is dirty (device copy is the
+        authoritative one awaiting flush); else None."""
+        e = self._entries.get(key)
+        return e.arr if e is not None and e.csum is None else None
+
+    def get(self, key: ShardKey, store_csum: Optional[int]):
+        """Return the staged array, validating against the durable
+        tier's current checksum.  Dirty entries are authoritative and
+        served unconditionally; a csum mismatch (external mutation of
+        the bytes underneath) drops the stale staging."""
+        e = self._entries.get(key)
+        if e is None:
+            self.misses += 1
+            return None
+        if e.csum is not None and e.csum != store_csum:
+            self.evict(key)
+            self.misses += 1
+            return None
+        self.hits += 1
+        return e.arr
+
+    def dirty_items(self) -> Iterable[Tuple[ShardKey, object]]:
+        return [(k, e.arr) for k, e in self._entries.items()
+                if e.csum is None]
+
+    def mark_clean(self, key: ShardKey, csum: int) -> None:
+        e = self._entries.get(key)
+        if e is not None:
+            e.csum = csum
+
+    def stats(self) -> Dict[str, int]:
+        return {"entries": len(self._entries),
+                "bytes": sum(e.nbytes for e in self._entries.values()),
+                "hits": self.hits, "misses": self.misses,
+                "invalidations": self.invalidations}

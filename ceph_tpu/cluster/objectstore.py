@@ -219,6 +219,12 @@ class MemStore:
     def exists(self, coll: Coll, oid: str) -> bool:
         return oid in self._colls.get(coll, {})
 
+    def verify(self, coll: Coll, oid: str) -> bool:
+        """Presence + integrity without copying payload bytes: True iff
+        the object exists and its (lazily re-checked) checksum holds."""
+        o = self._colls.get(coll, {}).get(oid)
+        return o is not None and (o.verified or o.check())
+
     def read(self, coll: Coll, oid: str, offset: int = 0,
              length: Optional[int] = None) -> bytes:
         o = self._get(coll, oid)

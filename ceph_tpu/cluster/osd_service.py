@@ -45,6 +45,11 @@ class OSDService:
         self._lock = threading.Lock()
         self._events: Dict[int, threading.Event] = {}
         self._results: Dict[int, Any] = {}
+        # device-array side table: the control frame rides the native
+        # queue, the HBM buffer handle rides here (the zero-copy "data
+        # segment" of a real messenger frame — device payloads never
+        # serialize through the wire path in-process)
+        self._op_objs: Dict[int, Any] = {}
         self.dispatcher = BatchingDispatcher(
             self.in_q, self._handle, linger=0.0,
             name=f"osd.{osd.id}").start()
@@ -54,6 +59,10 @@ class OSDService:
         # fast dispatch: envelopes land in the QoS scheduler first
         for env in batch:
             op = pickle.loads(env.payload)
+            with self._lock:
+                obj = self._op_objs.pop(env.id, None)
+            if obj is not None:
+                op["_obj"] = obj
             self.sched.enqueue((env.id, op),
                                klass=op.get("klass", CLASS_CLIENT))
         # dequeue_op in scheduler order
@@ -81,17 +90,28 @@ class OSDService:
             return True
         if kind == "get":
             return self.osd.get(key)
+        if kind == "put_dev":
+            self.osd.put_device(key, op["_obj"], op.get("data"))
+            return True
+        if kind == "get_dev":
+            return self.osd.get_device(key)
         if kind == "delete":
             self.osd.delete(key)
             return True
         raise ValueError(f"unknown osd op kind {kind!r}")
 
     # ------------------------------------------------------- client side --
-    def _call(self, op: Dict[str, Any], timeout: float = 30.0):
+    def call_async(self, op: Dict[str, Any], timeout: float = 30.0,
+                   obj: Any = None) -> Tuple[int, threading.Event]:
+        """Enqueue an op without waiting (the MOSDECSubOp fan-out
+        shape: a primary keeps k+m sub-ops in flight concurrently,
+        src/osd/ECBackend.cc:1976).  Pair with wait_async()."""
         op_id = next(self._ids)
         ev = threading.Event()
         with self._lock:
             self._events[op_id] = ev
+            if obj is not None:
+                self._op_objs[op_id] = obj
         payload = pickle.dumps(op, protocol=pickle.HIGHEST_PROTOCOL)
         try:
             self.in_q.push(Envelope(MSG_OSD_OP, op_id, -1, payload),
@@ -99,11 +119,17 @@ class OSDService:
         except (QueueFull, QueueClosed):
             with self._lock:
                 self._events.pop(op_id, None)
+                self._op_objs.pop(op_id, None)
             raise IOError(f"osd.{self.osd.id}: op queue unavailable")
+        return op_id, ev
+
+    def wait_async(self, op_id: int, ev: threading.Event,
+                   timeout: float = 30.0):
         if not ev.wait(timeout):
             with self._lock:
                 self._events.pop(op_id, None)
                 self._results.pop(op_id, None)
+                self._op_objs.pop(op_id, None)
             raise IOError(f"osd.{self.osd.id}: op {op_id} timed out")
         with self._lock:
             self._events.pop(op_id, None)
@@ -111,6 +137,11 @@ class OSDService:
         if isinstance(result, Exception):
             raise result
         return result
+
+    def _call(self, op: Dict[str, Any], timeout: float = 30.0,
+              obj: Any = None):
+        op_id, ev = self.call_async(op, timeout, obj)
+        return self.wait_async(op_id, ev, timeout)
 
     def put(self, key: ShardKey, data: np.ndarray,
             klass: str = CLASS_CLIENT) -> None:
@@ -127,6 +158,25 @@ class OSDService:
     def put_recovery(self, key: ShardKey, data: np.ndarray) -> None:
         """Recovery pushes ride the background-recovery QoS class."""
         self.put(key, data, klass=CLASS_RECOVERY)
+
+    # --------------------------------------------- device-staged shards --
+    def put_device(self, key: ShardKey, arr,
+                   data_bytes: Optional[bytes] = None,
+                   klass: str = CLASS_CLIENT) -> None:
+        """Stage a device shard array on the OSD.  ``data_bytes`` is the
+        eager durable write-through (same bytes); None defers flushing
+        (staged/WAL mode)."""
+        self._call({"kind": "put_dev", "key": key, "klass": klass,
+                    "data": data_bytes}, obj=arr)
+
+    def get_device(self, key: ShardKey, klass: str = CLASS_CLIENT):
+        """Fetch a shard as a device array (HBM-resident if staged)."""
+        return self._call({"kind": "get_dev", "key": key,
+                           "klass": klass})
+
+    def put_device_recovery(self, key: ShardKey, arr,
+                            data_bytes: Optional[bytes] = None) -> None:
+        self.put_device(key, arr, data_bytes, klass=CLASS_RECOVERY)
 
     def stats(self) -> Dict[str, int]:
         return self.in_q.stats()

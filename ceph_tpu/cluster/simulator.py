@@ -75,6 +75,7 @@ class _StoreView:
         # raw store poke (tests/debug): no liveness check, like the
         # plain dict this view replaces
         coll, oid = SimOSD._split(key)
+        self._osd.dev.evict(key)   # poke supersedes any staged copy
         self._osd.objectstore.apply_transaction(
             Transaction().write_full(
                 coll, oid, np.asarray(data, dtype=np.uint8).tobytes()))
@@ -82,12 +83,16 @@ class _StoreView:
 
 class SimOSD:
     """A fake OSD: a transactional checksummed ObjectStore (memstore
-    backend, src/os/memstore/ + ObjectStore.h roles) plus liveness."""
+    backend, src/os/memstore/ + ObjectStore.h roles) plus liveness and
+    an HBM staging tier for EC shard plane words (device_store.py —
+    the ECBackend shard-store role, src/osd/ECBackend.cc:934,1015)."""
 
     def __init__(self, osd_id: int):
         self.id = osd_id
         self.objectstore = MemStore()
         self.store = _StoreView(self)
+        from .device_store import DeviceShardCache
+        self.dev = DeviceShardCache()
         self.alive = True
         # last applied PG version per (pool, pg) — the replica-side
         # state delta recovery compares against the authoritative log
@@ -105,10 +110,16 @@ class SimOSD:
         self.objectstore.apply_transaction(
             Transaction().write_full(
                 coll, oid, np.asarray(data, dtype=np.uint8).tobytes()))
+        self.dev.evict(key)      # byte write supersedes staged copy
 
     def get(self, key: ShardKey) -> Optional[np.ndarray]:
         if not self.alive:
             return None
+        dirty = self.dev.dirty_get(key)
+        if dirty is not None:
+            # dirty staged entry IS the authoritative copy (WAL role):
+            # host readers get a readback of the device words, as bytes
+            return np.asarray(dirty).view(np.uint8)
         coll, oid = self._split(key)
         try:
             data = self.objectstore.read(coll, oid)
@@ -121,10 +132,83 @@ class SimOSD:
         return np.frombuffer(data, dtype=np.uint8)
 
     def delete(self, key: ShardKey) -> None:
+        self.dev.evict(key)
         coll, oid = self._split(key)
         if self.objectstore.exists(coll, oid):
             self.objectstore.apply_transaction(
                 Transaction().remove(coll, oid))
+
+    def has(self, key: ShardKey) -> bool:
+        """Cheap presence+integrity probe (no payload readback): a
+        dirty staged entry counts; else the durable object must exist
+        and pass its (lazily re-verified) checksum."""
+        if not self.alive:
+            return False
+        if self.dev.dirty_get(key) is not None:
+            return True
+        return self.objectstore.verify(*self._split(key))
+
+    # -------------------------------------------------- device staging --
+    def _csum(self, coll, oid) -> Optional[int]:
+        try:
+            return self.objectstore.stat(coll, oid)["csum"]
+        except ObjectStoreError:
+            return None
+
+    def put_device(self, key: ShardKey, arr,
+                   data_bytes: Optional[bytes] = None) -> None:
+        """Stage shard plane words in HBM.  ``data_bytes`` (the same
+        bytes, host-side) is written through to the durable store when
+        given; None defers durability to flush_device() (staged mode)."""
+        if not self.alive:
+            raise IOError(f"osd.{self.id} is dead")
+        coll, oid = self._split(key)
+        if data_bytes is not None:
+            self.objectstore.apply_transaction(
+                Transaction().write_full(coll, oid, data_bytes))
+            self.dev.put(key, arr, self._csum(coll, oid))
+        else:
+            self.dev.put(key, arr, None)
+
+    def get_device(self, key: ShardKey):
+        """Shard as a device array: HBM hit, else upload from the
+        durable bytes (checksum-verified) and stage for next time."""
+        if not self.alive:
+            return None
+        coll, oid = self._split(key)
+        arr = self.dev.get(key, self._csum(coll, oid))
+        if arr is not None:
+            return arr
+        try:
+            data = self.objectstore.read(coll, oid)
+        except (ChecksumError, ObjectStoreError):
+            return None
+        import jax.numpy as jnp
+        from .device_store import as_ref
+        # shard files are whole words (chunk % 32 == 0): upload in the
+        # staged at-rest domain (int32 plane words)
+        ref = as_ref(jnp.asarray(np.frombuffer(data, dtype="<i4")))
+        self.dev.put(key, ref, self._csum(coll, oid))
+        return ref
+
+    def flush_device(self) -> int:
+        """Write every dirty staged shard through to the durable store
+        (the deferred-write/WAL flush). Returns shards flushed."""
+        n = 0
+        for key, arr in self.dev.dirty_items():
+            coll, oid = self._split(key)
+            self.objectstore.apply_transaction(
+                Transaction().write_full(
+                    coll, oid, np.asarray(arr).tobytes()))
+            self.dev.mark_clean(key, self._csum(coll, oid))
+            n += 1
+        return n
+
+    def crash(self) -> None:
+        """Process death: unflushed staging (HBM) is lost; durable
+        bytes survive — exactly a WAL-less deferred write's fate."""
+        for key, _ in self.dev.dirty_items():
+            self.dev.evict(key)
 
 
 @dataclass
@@ -171,6 +255,11 @@ class ClusterSim:
         # per-object watch registrations (Watch/Notify role)
         self._watches: Dict[Tuple[int, str], Dict[int, object]] = {}
         self._next_watch = 1
+        # HBM staging flush policy: "eager" writes shard bytes through
+        # to the durable store inside the op (non-staged semantics);
+        # "staged" defers durability to flush_all() (deferred-write/WAL
+        # shape — a crash before flush loses the staged writes)
+        self.staging_flush = "eager"
 
     @staticmethod
     def _stop_services(services) -> None:
@@ -209,11 +298,20 @@ class ClusterSim:
     # ------------------------------------------------------------- pools --
     def create_ec_profile(self, name: str, profile: Dict[str, str]) -> None:
         """Validates by instantiating the plugin, like the mon
-        (src/mon/OSDMonitor.cc:7349-7444)."""
+        (src/mon/OSDMonitor.cc:7349-7444).  jax-plugin profiles that
+        name no layout get the cluster default (bitsliced: shards at
+        rest are the plane words the masked-XOR kernel consumes — the
+        jerasure-packet-layout-at-rest property,
+        src/erasure-code/jerasure/ErasureCodeJerasure.cc:162)."""
         from ..common.options import config
-        default = config().get("erasure_code_default_plugin")
-        ec_registry().factory(profile.get("plugin", default), profile)
-        self.ec_profiles[name] = dict(profile)
+        profile = dict(profile)
+        plugin = profile.get("plugin",
+                             config().get("erasure_code_default_plugin"))
+        if plugin == "jax" and "layout" not in profile:
+            profile["layout"] = config().get(
+                "erasure_code_default_layout")
+        ec_registry().factory(plugin, profile)
+        self.ec_profiles[name] = profile
 
     def codec_for(self, pool: PGPool):
         codec = self.codecs.get(pool.id)
@@ -245,10 +343,35 @@ class ClusterSim:
         return pool.raw_pg_to_pg(ps)
 
     def pg_up(self, pool: PGPool, pg: int) -> List[int]:
+        """Acting/up set for a PG, cached per map epoch (the client's
+        cached-OSDMap target calc, Objecter::_calc_target — placement
+        is recomputed only when the map changes)."""
+        cache = getattr(self, "_up_cache", None)
+        if cache is None or cache[0] != self.osdmap.epoch:
+            cache = self._up_cache = (self.osdmap.epoch, {})
+        hit = cache[1].get((pool.id, pg))
+        if hit is not None:
+            return hit
         up, _, acting, _ = self.osdmap.pg_to_up_acting_osds(pool.id, pg)
-        return acting or up
+        out = acting or up
+        cache[1][(pool.id, pg)] = out
+        return out
 
     # ------------------------------------------------------- shard access --
+    def _device_staging(self, codec=None) -> bool:
+        """HBM staging applies when enabled AND the pool's codec has a
+        device data path (jax/bitmatrix plugins); layered codecs
+        (lrc/shec/clay) keep the host path."""
+        from ..common.options import config
+        if not config().get("osd_device_staging"):
+            return False
+        # the staged data plane runs in the int32 word domain (no
+        # u8<->i32 bitcasts — see plugin_jax.encode_words_device);
+        # codecs without word-domain kernels use the host path
+        return codec is None or (
+            hasattr(codec, "encode_words_device") and
+            getattr(codec, "layout", None) == "bitsliced")
+
     def _shard_sources(self, up: List[int], shard: int) -> List[int]:
         tgt = up[shard] if shard < len(up) else ITEM_NONE
         return ([tgt] if tgt != ITEM_NONE else []) + \
@@ -265,7 +388,10 @@ class ClusterSim:
         return None
 
     def _write_shard(self, pool_id: int, pg: int, name: str, shard: int,
-                     up: List[int], payload: np.ndarray) -> Optional[int]:
+                     up: List[int],
+                     payload: np.ndarray) -> Optional[int]:
+        """Place one host-byte shard on its mapped home (the staged
+        device path fans out through _fanout_shards instead)."""
         tgt = up[shard] if shard < len(up) else ITEM_NONE
         if tgt == ITEM_NONE:
             # degraded write: the shard is homeless.  Stale copies of
@@ -293,6 +419,155 @@ class ClusterSim:
             if o.id != tgt:
                 o.delete((pool_id, pg, name, shard))
         return tgt
+
+    def _read_shard_dev(self, pool_id: int, pg: int, name: str,
+                        shard: int, up: List[int]):
+        """Device-domain shard read: HBM staging tier first (upload on
+        miss), same source order as _read_shard.  Sources are
+        pre-filtered by the host-side presence probe — the MissingLoc
+        role (src/osd/MissingLoc.h: peering tells the primary exactly
+        which OSDs hold a shard; it never polls the whole cluster)."""
+        key = (pool_id, pg, name, shard)
+        for o in self._shard_sources(up, shard):
+            if not self.osds[o].has(key):
+                continue
+            a = self.services[o].get_device(key)
+            if a is not None:
+                return a
+        return None
+
+    @staticmethod
+    def _to_words(a, S: int, k: int, U: int):
+        """Any payload form -> [S, k, U/4] int32 plane words (the
+        staged at-rest domain).  Host bytes reinterpret for free; a
+        device u8 array needs a bitcast dispatch (fine at small sizes;
+        bulk clients hand words directly — see put_many_from_device)."""
+        import jax.numpy as jnp
+        W = U // 4
+        if isinstance(a, np.ndarray):
+            return jnp.asarray(
+                np.ascontiguousarray(a).view(np.int32).reshape(S, k, W))
+        if a.dtype == jnp.int32:
+            return a if a.shape == (S, k, W) else a.reshape(S, k, W)
+        import jax
+        u8 = a if a.shape == (S, k, U) else a.reshape(S, k, U)
+        return jax.lax.bitcast_convert_type(
+            u8.reshape(S, k, W, 4), jnp.int32)
+
+    def _place_shards_dev(self, pool_id: int, pg: int, name: str,
+                          up: List[int], codec, payload, S: int,
+                          U: int,
+                          dchunks_host: Optional[np.ndarray] = None
+                          ) -> List[int]:
+        """Encode the device payload (ONE word-domain dispatch) and
+        stage each shard on its target as a zero-copy column ref: data
+        shards are columns of the client's [S, k, W] word view, parity
+        shards columns of the encode output (shared by
+        put/put_from_device).  Eager flush takes durable bytes from
+        ``dchunks_host`` when the caller already has them, else from
+        one readback per buffer."""
+        from ..msg.scheduler import CLASS_CLIENT
+        from .device_store import ShardRef
+        k = codec.get_data_chunk_count()
+        mm = codec.get_coding_chunk_count()
+        d = self._to_words(payload, S, k, U)
+        par = codec.encode_words_device(d)
+        eager = self.staging_flush == "eager"
+        d_host = p_host = None
+        if eager:
+            d_host = (dchunks_host if dchunks_host is not None
+                      else np.asarray(d))
+            p_host = np.asarray(par)
+
+        def ref_for(shard):
+            return (ShardRef(d, shard, axis=1) if shard < k
+                    else ShardRef(par, shard - k, axis=1))
+
+        def bytes_for(shard):
+            if not eager:
+                return None
+            h, c = (d_host, shard) if shard < k else (p_host, shard - k)
+            return np.ascontiguousarray(h[:, c]).tobytes()
+
+        return self._fanout_shards(pool_id, pg, name, up, k + mm,
+                                   ref_for, bytes_for)
+
+    def _fanout_shards(self, pool_id: int, pg: int, name: str,
+                       up: List[int], n_shards: int, ref_for,
+                       bytes_for) -> List[int]:
+        """Fan out all n sub-writes concurrently, then gather — the
+        MOSDECSubOpWrite shape (src/osd/ECBackend.cc:1976).  Homeless
+        slots, dead targets and failed sub-ops purge stale copies so
+        no older shard version can be served (see _write_shard)."""
+        from ..msg.scheduler import CLASS_CLIENT
+
+        def purge(shard):
+            for o in self.osds:
+                o.delete((pool_id, pg, name, shard))
+
+        subs = []
+        for shard in range(n_shards):
+            tgt = up[shard] if shard < len(up) else ITEM_NONE
+            if tgt == ITEM_NONE:
+                purge(shard)           # homeless: supersede stale copies
+                continue
+            op = {"kind": "put_dev",
+                  "key": (pool_id, pg, name, shard),
+                  "klass": CLASS_CLIENT, "data": bytes_for(shard)}
+            try:
+                op_id, ev = self.services[tgt].call_async(
+                    op, obj=ref_for(shard))
+            except IOError:
+                purge(shard)
+                continue
+            subs.append((shard, tgt, op_id, ev))
+        placed = []
+        for shard, tgt, op_id, ev in subs:
+            try:
+                self.services[tgt].wait_async(op_id, ev)
+            except IOError:
+                purge(shard)           # undetected-dead target
+                continue
+            for o in self.osds:        # success supersedes stale copies
+                if o.id != tgt:
+                    o.delete((pool_id, pg, name, shard))
+            placed.append(tgt)
+        return placed
+
+    def _gather_decode_dev(self, pool: PGPool, name: str,
+                           info: ObjectInfo, pg: int, up: List[int]):
+        """Assemble the object payload in the device domain: gather
+        staged shard refs, decode missing data chunks with the
+        masked-XOR kernel, stitch columns — ~one dispatch per stage
+        over shared packed buffers (shared by get / get_to_device; the
+        handle_sub_read_reply -> decode flow,
+        src/osd/ECBackend.cc:1183).  Returns the int32 [S, k, U/4]
+        word-domain stripe view on device (untrimmed — see
+        assemble_object; bytes == the u8 view, little-endian)."""
+        from .device_store import assemble_object, assemble_refs
+        codec = self.codec_for(pool)
+        k = codec.get_data_chunk_count()
+        mm = codec.get_coding_chunk_count()
+        U, S = info.chunk_size, info.n_stripes
+        W = U // 4
+        files = {}
+        for shard in range(k + mm):
+            r = self._read_shard_dev(pool.id, pg, name, shard, up)
+            if r is not None and r.size >= S * U:
+                files[shard] = r
+        missing_data = [c for c in range(k) if c not in files]
+        dec = None
+        if missing_data:
+            try:
+                plan = sorted(codec.minimum_to_decode(set(range(k)),
+                                                      set(files)))
+            except ErasureCodeError:
+                raise IOError(f"object {name}: unrecoverable "
+                              f"(only shards {sorted(files)})")
+            sub = assemble_refs([files[c] for c in plan], S, W)
+            dec = codec.decode_words_device(plan, sub, missing_data)
+        return assemble_object([files.get(c) for c in range(k)], dec,
+                               S, W)
 
     def _new_info(self, pool: PGPool, name: str, size: int, chunk: int,
                   n_str: int = 1) -> ObjectInfo:
@@ -624,14 +899,25 @@ class ClusterSim:
         buf = np.zeros(n_str * si.stripe_width, dtype=np.uint8)
         buf[:len(data)] = np.frombuffer(data, dtype=np.uint8)
         dchunks = buf.reshape(n_str, k, si.chunk_size)
-        parity = np.asarray(codec.encode_chunks_batch(dchunks))
-        full = np.concatenate([dchunks, parity], axis=1)   # [S, k+m, U]
-        placed = []
-        for shard in range(k + mm):
-            tgt = self._write_shard(pool_id, pg, name, shard, up,
-                                    full[:, shard].reshape(-1))
-            if tgt is not None:
-                placed.append(tgt)
+        if self._device_staging(codec):
+            # device data plane: ONE host->device upload of the object
+            # (the np buffer reinterprets as words for free in
+            # _to_words), one word-domain encode dispatch, shard
+            # columns staged zero-copy in each target's HBM tier (the
+            # at-rest layout IS the kernel operand layout —
+            # ECBackend.cc:934 / jerasure packet role)
+            placed = self._place_shards_dev(
+                pool_id, pg, name, up, codec, buf,
+                n_str, si.chunk_size, dchunks_host=dchunks)
+        else:
+            placed = []
+            parity = np.asarray(codec.encode_chunks_batch(dchunks))
+            full = np.concatenate([dchunks, parity], axis=1)  # [S,k+m,U]
+            for shard in range(k + mm):
+                tgt = self._write_shard(pool_id, pg, name, shard, up,
+                                        full[:, shard].reshape(-1))
+                if tgt is not None:
+                    placed.append(tgt)
         self.extent_cache.invalidate_object((pool_id, name))
         self.objects[(pool_id, name)] = self._new_info(
             pool, name, len(data), si.chunk_size, n_str)
@@ -693,10 +979,202 @@ class ClusterSim:
                 if payload is not None:
                     return payload.tobytes()[:info.size]
             raise IOError(f"object {name}: no replica available")
+        if self._device_staging(self.codec_for(pool)):
+            view = self._gather_decode_dev(pool, name, info, pg, up)
+            return np.asarray(view).tobytes()[:info.size]
         stripes = list(range(info.n_stripes))
         chunks = self._gather_stripes(pool, name, info, stripes)
         buf = np.concatenate([chunks[s].reshape(-1) for s in stripes])
         return buf.tobytes()[:info.size]
+
+    def flush_all(self) -> int:
+        """Flush every OSD's dirty HBM staging to the durable store."""
+        return sum(o.flush_device() for o in self.osds)
+
+    # ---------------------------------------------- device-client I/O --
+    def put_from_device(self, pool_id: int, name: str, arr,
+                        size: Optional[int] = None) -> List[int]:
+        """EC put whose payload is ALREADY a device array (uint8 [n]) —
+        the TPU-native client shape: data produced by an on-device
+        pipeline is striped/encoded/staged without ever visiting the
+        host.  Same placement, logging and staging semantics as put().
+        """
+        import jax.numpy as jnp
+        pool = self.osdmap.pools[pool_id]
+        if pool.type != POOL_ERASURE:
+            raise IOError("put_from_device requires an EC pool")
+        codec = self.codec_for(pool)
+        n = int(arr.size) if size is None else int(size)
+        if not self._device_staging(codec):
+            # layered codec / staging off: one readback, host path
+            return self.put(pool_id, name,
+                            np.asarray(arr).tobytes()[:n])
+        if "@" not in name:
+            self._maybe_clone(pool, name)
+        pg = self.object_pg(pool, name)
+        up = self.pg_up(pool, pg)
+        si = self._sinfo(pool)
+        n_str = max(1, si.stripe_count(n))
+        pad = n_str * si.stripe_width - int(arr.size)
+        a = jnp.asarray(arr, jnp.uint8)
+        if pad > 0:
+            a = jnp.pad(a.reshape(-1), (0, pad))
+        placed = self._place_shards_dev(pool_id, pg, name, up, codec,
+                                        a, n_str, si.chunk_size)
+        self.extent_cache.invalidate_object((pool_id, name))
+        self.objects[(pool_id, name)] = self._new_info(
+            pool, name, n, si.chunk_size, n_str)
+        self._log_write(pool_id, pg, name, set(placed))
+        return placed
+
+    def put_many_from_device(self, pool_id: int, names: List[str],
+                             batch) -> Dict[str, List[int]]:
+        """Batched EC ingest: N same-size objects as ONE device array
+        [N, S, k, U] (or [N, S*k*U]), encoded in a single dispatch and
+        staged as range refs into the shared buffers.  The device-side
+        analog of the framework's batching stance everywhere else
+        (ParallelPGMapper -> one pjit): amortizes per-dispatch cost
+        over the whole batch; placement/logging run per object."""
+        import jax.numpy as jnp
+        from .device_store import ShardRef
+        pool = self.osdmap.pools[pool_id]
+        codec = self.codec_for(pool)
+        if not self._device_staging(codec):
+            out = {}
+            for i, nm in enumerate(names):
+                out[nm] = self.put(pool_id, nm,
+                                   np.asarray(batch[i]).tobytes())
+            return out
+        si = self._sinfo(pool)
+        k = codec.get_data_chunk_count()
+        U = si.chunk_size
+        N = len(names)
+        a = jnp.asarray(batch)
+        itemsize = 4 if a.dtype == jnp.int32 else 1
+        obj_bytes = int(a.size) * itemsize // N
+        S = si.stripe_count(obj_bytes)
+        if S * si.stripe_width != obj_bytes:
+            raise IOError("put_many_from_device needs stripe-aligned "
+                          "objects")
+        a = self._to_words(a, N * S, k, U)
+        par = codec.encode_words_device(a)       # ONE dispatch, all N
+        eager = self.staging_flush == "eager"
+        d_host = np.asarray(a) if eager else None
+        p_host = np.asarray(par) if eager else None
+        results: Dict[str, List[int]] = {}
+        for n_i, name in enumerate(names):
+            if "@" not in name:
+                self._maybe_clone(pool, name)
+            pg = self.object_pg(pool, name)
+            up = self.pg_up(pool, pg)
+            s0, s1 = n_i * S, (n_i + 1) * S
+
+            def ref_for(shard):
+                src = a if shard < k else par
+                col = shard if shard < k else shard - k
+                return ShardRef(src, col, axis=1, s0=s0, s1=s1)
+
+            def bytes_for(shard):
+                if not eager:
+                    return None
+                h = d_host if shard < k else p_host
+                col = shard if shard < k else shard - k
+                return np.ascontiguousarray(h[s0:s1, col]).tobytes()
+
+            placed = self._fanout_shards(pool_id, pg, name, up,
+                                         pool.size, ref_for, bytes_for)
+            self.extent_cache.invalidate_object((pool_id, name))
+            self.objects[(pool_id, name)] = self._new_info(
+                pool, name, obj_bytes, U, S)
+            self._log_write(pool_id, pg, name, set(placed))
+            results[name] = placed
+        return results
+
+    def get_many_to_device(self, pool_id: int, names: List[str]):
+        """Batched EC read: N same-geometry HEALTHY objects gathered
+        as ONE [N*S, k, U] device array in a single dispatch.  Any
+        object with a missing data shard falls back to its own
+        degraded get_to_device (decode path)."""
+        from .device_store import assemble_many
+        pool = self.osdmap.pools[pool_id]
+        codec = self.codec_for(pool)
+        k = codec.get_data_chunk_count()
+        refs_per_obj = []
+        S = U = None
+        for name in names:
+            info = self.objects[(pool_id, name)]
+            pg = self.object_pg(pool, name)
+            up = self.pg_up(pool, pg)
+            if S is None:
+                S, U = info.n_stripes, info.chunk_size
+            elif (info.n_stripes, info.chunk_size) != (S, U):
+                raise IOError("get_many_to_device needs same-geometry "
+                              "objects")
+            refs = []
+            for c in range(k):
+                r = self._read_shard_dev(pool_id, pg, name, c, up)
+                if r is None or r.size < S * U:
+                    refs = None
+                    break
+                refs.append(r)
+            if refs is None:
+                # degraded member: decode individually
+                refs_per_obj.append(None)
+            else:
+                refs_per_obj.append(refs)
+        healthy = [r for r in refs_per_obj if r is not None]
+        out = assemble_many(healthy, S, U // 4) if healthy else None
+        if all(r is not None for r in refs_per_obj):
+            return out
+        # stitch healthy batch + degraded singles (rare path): degraded
+        # members use the word-domain gather/decode directly so every
+        # part is the same [S, k, W] int32 view
+        import jax.numpy as jnp
+        parts, hi = [], 0
+        for name, refs in zip(names, refs_per_obj):
+            if refs is None:
+                info = self.objects[(pool_id, name)]
+                pg = self.object_pg(pool, name)
+                up = self.pg_up(pool, pg)
+                parts.append(self._gather_decode_dev(pool, name, info,
+                                                     pg, up))
+            else:
+                parts.append(out[hi * S:(hi + 1) * S])
+                hi += 1
+        return jnp.concatenate(parts)
+
+    def get_to_device(self, pool_id: int, name: str):
+        """EC get returning the object as a device array — the
+        consumer is an on-device pipeline; no host readback happens.
+        Degraded chunks decode via the masked-XOR kernel in the same
+        graph.  Stripe-aligned objects come back as their [S, k, U]
+        stripe view (zero trim work; a flat view of >=2 GiB would need
+        64-bit slice indices the TPU rejects); smaller or unaligned
+        objects come back flat [size]."""
+        pool = self.osdmap.pools[pool_id]
+        if pool.type != POOL_ERASURE:
+            raise IOError("get_to_device requires an EC pool")
+        info = self.objects[(pool_id, name)]
+        codec = self.codec_for(pool)
+        if not self._device_staging(codec):
+            import jax.numpy as jnp
+            data = self.get(pool_id, name)       # host path, one upload
+            return jnp.asarray(np.frombuffer(data, dtype=np.uint8))
+        pg = self.object_pg(pool, name)
+        up = self.pg_up(pool, pg)
+        view = self._gather_decode_dev(pool, name, info, pg, up)
+        total = 4 * int(view.shape[0]) * int(view.shape[1]) * \
+            int(view.shape[2])
+        if info.size == total:
+            return view                 # [S, k, W] int32 word view
+        if total < (1 << 31):
+            import jax
+            import jax.numpy as jnp
+            u8 = jax.lax.bitcast_convert_type(view, jnp.uint8)
+            return u8.reshape(-1)[:info.size]
+        raise IOError(f"object {name}: unaligned size {info.size} on "
+                      f">=2GiB object cannot be flattened on device; "
+                      f"read the stripe view or use get()")
 
     def write(self, pool_id: int, name: str, offset: int,
               data: bytes) -> List[int]:
@@ -790,12 +1268,14 @@ class ClusterSim:
     def kill_osd(self, osd: int) -> None:
         """Thrasher-style kill (qa/tasks/ceph_manager.py kill_osd): process
         death — store contents are lost to the cluster."""
+        self.osds[osd].crash()
         self.osds[osd].alive = False
         self.osdmap.mark_down(osd)
 
     def fail_osd(self, osd: int) -> None:
         """Process death WITHOUT the map knowing yet: the state the
         heartbeat/failure-report pipeline exists to detect."""
+        self.osds[osd].crash()
         self.osds[osd].alive = False
 
     def out_osd(self, osd: int) -> None:
@@ -856,6 +1336,11 @@ class ClusterSim:
         codec = self.codec_for(pool)
         k, mm = codec.get_data_chunk_count(), codec.get_coding_chunk_count()
         n_shards = k + mm
+        dev = self._device_staging(codec)
+        eager = self.staging_flush == "eager"
+        if dev:
+            import jax.numpy as jnp
+            from .device_store import ShardRef, assemble_refs
         # (avail_plan, missing, U) -> list of (name, up, shard_files,
         #  n_stripes) sharing one decode executable
         groups: Dict[Tuple, List] = {}
@@ -869,8 +1354,10 @@ class ClusterSim:
             shard_files: Dict[int, np.ndarray] = {}
             missing: List[int] = []
             for shard in range(n_shards):
-                f = self._read_shard(pool_id, pg, name, shard, up)
-                if f is None or len(f) < info.n_stripes * U:
+                f = (self._read_shard_dev(pool_id, pg, name, shard, up)
+                     if dev else
+                     self._read_shard(pool_id, pg, name, shard, up))
+                if f is None or f.size < info.n_stripes * U:
                     missing.append(shard)
                 else:
                     shard_files[shard] = f
@@ -878,9 +1365,15 @@ class ClusterSim:
             for shard, payload in shard_files.items():
                 tgt = up[shard] if shard < len(up) else ITEM_NONE
                 if tgt != ITEM_NONE and self.osds[tgt].alive and \
-                        self.osds[tgt].get((pool_id, pg, name, shard)) is None:
-                    self.services[tgt].put_recovery(
-                        (pool_id, pg, name, shard), payload)
+                        not self.osds[tgt].has((pool_id, pg, name, shard)):
+                    if dev:
+                        self.services[tgt].put_device_recovery(
+                            (pool_id, pg, name, shard), payload,
+                            np.asarray(payload).tobytes() if eager
+                            else None)
+                    else:
+                        self.services[tgt].put_recovery(
+                            (pool_id, pg, name, shard), payload)
                     stats["shards_copied"] += 1
             if not missing:
                 continue
@@ -896,24 +1389,41 @@ class ClusterSim:
         for (plan, missing, U), members in groups.items():
             stats["batches"] += 1
             # batch axis = every damaged stripe of every member object
-            blocks = []
-            for name, up, files, n_str, pg in members:
-                blocks.append(np.stack(
-                    [np.stack([files[c][s * U:(s + 1) * U]
-                               for c in plan]) for s in range(n_str)]))
-            batch = np.concatenate(blocks)          # [sum_S, n_plan, U]
-            rebuilt = np.asarray(codec.decode_chunks_batch(
-                list(plan), batch, list(missing)))
+            if dev:
+                batch = jnp.concatenate([
+                    assemble_refs([files[c] for c in plan], n_str,
+                                  U // 4)
+                    for name, up, files, n_str, pg in members])
+                rebuilt = codec.decode_words_device(
+                    list(plan), batch, list(missing))
+            else:
+                batch = np.concatenate([
+                    np.stack([np.stack([files[c][s * U:(s + 1) * U]
+                                        for c in plan])
+                              for s in range(n_str)])
+                    for name, up, files, n_str, pg in members])
+                rebuilt = np.asarray(codec.decode_chunks_batch(
+                    list(plan), batch, list(missing)))
             pos = 0
             for name, up, files, n_str, pg in members:
                 part = rebuilt[pos:pos + n_str]      # [S, n_miss, U]
                 pos += n_str
+                part_host = np.asarray(part) if dev and eager else None
                 for i, shard in enumerate(missing):
                     tgt = up[shard] if shard < len(up) else ITEM_NONE
                     if tgt == ITEM_NONE or not self.osds[tgt].alive:
                         continue
-                    self.services[tgt].put_recovery(
-                        (pool_id, pg, name, shard), part[:, i].reshape(-1))
+                    if dev:
+                        b = np.ascontiguousarray(
+                            part_host[:, i]).tobytes() if eager \
+                            else None
+                        self.services[tgt].put_device_recovery(
+                            (pool_id, pg, name, shard),
+                            ShardRef(part, i, axis=1), b)
+                    else:
+                        self.services[tgt].put_recovery(
+                            (pool_id, pg, name, shard),
+                            part[:, i].reshape(-1))
                     stats["shards_rebuilt"] += 1
         return stats
 
@@ -1017,21 +1527,31 @@ class ClusterSim:
         codec = self.codec_for(pool)
         k, mm = codec.get_data_chunk_count(), codec.get_coding_chunk_count()
         U = info.chunk_size
+        S = info.n_stripes
+        dev = self._device_staging(codec)
+        eager = self.staging_flush == "eager"
         missing = []
         files: Dict[int, np.ndarray] = {}
         ok = True
         for shard in range(k + mm):
-            f = self._read_shard(pool.id, pg, name, shard, up)
-            if f is None or len(f) < info.n_stripes * U:
+            f = (self._read_shard_dev(pool.id, pg, name, shard, up)
+                 if dev else
+                 self._read_shard(pool.id, pg, name, shard, up))
+            if f is None or f.size < S * U:
                 missing.append(shard)
             else:
                 files[shard] = f
                 tgt = up[shard] if shard < len(up) else ITEM_NONE
                 if tgt != ITEM_NONE and self.osds[tgt].alive and \
-                        self.osds[tgt].get(
-                            (pool.id, pg, name, shard)) is None:
-                    self.services[tgt].put_recovery(
-                        (pool.id, pg, name, shard), f)
+                        not self.osds[tgt].has(
+                            (pool.id, pg, name, shard)):
+                    if dev:
+                        self.services[tgt].put_device_recovery(
+                            (pool.id, pg, name, shard), f,
+                            np.asarray(f).tobytes() if eager else None)
+                    else:
+                        self.services[tgt].put_recovery(
+                            (pool.id, pg, name, shard), f)
                     stats["shards_copied"] += 1
         if not missing:
             return True
@@ -1040,17 +1560,31 @@ class ClusterSim:
                                                   set(files)))
         except ErasureCodeError:
             return False     # unrecoverable NOW; retry when shards return
-        sub = np.stack([
-            np.stack([files[c][s * U:(s + 1) * U] for c in plan])
-            for s in range(info.n_stripes)])
-        dec = np.asarray(codec.decode_chunks_batch(plan, sub, missing))
+        if dev:
+            from .device_store import ShardRef, assemble_refs
+            sub = assemble_refs([files[c] for c in plan], S, U // 4)
+            dec = codec.decode_words_device(plan, sub, missing)
+            dec_host = np.asarray(dec) if eager else None
+        else:
+            sub = np.stack([
+                np.stack([files[c][s * U:(s + 1) * U] for c in plan])
+                for s in range(S)])
+            dec = np.asarray(codec.decode_chunks_batch(plan, sub,
+                                                       missing))
         for i, shard in enumerate(missing):
             tgt = up[shard] if shard < len(up) else ITEM_NONE
             if tgt == ITEM_NONE or not self.osds[tgt].alive:
                 ok = False
                 continue
-            self.services[tgt].put_recovery((pool.id, pg, name, shard),
-                                            dec[:, i].reshape(-1))
+            if dev:
+                b = np.ascontiguousarray(dec_host[:, i]).tobytes() \
+                    if eager else None
+                self.services[tgt].put_device_recovery(
+                    (pool.id, pg, name, shard),
+                    ShardRef(dec, i, axis=1), b)
+            else:
+                self.services[tgt].put_recovery(
+                    (pool.id, pg, name, shard), dec[:, i].reshape(-1))
             stats["shards_rebuilt"] += 1
         return ok
 

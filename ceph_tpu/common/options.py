@@ -251,6 +251,20 @@ _TABLE: Tuple[Option, ...] = (
     Option("erasure_code_default_plugin", TYPE_STR, "jax",
            "plugin used when a profile names none (reference: "
            "osd_pool_default_erasure_code_profile, options.cc:2748)"),
+    Option("erasure_code_default_layout", TYPE_STR, "bitsliced",
+           "chunk layout injected into jax-plugin EC profiles that name "
+           "none: bitsliced = jerasure-packet plane layout consumed "
+           "directly by the masked-XOR region kernel (the at-rest "
+           "format, like jerasure_schedule_encode packets, "
+           "ErasureCodeJerasure.cc:162); bytes = byte-symbol compat "
+           "layout (bit-plane MXU matmul path)",
+           enum_values=("bytes", "bitsliced")),
+    Option("osd_device_staging", TYPE_BOOL, True,
+           "stage EC shard payloads in device HBM as int32 plane words "
+           "(the ECBackend shard store role, ECBackend.cc:934,1015): "
+           "encode/decode/recovery consume the staged planes without "
+           "host round-trips; the objectstore keeps the same bytes as "
+           "the durable tier"),
     Option("perf_counters_enabled", TYPE_BOOL, True,
            "collect dispatch/cache/bytes counters"),
 )

@@ -132,6 +132,83 @@ class ErasureCodeJax(MatrixCodec):
             return self._plane_matmul(self.parity, data)
         return self._matmul(self.parity, data)
 
+    # ------------------------------------------------ word-domain (i32) ---
+    # The bitsliced at-rest format IS int32 plane words (32 GF(2)
+    # lanes per word).  These entry points take/return [.., n, W]
+    # int32 (W = chunk_bytes/4) and never touch a u8<->i32 bitcast:
+    # region boundaries are word-aligned (chunk % 32 == 0), so the
+    # plane view is a pure word-domain reshape.  This matters: XLA
+    # materializes the [.., W, 4]-minor u8 bitcast intermediate with
+    # ~5x tile padding (5 GiB temp per 1 GiB encoded; un-compilable at
+    # 3 GiB) — the words-native path has no such temp and is how the
+    # cluster's device data plane runs (cluster/device_store.py).
+
+    def encode_words_device(self, words):
+        """[.., k, W] int32 -> [.., m, W] int32, on device."""
+        from ..ops import xor_kernel
+        if self.layout != "bitsliced":
+            raise ErasureCodeError(
+                "word-domain encode requires layout=bitsliced")
+        if words.shape[-2] != self.k:
+            raise ErasureCodeError(
+                f"expected {self.k} data chunks, got {words.shape[-2]}")
+        W = words.shape[-1]
+        if (W * 4) % 32:
+            raise ErasureCodeError(
+                f"bitsliced layout needs chunk size % 32 == 0, "
+                f"got {W * 4}")
+        masks = xor_kernel.masks_to_device(gf.gf8_bitmatrix(self.parity))
+        planes = words.reshape(words.shape[:-2] +
+                               (8 * self.k, W // 8))
+        pc = self._pc
+        pc.inc("encode_dispatches")
+        pc.inc("encode_bytes", 4 * int(np.prod(words.shape)))
+        out = xor_kernel.xor_matmul_w32(masks, planes)
+        return out.reshape(words.shape[:-2] + (self.m, W))
+
+    def decode_words_device(self, available_ids, words, erased_ids):
+        """words [.., n_avail, W] int32 for one erasure signature ->
+        [.., n_erased, W] int32 on device (recovery matrix is a
+        dynamic operand: new signatures do NOT recompile)."""
+        from ..ops import xor_kernel
+        if self.layout != "bitsliced":
+            raise ErasureCodeError(
+                "word-domain decode requires layout=bitsliced")
+        erased = sorted(erased_ids)
+        if not erased:
+            import jax.numpy as jnp
+            return jnp.zeros(words.shape[:-2] + (0, words.shape[-1]),
+                             dtype=words.dtype)
+        W = words.shape[-1]
+        if (W * 4) % 32:
+            raise ErasureCodeError(
+                f"bitsliced layout needs chunk size % 32 == 0, "
+                f"got {W * 4}")
+        pc = self._pc
+        pc.inc("decode_dispatches")
+        pc.inc("decode_bytes", 4 * int(np.prod(words.shape)))
+        R, dev = self._select_rows(available_ids, erased, words)
+        masks = xor_kernel.masks_to_device(gf.gf8_bitmatrix(R))
+        planes = dev.reshape(dev.shape[:-2] +
+                             (8 * dev.shape[-2], W // 8))
+        out = xor_kernel.xor_matmul_w32(masks, planes)
+        return out.reshape(dev.shape[:-2] + (len(erased), W))
+
+    def _select_rows(self, available_ids, erased, chunks):
+        """Decode matrix + the used-row subset of ``chunks`` (shared
+        by both decode domains).  Static per-row slices, NOT a
+        fancy-index gather: a gather lowers to ~0.1 G elem/s serial
+        loops on TPU — measured 60x slower than the encode matmul it
+        feeds."""
+        import jax.numpy as jnp
+        R, used = self.decode_matrix(available_ids, erased)
+        order = list(available_ids)
+        sel = [order.index(c) for c in used]
+        dev = jnp.asarray(chunks)
+        if sel != list(range(len(order))):
+            dev = jnp.stack([dev[..., i, :] for i in sel], axis=-2)
+        return R, dev
+
     # ----------------------------------------------------------- decode ---
     def decode_chunks(self, available_ids, chunks, erased_ids):
         return np.asarray(
@@ -150,23 +227,12 @@ class ErasureCodeJax(MatrixCodec):
             return np.zeros(
                 tuple(chunks.shape[:-2]) + (0, chunks.shape[-1]),
                 dtype=np.uint8)
-        R, used = self.decode_matrix(available_ids, erased)
-        order = list(available_ids)
-        sel = [order.index(c) for c in used]
-        import jax.numpy as jnp
         pc = self._pc
         pc.inc("decode_dispatches")
         pc.inc("decode_bytes", int(np.prod(chunks.shape)))
         pc.set("decode_cache_hits", self._cache.hits)
         pc.set("decode_cache_misses", self._cache.misses)
-        dev = jnp.asarray(chunks)
-        if sel == list(range(len(order))):
-            rows = dev                  # already the exact row set
-        else:
-            # static per-row slices, NOT dev[..., sel, :]: a fancy-index
-            # gather lowers to ~0.1 G elem/s serial loops on TPU
-            # (measured 60x slower than the encode matmul it feeds)
-            rows = jnp.stack([dev[..., i, :] for i in sel], axis=-2)
+        R, rows = self._select_rows(available_ids, erased, chunks)
         if self.layout == "bitsliced":
             return self._plane_matmul(R, rows)
         return self._matmul(R, rows)
