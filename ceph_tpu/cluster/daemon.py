@@ -56,6 +56,22 @@ def _dumps(obj) -> bytes:
     return pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
 
 
+def mon_sockets(cluster_dir: str) -> List[str]:
+    """The cluster's mon socket paths (single source of the naming
+    convention: 'mon.sock' for a lone mon, 'mon.{r}.sock' per rank
+    for a quorum).  Consumed by clients, OSDs and vstart alike."""
+    try:
+        spec = json.load(open(os.path.join(cluster_dir,
+                                           "cluster.json")))
+        n = int(spec.get("n_mons", 1))
+    except FileNotFoundError:
+        n = 1
+    if n == 1:
+        return [os.path.join(cluster_dir, "mon.sock")]
+    return [os.path.join(cluster_dir, f"mon.{r}.sock")
+            for r in range(n)]
+
+
 # ---------------------------------------------------------------- server ---
 
 class WireServer:
@@ -251,29 +267,149 @@ class MonDaemon:
     """Monitor process: durable map + config + auth ticket server.
 
     Serves (entity-checked): get_ticket, get_map, osd_boot,
-    report_failure, mark_out, status, config_get/set, health.
+    report_failure, mark_out, status, mon_status, config_get/set,
+    health.
+
+    Multi-mon (``n_mons`` > 1 in cluster.json): each rank runs a
+    QuorumNode (cluster/mon_quorum.py) — elected leader, replicated
+    commit over authenticated mon<->mon wire calls, per-rank durable
+    store that replays the quorum log on restart.  Followers forward
+    map mutations to the leader (the reference's peons do the same);
+    reads serve the local committed state.  Reference:
+    src/mon/Elector.h:37, Paxos.{h,cc}, MonitorDBStore.h.
     """
 
-    def __init__(self, cluster_dir: str):
+    MUTATIONS = ("osd_boot", "report_failure", "mark_out")
+
+    def __init__(self, cluster_dir: str, rank: int = 0):
         self.dir = cluster_dir
-        self.keyring = cx.Keyring.load(
-            os.path.join(cluster_dir, "keyring.mon"))
-        self.tickets = cx.TicketServer(self.keyring)
+        self.rank = rank
         spec = json.load(open(os.path.join(cluster_dir, "cluster.json")))
         self.spec = spec
+        self.n_mons = int(spec.get("n_mons", 1))
+        self.keyring = cx.Keyring.load(
+            os.path.join(cluster_dir, "keyring.mon"))
+        self.entity = f"mon.{rank}" if \
+            f"mon.{rank}" in self.keyring.entries else "mon."
+        self.tickets = cx.TicketServer(self.keyring)
         from .monitor import Monitor
         from .wal_kv import WalDB
-        self.db = WalDB(os.path.join(cluster_dir, "mon-store"),
+        store = "mon-store" if self.n_mons == 1 else f"mon-store.{rank}"
+        self.db = WalDB(os.path.join(cluster_dir, store),
                         fsync=bool(spec.get("fsync", True)))
         base = self._base_map()
-        from .monitor import Monitor
         self.mon = Monitor.open(
             base, self.db,
             failure_reports_needed=spec.get("failure_reports_needed", 2))
-        self._lock = threading.Lock()
+        # RLock: the leader's propose path re-enters through the
+        # quorum's local apply (handle -> commit_incremental ->
+        # propose -> _commit_entry -> _apply_decree)
+        self._lock = threading.RLock()
+        self._stop = threading.Event()
+        self.quorum = None
+        self._peer_mons: Dict[int, WireClient] = {}
+        if self.n_mons > 1:
+            from .mon_quorum import QuorumNode
+            self.quorum = QuorumNode(rank, self.n_mons, self.db,
+                                     self._apply_decree,
+                                     self._send_peer_mon)
+            self.mon.set_proposer(self._propose_value)
+            self.quorum.replay(0)      # idempotent re-apply after crash
+        sock = os.path.join(cluster_dir, "mon.sock") \
+            if self.n_mons == 1 else \
+            os.path.join(cluster_dir, f"mon.{rank}.sock")
         self.server = WireServer(
-            os.path.join(cluster_dir, "mon.sock"), "mon.",
-            self.keyring, self._handle, secret_mode_keyring=self.keyring)
+            sock, "mon.", self.keyring, self._handle,
+            secret_mode_keyring=self.keyring)
+        if self.n_mons > 1 and rank == 0:
+            # back-compat alias: clients that only know "mon.sock"
+            # reach rank 0 through a symlink
+            alias = os.path.join(cluster_dir, "mon.sock")
+            try:
+                if os.path.islink(alias) or os.path.exists(alias):
+                    os.unlink(alias)
+                os.symlink(f"mon.{rank}.sock", alias)
+            except OSError:
+                pass
+        if self.quorum is not None:
+            threading.Thread(target=self._election_loop, daemon=True,
+                             name=f"mon.{rank}-elect").start()
+
+    # ------------------------------------------------------ quorum glue --
+    def _peer_call(self, rank: int, req: Dict[str, Any]):
+        c = self._peer_mons.get(rank)
+        if c is None:
+            c = WireClient(
+                os.path.join(self.dir, f"mon.{rank}.sock"),
+                self.entity,
+                secret=self.keyring.secret(self.entity), timeout=3.0)
+            self._peer_mons[rank] = c
+        try:
+            return c.call(req)
+        except (OSError, IOError):
+            self._peer_mons.pop(rank, None)
+            try:
+                c.close()
+            except Exception:
+                pass
+            raise
+
+    def _send_peer_mon(self, rank: int, msg: Dict[str, Any]):
+        return self._peer_call(rank, {"cmd": "quorum", "msg": msg})
+
+    def _apply_decree(self, version: int, blob: bytes) -> None:
+        """Commit path on every rank (idempotent: replay after crash
+        re-applies only what the service lacks)."""
+        from .mon_quorum import decode_decree
+        from .monitor import Monitor
+        d = decode_decree(blob)
+        with self._lock:      # followers apply off quorum threads
+            if d["kind"] == "osdmap":
+                inc = Monitor._inc_from_json(d["inc"].encode())
+                if inc.epoch <= self.mon.osdmap.epoch:
+                    return
+                self.mon.apply_committed_incremental(inc)
+            elif d["kind"] == "config":
+                self.mon.apply_committed_config(d["key"], d["value"])
+
+    def _propose_value(self, value) -> bool:
+        from .mon_quorum import encode_decree
+        from .monitor import Monitor
+        if value[0] == "osdmap":
+            blob = encode_decree(
+                "osdmap", inc=Monitor._inc_json(value[1]).decode())
+        else:
+            blob = encode_decree("config", key=value[1], value=value[2])
+        return self.quorum.propose(blob)
+
+    def _election_loop(self, interval: float = 0.4) -> None:
+        """Leader liveness + election trigger.  Rank-staggered retry
+        delays bias low ranks to win (ElectionLogic's rank preference
+        without the deferral subprotocol).  Every protocol call is
+        guarded: a peer dying mid-election (e.g. between granting a
+        vote and serving the catch-up fetch) must not kill this
+        thread — the loop IS the retry mechanism."""
+        time.sleep(0.05 + 0.15 * self.rank)
+        while not self._stop.is_set():
+            q = self.quorum
+            lead = q.leader
+            try:
+                if lead is None:
+                    q.start_election()
+                elif lead != self.rank:
+                    try:
+                        self._send_peer_mon(lead, {"q": "ping"})
+                    except Exception:
+                        with self._lock:
+                            if q.leader == lead:
+                                q.leader = None
+                        time.sleep(0.05 + 0.15 * self.rank)
+                        q.start_election()
+            except Exception as e:
+                from ..common.log import dout
+                dout("mon", 5, f"mon.{self.rank} election round "
+                               f"failed: {e!r}")
+            time.sleep(interval)
 
     def _base_map(self):
         from ..placement.compiler import compile_crushmap
@@ -297,10 +433,48 @@ class MonDaemon:
             "osd_weight": [int(v) for v in m.osd_weight[:m.max_osd]],
             "addrs": {str(i): os.path.join(self.dir, f"osd.{i}.sock")
                       for i in range(m.max_osd)},
+            "mons": ([os.path.join(self.dir, "mon.sock")]
+                     if self.n_mons == 1 else
+                     [os.path.join(self.dir, f"mon.{r}.sock")
+                      for r in range(self.n_mons)]),
         }
+
+    def _forward_to_leader(self, entity: str,
+                           req: Dict[str, Any]) -> Any:
+        lead = self.quorum.leader
+        if lead is None:
+            raise IOError("mon quorum has no leader (election pending)")
+        fwd = dict(req)
+        fwd["fwd_entity"] = entity
+        return self._peer_call(lead, {"cmd": "_forwarded",
+                                      "req": fwd})["reply"]
 
     def _handle(self, entity: str, req: Dict[str, Any]) -> Any:
         cmd = req["cmd"]
+        if cmd == "quorum":
+            # mon<->mon consensus traffic only
+            if not entity.startswith("mon."):
+                raise cx.AuthError(f"{entity} may not speak quorum")
+            return self.quorum.handle(req["msg"])
+        if cmd == "mon_status":
+            q = self.quorum
+            return {"rank": self.rank, "n_mons": self.n_mons,
+                    "leader": None if q is None else q.leader,
+                    "election_epoch":
+                        0 if q is None else q.election_epoch,
+                    "committed": 0 if q is None else q.committed,
+                    "epoch": self.mon.osdmap.epoch}
+        if cmd == "_forwarded":
+            # leader-side unwrap of a peon-forwarded mutation: the
+            # peon (a mon) asserts the original requester identity
+            if not entity.startswith("mon."):
+                raise cx.AuthError(f"{entity} may not forward")
+            inner = dict(req["req"])
+            orig = inner.pop("fwd_entity")
+            return {"reply": self._handle(orig, inner)}
+        if (self.quorum is not None and cmd in self.MUTATIONS and
+                self.quorum.leader != self.rank):
+            return self._forward_to_leader(entity, req)
         with self._lock:
             if cmd == "get_ticket":
                 service = req["service"]
@@ -373,11 +547,24 @@ class OSDDaemon:
         self._hb_misses: Dict[int, int] = {}
 
     # ----------------------------------------------------------- mon I/O --
+    def _mon_socks(self) -> List[str]:
+        return mon_sockets(self.dir)
+
     def mon_client(self) -> WireClient:
+        """Any live mon will do (mutations forward to the leader
+        server-side); fail over across the quorum."""
         if self._mon is None:
-            self._mon = WireClient(
-                os.path.join(self.dir, "mon.sock"), self.entity,
-                secret=self.keyring.secret(self.entity))
+            last: Optional[Exception] = None
+            for sock in self._mon_socks():
+                try:
+                    self._mon = WireClient(
+                        sock, self.entity,
+                        secret=self.keyring.secret(self.entity))
+                    break
+                except (OSError, IOError, cx.AuthError) as e:
+                    last = e
+            if self._mon is None:
+                raise IOError(f"no mon reachable: {last}")
         return self._mon
 
     def peer_client(self, osd: int) -> WireClient:
@@ -603,7 +790,7 @@ def main(argv=None) -> int:
     ap.add_argument("--hb-interval", type=float, default=0.5)
     args = ap.parse_args(argv)
     if args.role == "mon":
-        d = MonDaemon(args.cluster_dir)
+        d = MonDaemon(args.cluster_dir, rank=args.id)
         d.run_forever()
     else:
         d = OSDDaemon(args.id, args.cluster_dir)

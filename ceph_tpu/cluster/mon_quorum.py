@@ -1,0 +1,352 @@
+"""Multi-mon quorum: leader election + replicated commit over peers.
+
+The reference control plane is a mon quorum: rank-based leader election
+(src/mon/Elector.h:37, ElectionLogic.cc — election epochs, one vote per
+epoch, persisted), a single-slot proposal pipeline driven by the leader
+(src/mon/Paxos.h:57-88 collect/begin/accept/commit), and a store every
+mon replicates through the commit path (src/mon/MonitorDBStore.h).
+
+``QuorumNode`` is that machinery, transport-abstract: ``send(rank,
+msg) -> reply`` is injected (in-process dict calls in unit tests;
+authenticated WireClients in the mon daemon), so the protocol is
+testable without processes and deployable over the wire unchanged.
+
+Safety properties (tested in tests/test_mon_quorum.py):
+  * one vote per election epoch, persisted — two leaders cannot both
+    win the same epoch;
+  * an entry is acknowledged only after a majority stores it, so any
+    later winner's vote majority intersects the storing majority and
+    the collect phase recovers the entry (no acked commit lost);
+  * a deposed leader's begin/commit carries a stale election epoch and
+    is refused — it cannot reach majority;
+  * a restarted or lagging node catches up from the leader's log
+    (fetch), applying entries in order.
+
+Simplifications vs the reference, on purpose: one in-flight slot (no
+pipelining, Paxos.h pipelines too but one-at-a-time is its documented
+base case), and election preference by rank emerges from staggered
+timeouts rather than a deferral subprotocol.
+"""
+from __future__ import annotations
+
+import json
+import threading
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from ..common.log import dout
+
+SendFn = Callable[[int, Dict[str, Any]], Dict[str, Any]]
+ApplyFn = Callable[[int, bytes], None]
+
+
+class NotLeader(RuntimeError):
+    def __init__(self, leader: Optional[int]):
+        super().__init__(f"not the leader (leader={leader})")
+        self.leader = leader
+
+
+class QuorumNode:
+    """One mon rank's consensus state machine."""
+
+    def __init__(self, rank: int, n_ranks: int, db, apply_fn: ApplyFn,
+                 send_fn: SendFn):
+        self.rank = rank
+        self.n_ranks = n_ranks
+        self.db = db
+        self.apply_fn = apply_fn
+        self.send_fn = send_fn
+        self._lock = threading.RLock()
+        self.leader: Optional[int] = None
+        # persisted state
+        self.election_epoch = int(db.get("quorum", "election_epoch")
+                                  or b"0")
+        self.committed = int(db.get("quorum", "committed") or b"0")
+        self.applied = 0          # caller advances via replay/apply
+
+    # -------------------------------------------------------- persistence --
+    def _put(self, key: str, value: bytes) -> None:
+        from .kv import WriteBatch
+        self.db.submit(WriteBatch().set("quorum", key, value))
+
+    def _log_key(self, version: int) -> str:
+        return f"log:{version:010d}"
+
+    def _get_entry(self, version: int) -> Optional[bytes]:
+        return self.db.get("quorum", self._log_key(version))
+
+    def _entry_epoch(self, version: int) -> int:
+        b = self.db.get("quorum", f"logep:{version:010d}")
+        return int(b or b"0")
+
+    def _store_entry(self, version: int, value: bytes,
+                     epoch: int) -> None:
+        """Entry + the election epoch that accepted it: the collect
+        phase must prefer the HIGHEST-epoch accepted value for a slot
+        (classic Paxos — a stale minority tail at the same version
+        must not beat a later majority-accepted one)."""
+        from .kv import WriteBatch
+        self.db.submit(WriteBatch()
+                       .set("quorum", self._log_key(version), value)
+                       .set("quorum", f"logep:{version:010d}",
+                            str(epoch).encode()))
+
+    def quorum(self) -> int:
+        return self.n_ranks // 2 + 1
+
+    # ---------------------------------------------------------- election --
+    def start_election(self) -> bool:
+        """Run one election round as candidate.  Returns True when this
+        rank won (and synchronized the quorum)."""
+        with self._lock:
+            e = self.election_epoch + 1
+            self.election_epoch = e
+            self._put("election_epoch", str(e).encode())
+            self.leader = None
+        votes = 1                      # self
+        voters: List[Tuple[int, int, Optional[Tuple[int, bytes]]]] = [
+            (self.rank, self.committed, self._tail())]
+        for r in range(self.n_ranks):
+            if r == self.rank:
+                continue
+            try:
+                rep = self.send_fn(r, {"q": "vote", "epoch": e,
+                                       "candidate": self.rank})
+            except Exception:
+                continue
+            if rep.get("granted"):
+                votes += 1
+                tail = rep.get("tail")
+                voters.append((r, int(rep["committed"]),
+                               (int(tail[0]), bytes(tail[1]),
+                                int(tail[2]))
+                               if tail else None))
+        if votes < self.quorum():
+            dout("mon", 10, f"rank {self.rank} lost election epoch "
+                            f"{e} ({votes} votes)")
+            return False
+        with self._lock:
+            self.leader = self.rank
+        self._collect(voters)
+        # victory: peers learn the leader and catch up
+        for r in range(self.n_ranks):
+            if r == self.rank:
+                continue
+            try:
+                self.send_fn(r, {"q": "victory", "epoch": e,
+                                 "leader": self.rank,
+                                 "committed": self.committed})
+            except Exception:
+                continue
+        dout("mon", 5, f"rank {self.rank} won election epoch {e} "
+                       f"({votes} votes)")
+        return True
+
+    def _tail(self) -> Optional[Tuple[int, bytes, int]]:
+        """The accepted-but-uncommitted entry + its accept epoch, if
+        any (at most one: single in-flight slot)."""
+        v = self.committed + 1
+        blob = self._get_entry(v)
+        return (v, blob, self._entry_epoch(v)) \
+            if blob is not None else None
+
+    def _collect(self, voters) -> None:
+        """Paxos collect: adopt the longest committed log among the
+        vote majority, then re-commit the accepted-but-uncommitted
+        tail with the HIGHEST accept epoch (it may have been
+        acknowledged to a client; a stale minority tail at the same
+        version loses to a later-epoch majority-accepted one)."""
+        best_rank, best_committed = self.rank, self.committed
+        for rank, committed, tail in voters:
+            if committed > best_committed:
+                best_rank, best_committed = rank, committed
+        if best_committed > self.committed:
+            self._catch_up_from(best_rank, best_committed)
+        best_tail: Optional[Tuple[int, bytes, int]] = None
+        for rank, committed, tail in voters:
+            if tail is None or tail[0] != self.committed + 1:
+                continue              # stale/irrelevant slot
+            if best_tail is None or tail[2] > best_tail[2]:
+                best_tail = tail
+        if best_tail is not None:
+            # finish the in-flight slot under our (new) epoch
+            self._commit_entry(best_tail[0], best_tail[1])
+            self._replicate_commit(best_tail[0], best_tail[1])
+
+    def _catch_up_from(self, rank: int, target: int) -> None:
+        rep = self.send_fn(rank, {"q": "fetch",
+                                  "after": self.committed})
+        for v, blob in rep["entries"]:
+            if v != self.committed + 1:
+                continue
+            self._commit_entry(v, bytes(blob))
+
+    # ------------------------------------------------------------ commit --
+    def _commit_entry(self, version: int, value: bytes) -> None:
+        """Persist + mark committed + apply, in that order (replay on
+        restart re-applies anything past the service's state)."""
+        with self._lock:
+            if version != self.committed + 1:
+                return
+            self._store_entry(version, value, self.election_epoch)
+            self.committed = version
+            self._put("committed", str(version).encode())
+        self.apply_fn(version, value)
+
+    def _replicate_commit(self, version: int, value: bytes) -> None:
+        for r in range(self.n_ranks):
+            if r == self.rank:
+                continue
+            try:
+                self.send_fn(r, {"q": "commit", "epoch":
+                                 self.election_epoch,
+                                 "version": version, "value": value})
+            except Exception:
+                continue          # laggard catches up later
+
+    def propose(self, value: bytes) -> bool:
+        """Leader path: begin/accept on a majority, then commit.  The
+        caller may acknowledge its client iff this returns True."""
+        with self._lock:
+            if self.leader != self.rank:
+                raise NotLeader(self.leader)
+            e = self.election_epoch
+            v = self.committed + 1
+            self._store_entry(v, value, e)    # self-accept
+        acks = 1
+        for r in range(self.n_ranks):
+            if r == self.rank:
+                continue
+            try:
+                rep = self.send_fn(r, {"q": "begin", "epoch": e,
+                                       "version": v, "value": value})
+            except Exception:
+                continue
+            if rep.get("accepted"):
+                acks += 1
+        if acks < self.quorum():
+            # no majority (partition / deposed): the stored entry stays
+            # uncommitted; a future leader's collect may still finish
+            # it, which is safe — we report failure and the caller must
+            # not ack its client
+            return False
+        self._commit_entry(v, value)
+        self._replicate_commit(v, value)
+        return True
+
+    # ---------------------------------------------------------- handlers --
+    def handle(self, msg: Dict[str, Any]) -> Dict[str, Any]:
+        """Peer-message dispatch (the mon<->mon wire surface)."""
+        q = msg["q"]
+        if q == "vote":
+            return self._on_vote(msg)
+        if q == "victory":
+            return self._on_victory(msg)
+        if q == "begin":
+            return self._on_begin(msg)
+        if q == "commit":
+            self._on_commit(msg)
+            return {"ok": True}
+        if q == "fetch":
+            after = int(msg["after"])
+            entries = []
+            v = after + 1
+            while v <= self.committed:
+                blob = self._get_entry(v)
+                if blob is None:
+                    break
+                entries.append((v, blob))
+                v += 1
+            return {"entries": entries, "committed": self.committed}
+        if q == "ping":
+            return {"leader": self.leader,
+                    "epoch": self.election_epoch,
+                    "committed": self.committed}
+        raise ValueError(f"unknown quorum message {q!r}")
+
+    def _on_vote(self, msg) -> Dict[str, Any]:
+        e = int(msg["epoch"])
+        with self._lock:
+            if e <= self.election_epoch:
+                return {"granted": False, "epoch": self.election_epoch}
+            # one vote per epoch, persisted BEFORE granting
+            self.election_epoch = e
+            self._put("election_epoch", str(e).encode())
+            self.leader = None
+            return {"granted": True, "committed": self.committed,
+                    "tail": self._tail()}
+
+    def _on_victory(self, msg) -> Dict[str, Any]:
+        e = int(msg["epoch"])
+        with self._lock:
+            if e < self.election_epoch:
+                return {"ok": False}
+            self.election_epoch = e
+            self._put("election_epoch", str(e).encode())
+            self.leader = int(msg["leader"])
+            behind = int(msg["committed"]) > self.committed
+            leader = self.leader
+        if behind:
+            try:
+                self._catch_up_from(leader, int(msg["committed"]))
+            except Exception:
+                pass
+        return {"ok": True}
+
+    def _on_begin(self, msg) -> Dict[str, Any]:
+        e, v = int(msg["epoch"]), int(msg["version"])
+        with self._lock:
+            if e < self.election_epoch or self.leader is None:
+                return {"accepted": False,
+                        "epoch": self.election_epoch}
+            if e > self.election_epoch:
+                # a leader we missed the victory of: adopt it
+                self.election_epoch = e
+                self._put("election_epoch", str(e).encode())
+                self.leader = int(msg.get("leader", -1)) \
+                    if "leader" in msg else self.leader
+            if v != self.committed + 1:
+                return {"accepted": False,
+                        "committed": self.committed}
+            self._store_entry(v, bytes(msg["value"]), e)
+            return {"accepted": True}
+
+    def _on_commit(self, msg) -> None:
+        v = int(msg["version"])
+        if v == self.committed + 1:
+            self._commit_entry(v, bytes(msg["value"]))
+        elif v > self.committed:
+            # gap: pull the backlog from the leader
+            leader = int(msg.get("leader", -1))
+            src = leader if leader >= 0 else \
+                (self.leader if self.leader is not None else -1)
+            if src >= 0 and src != self.rank:
+                try:
+                    self._catch_up_from(src, v)
+                except Exception:
+                    pass
+
+    # ------------------------------------------------------------ replay --
+    def replay(self, applied_hint: int = 0) -> int:
+        """On restart: re-apply committed entries beyond what the
+        service already holds (the MonitorDBStore recovery walk)."""
+        applied = applied_hint
+        v = applied + 1
+        while v <= self.committed:
+            blob = self._get_entry(v)
+            if blob is None:
+                break
+            self.apply_fn(v, blob)
+            applied = v
+            v += 1
+        return applied
+
+
+# ----------------------------------------------------------- encoding ---
+
+def encode_decree(kind: str, **fields) -> bytes:
+    """Typed JSON decree (no pickle on the quorum wire)."""
+    return json.dumps({"kind": kind, **fields}).encode()
+
+
+def decode_decree(blob: bytes) -> Dict[str, Any]:
+    return json.loads(bytes(blob).decode())

@@ -3,7 +3,7 @@ health checks.
 
 Compact TPU-native re-creation of the mon's roles (src/mon/):
 
-  * ``PaxosLog`` — the consensus substrate (src/mon/Paxos.{h,cc}): a
+  * ``QuorumModel`` — the consensus substrate (src/mon/Paxos.{h,cc}): a
     proposal/accept/commit state machine over N in-process ranks with
     majority acceptance and monotone proposal numbers.  One class,
     testable, with the properties that matter: committed versions are
@@ -36,8 +36,11 @@ from .osdmap import Incremental, OSDMap
 
 # ------------------------------------------------------------- consensus ---
 
-class PaxosLog:
-    """Single-decree-per-version Paxos over in-process ranks.
+class QuorumModel:
+    """In-process MODEL of single-decree quorum acceptance (NOT the
+    deployable consensus — that is cluster/mon_quorum.QuorumNode, a
+    real elected multi-mon log over the wire; this class backs
+    standalone single-mon setups and the consensus unit tests).
 
     The reference pipelines one decree at a time through
     collect/begin/accept/commit (Paxos.h:57-88 'The Leader election ...
@@ -104,22 +107,34 @@ class HealthCheck:
 
 
 class Monitor:
-    """Single logical mon cluster (PaxosLog-backed) owning the OSDMap.
+    """Single logical mon cluster (QuorumModel-backed) owning the OSDMap.
     Committed state persists into a KeyValueDB (the MonitorDBStore
     role, src/mon/MonitorDBStore.h over src/kv/): prefixes `osdmap`
     (per-epoch incrementals), `config` (central options), `paxos`
     (commit markers)."""
 
     def __init__(self, osdmap: OSDMap, n_ranks: int = 3,
-                 failure_reports_needed: int = 2, db=None):
+                 failure_reports_needed: int = 2, db=None,
+                 proposer: Optional[Callable[[Tuple], bool]] = None):
         from .kv import MemDB
         self.osdmap = osdmap
-        self.paxos = PaxosLog(n_ranks)
+        self.paxos = QuorumModel(n_ranks)
         self.incrementals: List[Incremental] = []
         self.config_db: Dict[str, Any] = {}
         self.failure_reports_needed = failure_reports_needed
         self._failure_reports: Dict[int, set] = {}
         self.db = db if db is not None else MemDB()
+        # consensus seam: None = the in-process QuorumModel decides AND
+        # this object applies inline; a wire-quorum daemon installs its
+        # QuorumNode.propose here, and application happens through the
+        # quorum's apply path (apply_committed_*) on every rank —
+        # including this one — so proposal success implies local state
+        # is already updated
+        self._proposer = proposer
+
+    def set_proposer(self,
+                     fn: Optional[Callable[[Tuple], bool]]) -> None:
+        self._proposer = fn
 
     @staticmethod
     def _inc_json(inc: Incremental) -> bytes:
@@ -212,17 +227,31 @@ class Monitor:
             raise ValueError(
                 f"incremental epoch {inc.epoch} != "
                 f"{self.osdmap.epoch} + 1")
+        if self._proposer is not None:
+            # wire quorum: commit applies on every rank (incl. here)
+            # through apply_committed_incremental before this returns
+            return self._proposer(("osdmap", inc))
         if not self.paxos.propose(("osdmap", inc)):
             return False
+        self.apply_committed_incremental(inc, paxos_marker=True)
+        return True
+
+    def apply_committed_incremental(self, inc: Incremental,
+                                    paxos_marker: bool = False) -> None:
+        """Apply + persist an incremental the quorum already decided
+        (the commit path every mon rank runs)."""
+        if inc.epoch != self.osdmap.epoch + 1:
+            raise ValueError(
+                f"committed incremental epoch {inc.epoch} does not "
+                f"follow map epoch {self.osdmap.epoch}")
         self.osdmap.apply_incremental(inc)
         self.incrementals.append(inc)
         from .kv import WriteBatch
-        self.db.submit(WriteBatch()
-                       .set("osdmap", f"{inc.epoch:010d}",
-                            self._inc_json(inc))
-                       .set("paxos", f"{self.paxos.version:010d}",
-                            b"osdmap"))
-        return True
+        b = WriteBatch().set("osdmap", f"{inc.epoch:010d}",
+                             self._inc_json(inc))
+        if paxos_marker:
+            b.set("paxos", f"{self.paxos.version:010d}", b"osdmap")
+        self.db.submit(b)
 
     def next_incremental(self) -> Incremental:
         return Incremental(epoch=self.osdmap.epoch + 1)
@@ -235,20 +264,27 @@ class Monitor:
     def config_set(self, key: str, value: Any) -> bool:
         """Central config commit (ConfigMonitor): consensus first, then
         push into the process registry at FILE level."""
+        if self._proposer is not None:
+            return self._proposer(("config", key, value))
         if not self.paxos.propose(("config", key, value)):
             return False
+        self.apply_committed_config(key, value, paxos_marker=True)
+        return True
+
+    def apply_committed_config(self, key: str, value: Any,
+                               paxos_marker: bool = False) -> None:
         self.config_db[key] = value
         import json
         from .kv import WriteBatch
-        self.db.submit(WriteBatch()
-                       .set("config", key, json.dumps(value).encode())
-                       .set("paxos", f"{self.paxos.version:010d}",
-                            b"config"))
+        b = WriteBatch().set("config", key,
+                             json.dumps(value).encode())
+        if paxos_marker:
+            b.set("paxos", f"{self.paxos.version:010d}", b"config")
+        self.db.submit(b)
         try:
             config().set(key, value, level=LEVEL_FILE)
         except OptionError:
             pass          # unknown keys stay mon-side only
-        return True
 
     def config_get(self, key: str) -> Any:
         return self.config_db.get(key)

@@ -28,7 +28,7 @@ from ..common import auth as cx
 def build_cluster_dir(cluster_dir: str, n_osds: int = 6,
                       osds_per_host: int = 2,
                       pools: Optional[List[dict]] = None,
-                      fsync: bool = True) -> None:
+                      fsync: bool = True, n_mons: int = 1) -> None:
     """Write crushmap.txt, cluster.json and keyrings."""
     os.makedirs(cluster_dir, exist_ok=True)
     from ..placement.builder import TYPE_HOST, build_flat_cluster
@@ -50,9 +50,12 @@ def build_cluster_dir(cluster_dir: str, n_osds: int = 6,
     if pools is None:
         pools = [{"id": 1, "name": "rep", "type": 1, "size": 3,
                   "pg_num": 16, "crush_rule": 0}]
-    json.dump({"pools": pools, "fsync": fsync, "n_osds": n_osds},
+    json.dump({"pools": pools, "fsync": fsync, "n_osds": n_osds,
+               "n_mons": n_mons},
               open(os.path.join(cluster_dir, "cluster.json"), "w"))
-    names = ["mon.", "client.admin"] + [f"osd.{i}" for i in range(n_osds)]
+    names = ["mon.", "client.admin"] + \
+        [f"mon.{r}" for r in range(n_mons)] + \
+        [f"osd.{i}" for i in range(n_osds)]
     ring = cx.Keyring.generate(names)
     ring.save(os.path.join(cluster_dir, "keyring.mon"))
     ring.subset("client.admin").save(
@@ -89,11 +92,19 @@ class Vstart:
         except OSError:
             pass
 
-    def start_mon(self, timeout: float = 30.0) -> None:
-        sock = os.path.join(self.dir, "mon.sock")
+    def _n_mons(self) -> int:
+        from ..cluster.daemon import mon_sockets
+        return len(mon_sockets(self.dir))
+
+    def start_mon(self, rank: int = 0, timeout: float = 30.0) -> None:
+        from ..cluster.daemon import mon_sockets
+        sock = mon_sockets(self.dir)[rank]
         self._clear_stale_sock(sock)
-        self.procs["mon"] = self._spawn(
-            "mon", "--cluster-dir", self.dir)
+        p = self._spawn("mon", "--cluster-dir", self.dir,
+                        "--id", str(rank))
+        self.procs[f"mon.{rank}"] = p
+        if rank == 0:
+            self.procs["mon"] = p          # legacy alias
         self._wait_sock(sock, timeout)
 
     def start_osd(self, osd_id: int, timeout: float = 30.0,
@@ -115,7 +126,8 @@ class Vstart:
         raise TimeoutError(f"daemon socket {path} never appeared")
 
     def start(self, n_osds: int, hb_interval: float = 0.5) -> None:
-        self.start_mon()
+        for r in range(self._n_mons()):
+            self.start_mon(r)
         for i in range(n_osds):
             self.start_osd(i, hb_interval=hb_interval)
 

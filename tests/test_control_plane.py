@@ -8,7 +8,7 @@ import numpy as np
 import pytest
 
 from ceph_tpu.cluster.heartbeat import HeartbeatConfig, HeartbeatMonitor
-from ceph_tpu.cluster.monitor import Monitor, PaxosLog
+from ceph_tpu.cluster.monitor import Monitor, QuorumModel
 from ceph_tpu.cluster.objecter import Objecter, TooManyRetries
 from ceph_tpu.cluster.osdmap import Incremental
 from tests.test_simulator import make_sim
@@ -17,14 +17,14 @@ from tests.test_simulator import make_sim
 # --------------------------------------------------------------- paxos ----
 
 def test_paxos_commits_with_majority():
-    p = PaxosLog(n_ranks=3)
+    p = QuorumModel(n_ranks=3)
     assert p.propose("a") and p.propose("b")
     assert p.committed == ["a", "b"]
     assert p.version == 2
 
 
 def test_paxos_minority_cannot_commit():
-    p = PaxosLog(n_ranks=3)
+    p = QuorumModel(n_ranks=3)
     p.reachable[1] = False
     assert p.propose("ok")              # 2/3 is still a quorum
     p.reachable[2] = False
@@ -33,7 +33,7 @@ def test_paxos_minority_cannot_commit():
 
 
 def test_paxos_new_leader_supersedes():
-    p = PaxosLog(n_ranks=3)
+    p = QuorumModel(n_ranks=3)
     p.propose("v1")
     old_pn = p.accepted_pn[0]
     p.elect(leader=1)
@@ -43,7 +43,7 @@ def test_paxos_new_leader_supersedes():
 
 
 def test_paxos_single_rank():
-    p = PaxosLog(n_ranks=1)
+    p = QuorumModel(n_ranks=1)
     assert p.propose("solo")
 
 

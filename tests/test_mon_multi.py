@@ -1,0 +1,172 @@
+"""Multi-mon process cluster: elected quorum over the wire.
+
+VERDICT r3 missing #1: three mon PROCESSES with a real election, a
+replicated commit path, and client/OSD failover — SIGKILL the leader,
+survivors elect, map mutations keep committing, the revived mon
+catches up from the quorum log.  Reference: src/mon/Elector.h:37,
+Paxos.{h,cc}, MonitorDBStore.h.
+"""
+import os
+import time
+
+import numpy as np
+import pytest
+
+from ceph_tpu.tools.vstart import Vstart, build_cluster_dir
+
+N_OSDS = 4
+N_MONS = 3
+
+
+@pytest.fixture
+def cluster3(tmp_path):
+    d = str(tmp_path / "c3")
+    build_cluster_dir(d, n_osds=N_OSDS, osds_per_host=2, fsync=False,
+                      n_mons=N_MONS)
+    v = Vstart(d)
+    v.start(N_OSDS, hb_interval=0.25)
+    yield d, v
+    v.stop()
+
+
+def _client(d):
+    from ceph_tpu.client.remote import RemoteCluster
+    return RemoteCluster(d)
+
+
+def _wait_up(rc, n, timeout=20.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if rc.status()["n_up"] >= n:
+            rc.refresh_map()
+            return
+        time.sleep(0.3)
+    raise AssertionError(f"cluster never reached {n} up OSDs")
+
+
+def _wait_leader(rc, exclude=None, timeout=20.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            st = rc.mon_status()
+        except (OSError, IOError):
+            time.sleep(0.3)
+            continue
+        lead = st.get("leader")
+        if lead is not None and lead != exclude:
+            return st
+        time.sleep(0.3)
+    raise AssertionError(f"no quorum leader (excluding {exclude}) "
+                         f"within {timeout}s")
+
+
+def test_quorum_elects_and_replicates(cluster3):
+    d, v = cluster3
+    rc = _client(d)
+    st = _wait_leader(rc)
+    assert st["n_mons"] == N_MONS
+    _wait_up(rc, N_OSDS)
+    # I/O works through the quorum-backed control plane
+    rng = np.random.default_rng(3)
+    data = rng.integers(0, 256, 3000, dtype=np.uint8).tobytes()
+    assert rc.put(1, "obj", data) >= 2
+    assert rc.get(1, "obj") == data
+    # committed map state is REPLICATED: every rank's store holds the
+    # same committed count and map epoch
+    from ceph_tpu.cluster.daemon import WireClient
+    from ceph_tpu.common import auth as cx
+    ring = cx.Keyring.load(os.path.join(d, "keyring.client"))
+    stats = []
+    deadline = time.monotonic() + 15
+    while time.monotonic() < deadline:
+        stats = []
+        for r in range(N_MONS):
+            c = WireClient(os.path.join(d, f"mon.{r}.sock"),
+                           "client.admin",
+                           secret=ring.secret("client.admin"))
+            stats.append(c.call({"cmd": "mon_status"}))
+            c.close()
+        if len({s["committed"] for s in stats}) == 1 and \
+                len({s["epoch"] for s in stats}) == 1:
+            break
+        time.sleep(0.3)
+    assert len({s["committed"] for s in stats}) == 1, stats
+    assert len({s["epoch"] for s in stats}) == 1, stats
+    assert stats[0]["committed"] >= N_OSDS   # the osd boots committed
+    rc.close()
+
+
+def test_leader_sigkill_survivors_commit_and_revive_catches_up(
+        cluster3):
+    d, v = cluster3
+    rc = _client(d)
+    st = _wait_leader(rc)
+    leader = st["leader"]
+    _wait_up(rc, N_OSDS)
+    rng = np.random.default_rng(5)
+    blobs = {f"o{i}": rng.integers(0, 256, 2000,
+                                   dtype=np.uint8).tobytes()
+             for i in range(6)}
+    for name, data in blobs.items():
+        rc.put(1, name, data)
+    epoch_before = rc.mon_status()["epoch"]
+
+    # SIGKILL the LEADER
+    v.kill9(f"mon.{leader}")
+    assert not v.alive(f"mon.{leader}")
+
+    # survivors elect a new leader (client fails over automatically)
+    st2 = _wait_leader(rc, exclude=leader, timeout=25.0)
+    assert st2["leader"] != leader
+
+    # an acked map mutation commits through the NEW leader
+    r = rc.mon_call({"cmd": "mark_out", "osd": N_OSDS - 1})
+    epoch_after = r["epoch"]
+    assert epoch_after > epoch_before
+
+    # I/O continues against the survivor quorum
+    for name, data in blobs.items():
+        assert rc.get(1, name) == data
+    assert rc.put(1, "post-failover", blobs["o0"]) >= 1
+
+    # revive the killed mon: it must catch up to the committed state —
+    # including the epoch acked AFTER its death (nothing lost)
+    v.start_mon(leader)
+    from ceph_tpu.cluster.daemon import WireClient
+    from ceph_tpu.common import auth as cx
+    ring = cx.Keyring.load(os.path.join(d, "keyring.client"))
+    deadline = time.monotonic() + 25
+    caught_up = False
+    while time.monotonic() < deadline:
+        try:
+            c = WireClient(os.path.join(d, f"mon.{leader}.sock"),
+                           "client.admin",
+                           secret=ring.secret("client.admin"))
+            st3 = c.call({"cmd": "mon_status"})
+            c.close()
+            if st3["epoch"] >= epoch_after:
+                caught_up = True
+                break
+        except (OSError, IOError):
+            pass
+        time.sleep(0.4)
+    assert caught_up, "revived mon never caught up to the acked epoch"
+    rc.close()
+
+
+def test_follower_forwards_mutations(cluster3):
+    d, v = cluster3
+    rc = _client(d)
+    st = _wait_leader(rc)
+    leader = st["leader"]
+    follower = next(r for r in range(N_MONS) if r != leader)
+    from ceph_tpu.cluster.daemon import WireClient
+    from ceph_tpu.common import auth as cx
+    ring = cx.Keyring.load(os.path.join(d, "keyring.client"))
+    c = WireClient(os.path.join(d, f"mon.{follower}.sock"),
+                   "client.admin", secret=ring.secret("client.admin"))
+    before = c.call({"cmd": "mon_status"})["epoch"]
+    r = c.call({"cmd": "mark_out", "osd": 0})
+    assert r["epoch"] > before     # committed via leader forwarding
+    c.close()
+    rc.close()
