@@ -98,6 +98,8 @@ class RemoteCluster:
             m.add_pool(PGPool(**p))
         self.osdmap = m
         self.addrs = {int(k): v for k, v in blob["addrs"].items()}
+        self.pool_snaps = {int(k): v for k, v in
+                           blob.get("pool_snaps", {}).items()}
 
     def osd_client(self, osd: int) -> WireClient:
         c = self._osd_clients.get(osd)
@@ -139,6 +141,147 @@ class RemoteCluster:
             self._codecs[pool.id] = codec
         return codec
 
+    # ----------------------------------------------------------- snapshots --
+    def snap_create(self, pool_id: int, name: str) -> int:
+        """Pool snapshot: committed mon state (quorum decree); clones
+        appear lazily on the next write per object (pool snap_seq +
+        COW, the OSDMonitor prepare_pool_op / make_writeable shape)."""
+        r = self.mon_call({"cmd": "pool_snap_create", "pool": pool_id,
+                           "name": name})
+        self.refresh_map()
+        return int(r["snap_seq"])
+
+    def snap_lookup(self, pool_id: int, name: str) -> int:
+        snaps = self.pool_snaps.get(pool_id, {}).get("snaps", {})
+        for sid, nm in snaps.items():
+            if nm == name:
+                return int(sid)
+        raise KeyError(f"no snapshot {name!r} in pool {pool_id}")
+
+    def _snapset_of(self, pool: PGPool, pg: int,
+                    name: str) -> Optional[Dict]:
+        """The snapset attr from ANY member holding it (replicated:
+        every replica stores it; a member without the attr — e.g. one
+        restored by data-only recovery — must not mask the others)."""
+        coll = [pool.id, pg]
+        up = self._up(pool, pg)
+        answered = False
+        for o in [x for x in up if x != ITEM_NONE]:
+            try:
+                raw = self.osd_client(o).call({
+                    "cmd": "getattr_shard", "coll": coll,
+                    "oid": f"0:{name}", "key": "snapset"})
+            except (OSError, IOError):
+                self.drop_osd_client(o)
+                continue
+            answered = True
+            if raw is not None:
+                return json.loads(bytes(raw).decode())
+        if not answered:
+            raise IOError(f"{name}: no member reachable for snapset")
+        return None
+
+    def _store_snapset(self, pool: PGPool, pg: int, name: str,
+                       snapset: Dict) -> None:
+        """Persist the snapset on EVERY mapped member (replicated:
+        all replicas; EC: every shard).  Zero acks is a hard error —
+        a silently-lost snapset corrupts later COW rounds."""
+        coll = [pool.id, pg]
+        up = self._up(pool, pg)
+        blob = json.dumps(snapset).encode()
+        n_shards = self.codec_for(pool).get_chunk_count() \
+            if pool.type == POOL_ERASURE else len(
+                [x for x in up if x != ITEM_NONE])
+        acks = 0
+        for shard in range(n_shards):
+            if pool.type == POOL_ERASURE:
+                tgt = up[shard] if shard < len(up) else ITEM_NONE
+                oid = f"{shard}:{name}"
+            else:
+                tgt = [x for x in up if x != ITEM_NONE][shard]
+                oid = f"0:{name}"
+            if tgt == ITEM_NONE:
+                continue
+            try:
+                self.osd_client(tgt).call({
+                    "cmd": "setattr_shard", "coll": coll,
+                    "oid": oid, "attrs": {"snapset": blob}})
+                acks += 1
+            except (OSError, IOError):
+                self.drop_osd_client(tgt)
+        if acks == 0:
+            raise IOError(f"{name}: snapset not persisted anywhere")
+
+    def _maybe_cow(self, pool: PGPool, pg: int,
+                   name: str) -> Optional[Dict]:
+        """Copy-on-write before the first overwrite after a snapshot
+        (PrimaryLogPG make_writeable role, driven by the TPU-attached
+        client as primary): preserve the head as a clone object.
+        Returns the snapset to store after the head write."""
+        info = self.pool_snaps.get(pool.id) or {"seq": 0, "snaps": {}}
+        seq = int(info["seq"])
+        if seq == 0:
+            return None       # never-snapped pool: zero write overhead
+        ss = self._snapset_of(pool, pg, name)
+        if ss is None:
+            # no snapset attr: distinguish a brand-new object (born
+            # at the current seq) from one written before snapshots
+            # existed (implicit write_seq 0 -> COW applies)
+            exists = False
+            for o in [x for x in self._up(pool, pg)
+                      if x != ITEM_NONE]:
+                try:
+                    exists = self.osd_client(o).call({
+                        "cmd": "digest_shard", "coll": [pool.id, pg],
+                        "oid": f"0:{name}"}) is not None
+                    break
+                except (OSError, IOError):
+                    self.drop_osd_client(o)
+            if not exists:
+                return {"write_seq": seq, "clones": []} if seq \
+                    else None
+            ss = {"write_seq": 0, "clones": []}
+        if int(ss.get("write_seq", 0)) >= seq:
+            return ss
+        covered = [int(s) for s in info["snaps"]
+                   if int(ss.get("write_seq", 0)) < int(s) <= seq]
+        if covered:
+            # idempotency: if a previous COW round already preserved
+            # this clone (but the snapset update was lost), do NOT
+            # overwrite it with the newer head
+            clone = f"{name}@{seq}"
+            cpg = self._pg_for(pool, clone)
+            exists = False
+            for o in [x for x in self._up(pool, cpg)
+                      if x != ITEM_NONE]:
+                try:
+                    exists = self.osd_client(o).call({
+                        "cmd": "digest_shard",
+                        "coll": [pool.id, cpg],
+                        "oid": f"0:{clone}"}) is not None
+                    break
+                except (OSError, IOError):
+                    self.drop_osd_client(o)
+            if not exists:
+                data = self.get(pool.id, name)
+                self.put(pool.id, clone, data)
+            ss.setdefault("clones", []).append(
+                {"id": seq, "snaps": covered})
+        ss["write_seq"] = seq
+        return ss
+
+    def get_snap(self, pool_id: int, name: str, snap_id: int) -> bytes:
+        """Read an object AT a snapshot: clone covering it, else the
+        unchanged head (SnapSet resolution)."""
+        pool = self.osdmap.pools[pool_id]
+        pg = self._pg_for(pool, name)
+        ss = self._snapset_of(pool, pg, name)
+        if ss:
+            for c in ss.get("clones", []):
+                if snap_id in c["snaps"]:
+                    return self.get(pool_id, f"{name}@{c['id']}")
+        return self.get(pool_id, name)
+
     # ----------------------------------------------------------------- IO --
     def put(self, pool_id: int, name: str, data: bytes) -> int:
         """Returns the number of shard/replica writes acknowledged."""
@@ -146,6 +289,8 @@ class RemoteCluster:
         pg = self._pg_for(pool, name)
         up = self._up(pool, pg)
         coll = [pool_id, pg]
+        snapset = self._maybe_cow(pool, pg, name) \
+            if "@" not in name else None
         if pool.type != POOL_ERASURE:
             replicas = [o for o in up if o != ITEM_NONE]
             if not replicas:
@@ -156,10 +301,12 @@ class RemoteCluster:
                     "cmd": "put_object", "coll": coll,
                     "oid": f"0:{name}", "data": data,
                     "replicas": replicas})
-                return int(r["acks"])
             except (OSError, IOError):
                 self.drop_osd_client(primary)
                 raise
+            if snapset is not None:
+                self._store_snapset(pool, pg, name, snapset)
+            return int(r["acks"])
         codec = self.codec_for(pool)
         k = codec.get_data_chunk_count()
         n = codec.get_chunk_count()
@@ -215,6 +362,8 @@ class RemoteCluster:
                 f"{name}: EC write incomplete — {acks}/{n} shards "
                 f"committed, unacked mapped shards {missing} "
                 f"(gather-all-commits contract)")
+        if snapset is not None:
+            self._store_snapset(pool, pg, name, snapset)
         return acks
 
     def get(self, pool_id: int, name: str,
@@ -289,10 +438,15 @@ class RemoteCluster:
         return buf[:size]
 
     # ------------------------------------------------------------ recovery --
-    def recover_pool(self, pool_id: int) -> Dict[str, int]:
-        """Replicated pools: primary-driven list/pull/push per PG."""
+    def recover_pool(self, pool_id: int) -> Dict:
+        """Replicated pools: primary-driven PEERING recovery per PG
+        (GetInfo/GetLog/GetMissing on the primary daemon; members
+        catch up by log delta when the log covers their gap, else
+        backfill — src/osd/PeeringState.h:561, PGLog.h)."""
         pool = self.osdmap.pools[pool_id]
-        totals = {"objects": 0, "copied": 0}
+        totals = {"copied": 0, "delta_objects": 0,
+                  "backfill_objects": 0, "deletes_applied": 0,
+                  "modes": {"delta": 0, "backfill": 0, "clean": 0}}
         for pg in range(pool.pg_num):
             up = self._up(pool, pg)
             members = [o for o in up if o != ITEM_NONE]
@@ -305,8 +459,43 @@ class RemoteCluster:
             except (OSError, IOError):
                 self.drop_osd_client(members[0])
                 continue
+            for key in ("copied", "delta_objects",
+                        "backfill_objects", "deletes_applied"):
+                totals[key] += r.get(key, 0)
+            for mode in r.get("mode", {}).values():
+                totals["modes"][mode] = \
+                    totals["modes"].get(mode, 0) + 1
+        return totals
+
+    def scrub_pool(self, pool_id: int,
+                   repair: bool = False) -> Dict:
+        """Cross-replica scrub over the wire, per PG on the primary
+        (pg_scrubber role): digests compared across members,
+        inconsistencies listed, optionally repaired from the
+        majority."""
+        pool = self.osdmap.pools[pool_id]
+        if pool.type == POOL_ERASURE:
+            raise IOError(
+                "scrub_pool compares replica digests; EC pools "
+                "scrub by parity re-encode (ClusterSim.scrub / "
+                "recover_ec_pool)")
+        totals = {"objects": 0, "inconsistent": [], "repaired": 0}
+        for pg in range(pool.pg_num):
+            up = self._up(pool, pg)
+            members = [o for o in up if o != ITEM_NONE]
+            if not members:
+                continue
+            try:
+                r = self.osd_client(members[0]).call({
+                    "cmd": "scrub_pg", "coll": [pool_id, pg],
+                    "members": members, "repair": repair})
+            except (OSError, IOError):
+                self.drop_osd_client(members[0])
+                continue
             totals["objects"] += r["objects"]
-            totals["copied"] += r["copied"]
+            totals["inconsistent"].extend(
+                dict(i, pg=pg) for i in r["inconsistent"])
+            totals["repaired"] += r["repaired"]
         return totals
 
     def recover_ec_pool(self, pool_id: int) -> Dict[str, int]:

@@ -279,7 +279,8 @@ class MonDaemon:
     src/mon/Elector.h:37, Paxos.{h,cc}, MonitorDBStore.h.
     """
 
-    MUTATIONS = ("osd_boot", "report_failure", "mark_out")
+    MUTATIONS = ("osd_boot", "report_failure", "mark_out",
+                 "pool_snap_create", "pool_snap_remove")
 
     def __init__(self, cluster_dir: str, rank: int = 0):
         self.dir = cluster_dir
@@ -437,6 +438,11 @@ class MonDaemon:
                      if self.n_mons == 1 else
                      [os.path.join(self.dir, f"mon.{r}.sock")
                       for r in range(self.n_mons)]),
+            "pool_snaps": {
+                str(p["id"]): (self.mon.config_get(
+                    f"pool.{p['id']}.snaps") or
+                    {"seq": 0, "snaps": {}})
+                for p in self.spec["pools"]},
         }
 
     def _forward_to_leader(self, entity: str,
@@ -501,6 +507,36 @@ class MonDaemon:
                 inc.new_weight[int(req["osd"])] = 0
                 self.mon.commit_incremental(inc)
                 return {"epoch": self.mon.osdmap.epoch}
+            if cmd == "pool_snap_create":
+                # pool snapshot state is COMMITTED mon state (the
+                # pg_pool_t::snap_seq + snaps role, committed through
+                # the quorum's config decree path)
+                pid = int(req["pool"])
+                cur = self.mon.config_get(f"pool.{pid}.snaps") or \
+                    {"seq": 0, "snaps": {}}
+                seq = int(cur["seq"]) + 1
+                snaps = dict(cur["snaps"])
+                snaps[str(seq)] = req["name"]
+                if not self.mon.config_set(
+                        f"pool.{pid}.snaps",
+                        {"seq": seq, "snaps": snaps}):
+                    raise IOError("snap create: no quorum")
+                return {"snap_seq": seq}
+            if cmd == "pool_snap_remove":
+                pid = int(req["pool"])
+                cur = self.mon.config_get(f"pool.{pid}.snaps") or \
+                    {"seq": 0, "snaps": {}}
+                snaps = {s: n for s, n in cur["snaps"].items()
+                         if n != req["name"]}
+                if not self.mon.config_set(
+                        f"pool.{pid}.snaps",
+                        {"seq": int(cur["seq"]), "snaps": snaps}):
+                    raise IOError("snap remove: no quorum")
+                return {"snaps": snaps}
+            if cmd == "pool_snap_ls":
+                pid = int(req["pool"])
+                return self.mon.config_get(f"pool.{pid}.snaps") or \
+                    {"seq": 0, "snaps": {}}
             if cmd == "status":
                 m = self.mon.osdmap
                 return {"epoch": m.epoch,
@@ -536,6 +572,14 @@ class OSDDaemon:
         from ..msg.scheduler import MClockScheduler
         self.sched = MClockScheduler()
         self._sched_lock = threading.Lock()
+        # durable per-PG op logs (process-tier PGLog, daemon_pglog.py)
+        from .daemon_pglog import DurablePGLog
+        self._pglogs: Dict[Tuple[int, int], DurablePGLog] = {}
+        self._pglog_lock = threading.Lock()
+        # per-PG write serialization (the reference's PG lock): version
+        # assignment + log append + apply must be atomic per PG across
+        # the thread-per-connection wire server
+        self._pg_locks: Dict[Tuple[int, int], threading.Lock] = {}
         self._peers: Dict[int, WireClient] = {}
         self._peer_lock = threading.Lock()
         self._mon: Optional[WireClient] = None
@@ -595,6 +639,22 @@ class OSDDaemon:
         mon.call({"cmd": "osd_boot", "osd": self.id})
         self._map = mon.call({"cmd": "get_map"})
 
+    def _pglog(self, coll: Tuple[int, int]):
+        from .daemon_pglog import DurablePGLog
+        with self._pglog_lock:
+            log = self._pglogs.get(coll)
+            if log is None:
+                log = self._pglogs[coll] = DurablePGLog(self.store,
+                                                        coll)
+            return log
+
+    def _pg_lock(self, coll: Tuple[int, int]) -> threading.Lock:
+        with self._pglog_lock:
+            lk = self._pg_locks.get(coll)
+            if lk is None:
+                lk = self._pg_locks[coll] = threading.Lock()
+            return lk
+
     # ------------------------------------------------------------ serving --
     def _run_sched(self, op: Callable[[], Any], klass: str) -> Any:
         """Every op passes through the mClock scheduler (the dispatch
@@ -617,9 +677,36 @@ class OSDDaemon:
                                                req["data"])
                 for ak, av in (req.get("attrs") or {}).items():
                     txn.setattr(coll, req["oid"], ak, av)
-                self.store.apply_transaction(txn)
+                lg = req.get("log")
+                if not lg:
+                    self.store.apply_transaction(txn)
+                    return True
+                with self._pg_lock(coll):
+                    # replica-side log append in the SAME txn; the
+                    # replica only advances last_complete when it was
+                    # current through the primary's previous version —
+                    # otherwise the entry lands but the gap stays
+                    # visible to peering (missing-set semantics)
+                    log = self._pglog(coll)
+                    v = tuple(lg["version"])
+                    prev = tuple(lg.get("prev", (0, 0)))
+                    log.append_txn(
+                        txn, v, req["oid"],
+                        advance_lc=log.last_complete >= prev)
+                    self.store.apply_transaction(txn)
                 return True
             return self._run_sched(put, klass)
+        if cmd == "setattr_shard":
+            coll = tuple(req["coll"])
+            from .objectstore import Transaction
+
+            def sa():
+                txn = Transaction()
+                for ak, av in req["attrs"].items():
+                    txn.setattr(coll, req["oid"], ak, av)
+                self.store.apply_transaction(txn)
+                return True
+            return self._run_sched(sa, klass)
         if cmd == "getattr_shard":
             coll = tuple(req["coll"])
             def rd():
@@ -647,31 +734,74 @@ class OSDDaemon:
                 return True
             return self._run_sched(rm, klass)
         if cmd == "put_object":
-            # replicated primary: store locally then fan out to peers
-            # (daemon-to-daemon envelopes)
+            # replicated primary: assign the version, persist object +
+            # log entry in ONE txn, fan the versioned write out to
+            # replicas (PrimaryLogPG::execute_ctx -> issue_repop shape)
             coll = tuple(req["coll"])
             from .objectstore import Transaction
-            self._run_sched(
-                lambda: self.store.apply_transaction(
-                    Transaction().write_full(coll, req["oid"],
-                                             req["data"])),
-                klass)
-            acks = 1
-            for peer in req["replicas"]:
-                if peer == self.id:
-                    continue
-                try:
-                    self.peer_client(peer).call({
-                        "cmd": "put_shard", "coll": list(coll),
-                        "oid": req["oid"], "data": req["data"],
-                        "klass": klass})
-                    acks += 1
-                except (OSError, IOError):
-                    self.drop_peer(peer)
-            return {"acks": acks}
+            with self._pg_lock(coll):      # PG lock: serialize writes
+                log = self._pglog(coll)
+                prev = log.log.head
+                version = log.next_version(
+                    int(self._map.get("epoch", prev[0] or 1)))
+
+                def put_primary():
+                    txn = Transaction().write_full(coll, req["oid"],
+                                                   req["data"])
+                    for ak, av in (req.get("attrs") or {}).items():
+                        txn.setattr(coll, req["oid"], ak, av)
+                    log.append_txn(txn, version, req["oid"])
+                    self.store.apply_transaction(txn)
+                self._run_sched(put_primary, klass)
+                acks = 1
+                for peer in req["replicas"]:
+                    if peer == self.id:
+                        continue
+                    try:
+                        self.peer_client(peer).call({
+                            "cmd": "put_shard", "coll": list(coll),
+                            "oid": req["oid"], "data": req["data"],
+                            "klass": klass, "attrs": req.get("attrs"),
+                            "log": {"version": list(version),
+                                    "prev": list(prev)}})
+                        acks += 1
+                    except (OSError, IOError):
+                        self.drop_peer(peer)
+            return {"acks": acks, "version": list(version)}
         if cmd == "list_pg":
             coll = tuple(req["coll"])
             return self.store.list_objects(coll)
+        if cmd == "pg_info":
+            # GetInfo: this replica's log bounds + applied version
+            return self._pglog(tuple(req["coll"])).info()
+        if cmd == "pg_log":
+            # GetLog: authoritative entries after a version
+            log = self._pglog(tuple(req["coll"]))
+            return {"entries": [(list(v), o, op) for v, o, op in
+                                log.entries_after(tuple(req["after"]))],
+                    "head": list(log.log.head)}
+        if cmd == "log_sync":
+            # merge the authority's tail + advance last_complete
+            # (PGLog::merge_log after recovery completes)
+            coll = tuple(req["coll"])
+            from .objectstore import Transaction
+            log = self._pglog(coll)
+            txn = Transaction()
+            log.merge_tail_txn(
+                txn,
+                [(tuple(v), o, op) for v, o, op in req["entries"]],
+                tuple(req["head"]))
+            self.store.apply_transaction(txn)
+            return True
+        if cmd == "digest_shard":
+            coll = tuple(req["coll"])
+            try:
+                return self.store.stat(coll, req["oid"])["csum"]
+            except (IOError, KeyError):
+                return None
+        if cmd == "scrub_pg":
+            return self._scrub_pg(tuple(req["coll"]), req["members"],
+                                  bool(req.get("repair", False)))
         if cmd == "recover_pg":
             return self._recover_pg(tuple(req["coll"]), req["members"])
         if cmd == "ping":
@@ -685,62 +815,239 @@ class OSDDaemon:
             return [list(map(str, b)) for b in self.store.fsck()]
         raise ValueError(f"unknown osd command {cmd!r}")
 
+    def _peer_req(self, m: int, req: Dict[str, Any]):
+        """One guarded peer call (None on failure)."""
+        try:
+            return self.peer_client(m).call(req)
+        except (OSError, IOError):
+            self.drop_peer(m)
+            return None
+
+    def _pull_object(self, coll, oid, holders) -> Optional[bytes]:
+        for h in holders:
+            if h == self.id:
+                try:
+                    return self.store.read(coll, oid)
+                except IOError:
+                    continue
+            d = self._peer_req(h, {"cmd": "get_shard",
+                                   "coll": list(coll), "oid": oid,
+                                   "klass": "background_recovery"})
+            if d is not None:
+                return d
+        return None
+
+    def _push_object(self, coll, oid, data, m) -> bool:
+        from .objectstore import Transaction
+        if m == self.id:
+            self.store.apply_transaction(
+                Transaction().write_full(coll, oid, data))
+            return True
+        return self._peer_req(m, {
+            "cmd": "put_shard", "coll": list(coll), "oid": oid,
+            "data": data, "klass": "background_recovery"}) is not None
+
     def _recover_pg(self, coll: Tuple[int, int],
-                    members: List[int]) -> Dict[str, int]:
-        """Primary-driven replicated recovery: union of every member's
-        object list; pull any object this PG is missing anywhere and
-        push it to members that lack it (the ReplicatedBackend
-        recovery role collapsed to list/pull/push)."""
-        listing: Dict[int, set] = {}
+                    members: List[int]) -> Dict[str, Any]:
+        """Primary-driven PG recovery running the PeeringState shape
+        over the wire (GetInfo -> GetLog -> GetMissing -> Recovering
+        or Backfilling, src/osd/PeeringState.h:561):
+
+        1. GetInfo: every member reports its log bounds +
+           last_complete (pg_info).
+        2. GetLog: the authority is the member with the newest head;
+           a stale primary first catches ITSELF up from it.
+        3. GetMissing: per member, if the authoritative log still
+           covers its last_complete, recover by LOG DELTA — only the
+           objects the log names after that version (deletes applied
+           as deletes); otherwise fall back to BACKFILL (full listing
+           diff, the pre-peering path).
+        4. Recovered members merge the authority's log tail and
+           advance last_complete (log_sync).
+        Stats record which path each member took so chaos tests can
+        assert delta vs backfill.
+        """
+        from .pglog import OP_DELETE
+        me = self.id
+        log = self._pglog(coll)
+        infos: Dict[int, Dict] = {me: log.info()}
+        peers = [m for m in members if m != me]
+        for m in peers:
+            inf = self._peer_req(m, {"cmd": "pg_info",
+                                     "coll": list(coll)})
+            if inf is not None:
+                infos[m] = inf
+        # authority = newest head
+        auth = max(infos, key=lambda m: tuple(infos[m]["head"]))
+        auth_head = tuple(infos[auth]["head"])
+        stats: Dict[str, Any] = {"authority": auth, "mode": {},
+                                 "delta_objects": 0,
+                                 "backfill_objects": 0,
+                                 "deletes_applied": 0, "copied": 0}
+
+        def sync_member(m, entries, head):
+            if m == me:
+                from .objectstore import Transaction
+                txn = Transaction()
+                log.merge_tail_txn(txn, entries, head)
+                self.store.apply_transaction(txn)
+                return True
+            return self._peer_req(m, {
+                "cmd": "log_sync", "coll": list(coll),
+                "entries": [(list(v), o, op) for v, o, op in entries],
+                "head": list(head)}) is not None
+
+        def auth_entries_after(v):
+            if auth == me:
+                return log.entries_after(v)
+            r = self._peer_req(auth, {"cmd": "pg_log",
+                                      "coll": list(coll),
+                                      "after": list(v)})
+            if r is None:
+                return None
+            return [(tuple(vv), o, op) for vv, o, op in r["entries"]]
+
+        def listing_of(m):
+            if m == me:
+                return set(o for o in self.store.list_objects(coll)
+                           if not o.startswith("meta:"))
+            r = self._peer_req(m, {"cmd": "list_pg",
+                                   "coll": list(coll)})
+            return set(o for o in (r or [])
+                       if not o.startswith("meta:"))
+
+        auth_listing = None
+        for m in sorted(infos, key=lambda x: x != auth):
+            if m == auth:
+                continue
+            lc = tuple(infos[m]["last_complete"])
+            if lc >= auth_head:
+                stats["mode"][str(m)] = "clean"
+                continue
+            covered = tuple(infos[auth]["tail"]) <= lc
+            entries = auth_entries_after(lc) if covered else None
+            complete = True       # every needed object moved
+            if entries is not None:
+                stats["mode"][str(m)] = "delta"
+                # latest op per object wins (missing-set semantics of
+                # PGLog::missing_since, over the fetched entries)
+                latest: Dict[str, int] = {}
+                for v, obj, op in entries:
+                    latest[obj] = op
+                for obj, op in sorted(latest.items()):
+                    stats["delta_objects"] += 1
+                    if op == OP_DELETE:
+                        if m == me:
+                            self._local_delete(coll, obj)
+                        elif self._peer_req(
+                                m, {"cmd": "delete_shard",
+                                    "coll": list(coll),
+                                    "oid": obj}) is None:
+                            complete = False
+                        stats["deletes_applied"] += 1
+                        continue
+                    data = self._pull_object(coll, obj, [auth])
+                    if data is None:
+                        complete = False
+                        continue
+                    if self._push_object(coll, obj, data, m):
+                        stats["copied"] += 1
+                    else:
+                        complete = False
+            else:
+                stats["mode"][str(m)] = "backfill"
+                if auth_listing is None:
+                    auth_listing = listing_of(auth)
+                have = listing_of(m)
+                for obj in sorted(auth_listing - have):
+                    stats["backfill_objects"] += 1
+                    data = self._pull_object(coll, obj, [auth])
+                    if data is None:
+                        complete = False
+                        continue
+                    if self._push_object(coll, obj, data, m):
+                        stats["copied"] += 1
+                    else:
+                        complete = False
+                entries = auth_entries_after(lc) or []
+            # advance last_complete ONLY when every object landed —
+            # a partial pass must stay visible to the next peering
+            # round, or the gap is masked forever
+            if complete:
+                sync_member(m, entries, auth_head)
+            else:
+                stats["mode"][str(m)] += "-incomplete"
+        return stats
+
+    def _local_delete(self, coll, oid) -> None:
+        from .objectstore import Transaction
+        if self.store.exists(coll, oid):
+            self.store.apply_transaction(
+                Transaction().remove(coll, oid))
+
+    def _scrub_pg(self, coll: Tuple[int, int], members: List[int],
+                  repair: bool) -> Dict[str, Any]:
+        """Cross-replica scrub over the wire (pg_scrubber role): every
+        member digests every object; mismatching or absent copies are
+        inconsistencies.  With ``repair`` the majority digest's bytes
+        overwrite the minority (scrub repair)."""
+        listings = {m: set() for m in members}
         for m in members:
             if m == self.id:
-                listing[m] = set(self.store.list_objects(coll))
-                continue
-            try:
-                listing[m] = set(self.peer_client(m).call(
-                    {"cmd": "list_pg", "coll": list(coll)}))
-            except (OSError, IOError):
-                self.drop_peer(m)
-        universe = set().union(*listing.values()) if listing else set()
-        copied = 0
-        from .objectstore import Transaction
+                listings[m] = set(
+                    o for o in self.store.list_objects(coll)
+                    if not o.startswith("meta:"))
+            else:
+                r = self._peer_req(m, {"cmd": "list_pg",
+                                       "coll": list(coll)})
+                listings[m] = set(o for o in (r or [])
+                                  if not o.startswith("meta:"))
+        universe = set().union(*listings.values())
+        inconsistent: List[Dict[str, Any]] = []
+        repaired = 0
         for oid in sorted(universe):
-            holders = [m for m, objs in listing.items() if oid in objs]
-            data = None
-            for h in holders:
-                if h == self.id:
-                    try:
-                        data = self.store.read(coll, oid)
-                        break
-                    except IOError:
-                        continue
-                try:
-                    data = self.peer_client(h).call(
-                        {"cmd": "get_shard", "coll": list(coll),
-                         "oid": oid, "klass": "background_recovery"})
-                    if data is not None:
-                        break
-                except (OSError, IOError):
-                    self.drop_peer(h)
-            if data is None:
-                continue
-            for m in listing:
-                if oid in listing[m]:
+            digests: Dict[int, Optional[int]] = {}
+            for m in members:
+                if oid not in listings[m]:
+                    digests[m] = None
                     continue
                 if m == self.id:
-                    self.store.apply_transaction(
-                        Transaction().write_full(coll, oid, data))
-                    copied += 1
-                    continue
-                try:
-                    self.peer_client(m).call({
-                        "cmd": "put_shard", "coll": list(coll),
-                        "oid": oid, "data": data,
-                        "klass": "background_recovery"})
-                    copied += 1
-                except (OSError, IOError):
-                    self.drop_peer(m)
-        return {"objects": len(universe), "copied": copied}
+                    try:
+                        digests[m] = self.store.stat(coll,
+                                                     oid)["csum"]
+                    except (IOError, KeyError):
+                        digests[m] = None
+                else:
+                    digests[m] = self._peer_req(
+                        m, {"cmd": "digest_shard", "coll": list(coll),
+                            "oid": oid})
+            present = [d for d in digests.values() if d is not None]
+            if not present or len(set(present)) == 1 and \
+                    len(present) == len(members):
+                continue
+            # STRICT majority digest — on a tie (e.g. size-2 pool,
+            # 1-vs-1) there is no safe repair source: report the
+            # inconsistency but never overwrite either copy
+            counts: Dict[int, int] = {}
+            for d in present:
+                counts[d] = counts.get(d, 0) + 1
+            best = max(counts, key=counts.get)
+            strict = counts[best] * 2 > len(members)
+            bad = [m for m, d in digests.items() if d != best] \
+                if strict else []
+            inconsistent.append({
+                "oid": oid, "bad_members": bad,
+                "majority": best if strict else None,
+                "no_majority": not strict})
+            if repair and strict:
+                holders = [m for m, d in digests.items() if d == best]
+                data = self._pull_object(coll, oid, holders)
+                if data is not None:
+                    for m in bad:
+                        if self._push_object(coll, oid, data, m):
+                            repaired += 1
+        return {"objects": len(universe),
+                "inconsistent": inconsistent, "repaired": repaired}
 
     # --------------------------------------------------------- heartbeats --
     def _heartbeat_loop(self, interval: float, grace: int) -> None:

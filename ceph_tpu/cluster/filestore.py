@@ -423,6 +423,18 @@ class FileStore:
             raise KeyError(key)
         return v
 
+    def omap_list(self, coll: Coll, oid: str,
+                  start: str = "") -> List[Tuple[str, bytes]]:
+        """All omap rows of an object from ``start`` (sorted) — the
+        ObjectMap::get_iterator role (PG logs live here)."""
+        prefix = _objkey(coll, oid) + "\x00"
+        out = []
+        for k, v in self.kv.iterate("omap", start=prefix + start):
+            if not k.startswith(prefix):
+                break
+            out.append((k[len(prefix):], v))
+        return out
+
     def list_objects(self, coll: Coll) -> List[str]:
         ck = _collkey(coll) + "/"
         out = []

@@ -1,0 +1,101 @@
+"""DurablePGLog: the process-tier PGLog bound to a FileStore.
+
+Atomicity (entry + object in one txn), restart replay, delta vs
+backfill decisions, merge_tail semantics.  Reference: src/osd/PGLog.h,
+doc/dev/osd_internals/log_based_pg.rst.
+"""
+import pytest
+
+from ceph_tpu.cluster.daemon_pglog import DurablePGLog
+from ceph_tpu.cluster.filestore import FileStore
+from ceph_tpu.cluster.objectstore import Transaction
+from ceph_tpu.cluster.pglog import OP_DELETE
+
+
+@pytest.fixture
+def store(tmp_path):
+    return FileStore(str(tmp_path / "fs"), fsync=False)
+
+
+COLL = (1, 0)
+
+
+def _write(store, log, version, oid, data=b"x"):
+    txn = Transaction().write_full(COLL, oid, data)
+    log.append_txn(txn, version, oid)
+    store.apply_transaction(txn)
+
+
+def test_append_and_restart_replay(store, tmp_path):
+    log = DurablePGLog(store, COLL)
+    _write(store, log, (1, 1), "a")
+    _write(store, log, (1, 2), "b")
+    _write(store, log, (2, 3), "a")
+    assert log.log.head == (2, 3)
+    assert log.last_complete == (2, 3)
+    # reopen the store: the log reloads from omap rows
+    store2 = FileStore(str(tmp_path / "fs"), fsync=False)
+    log2 = DurablePGLog(store2, COLL)
+    assert log2.log.head == (2, 3)
+    assert log2.last_complete == (2, 3)
+    assert [e.obj for e in log2.log.entries] == ["a", "b", "a"]
+    # version assignment continues after the head
+    assert log2.next_version(2) == (2, 4)
+    assert log2.next_version(5) == (5, 1)
+
+
+def test_lagging_lc_is_visible_and_delta_covered(store):
+    log = DurablePGLog(store, COLL)
+    for i in range(1, 6):
+        _write(store, log, (1, i), f"o{i}")
+    # a replica at (1,2) catches up by delta: log covers it
+    assert log.covers((1, 2))
+    after = log.entries_after((1, 2))
+    assert [o for _, o, _ in after] == ["o3", "o4", "o5"]
+
+
+def test_trim_forces_backfill(store):
+    log = DurablePGLog(store, COLL, max_entries=3)
+    for i in range(1, 8):
+        _write(store, log, (1, i), f"o{i}")
+    assert len(log.log.entries) == 3
+    assert not log.covers((1, 1))     # trimmed past -> backfill
+    assert log.covers((1, 4))
+
+
+def test_trim_persists(store, tmp_path):
+    log = DurablePGLog(store, COLL, max_entries=3)
+    for i in range(1, 8):
+        _write(store, log, (1, i), f"o{i}")
+    store2 = FileStore(str(tmp_path / "fs"), fsync=False)
+    log2 = DurablePGLog(store2, COLL, max_entries=3)
+    assert len(log2.log.entries) == 3
+    assert log2.log.tail == log.log.tail
+
+
+def test_replica_gap_keeps_lc_behind(store):
+    """A replica that missed an op must not advance last_complete
+    past the gap (advance_lc gating)."""
+    log = DurablePGLog(store, COLL)
+    txn = Transaction().write_full(COLL, "a", b"x")
+    log.append_txn(txn, (1, 1), "a", advance_lc=True)
+    store.apply_transaction(txn)
+    # op (1,2) missed; op (1,3) arrives with prev=(1,2)
+    txn = Transaction().write_full(COLL, "c", b"x")
+    log.append_txn(txn, (1, 3), "c",
+                   advance_lc=log.last_complete >= (1, 2))
+    store.apply_transaction(txn)
+    assert log.last_complete == (1, 1)    # the gap stays visible
+    assert log.log.head == (1, 3)
+
+
+def test_merge_tail_adopts_authority(store):
+    log = DurablePGLog(store, COLL)
+    _write(store, log, (1, 1), "a")
+    entries = [((1, 2), "b", 1), ((1, 3), "a", OP_DELETE)]
+    txn = Transaction()
+    log.merge_tail_txn(txn, entries, (1, 3))
+    store.apply_transaction(txn)
+    assert log.log.head == (1, 3)
+    assert log.last_complete == (1, 3)
+    assert [e.op for e in log.log.entries] == [1, 1, OP_DELETE]

@@ -74,9 +74,13 @@ def test_replicated_io_and_sigkill_recovery(cluster):
         time.sleep(0.3)
     assert rc.status()["n_up"] == N_OSDS
     rc.refresh_map()
-    # primary-driven recovery re-replicates everything
+    # primary-driven peering recovery re-replicates everything; the
+    # revived OSDs' gaps are covered by the pg logs, so they catch up
+    # by LOG DELTA (not backfill) — the PeeringState contract
     stats = rc.recover_pool(1)
-    assert stats["objects"] > 0
+    assert stats["copied"] > 0
+    assert stats["modes"]["delta"] > 0
+    assert stats["modes"]["backfill"] == 0
     for name, data in blobs.items():
         assert rc.get(1, name) == data
     for i in range(6):
@@ -119,6 +123,70 @@ def test_ec_io_across_processes(tmp_path):
         rc.close()
     finally:
         v.stop()
+
+
+def test_snapshots_over_the_wire(cluster):
+    """VERDICT r3 next #3: snapshots work against daemons — pool snap
+    state committed mon-side, client-driven COW (make_writeable role),
+    snap reads resolve through the SnapSet attr."""
+    d, v = cluster
+    rc = _client(d)
+    v1 = b"version-one" * 100
+    v2 = b"version-TWO" * 100
+    rc.put(1, "snappy", v1)
+    sid = rc.snap_create(1, "s1")
+    assert rc.snap_lookup(1, "s1") == sid
+    rc.put(1, "snappy", v2)              # COW preserves v1 as a clone
+    assert rc.get(1, "snappy") == v2
+    assert rc.get_snap(1, "snappy", sid) == v1
+    # a second snapshot without further writes reads the current head
+    sid2 = rc.snap_create(1, "s2")
+    assert rc.get_snap(1, "snappy", sid2) == v2
+    # snapshots survive a mon restart (committed state)
+    v.kill9("mon")
+    v.start_mon()
+    time.sleep(0.5)
+    rc2 = _client(d)
+    assert rc2.snap_lookup(1, "s1") == sid
+    assert rc2.get_snap(1, "snappy", sid) == v1
+    rc2.close()
+    rc.close()
+
+
+def test_scrub_over_the_wire(cluster):
+    """VERDICT r3 next #3: scrub runs against daemons — cross-replica
+    digest compare on the primary, inconsistent copy repaired from
+    the majority."""
+    d, v = cluster
+    rc = _client(d)
+    rng = np.random.default_rng(9)
+    data = rng.integers(0, 256, 5000, dtype=np.uint8).tobytes()
+    for i in range(4):
+        rc.put(1, f"scr{i}", data)
+    # converge first: a replica write may have raced under load (put
+    # acks a majority); recovery heals it so the baseline is clean
+    rc.recover_pool(1)
+    clean = rc.scrub_pool(1)
+    assert clean["objects"] >= 4
+    assert clean["inconsistent"] == []
+    # corrupt ONE replica of one object out-of-band (direct shard
+    # write to a non-primary member — the objectstore-surgery shape)
+    pool = rc.osdmap.pools[1]
+    pg = rc._pg_for(pool, "scr0")
+    up = rc._up(pool, pg)
+    victim = up[1]
+    rc.osd_client(victim).call({
+        "cmd": "put_shard", "coll": [1, pg], "oid": "0:scr0",
+        "data": b"\x00" * len(data)})
+    dirty = rc.scrub_pool(1)
+    bad = [i for i in dirty["inconsistent"] if i["oid"] == "0:scr0"]
+    assert bad and victim in bad[0]["bad_members"]
+    # repair from the majority, then verify clean + readable
+    fixed = rc.scrub_pool(1, repair=True)
+    assert fixed["repaired"] >= 1
+    assert rc.scrub_pool(1)["inconsistent"] == []
+    assert rc.get(1, "scr0") == data
+    rc.close()
 
 
 def test_auth_rejections(cluster):
