@@ -30,7 +30,6 @@ from __future__ import annotations
 import argparse
 import json
 import os
-import pickle
 import secrets
 import socket
 import threading
@@ -38,6 +37,7 @@ import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from ..common import auth as cx
+from ..msg import encoding
 from ..msg.queue import Envelope
 from ..msg import wire
 
@@ -47,13 +47,14 @@ MSG_AUTH_SECRET = 0x02       # secret-mode proof
 MSG_AUTH_TICKET = 0x03       # ticket-mode (ticket + authorizer)
 MSG_AUTH_OK = 0x04
 MSG_AUTH_FAIL = 0x05
-MSG_REQ = 0x10               # pickled {"cmd": ..., ...}
+MSG_REQ = 0x10               # typed-encoded {"cmd": ..., ...}
 MSG_REPLY = 0x11
 MSG_ERR = 0x12
 
-
-def _dumps(obj) -> bytes:
-    return pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+# typed wire encoding (msg/encoding.py) — pickle never touches
+# network input (reference: typed struct encode/decode,
+# src/include/encoding.h)
+_dumps = encoding.dumps
 
 
 def mon_sockets(cluster_dir: str) -> List[str]:
@@ -119,13 +120,13 @@ class WireServer:
         wire.send_frame(conn, Envelope(MSG_AUTH_NONCE, 0, -1, nonce))
         env = wire.recv_frame(conn)
         if env.type == MSG_AUTH_TICKET:
-            blob = pickle.loads(env.payload)
+            blob = encoding.loads(env.payload)
             entity, session_key = cx.verify_authorizer(
                 self.keyring.secret(self.service), blob["ticket"],
                 blob["authorizer"], nonce)
             return entity, session_key
         if env.type == MSG_AUTH_SECRET and self.secret_mode_keyring:
-            blob = pickle.loads(env.payload)
+            blob = encoding.loads(env.payload)
             entity = blob["entity"]
             secret = self.secret_mode_keyring.secret(entity)
             import hmac as _hmac
@@ -167,7 +168,7 @@ class WireServer:
                 if env.type != MSG_REQ:
                     continue
                 try:
-                    req = pickle.loads(env.payload)
+                    req = encoding.loads(env.payload)
                     reply = self.handler(entity, req)
                     out = Envelope(MSG_REPLY, env.id, -1, _dumps(reply))
                 except Exception as e:
@@ -246,13 +247,13 @@ class WireClient:
                             session_key=self.key)
             env = wire.recv_frame(self.sock, session_key=self.key)
         if env.type == MSG_ERR:
-            name, msg = pickle.loads(env.payload)
+            name, msg = encoding.loads(env.payload)
             exc = {"IOError": IOError, "KeyError": KeyError,
                    "AuthError": cx.AuthError,
                    "PermissionError": PermissionError,
                    "ObjectStoreError": IOError}.get(name, RuntimeError)
             raise exc(f"{name}: {msg}")
-        return pickle.loads(env.payload)
+        return encoding.loads(env.payload)
 
     def close(self) -> None:
         try:

@@ -17,12 +17,12 @@ unchanged while every byte flows queue -> scheduler -> dispatch.
 from __future__ import annotations
 
 import itertools
-import pickle
 import threading
 from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from ..msg import encoding
 from ..msg.dispatcher import BatchingDispatcher
 from ..msg.queue import Envelope, MessageQueue, QueueClosed, QueueFull
 from ..msg.scheduler import CLASS_CLIENT, CLASS_RECOVERY, MClockScheduler
@@ -58,7 +58,7 @@ class OSDService:
     def _handle(self, batch: List[Envelope]) -> None:
         # fast dispatch: envelopes land in the QoS scheduler first
         for env in batch:
-            op = pickle.loads(env.payload)
+            op = encoding.loads(env.payload)
             with self._lock:
                 obj = self._op_objs.pop(env.id, None)
             if obj is not None:
@@ -84,7 +84,7 @@ class OSDService:
 
     def _execute(self, op: Dict[str, Any]):
         kind = op["kind"]
-        key: ShardKey = op["key"]
+        key: ShardKey = tuple(op["key"])   # typed encoding lists it
         if kind == "put":
             self.osd.put(key, np.frombuffer(op["data"], dtype=np.uint8))
             return True
@@ -112,7 +112,7 @@ class OSDService:
             self._events[op_id] = ev
             if obj is not None:
                 self._op_objs[op_id] = obj
-        payload = pickle.dumps(op, protocol=pickle.HIGHEST_PROTOCOL)
+        payload = encoding.dumps(op)
         try:
             self.in_q.push(Envelope(MSG_OSD_OP, op_id, -1, payload),
                            timeout=timeout)

@@ -8,10 +8,15 @@ banner exchange, an authentication frame (ceph_tpu.common.auth — the
 cephx handshake role), then framed request/reply.
 
 Frame:  u32 magic | u32 type | u64 id | i32 shard | u32 len |
-        u32 crc(payload) | payload
-Every frame after the auth handshake additionally carries a 32-byte
-HMAC-SHA256 trailer keyed by the session key (Protocol V2's
-per-message authentication role); frames failing the MAC are rejected.
+        u32 crc(wire_payload) | wire_payload
+Secure mode (every frame after the auth handshake, Protocol V2's
+crypto_onwire role, src/msg/async/crypto_onwire.cc): the payload is a
+SEALED BOX under the session key (PRF-CTR encryption, encrypt-then-MAC
+— common/auth.seal), so traffic is unreadable on the socket, plus a
+32-byte HMAC-SHA256 trailer over header+ciphertext so the plaintext
+header cannot be tampered with either.  Pre-auth frames (banner,
+nonce, auth blobs) are plaintext by necessity; secrets inside them are
+themselves sealed under entity keys.
 """
 from __future__ import annotations
 
@@ -27,6 +32,10 @@ MAGIC = 0x43455054        # "CEPT"
 BANNER = b"ceph-tpu v1\n"
 _FHDR = struct.Struct("<IIQiII")
 _MAC_LEN = 32
+# unauthenticated peers control the length field: cap it so a forged
+# header cannot make _recv_exact buffer gigabytes pre-auth (the
+# Throttle/ms_max_message_size role)
+MAX_FRAME = 256 << 20
 
 
 class WireError(IOError):
@@ -50,6 +59,9 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
 def send_frame(sock: socket.socket, env: Envelope,
                session_key: Optional[bytes] = None) -> None:
     payload = env.payload or b""
+    if session_key is not None:
+        from ..common.auth import seal
+        payload = seal(session_key, payload)    # secure mode
     hdr = _FHDR.pack(MAGIC, env.type, env.id, env.shard, len(payload),
                      zlib.crc32(payload))
     mac = b""
@@ -64,6 +76,8 @@ def recv_frame(sock: socket.socket,
     magic, typ, mid, shard, ln, crc = _FHDR.unpack(hdr)
     if magic != MAGIC:
         raise WireError(f"bad magic {magic:#x}")
+    if ln > MAX_FRAME:
+        raise WireError(f"frame length {ln} exceeds cap {MAX_FRAME}")
     payload = _recv_exact(sock, ln) if ln else b""
     if zlib.crc32(payload) != crc:
         raise WireError("payload crc mismatch")
@@ -72,6 +86,11 @@ def recv_frame(sock: socket.socket,
         want = hmac.new(session_key, hdr + payload, "sha256").digest()
         if not hmac.compare_digest(mac, want):
             raise WireError("frame MAC rejected")
+        from ..common.auth import AuthError, unseal
+        try:
+            payload = unseal(session_key, payload)
+        except AuthError as e:
+            raise WireError(f"secure payload rejected: {e}")
     return Envelope(typ, mid, shard, payload)
 
 

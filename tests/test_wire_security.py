@@ -1,0 +1,123 @@
+"""Wire security: typed encoding (no pickle) + secure-mode frames.
+
+VERDICT r3 missing #6: daemon payloads must not be pickle (RCE-adjacent
+on network input) and post-auth traffic must be unreadable on the
+socket (crypto_onwire role, src/msg/async/crypto_onwire.cc).
+"""
+import socket
+
+import pytest
+
+from ceph_tpu.common import auth as cx
+from ceph_tpu.msg import encoding, wire
+from ceph_tpu.msg.queue import Envelope
+
+
+# ---------------------------------------------------------- encoding ---
+
+def test_encoding_roundtrip():
+    cases = [
+        None, True, False, 0, -1, 1 << 40, -(1 << 70), 3.5, "héllo",
+        b"\x00\xffbytes", [], [1, "a", None], (1, 2, "x"),
+        {"cmd": "put", "coll": [1, 2], "data": b"\x01" * 100,
+         "nested": {"k": [True, 2.5]}},
+    ]
+    for obj in cases:
+        got = encoding.loads(encoding.dumps(obj))
+        want = list(obj) if isinstance(obj, tuple) else obj
+        assert got == want, obj
+
+
+def test_encoding_tuple_dict_keys():
+    d = {(1, 0, "obj", 3): "v"}
+    got = encoding.loads(encoding.dumps(d))
+    assert got == {(1, 0, "obj", 3): "v"}
+
+
+def test_encoding_rejects_objects():
+    class Evil:
+        pass
+    with pytest.raises(encoding.EncodingError):
+        encoding.dumps(Evil())
+
+
+def test_encoding_rejects_malformed():
+    with pytest.raises(encoding.EncodingError):
+        encoding.loads(b"\x99")
+    with pytest.raises(encoding.EncodingError):
+        encoding.loads(encoding.dumps([1, 2]) + b"junk")
+    with pytest.raises(encoding.EncodingError):
+        encoding.loads(b"s\xff\xff\xff\xff")       # truncated length
+
+
+def test_no_pickle_on_network_input():
+    """Static check: the wire-facing modules never unpickle."""
+    import inspect
+    import ceph_tpu.cluster.daemon as daemon
+    import ceph_tpu.cluster.osd_service as osd_service
+    import ceph_tpu.msg.wire as wire_mod
+    for mod in (daemon, osd_service, wire_mod):
+        src = inspect.getsource(mod)
+        assert "pickle.loads" not in src, mod.__name__
+        assert "import pickle" not in src, mod.__name__
+
+
+# ------------------------------------------------------ secure frames ---
+
+def test_secure_frames_unreadable_on_socket():
+    """With a session key, payload bytes on the wire are ciphertext."""
+    a, b = socket.socketpair()
+    key = b"k" * 32
+    secret = b"TOP-SECRET-OBJECT-BYTES" * 20
+    wire.send_frame(a, Envelope(0x10, 1, -1, secret), session_key=key)
+    raw = b.recv(65536)
+    assert secret not in raw
+    assert b"TOP-SECRET" not in raw
+    # and the receiver recovers the plaintext exactly
+    a2, b2 = socket.socketpair()
+    wire.send_frame(a2, Envelope(0x10, 1, -1, secret),
+                    session_key=key)
+    env = wire.recv_frame(b2, session_key=key)
+    assert env.payload == secret
+    for s in (a, b, a2, b2):
+        s.close()
+
+
+def test_secure_frame_rejects_tamper_and_wrong_key():
+    key = b"k" * 32
+    a, b = socket.socketpair()
+    wire.send_frame(a, Envelope(0x10, 1, -1, b"payload"),
+                    session_key=key)
+    with pytest.raises(wire.WireError):
+        wire.recv_frame(b, session_key=b"x" * 32)
+    a.close()
+    b.close()
+    # bit-flip in the ciphertext: CRC may pass (recomputed) but the
+    # MAC/seal must reject
+    a, b = socket.socketpair()
+    wire.send_frame(a, Envelope(0x10, 1, -1, b"payload" * 10),
+                    session_key=key)
+    raw = bytearray(b.recv(65536))
+    raw[40] ^= 0x01
+    c, d = socket.socketpair()
+    c.sendall(bytes(raw))
+    with pytest.raises(wire.WireError):
+        wire.recv_frame(d, session_key=key)
+    for s in (a, b, c, d):
+        s.close()
+
+
+def test_plaintext_frames_still_work_pre_auth():
+    a, b = socket.socketpair()
+    wire.send_frame(a, Envelope(0x01, 0, -1, b"nonce123"))
+    env = wire.recv_frame(b)
+    assert env.payload == b"nonce123"
+    a.close()
+    b.close()
+
+
+def test_seal_large_payload_fast():
+    """The big-int XOR path: MB-scale sealed boxes round-trip."""
+    key = b"s" * 32
+    data = bytes(range(256)) * 4096          # 1 MiB
+    assert cx.unseal(key, cx.seal(key, data)) == data
