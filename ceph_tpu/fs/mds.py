@@ -56,6 +56,11 @@ class MDS:
             pass
         # advisory file locks (Locker role) — MDS session state
         self._locks: Dict[int, Dict[str, bool]] = {}
+        # client sessions + per-inode capability grants (Capability.h /
+        # SessionMap roles) — session state, rebuilt on reconnect like
+        # the reference's client-reconnect phase
+        self._sessions: Dict[str, dict] = {}
+        self._caps: Dict[int, Dict[str, str]] = {}
         # root must exist before replay: journaled ops re-apply into it
         if not self._dir_exists(ROOT_INO):
             self._write_dir(ROOT_INO, {})
@@ -232,6 +237,113 @@ class MDS:
     # lock machine, not the journal) — a failed-over MDS starts with
     # clean locks, like real clients re-acquiring after reconnect.
 
+    # ------------------------------------------------------ capabilities --
+    # The client-coherence protocol (src/mds/Capability.h + Locker.cc
+    # filelock states, collapsed to the decisive shape):
+    #   "r"  may read            "w"  may write
+    #   "c"  may CACHE/BUFFER    (the Fc/Fb file-cap role: only ever
+    #        granted to a single client per inode — the loner)
+    # Grant rules: a lone client gets rwc; concurrent readers share r;
+    # any reader/writer mix forces SYNC I/O (rw, no c).  Conflicting
+    # grants REVOKE the current holders first — a revoked client's
+    # flush callback writes its dirty data back before the new grant
+    # is issued, which is what makes two clients coherent.
+    # Every session's caps sit under a LEASE; a client that stops
+    # renewing is evicted and its caps/locks drop (session timeout,
+    # src/mds/Sessionmap.h + Locker revoke-on-eviction).
+
+    LEASE_TTL = 30.0
+
+    def open_session(self, client: str, flush_cb=None,
+                     now: Optional[float] = None) -> None:
+        """flush_cb(ino, why) is called when a cap this client holds
+        is being revoked; it must write back dirty state."""
+        self._sessions[client] = {
+            "flush_cb": flush_cb,
+            "renewed": time.time() if now is None else now}
+
+    def renew_session(self, client: str,
+                      now: Optional[float] = None) -> None:
+        s = self._sessions.get(client)
+        if s is None:
+            raise FSError(f"ESTALE: no session for {client}")
+        s["renewed"] = time.time() if now is None else now
+
+    def _session_live(self, client: str, now: float) -> bool:
+        s = self._sessions.get(client)
+        return s is not None and now - s["renewed"] < self.LEASE_TTL
+
+    def _revoke(self, ino: int, client: str, caps_lost: str) -> None:
+        held = self._caps.get(ino, {})
+        cur = held.get(client, "")
+        rest = "".join(c for c in cur if c not in caps_lost)
+        s = self._sessions.get(client)
+        if s and s["flush_cb"] and ("c" in cur or "w" in cur):
+            s["flush_cb"](ino, caps_lost)
+        if rest:
+            held[client] = rest
+        else:
+            held.pop(client, None)
+
+    def acquire_caps(self, client: str, path: str, want: str,
+                     now: Optional[float] = None) -> str:
+        """Grant capabilities on the inode at ``path`` (revoking
+        conflicting holders first).  Returns the granted cap string.
+        ``want``: subset of "rwc" ("c" upgrades to exclusive when this
+        client is alone)."""
+        now = time.time() if now is None else now
+        if not self._session_live(client, now):
+            raise FSError(f"ESTALE: session for {client} expired")
+        self.evict_expired(now)
+        ino = self._lookup(path)["ino"]
+        held = self._caps.setdefault(ino, {})
+        others = {c: v for c, v in held.items() if c != client}
+        if others:
+            # ANY second client breaks the loner: every other holder's
+            # cache cap is revoked first — a buffered writer flushes
+            # before even a plain reader proceeds (reader/writer mix
+            # forces sync I/O)
+            for o, v in list(others.items()):
+                if "c" in v:
+                    self._revoke(ino, o, "c")
+            others = {c: v for c, v in held.items() if c != client}
+        grant = "".join(c for c in want if c in "rw")
+        if "c" in want and not others:
+            grant += "c"                 # loner: exclusive/caching
+        if others and "c" in held.get(client, ""):
+            self._revoke(ino, client, "c")
+        held[client] = "".join(sorted(set(held.get(client, "")) |
+                                      set(grant)))
+        return held[client]
+
+    def release_caps(self, client: str, path: str) -> None:
+        ino = self._lookup(path)["ino"]
+        held = self._caps.get(ino)
+        if held:
+            held.pop(client, None)
+            if not held:
+                del self._caps[ino]
+
+    def caps_of(self, path: str) -> Dict[str, str]:
+        ino = self._lookup(path)["ino"]
+        return dict(self._caps.get(ino, {}))
+
+    def evict_expired(self, now: Optional[float] = None) -> List[str]:
+        """Drop lapsed sessions: their caps and locks vanish (the
+        session-timeout eviction path)."""
+        now = time.time() if now is None else now
+        evicted = []
+        for client in list(self._sessions):
+            if not self._session_live(client, now):
+                for ino in list(self._caps):
+                    self._caps[ino].pop(client, None)
+                    if not self._caps[ino]:
+                        del self._caps[ino]
+                self.release_owner(client)
+                del self._sessions[client]
+                evicted.append(client)
+        return evicted
+
     def setlk(self, path: str, owner: str,
               exclusive: bool = True) -> bool:
         """Try-lock; False on conflict (the F_SETLK no-wait shape)."""
@@ -348,10 +460,57 @@ class MDS:
 
 
 class CephFSClient:
-    """Path-based facade (libcephfs surface subset)."""
+    """Path-based facade (libcephfs surface subset) with a
+    capability-coherent client cache: exclusive ("c") caps buffer
+    writes and serve cached reads; a revoke from the MDS (another
+    client opened the file) writes dirty data back and drops the
+    cache — the reference's Fb/Fc client cap behavior
+    (src/client/Client.cc + mds/Locker.cc)."""
 
-    def __init__(self, mds: MDS):
+    def __init__(self, mds: MDS, client_id: Optional[str] = None):
         self.mds = mds
+        self.client = client_id or f"client.{id(self):x}"
+        self._cache: Dict[str, bytes] = {}
+        self._dirty: set = set()
+        self._ino_path: Dict[int, str] = {}
+        mds.open_session(self.client, flush_cb=self._on_revoke)
+
+    # ------------------------------------------------------- cap plumbing --
+    def _on_revoke(self, ino: int, caps_lost: str) -> None:
+        path = self._ino_path.get(ino)
+        if path is None:
+            return
+        if path in self._dirty:
+            self.mds.write_file(path, self._cache[path], 0)
+            self._dirty.discard(path)
+        self._cache.pop(path, None)
+
+    def _caps_for(self, path: str, want: str) -> str:
+        try:
+            caps = self.mds.acquire_caps(self.client, path, want)
+        except FSError as e:
+            if "ESTALE" not in str(e):
+                raise
+            # lease lapsed: the MDS evicted us.  Reconnect with a COLD
+            # cache — buffered-but-unflushed data from the dead session
+            # is LOST (exactly the reference's eviction semantics) and
+            # cached reads may be stale against post-eviction writers.
+            self._cache.clear()
+            self._dirty.clear()
+            self.mds.open_session(self.client,
+                                  flush_cb=self._on_revoke)
+            caps = self.mds.acquire_caps(self.client, path, want)
+        self._ino_path[self.mds.stat(path)["ino"]] = path
+        return caps
+
+    def renew(self) -> None:
+        self.mds.renew_session(self.client)
+
+    def flush(self) -> None:
+        """Write back every buffered file (client cap flush)."""
+        for path in list(self._dirty):
+            self.mds.write_file(path, self._cache[path], 0)
+            self._dirty.discard(path)
 
     def mkdir(self, path: str) -> None:
         self.mds.mkdir(path)
@@ -364,20 +523,59 @@ class CephFSClient:
             self.mds.stat(path)
         except FSError:
             self.mds.create(path)
-        return self.mds.write_file(path, data, offset)
+        caps = self._caps_for(path, "rwc")
+        if "c" not in caps:
+            # shared file: sync write-through (no buffering cap)
+            return self.mds.write_file(path, data, offset)
+        base = self._cache.get(path)
+        if base is None:
+            base = self.mds.read_file(path)
+        buf = bytearray(base)
+        if len(buf) < offset + len(data):
+            buf.extend(b"\0" * (offset + len(data) - len(buf)))
+        buf[offset:offset + len(data)] = data
+        self._cache[path] = bytes(buf)
+        self._dirty.add(path)
+        return len(data)
 
     def read(self, path: str, offset: int = 0,
              length: Optional[int] = None) -> bytes:
-        return self.mds.read_file(path, offset, length)
+        caps = self._caps_for(path, "rc")
+        if "c" not in caps:
+            # sync mode: read exactly the requested extent through
+            return self.mds.read_file(path, offset, length)
+        if path in self._cache:
+            data = self._cache[path]
+        else:
+            data = self.mds.read_file(path)
+            self._cache[path] = data
+        end = len(data) if length is None else offset + length
+        return data[offset:end]
 
     def unlink(self, path: str) -> None:
+        self._cache.pop(path, None)
+        self._dirty.discard(path)
         self.mds.unlink(path)
 
     def rmdir(self, path: str) -> None:
         self.mds.rmdir(path)
 
     def rename(self, src: str, dst: str) -> None:
+        # namespace ops flush buffered data first (the reference
+        # journals rename only after cap flush)
+        if src in self._dirty:
+            self.mds.write_file(src, self._cache[src], 0)
+            self._dirty.discard(src)
+        self._cache.pop(src, None)
+        self._cache.pop(dst, None)
+        self._dirty.discard(dst)
         self.mds.rename(src, dst)
 
     def stat(self, path: str) -> dict:
-        return self.mds.stat(path)
+        st = self.mds.stat(path)
+        if path in self._dirty:
+            # buffered writer provides the authoritative size (the
+            # client-caps size-projection the reference does for Fw
+            # holders)
+            st = dict(st, size=len(self._cache[path]))
+        return st

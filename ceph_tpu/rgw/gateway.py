@@ -71,33 +71,65 @@ class Bucket:
         self.gw.ioctx.write_full(self._index_oid(),
                                  json.dumps(idx).encode())
 
-    def _data_oid(self, key: str) -> str:
+    def _data_oid(self, key: str, gen: str = "") -> str:
         # '/' is forbidden in bucket names (create_bucket validates),
-        # so this join is collision-free across (bucket, key) pairs
-        return f"rgw_data.{self.name}/{key}"
+        # so this join is collision-free across (bucket, key) pairs.
+        # ``gen`` is the per-write generation token: data oids are
+        # UNIQUE per object version, so a superseded version's oid can
+        # sit in the deferred-GC log while the SAME KEY is rewritten —
+        # GC can never reclaim live data (the RGW tail-object
+        # generation role).
+        return f"rgw_data.{self.name}/{key}.{gen}" if gen \
+            else f"rgw_data.{self.name}/{key}"
 
     # --------------------------------------------------------------- ops --
     def put_object(self, key: str, data: bytes,
                    metadata: Optional[Dict[str, str]] = None) -> str:
         """-> ETag.  Data object first, index entry second."""
+        import secrets as _secrets
         etag = hashlib.md5(data).hexdigest()
+        gen = _secrets.token_hex(4)
         # bilog entry FIRST (the prepare-before-index-transaction
         # order): a crash between log and index leaves an entry whose
         # replay finds no object and skips — never a visible object
         # that multisite would silently miss
         self._log_op("put", key)
-        self.gw.ioctx.write_full(self._data_oid(key), data)
+        self.gw.ioctx.write_full(self._data_oid(key, gen), data)
         idx = self._read_index()
-        idx[key] = {"size": len(data), "etag": etag,
+        old = idx.get(key)
+        idx[key] = {"size": len(data), "etag": etag, "gen": gen,
                     "mtime": time.time(), "meta": metadata or {}}
         self._write_index(idx)
+        # the superseded version (plain or multipart) -> deferred GC
+        if old:
+            self.gw.gc_enqueue(self._version_oids(key, old))
         return etag
+
+    def _version_oids(self, key: str, ent: dict) -> List[str]:
+        """Every data oid one index-entry version owns."""
+        mp = ent.get("mp")
+        if mp:
+            return [self._mp_part_oid(mp["uid"], p["n"])
+                    for p in mp["parts"]]
+        return [self._data_oid(key, ent.get("gen", ""))]
 
     def get_object(self, key: str) -> Tuple[bytes, dict]:
         ent = self._read_index().get(key)
         if ent is None:
             raise RGWError(f"NoSuchKey: {key}")
-        data = self.gw.ioctx.read(self._data_oid(key))[:ent["size"]]
+        mp = ent.get("mp")
+        if mp:
+            # multipart manifest: the object is striped across its
+            # part objects (the RGW manifest role — completion never
+            # copies bytes, rgw_op.h:1210 CompleteMultipart)
+            chunks = []
+            for p in mp["parts"]:
+                raw = self.gw.ioctx.read(
+                    self._mp_part_oid(mp["uid"], p["n"]))
+                chunks.append(raw[:p["size"]])
+            return b"".join(chunks), ent
+        data = self.gw.ioctx.read(
+            self._data_oid(key, ent.get("gen", "")))[:ent["size"]]
         return data, ent
 
     def head_object(self, key: str) -> dict:
@@ -110,15 +142,119 @@ class Bucket:
         idx = self._read_index()
         if key not in idx:
             raise RGWError(f"NoSuchKey: {key}")
+        ent = idx[key]
         # index entry first, then data: a crash leaves an orphan data
         # object (GC-able), never a dangling index entry
         self._log_op("delete", key)       # log-ahead, like put
         del idx[key]
         self._write_index(idx)
+        mp = ent.get("mp")
+        if mp:
+            # multipart tails go through the DEFERRED-delete GC log
+            # (rgw_gc.cc role): the delete acks now, space reclaims
+            # on the next gc_process pass
+            self.gw.gc_enqueue(self._version_oids(key, ent))
+            return
         try:
-            self.gw.ioctx.remove(self._data_oid(key))
+            self.gw.ioctx.remove(self._data_oid(key,
+                                                ent.get("gen", "")))
         except Exception:
             pass
+
+    # --------------------------------------------------------- multipart --
+    # Reference: InitMultipart / UploadPart / CompleteMultipart ops
+    # (src/rgw/rgw_op.h:1210-1212).  Parts are RADOS objects; completion
+    # writes a MANIFEST into the index (striped mapping, no byte copy).
+
+    def _mp_meta_oid(self, uid: str) -> str:
+        return f"rgw.mp.{self.name}/{uid}"
+
+    def _mp_part_oid(self, uid: str, n: int) -> str:
+        return f"rgw_mp.{self.name}/{uid}.{n}"
+
+    def _read_mp(self, uid: str) -> dict:
+        try:
+            return json.loads(
+                self.gw.ioctx.read(self._mp_meta_oid(uid)).decode())
+        except Exception:
+            raise RGWError(f"NoSuchUpload: {uid}")
+
+    def initiate_multipart(self, key: str) -> str:
+        import secrets as _secrets
+        uid = _secrets.token_hex(8)
+        self.gw.ioctx.write_full(
+            self._mp_meta_oid(uid),
+            json.dumps({"key": key, "parts": {},
+                        "started": time.time()}).encode())
+        return uid
+
+    def upload_part(self, uid: str, part_number: int,
+                    data: bytes) -> str:
+        if part_number < 1 or part_number > 10000:
+            raise RGWError(f"InvalidPart: number {part_number}")
+        meta = self._read_mp(uid)
+        etag = hashlib.md5(data).hexdigest()
+        self.gw.ioctx.write_full(self._mp_part_oid(uid, part_number),
+                                 data)
+        meta["parts"][str(part_number)] = {"size": len(data),
+                                           "etag": etag}
+        self.gw.ioctx.write_full(self._mp_meta_oid(uid),
+                                 json.dumps(meta).encode())
+        return etag
+
+    def complete_multipart(self, uid: str,
+                           part_numbers: List[int]) -> str:
+        """Stitch the listed parts (ascending) into the object as a
+        manifest; superseded/unlisted parts go to GC.  ETag follows
+        the S3 multipart convention: md5(part-md5s) + '-N'."""
+        meta = self._read_mp(uid)
+        key = meta["key"]
+        parts = []
+        digest = hashlib.md5()
+        size = 0
+        for n in sorted(int(x) for x in part_numbers):
+            p = meta["parts"].get(str(n))
+            if p is None:
+                raise RGWError(f"InvalidPart: {n} was never uploaded")
+            parts.append({"n": n, "size": p["size"],
+                          "etag": p["etag"]})
+            digest.update(bytes.fromhex(p["etag"]))
+            size += p["size"]
+        if not parts:
+            raise RGWError("InvalidPart: empty part list")
+        etag = f"{digest.hexdigest()}-{len(parts)}"
+        self._log_op("put", key)
+        idx = self._read_index()
+        old = idx.get(key)
+        idx[key] = {"size": size, "etag": etag, "mtime": time.time(),
+                    "meta": {},
+                    "mp": {"uid": uid, "parts": parts}}
+        self._write_index(idx)
+        # unlisted parts + any overwritten previous object -> GC
+        listed = {p["n"] for p in parts}
+        orphans = [self._mp_part_oid(uid, int(n))
+                   for n in meta["parts"] if int(n) not in listed]
+        if old:
+            orphans += self._version_oids(key, old)
+        if orphans:
+            self.gw.gc_enqueue(orphans)
+        try:
+            self.gw.ioctx.remove(self._mp_meta_oid(uid))
+        except Exception:
+            pass
+        return etag
+
+    def abort_multipart(self, uid: str) -> int:
+        """Abandon an upload: every uploaded part becomes a deferred
+        GC entry (AbortMultipart -> rgw_gc.cc defer_gc shape)."""
+        meta = self._read_mp(uid)
+        oids = [self._mp_part_oid(uid, int(n)) for n in meta["parts"]]
+        self.gw.gc_enqueue(oids)
+        try:
+            self.gw.ioctx.remove(self._mp_meta_oid(uid))
+        except Exception:
+            pass
+        return len(oids)
 
     def list_objects(self, prefix: str = "", marker: str = "",
                      max_keys: int = 1000, delimiter: str = ""
@@ -154,11 +290,63 @@ class Bucket:
                 "is_truncated": False, "next_marker": ""}
 
 
+_GC_OID = "rgw.gc"
+
+
 class RGWGateway:
     """Bucket directory + per-bucket handles (the RGWRados role)."""
 
     def __init__(self, ioctx):
         self.ioctx = ioctx
+        import threading
+        # serializes GC-log read-modify-write across the frontend's
+        # request threads (one rgw.gc object; cross-PROCESS gateways
+        # would shard the log like the reference's gc objects)
+        self._gc_lock = threading.Lock()
+
+    # ------------------------------------------------------------------ GC --
+    # Deferred-delete log (src/rgw/rgw_gc.cc): deletions of tail/part
+    # objects enqueue here and reclaim on the next gc_process() pass,
+    # so client-visible deletes never wait on data removal and orphan
+    # cleanup is centralized.
+
+    def _read_gc(self) -> List[dict]:
+        try:
+            return json.loads(self.ioctx.read(_GC_OID).decode())
+        except Exception:
+            return []
+
+    def gc_enqueue(self, oids: List[str],
+                   delay: float = 0.0) -> None:
+        with self._gc_lock:
+            entries = self._read_gc()
+            due = time.time() + delay
+            entries.extend({"oid": o, "due": due} for o in oids)
+            self.ioctx.write_full(_GC_OID,
+                                  json.dumps(entries).encode())
+
+    def gc_list(self) -> List[dict]:
+        return self._read_gc()
+
+    def gc_process(self, now: Optional[float] = None) -> int:
+        """Remove every due entry's object; returns objects removed.
+        Entries whose object is already gone still clear (idempotent
+        across a crash mid-pass)."""
+        now = time.time() if now is None else now
+        with self._gc_lock:
+            entries = self._read_gc()
+            keep, removed = [], 0
+            for e in entries:
+                if e["due"] > now:
+                    keep.append(e)
+                    continue
+                try:
+                    self.ioctx.remove(e["oid"])
+                    removed += 1
+                except Exception:
+                    pass      # already gone: entry still clears
+            self.ioctx.write_full(_GC_OID, json.dumps(keep).encode())
+        return removed
 
     def _read_buckets(self) -> Dict[str, dict]:
         try:

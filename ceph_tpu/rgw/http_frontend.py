@@ -34,12 +34,20 @@ def _err_xml(code: str, message: str) -> bytes:
 
 _STATUS = {"NoSuchBucket": 404, "NoSuchKey": 404,
            "BucketAlreadyExists": 409, "BucketNotEmpty": 409,
-           "InvalidBucketName": 400}
+           "InvalidBucketName": 400, "NoSuchUpload": 404,
+           "InvalidPart": 400, "AccessDenied": 403,
+           "InvalidAccessKeyId": 403, "SignatureDoesNotMatch": 403}
 
 
 class S3Frontend:
-    def __init__(self, gateway: RGWGateway):
+    def __init__(self, gateway: RGWGateway,
+                 users: Optional[dict] = None):
+        """``users``: access_key -> {"secret":…, "user":…}.  When set,
+        every request must carry a valid SigV4-shaped signature
+        (rgw_auth_s3 role); None = auth disabled (dev mode, like
+        rgw_auth anonymous)."""
         self.gw = gateway
+        self.users = users
         self._server: Optional[http.server.ThreadingHTTPServer] = None
 
     # --------------------------------------------------------------- ops --
@@ -56,7 +64,9 @@ class S3Frontend:
                 key = urllib.parse.unquote(parts[1]) \
                     if len(parts) > 1 else ""
                 q = {k: v[0] for k, v in
-                     urllib.parse.parse_qs(parsed.query).items()}
+                     urllib.parse.parse_qs(
+                         parsed.query,
+                         keep_blank_values=True).items()}
                 return bucket, key, q
 
             def _send(self, status: int, body: bytes = b"",
@@ -73,19 +83,47 @@ class S3Frontend:
                 if not head_only and body:
                     self.wfile.write(body)
 
-            def _fail(self, e: RGWError, head_only=False):
+            def _fail(self, e: Exception, head_only=False):
                 code = str(e).split(":", 1)[0]
                 self._send(_STATUS.get(code, 400),
                            _err_xml(code, str(e)), head_only=head_only)
 
-            def do_PUT(self):             # noqa: N802
-                bucket, key, _ = self._split()
+            def _authenticate(self, body: bytes) -> bool:
+                """SigV4 verification against the frontend's user set
+                (True = proceed).  Anonymous requests are refused when
+                auth is enabled."""
+                if fe.users is None:
+                    return True
+                from .auth_s3 import S3AuthError, verify_request
+                parsed = urllib.parse.urlparse(self.path)
+                try:
+                    verify_request(self.command, parsed.path,
+                                   parsed.query,
+                                   dict(self.headers.items()), body,
+                                   fe.users)
+                    return True
+                except S3AuthError as e:
+                    self._fail(e)
+                    return False
+
+            def _body(self) -> bytes:
                 ln = int(self.headers.get("Content-Length", 0))
-                body = self.rfile.read(ln) if ln else b""
+                return self.rfile.read(ln) if ln else b""
+
+            def do_PUT(self):             # noqa: N802
+                bucket, key, q = self._split()
+                body = self._body()
+                if not self._authenticate(body):
+                    return
                 try:
                     if not key:
                         fe.gw.create_bucket(bucket)
                         self._send(200)
+                    elif "uploadId" in q:
+                        etag = fe.gw.bucket(bucket).upload_part(
+                            q["uploadId"], int(q.get("partNumber", 0)),
+                            body)
+                        self._send(200, etag=etag)
                     else:
                         meta = {k[11:]: v for k, v in
                                 self.headers.items()
@@ -96,8 +134,45 @@ class S3Frontend:
                 except RGWError as e:
                     self._fail(e)
 
+            def do_POST(self):            # noqa: N802
+                bucket, key, q = self._split()
+                body = self._body()
+                if not self._authenticate(body):
+                    return
+                try:
+                    if "uploads" in q:
+                        uid = fe.gw.bucket(bucket).initiate_multipart(
+                            key)
+                        xml = ("<?xml version='1.0'?>"
+                               "<InitiateMultipartUploadResult>"
+                               f"<Bucket>{escape(bucket)}</Bucket>"
+                               f"<Key>{escape(key)}</Key>"
+                               f"<UploadId>{uid}</UploadId>"
+                               "</InitiateMultipartUploadResult>")
+                        self._send(200, xml.encode())
+                    elif "uploadId" in q:
+                        import re
+                        nums = [int(n) for n in re.findall(
+                            r"<PartNumber>(\d+)</PartNumber>",
+                            body.decode(errors="replace"))]
+                        etag = fe.gw.bucket(bucket).complete_multipart(
+                            q["uploadId"], nums)
+                        xml = ("<?xml version='1.0'?>"
+                               "<CompleteMultipartUploadResult>"
+                               f"<Key>{escape(key)}</Key>"
+                               f"<ETag>&quot;{etag}&quot;</ETag>"
+                               "</CompleteMultipartUploadResult>")
+                        self._send(200, xml.encode(), etag=etag)
+                    else:
+                        self._send(400, _err_xml(
+                            "InvalidRequest", "unsupported POST"))
+                except RGWError as e:
+                    self._fail(e)
+
             def do_GET(self, head_only=False):    # noqa: N802
                 bucket, key, q = self._split()
+                if not self._authenticate(b""):
+                    return
                 try:
                     if not bucket:
                         names = fe.gw.list_buckets()
@@ -152,13 +227,20 @@ class S3Frontend:
                 self.do_GET(head_only=True)
 
             def do_DELETE(self):          # noqa: N802
-                bucket, key, _ = self._split()
+                bucket, key, q = self._split()
+                if not self._authenticate(b""):
+                    return
                 try:
-                    if key:
+                    if key and "uploadId" in q:
+                        fe.gw.bucket(bucket).abort_multipart(
+                            q["uploadId"])
+                        self._send(204)
+                    elif key:
                         fe.gw.bucket(bucket).delete_object(key)
+                        self._send(204)
                     else:
                         fe.gw.delete_bucket(bucket)
-                    self._send(204)
+                        self._send(204)
                 except RGWError as e:
                     self._fail(e)
 
