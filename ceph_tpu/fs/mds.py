@@ -317,9 +317,13 @@ class MDS:
         return held[client]
 
     def release_caps(self, client: str, path: str) -> None:
+        """Voluntary cap return: routed through the revoke path so a
+        buffered/caching client flushes AND drops its local cache —
+        otherwise a later lone re-grant would serve stale bytes."""
         ino = self._lookup(path)["ino"]
         held = self._caps.get(ino)
-        if held:
+        if held and client in held:
+            self._revoke(ino, client, "rwc")
             held.pop(client, None)
             if not held:
                 del self._caps[ino]
@@ -407,11 +411,21 @@ class MDS:
                                  "name": name, "ino": ino})
         return ino
 
+    def _flush_and_drop_caps(self, ino: int) -> None:
+        """Before a namespace op kills/moves an inode: revoke every
+        holder's caps (buffered writers flush via their callbacks
+        while the path still resolves), then drop the cap state —
+        caps die with the inode like locks do."""
+        for client in list(self._caps.get(ino, {})):
+            self._revoke(ino, client, "rwc")
+        self._caps.pop(ino, None)
+
     def unlink(self, path: str) -> None:
         parent, name = self._resolve(path)
         ent = self._read_dir(parent).get(name)
         if ent is None or ent["type"] != "file":
             raise FSError(f"no such file: {path}")
+        self._flush_and_drop_caps(ent["ino"])
         # purge every data object the file's size can cover; sparse
         # holes (missing objnos) are skipped, not treated as the end
         n_objs = -(-ent.get("size", 0) // self.layout.object_size)
@@ -440,10 +454,14 @@ class MDS:
     def rename(self, src: str, dst: str) -> None:
         sp, sn = self._resolve(src)
         dp, dn = self._resolve(dst)
-        if sn not in self._read_dir(sp):
+        ent = self._read_dir(sp).get(sn)
+        if ent is None:
             raise FSError(f"no such entry: {src}")
         if dn in self._read_dir(dp):
             raise FSError(f"exists: {dst}")
+        # buffered holders flush while the SOURCE path still resolves;
+        # their path-keyed client caches cannot follow the move
+        self._flush_and_drop_caps(ent["ino"])
         self._journal_and_apply({"op": "rename", "src_parent": sp,
                                  "src_name": sn, "dst_parent": dp,
                                  "dst_name": dn})

@@ -1034,14 +1034,19 @@ class FastMapper:
 
 
     def map_batch(self, ruleno: int, xs, result_max: int,
-                  weights: Sequence[int], mesh=None
-                  ) -> Tuple[np.ndarray, np.ndarray]:
+                  weights: Sequence[int], mesh=None,
+                  readback: bool = True):
         """→ (results [N, result_max] i32, incomplete [N] bool).
 
         Chunks stream through one compiled executable and stay ON DEVICE
         until a single final readback: device→host transfers through the
         driver tunnel cost ~0.25 s of latency each (measured), which at
         per-chunk granularity was 25x the actual compute time.
+
+        ``readback=False`` returns the DEVICE arrays (padded to the
+        chunk cap) — consumers that keep working on device (remap
+        diffs, recovery planning) skip the multi-MB host transfer
+        entirely, and benchmarks can meter compute vs readback.
         """
         if ruleno < 0 or ruleno >= self.cmap.max_rules or \
                 self.cmap.rules[ruleno] is None:
@@ -1084,4 +1089,6 @@ class FastMapper:
         else:
             out_d = jnp.concatenate(outs)
             inc_d = jnp.concatenate(incs)
+        if not readback:
+            return out_d, inc_d
         return np.asarray(out_d)[:n], np.asarray(inc_d)[:n]

@@ -192,14 +192,15 @@ class Bucket:
                     data: bytes) -> str:
         if part_number < 1 or part_number > 10000:
             raise RGWError(f"InvalidPart: number {part_number}")
-        meta = self._read_mp(uid)
         etag = hashlib.md5(data).hexdigest()
         self.gw.ioctx.write_full(self._mp_part_oid(uid, part_number),
                                  data)
-        meta["parts"][str(part_number)] = {"size": len(data),
-                                           "etag": etag}
-        self.gw.ioctx.write_full(self._mp_meta_oid(uid),
-                                 json.dumps(meta).encode())
+        with self.gw._mp_lock:     # concurrent parts: RMW must not
+            meta = self._read_mp(uid)          # lose registrations
+            meta["parts"][str(part_number)] = {"size": len(data),
+                                               "etag": etag}
+            self.gw.ioctx.write_full(self._mp_meta_oid(uid),
+                                     json.dumps(meta).encode())
         return etag
 
     def complete_multipart(self, uid: str,
@@ -209,10 +210,13 @@ class Bucket:
         the S3 multipart convention: md5(part-md5s) + '-N'."""
         meta = self._read_mp(uid)
         key = meta["key"]
+        nums = [int(x) for x in part_numbers]
+        if len(set(nums)) != len(nums):
+            raise RGWError("InvalidPart: duplicate part numbers")
         parts = []
         digest = hashlib.md5()
         size = 0
-        for n in sorted(int(x) for x in part_numbers):
+        for n in sorted(nums):
             p = meta["parts"].get(str(n))
             if p is None:
                 raise RGWError(f"InvalidPart: {n} was never uploaded")
@@ -299,10 +303,12 @@ class RGWGateway:
     def __init__(self, ioctx):
         self.ioctx = ioctx
         import threading
-        # serializes GC-log read-modify-write across the frontend's
-        # request threads (one rgw.gc object; cross-PROCESS gateways
-        # would shard the log like the reference's gc objects)
+        # serialize the shared-object read-modify-writes across the
+        # frontend's request threads (gc log + per-upload multipart
+        # meta; cross-PROCESS gateways would shard these like the
+        # reference's gc/bucket-index objects)
         self._gc_lock = threading.Lock()
+        self._mp_lock = threading.Lock()
 
     # ------------------------------------------------------------------ GC --
     # Deferred-delete log (src/rgw/rgw_gc.cc): deletions of tail/part

@@ -88,7 +88,8 @@ class S3Frontend:
                 self._send(_STATUS.get(code, 400),
                            _err_xml(code, str(e)), head_only=head_only)
 
-            def _authenticate(self, body: bytes) -> bool:
+            def _authenticate(self, body: bytes,
+                              head_only: bool = False) -> bool:
                 """SigV4 verification against the frontend's user set
                 (True = proceed).  Anonymous requests are refused when
                 auth is enabled."""
@@ -103,7 +104,7 @@ class S3Frontend:
                                    fe.users)
                     return True
                 except S3AuthError as e:
-                    self._fail(e)
+                    self._fail(e, head_only=head_only)
                     return False
 
             def _body(self) -> bytes:
@@ -171,7 +172,7 @@ class S3Frontend:
 
             def do_GET(self, head_only=False):    # noqa: N802
                 bucket, key, q = self._split()
-                if not self._authenticate(b""):
+                if not self._authenticate(b"", head_only=head_only):
                     return
                 try:
                     if not bucket:
