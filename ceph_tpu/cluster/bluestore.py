@@ -1,0 +1,775 @@
+"""BlueStore — block-device extent ObjectStore backend (L5).
+
+The role of the reference's flagship store (src/os/bluestore/
+BlueStore.cc — raw-device extents + RocksDB metadata + allocators +
+per-block checksums + inline compression + deferred small writes),
+re-designed around this repo's own seams rather than ported:
+
+  * the "raw device" is one fixed-size ``block`` file carved into
+    ``min_alloc``-sized blocks; free space is tracked by the native
+    bitmap allocator (native/allocator_native.cpp — the
+    BitmapAllocator role, src/os/bluestore/BitmapAllocator.h);
+  * object metadata (onode: size + blob/extent map), xattrs and omap
+    rows live in WalDB (the RocksDB role) and commit as ONE batch per
+    transaction — the atomic commit point;
+  * new data is written copy-on-write into freshly allocated blocks
+    and fsynced BEFORE the KV commit, so a torn transaction can never
+    clobber committed bytes; freed blocks are released only AFTER the
+    commit (same reasoning, in-process);
+  * every blob carries a crc32 per ``min_alloc`` stored block —
+    partial reads verify only the blocks they touch and raise
+    ChecksumError (EIO) on mismatch, BlueStore's csum-on-read stance;
+  * blobs at/above ``compress_min`` are compressed through the
+    compressor plugin registry (common/compressor.py) when it actually
+    saves space — stored_len < raw_len is recorded in the blob header
+    (the role of bluestore_compression_mode=aggressive);
+  * small overwrites that land inside one existing uncompressed blob
+    take the DEFERRED path (src/os/bluestore/BlueStore.cc deferred
+    writes): the merged block bytes ride the KV commit batch and are
+    applied to the device in place afterwards; mount() replays any
+    deferred rows left by a crash (idempotent pwrites), so the KV
+    batch remains the single durability point;
+  * there is NO persisted freelist: mount() rebuilds the allocator
+    bitmap from the committed onodes (the post-Pacific BlueStore "NCB"
+    stance), and double-allocation across onodes is detected while
+    marking — that is fsck's allocation check.
+
+Crash model (kill -9 anywhere): a transaction is visible iff its KV
+batch committed; COW data for uncommitted transactions sits in blocks
+the rebuilt allocator still considers free.  See
+tests/test_bluestore.py for the kill -9 storm.
+"""
+from __future__ import annotations
+
+import os
+import struct
+import threading
+import zlib
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from ..common.compressor import compressors
+from ..native_bridge import AllocatorError, BitmapAllocator
+from .kv import WriteBatch
+from .objectstore import (ChecksumError, Coll, ObjectStoreError,
+                          OP_OMAP_RM, OP_OMAP_SET, OP_REMOVE, OP_SETATTR,
+                          OP_TOUCH, OP_TRUNCATE, OP_WRITE, OP_WRITE_FULL,
+                          Transaction)
+from .wal_kv import WalDB
+
+_BLOB_HDR = struct.Struct("<BIIHI")      # flags, raw_len, stored_len,
+                                         #   n_runs, n_csums
+_RUN = struct.Struct("<QI")              # start_block, n_blocks
+_EXT = struct.Struct("<QIII")            # obj_off, length, blob_idx,
+                                         #   blob_off (into RAW stream)
+_DEF = struct.Struct("<QI")              # dev_byte_off, payload_len
+
+FLAG_COMPRESSED = 1
+
+
+@dataclass
+class Blob:
+    """A stored region: stored_len bytes across `runs` device blocks,
+    raw_len logical bytes after decompression, one crc32 per stored
+    min_alloc block (the bluestore_blob_t + csum array role)."""
+    flags: int = 0
+    raw_len: int = 0
+    stored_len: int = 0
+    runs: List[Tuple[int, int]] = field(default_factory=list)
+    csums: List[int] = field(default_factory=list)
+
+    @property
+    def compressed(self) -> bool:
+        return bool(self.flags & FLAG_COMPRESSED)
+
+    def n_blocks(self) -> int:
+        return sum(n for _, n in self.runs)
+
+
+@dataclass
+class Onode:
+    """Per-object metadata: logical size + extent map over blobs (the
+    bluestore onode_t/extent_map role).  Extents are sorted by
+    obj_off and never overlap (writes punch before inserting)."""
+    size: int = 0
+    blobs: List[Blob] = field(default_factory=list)
+    # (obj_off, length, blob_idx, blob_off)
+    extents: List[Tuple[int, int, int, int]] = field(default_factory=list)
+
+    def encode(self) -> bytes:
+        out = [struct.pack("<QI", self.size, len(self.blobs))]
+        for b in self.blobs:
+            out.append(_BLOB_HDR.pack(b.flags, b.raw_len, b.stored_len,
+                                      len(b.runs), len(b.csums)))
+            out += [_RUN.pack(*r) for r in b.runs]
+            out.append(struct.pack(f"<{len(b.csums)}I", *b.csums))
+        out.append(struct.pack("<I", len(self.extents)))
+        out += [_EXT.pack(*e) for e in self.extents]
+        return b"".join(out)
+
+    @classmethod
+    def decode(cls, blob: bytes) -> "Onode":
+        size, n_blobs = struct.unpack_from("<QI", blob, 0)
+        off = 12
+        blobs = []
+        for _ in range(n_blobs):
+            flags, raw_len, stored_len, n_runs, n_csums = \
+                _BLOB_HDR.unpack_from(blob, off)
+            off += _BLOB_HDR.size
+            runs = []
+            for _ in range(n_runs):
+                runs.append(_RUN.unpack_from(blob, off))
+                off += _RUN.size
+            csums = list(struct.unpack_from(f"<{n_csums}I", blob, off))
+            off += 4 * n_csums
+            blobs.append(Blob(flags, raw_len, stored_len, runs, csums))
+        (n_ext,) = struct.unpack_from("<I", blob, off)
+        off += 4
+        extents = []
+        for _ in range(n_ext):
+            extents.append(_EXT.unpack_from(blob, off))
+            off += _EXT.size
+        return cls(size=size, blobs=blobs, extents=extents)
+
+
+def _collkey(coll: Coll) -> str:
+    return f"{coll[0]}.{coll[1]}"
+
+
+def _objkey(coll: Coll, oid: str) -> str:
+    return f"{_collkey(coll)}/{oid}"
+
+
+def _split_objkey(key: str) -> Tuple[Coll, str]:
+    ck, oid = key.split("/", 1)
+    p, g = ck.split(".", 1)
+    return (int(p), int(g)), oid
+
+
+class BlueStore:
+    """Durable block-device ObjectStore (block file + WalDB metadata)."""
+
+    def __init__(self, path: str, *, device_bytes: int = 1 << 28,
+                 min_alloc: int = 4096, fsync: bool = True,
+                 compression: Optional[str] = None,
+                 compress_min: int = 4096,
+                 deferred_max: Optional[int] = None,
+                 compact_extents: int = 64,
+                 fsck_on_mount: bool = True):
+        self.path = path
+        self.fsync = fsync
+        os.makedirs(path, exist_ok=True)
+        self.kv = WalDB(os.path.join(path, "kv"), fsync=fsync)
+        # superblock: geometry is fixed at mkfs; remounts use the stored
+        # values (passing different ones is a config error, not a resize)
+        sb = self.kv.get("meta", "superblock")
+        if sb is None:
+            self.device_bytes = int(device_bytes)
+            self.min_alloc = int(min_alloc)
+            self.kv.set("meta", "superblock", struct.pack(
+                "<QI", self.device_bytes, self.min_alloc))
+        else:
+            self.device_bytes, self.min_alloc = struct.unpack("<QI", sb)
+        if self.device_bytes % self.min_alloc:
+            raise ObjectStoreError("device size not block-aligned")
+        self.n_blocks = self.device_bytes // self.min_alloc
+        self.compress_min = compress_min
+        self.compact_extents = compact_extents
+        self.deferred_max = (self.min_alloc if deferred_max is None
+                             else deferred_max)
+        self._comp = (compressors().factory(compression)
+                      if compression else None)
+        self._comp_name = compression
+        dev_path = os.path.join(path, "block")
+        flags = os.O_RDWR | os.O_CREAT
+        self._dev = os.open(dev_path, flags, 0o644)
+        os.ftruncate(self._dev, self.device_bytes)
+        self._lock = threading.RLock()
+        self.txns_applied = 0
+        self.deferred_applied = 0
+        self.alloc = BitmapAllocator(self.n_blocks)
+        self._rebuild_allocations()
+        self._replay_deferred()
+        if fsck_on_mount:
+            try:
+                bad = self.fsck()
+            except Exception:
+                self.close()
+                raise
+            if bad:
+                self.close()
+                raise ObjectStoreError(f"fsck on mount: bad objects {bad}")
+
+    # ------------------------------------------------------------- mount --
+    def _rebuild_allocations(self) -> None:
+        """NCB freelist rebuild: mark every committed blob's runs; an
+        overlap here is on-disk corruption."""
+        for key, blob in self.kv.iterate("onode"):
+            onode = Onode.decode(blob)
+            for b in onode.blobs:
+                for start, n in b.runs:
+                    try:
+                        self.alloc.mark(start, n)
+                    except AllocatorError as e:
+                        raise ObjectStoreError(
+                            f"mount: {key}: double-allocated blocks "
+                            f"[{start},+{n}): {e}") from e
+
+    def _replay_deferred(self) -> None:
+        """Re-apply deferred writes whose in-place pwrite may not have
+        happened before a crash (idempotent), then drop the rows."""
+        rows = list(self.kv.iterate("deferred"))
+        if not rows:
+            return
+        batch = WriteBatch()
+        for key, payload in rows:
+            dev_off, ln = _DEF.unpack_from(payload, 0)
+            data = payload[_DEF.size:_DEF.size + ln]
+            os.pwrite(self._dev, data, dev_off)
+            batch.rm("deferred", key)
+        if self.fsync:
+            os.fsync(self._dev)
+        self.kv.submit(batch)
+
+    # ------------------------------------------------------------ helpers --
+    def _onode(self, coll: Coll, oid: str) -> Optional[Onode]:
+        blob = self.kv.get("onode", _objkey(coll, oid))
+        return Onode.decode(blob) if blob is not None else None
+
+    def _blob_block_list(self, blob: Blob) -> List[int]:
+        blocks: List[int] = []
+        for start, n in blob.runs:
+            blocks.extend(range(start, start + n))
+        return blocks
+
+    def _read_stored(self, blob: Blob, s0: int, s1: int,
+                     check: bool = True) -> bytes:
+        """Read stored bytes [s0, s1) of a blob, verifying the crc of
+        every touched stored block."""
+        if s1 > blob.stored_len:
+            raise ObjectStoreError("stored read past blob end")
+        c0 = s0 // self.min_alloc
+        c1 = (s1 + self.min_alloc - 1) // self.min_alloc
+        blocks = self._blob_block_list(blob)
+        parts = []
+        for ci in range(c0, c1):
+            blk = blocks[ci]
+            want = min(self.min_alloc,
+                       blob.stored_len - ci * self.min_alloc)
+            buf = os.pread(self._dev, want, blk * self.min_alloc)
+            if len(buf) != want or (
+                    check and zlib.crc32(buf) != blob.csums[ci]):
+                raise ChecksumError(
+                    f"blob block {ci} @dev {blk}: data fails "
+                    f"checksum (EIO)")
+            parts.append(buf)
+        joined = b"".join(parts)
+        lo = s0 - c0 * self.min_alloc
+        return joined[lo:lo + (s1 - s0)]
+
+    def _read_raw(self, blob: Blob, r0: int, r1: int) -> bytes:
+        """Read RAW (decompressed) bytes [r0, r1) of a blob."""
+        if blob.compressed:
+            stored = self._read_stored(blob, 0, blob.stored_len)
+            comp = (self._comp if self._comp is not None
+                    else compressors().factory(self._comp_name or "zlib"))
+            raw = comp.decompress(stored)
+            if len(raw) != blob.raw_len:
+                raise ChecksumError("decompressed length mismatch (EIO)")
+            return raw[r0:r1]
+        return self._read_stored(blob, r0, r1)
+
+    @staticmethod
+    def _punch(onode: Onode, off: int, length: int) -> None:
+        """Remove [off, off+length) from the extent map, splitting
+        extents that straddle the boundary.  Blobs stay (possibly
+        partially referenced); _reap_blobs drops unreferenced ones."""
+        end = off + length
+        out: List[Tuple[int, int, int, int]] = []
+        for e_off, e_len, bi, b_off in onode.extents:
+            e_end = e_off + e_len
+            if e_end <= off or e_off >= end:
+                out.append((e_off, e_len, bi, b_off))
+                continue
+            if e_off < off:                    # keep head
+                out.append((e_off, off - e_off, bi, b_off))
+            if e_end > end:                    # keep tail
+                cut = end - e_off
+                out.append((end, e_end - end, bi, b_off + cut))
+        out.sort()
+        onode.extents = out
+
+    @staticmethod
+    def _reap_blobs(onode: Onode) -> List[Tuple[int, int]]:
+        """Drop blobs no extent references; returns their runs (to be
+        released AFTER commit) and renumbers extent blob indices."""
+        referenced = {bi for _, _, bi, _ in onode.extents}
+        freed: List[Tuple[int, int]] = []
+        remap: Dict[int, int] = {}
+        kept: List[Blob] = []
+        for i, b in enumerate(onode.blobs):
+            if i in referenced:
+                remap[i] = len(kept)
+                kept.append(b)
+            else:
+                freed.extend(b.runs)
+        onode.blobs = kept
+        onode.extents = [(o, ln, remap[bi], bo)
+                         for o, ln, bi, bo in onode.extents]
+        return freed
+
+    def _make_blob(self, data: bytes) -> Tuple[Blob, List[Tuple[int, bytes]]]:
+        """Build a blob for `data`: maybe compress, allocate blocks,
+        return (blob, [(dev_byte_off, payload)]) pending device writes.
+        Allocator state IS mutated — the caller must release on txn
+        failure."""
+        raw_len = len(data)
+        stored = data
+        flags = 0
+        if (self._comp is not None and raw_len >= self.compress_min):
+            c = self._comp.compress(data)
+            # only keep a win that saves at least one block
+            if (len(c) + self.min_alloc - 1) // self.min_alloc < \
+                    (raw_len + self.min_alloc - 1) // self.min_alloc:
+                stored = c
+                flags = FLAG_COMPRESSED
+        n_blocks = (len(stored) + self.min_alloc - 1) // self.min_alloc
+        runs = [(int(s), int(n))
+                for s, n in self.alloc.allocate(n_blocks)]
+        csums = []
+        writes: List[Tuple[int, bytes]] = []
+        blocks: List[int] = []
+        for start, n in runs:
+            blocks.extend(range(start, start + n))
+        for ci, blk in enumerate(blocks):
+            chunk = stored[ci * self.min_alloc:(ci + 1) * self.min_alloc]
+            csums.append(zlib.crc32(chunk))
+            writes.append((blk * self.min_alloc, chunk))
+        return Blob(flags, raw_len, len(stored), runs, csums), writes
+
+    # ------------------------------------------------------------- write --
+    def apply_transaction(self, txn: Transaction) -> None:
+        with self._lock:
+            self._apply_locked(txn)
+
+    def _apply_locked(self, txn: Transaction) -> None:
+        staged: Dict[Tuple[Coll, str], Optional[Onode]] = {}
+        xattrs: Dict[Tuple[Coll, str, str], Optional[bytes]] = {}
+        omaps: Dict[Tuple[Coll, str, str], Optional[bytes]] = {}
+        pending: List[Tuple[int, bytes]] = []     # COW device writes
+        # deferred in-place updates, keyed per staged object so a
+        # same-txn remove drops them: (dev_byte_off, payload)
+        deferred: Dict[Tuple[Coll, str], List[Tuple[int, bytes]]] = {}
+        newly_allocated: List[Tuple[int, int]] = []
+        to_release: List[Tuple[int, int]] = []
+
+        def stage(coll: Coll, oid: str, create: bool) -> Optional[Onode]:
+            key = (coll, oid)
+            if key not in staged:
+                cur = self._onode(coll, oid)
+                if cur is None:
+                    staged[key] = Onode() if create else None
+                else:
+                    staged[key] = Onode(cur.size,
+                                        [Blob(b.flags, b.raw_len,
+                                              b.stored_len, list(b.runs),
+                                              list(b.csums))
+                                         for b in cur.blobs],
+                                        list(cur.extents))
+            elif staged[key] is None and create:
+                staged[key] = Onode()
+            return staged[key]
+
+        def rm_obj_rows(coll: Coll, oid: str) -> None:
+            ok = _objkey(coll, oid) + "\x00"
+            for prefix, sink in (("xattr", xattrs), ("omap", omaps)):
+                for k, _ in self.kv.iterate(prefix, start=ok):
+                    if not k.startswith(ok):
+                        break
+                    sink[(coll, oid, k[len(ok):])] = None
+            for sink in (xattrs, omaps):
+                for (c2, o2, k2) in list(sink):
+                    if (c2, o2) == (coll, oid):
+                        sink[(c2, o2, k2)] = None
+
+        fresh_blobs: set = set()              # id(blob) created this txn
+
+        def maybe_compact(o: Onode, key) -> None:
+            """Extent-map defragmentation (the BlueStore blob-gc role):
+            once an object's map outgrows ``compact_extents``, rewrite
+            it as one blob.  Only safe when every referenced byte is
+            committed on the device (no fresh blobs, no pending
+            deferred merges for this object)."""
+            if len(o.extents) < self.compact_extents or \
+                    key in deferred or \
+                    any(id(o.blobs[bi]) in fresh_blobs
+                        for _, _, bi, _ in o.extents):
+                return
+            content = self._read_onode(o, 0, o.size)
+            for b in o.blobs:
+                to_release.extend(b.runs)
+            o.blobs = []
+            o.extents = []
+            if content:
+                new_blob(o, content, 0)
+
+        def new_blob(o: Onode, data: bytes, obj_off: int) -> None:
+            blob, writes = self._make_blob(data)
+            fresh_blobs.add(id(blob))
+            newly_allocated.extend(blob.runs)
+            pending.extend(writes)
+            self._punch(o, obj_off, len(data))
+            o.blobs.append(blob)
+            o.extents.append((obj_off, len(data), len(o.blobs) - 1, 0))
+            o.extents.sort()
+            to_release.extend(self._reap_blobs(o))
+
+        def try_deferred(o: Onode, key, obj_off: int,
+                         data: bytes) -> bool:
+            """Small overwrite fully inside ONE uncompressed extent →
+            merge into the affected stored blocks in place; payload
+            rides the KV batch (the BlueStore deferred-write path)."""
+            if len(data) > self.deferred_max:
+                return False
+            for e_off, e_len, bi, b_off in o.extents:
+                if not (e_off <= obj_off and
+                        obj_off + len(data) <= e_off + e_len):
+                    continue
+                blob = o.blobs[bi]
+                if blob.compressed or id(blob) in fresh_blobs:
+                    # fresh blobs' COW bytes are not on the device yet
+                    # — read-merge would see garbage; take the COW path
+                    return False
+                s0 = b_off + (obj_off - e_off)      # stored offset
+                s1 = s0 + len(data)
+                c0 = s0 // self.min_alloc
+                c1 = (s1 + self.min_alloc - 1) // self.min_alloc
+                lo = c0 * self.min_alloc
+                blocks = self._blob_block_list(blob)
+                prior = deferred.get(key, [])
+                # read-merge per touched stored block: a prior same-txn
+                # deferred payload for the block IS its current content
+                # (the device is stale until post-commit apply);
+                # otherwise read the device and verify its crc
+                cur = bytearray()
+                for ci in range(c0, c1):
+                    bs = blocks[ci] * self.min_alloc
+                    hit = next((p for off2, p in reversed(prior)
+                                if off2 == bs), None)
+                    if hit is not None:
+                        chunk = hit
+                    else:
+                        blk_end = min((ci + 1) * self.min_alloc,
+                                      blob.stored_len)
+                        chunk = self._read_stored(
+                            blob, ci * self.min_alloc, blk_end)
+                    cur.extend(chunk)
+                cur[s0 - lo:s1 - lo] = data
+                # per-block csum refresh + device payloads
+                dq = deferred.setdefault(key, [])
+                for ci in range(c0, c1):
+                    blo = (ci - c0) * self.min_alloc
+                    chunk = bytes(cur[blo:blo + self.min_alloc])
+                    blob.csums[ci] = zlib.crc32(chunk)
+                    dq.append((blocks[ci] * self.min_alloc, chunk))
+                return True
+            return False
+
+        try:
+            for op in txn.ops:
+                kind = op[0]
+                if kind == OP_TOUCH:
+                    _, coll, oid = op
+                    stage(coll, oid, create=True)
+                elif kind == OP_WRITE_FULL:
+                    _, coll, oid, data = op
+                    o = stage(coll, oid, create=True)
+                    # drop the whole extent map, then write one blob
+                    for b in o.blobs:
+                        to_release.extend(b.runs)
+                    o.blobs = []
+                    o.extents = []
+                    o.size = len(data)
+                    if data:
+                        new_blob(o, bytes(data), 0)
+                    deferred.pop((coll, oid), None)
+                elif kind == OP_WRITE:
+                    _, coll, oid, offset, data = op
+                    o = stage(coll, oid, create=True)
+                    o.size = max(o.size, offset + len(data))
+                    if not data:
+                        continue
+                    if not try_deferred(o, (coll, oid), offset,
+                                        bytes(data)):
+                        maybe_compact(o, (coll, oid))
+                        new_blob(o, bytes(data), offset)
+                elif kind == OP_TRUNCATE:
+                    _, coll, oid, size = op
+                    o = stage(coll, oid, create=False)
+                    if o is None:
+                        raise ObjectStoreError(
+                            f"truncate: no object {oid}")
+                    if size < o.size:
+                        self._punch(o, size, o.size - size)
+                        to_release.extend(self._reap_blobs(o))
+                    o.size = size
+                elif kind == OP_REMOVE:
+                    _, coll, oid = op
+                    o = stage(coll, oid, create=False)
+                    if o is None:
+                        raise ObjectStoreError(f"remove: no object {oid}")
+                    for b in o.blobs:
+                        to_release.extend(b.runs)
+                    staged[(coll, oid)] = None
+                    deferred.pop((coll, oid), None)
+                    rm_obj_rows(coll, oid)
+                elif kind == OP_SETATTR:
+                    _, coll, oid, key, value = op
+                    if stage(coll, oid, create=False) is None:
+                        raise ObjectStoreError(f"setattr: no object {oid}")
+                    xattrs[(coll, oid, key)] = value
+                elif kind == OP_OMAP_SET:
+                    _, coll, oid, key, value = op
+                    if stage(coll, oid, create=False) is None:
+                        raise ObjectStoreError(
+                            f"omap_set: no object {oid}")
+                    omaps[(coll, oid, key)] = value
+                elif kind == OP_OMAP_RM:
+                    _, coll, oid, key = op
+                    if stage(coll, oid, create=False) is None:
+                        raise ObjectStoreError(f"omap_rm: no object {oid}")
+                    if omaps.get((coll, oid, key), b"") is None or (
+                            (coll, oid, key) not in omaps and
+                            self.kv.get(
+                                "omap",
+                                _objkey(coll, oid) + "\x00" + key)
+                            is None):
+                        raise ObjectStoreError(f"omap_rm: no key {key}")
+                    omaps[(coll, oid, key)] = None
+                else:
+                    raise ObjectStoreError(f"unknown txn op {kind!r}")
+        except Exception:
+            # roll back this txn's allocations; nothing hit the KV
+            for start, n in newly_allocated:
+                self.alloc.release(start, n)
+            raise
+
+        # ---- COW data to the device FIRST (commit point is the KV) ----
+        for dev_off, payload in pending:
+            os.pwrite(self._dev, payload, dev_off)
+        if pending and self.fsync:
+            os.fsync(self._dev)
+
+        batch = WriteBatch()
+        def_rows: List[Tuple[str, int, bytes]] = []
+        seq = self.txns_applied
+        for (coll, oid), onode in staged.items():
+            key = _objkey(coll, oid)
+            if onode is None:
+                batch.rm("onode", key)
+            else:
+                batch.set("onode", key, onode.encode())
+        for (coll, oid, key), val in xattrs.items():
+            row = _objkey(coll, oid) + "\x00" + key
+            if val is None:
+                batch.rm("xattr", row)
+            else:
+                batch.set("xattr", row, val)
+        for (coll, oid, key), val in omaps.items():
+            row = _objkey(coll, oid) + "\x00" + key
+            if val is None:
+                batch.rm("omap", row)
+            else:
+                batch.set("omap", row, val)
+        for key, writes in deferred.items():
+            if staged.get(key) is None:
+                continue                      # object died this txn
+            for i, (dev_off, payload) in enumerate(writes):
+                row = f"{seq:016d}.{len(def_rows):04d}"
+                batch.set("deferred", row,
+                          _DEF.pack(dev_off, len(payload)) + payload)
+                def_rows.append((row, dev_off, payload))
+        self.kv.submit(batch)                 # ← the atomic commit point
+        self.txns_applied += 1
+
+        # ---- post-commit: deferred in-place applies, then cleanup ----
+        if def_rows:
+            clear = WriteBatch()
+            for row, dev_off, payload in def_rows:
+                os.pwrite(self._dev, payload, dev_off)
+                clear.rm("deferred", row)
+            self.deferred_applied += len(def_rows)
+            self.kv.submit(clear)
+        for start, n in to_release:
+            self.alloc.release(start, n)
+
+    # -------------------------------------------------------------- read --
+    # Reads hold the store lock: the post-commit deferred apply (and
+    # allocator release) must not interleave with a reader that already
+    # fetched the NEW onode but would see the OLD device bytes — that
+    # window would surface as a spurious EIO on committed data.
+    def _get(self, coll: Coll, oid: str) -> Onode:
+        o = self._onode(coll, oid)
+        if o is None:
+            raise ObjectStoreError(f"no object {oid} in {coll}")
+        return o
+
+    def exists(self, coll: Coll, oid: str) -> bool:
+        return self.kv.get("onode", _objkey(coll, oid)) is not None
+
+    def _read_onode(self, o: Onode, offset: int, end: int) -> bytes:
+        if end <= offset:
+            return b""
+        out = bytearray(end - offset)         # holes read as zeros
+        for e_off, e_len, bi, b_off in o.extents:
+            lo = max(e_off, offset)
+            hi = min(e_off + e_len, end)
+            if hi <= lo:
+                continue
+            raw = self._read_raw(o.blobs[bi], b_off + (lo - e_off),
+                                 b_off + (hi - e_off))
+            out[lo - offset:hi - offset] = raw
+        return bytes(out)
+
+    def read(self, coll: Coll, oid: str, offset: int = 0,
+             length: Optional[int] = None) -> bytes:
+        with self._lock:
+            o = self._get(coll, oid)
+            end = (o.size if length is None
+                   else min(offset + length, o.size))
+            return self._read_onode(o, offset, end)
+
+    def stat(self, coll: Coll, oid: str) -> Dict[str, int]:
+        with self._lock:
+            o = self._get(coll, oid)
+            # 'csum' is a CONTENT digest (crc over the logical bytes),
+            # not a layout digest — replicas with different extent
+            # histories must agree, that is what scrub compares
+            return {"size": o.size,
+                    "csum": zlib.crc32(self._read_onode(o, 0, o.size)),
+                    "allocated": sum(b.n_blocks() for b in o.blobs)
+                    * self.min_alloc,
+                    "stored": sum(b.stored_len for b in o.blobs),
+                    "extents": len(o.extents)}
+
+    def getattr(self, coll: Coll, oid: str, key: str) -> bytes:
+        with self._lock:
+            v = self.kv.get("xattr", _objkey(coll, oid) + "\x00" + key)
+            if v is None:
+                self._get(coll, oid)   # object-missing error first
+                raise KeyError(key)
+            return v
+
+    def omap_get(self, coll: Coll, oid: str, key: str) -> bytes:
+        with self._lock:
+            v = self.kv.get("omap", _objkey(coll, oid) + "\x00" + key)
+            if v is None:
+                self._get(coll, oid)
+                raise KeyError(key)
+            return v
+
+    def omap_list(self, coll: Coll, oid: str,
+                  start: str = "") -> List[Tuple[str, bytes]]:
+        """All omap rows of an object from ``start`` (sorted) — the
+        ObjectMap::get_iterator role (PG logs live here)."""
+        with self._lock:
+            ok = _objkey(coll, oid) + "\x00"
+            out = []
+            for k, v in self.kv.iterate("omap", start=ok + start):
+                if not k.startswith(ok):
+                    break
+                out.append((k[len(ok):], v))
+            return out
+
+    def list_objects(self, coll: Coll) -> List[str]:
+        ck = _collkey(coll) + "/"
+        out = []
+        for k, _ in self.kv.iterate("onode", start=ck):
+            if not k.startswith(ck):
+                break
+            out.append(k[len(ck):])
+        return sorted(out)
+
+    def list_collections(self) -> List[Coll]:
+        seen = set()
+        for k, _ in self.kv.iterate("onode"):
+            seen.add(_split_objkey(k)[0])
+        return sorted(seen)
+
+    def verify(self, coll: Coll, oid: str) -> bool:
+        with self._lock:
+            try:
+                o = self._onode(coll, oid)
+                if o is None:
+                    return False
+                for b in o.blobs:
+                    self._read_stored(b, 0, b.stored_len)
+                return True
+            except (ChecksumError, ObjectStoreError):
+                return False
+
+    # ------------------------------------------------------------- fsck --
+    def fsck(self) -> List[Tuple[Coll, str]]:
+        """Walk every onode: csum-verify all stored bytes, bounds-check
+        extents, and rebuild the allocation bitmap to detect
+        double-allocated blocks (the BlueStore fsck roles)."""
+        with self._lock:
+            return self._fsck_locked()
+
+    def _fsck_locked(self) -> List[Tuple[Coll, str]]:
+        bad = []
+        shadow = BitmapAllocator(self.n_blocks)
+        for key, raw in self.kv.iterate("onode"):
+            coll, oid = _split_objkey(key)
+            ok = True
+            try:
+                o = Onode.decode(raw)
+                for b in o.blobs:
+                    for start, n in b.runs:
+                        shadow.mark(start, n)
+                    want = ((b.stored_len + self.min_alloc - 1)
+                            // self.min_alloc)
+                    if b.n_blocks() < want or len(b.csums) != want:
+                        raise ObjectStoreError("blob geometry")
+                    self._read_stored(b, 0, b.stored_len)
+                for e_off, e_len, bi, b_off in o.extents:
+                    blob = o.blobs[bi]
+                    if b_off + e_len > blob.raw_len or \
+                            e_off + e_len > o.size:
+                        raise ObjectStoreError("extent bounds")
+            except (ChecksumError, ObjectStoreError, AllocatorError,
+                    struct.error, IndexError):
+                ok = False
+            if not ok:
+                bad.append((coll, oid))
+        return bad
+
+    def close(self) -> None:
+        with self._lock:
+            self.kv.close()
+            try:
+                os.close(self._dev)
+            except OSError:
+                pass
+
+    # --------------------------------------------------------- test hook --
+    def corrupt(self, coll: Coll, oid: str, offset: int = 0) -> None:
+        """Flip a stored device byte under `offset` WITHOUT updating
+        the blob csum (EIO injection)."""
+        with self._lock:
+            self._corrupt_locked(coll, oid, offset)
+
+    def _corrupt_locked(self, coll: Coll, oid: str, offset: int) -> None:
+        o = self._get(coll, oid)
+        for e_off, e_len, bi, b_off in o.extents:
+            if not (e_off <= offset < e_off + e_len):
+                continue
+            blob = o.blobs[bi]
+            s = b_off + (offset - e_off) if not blob.compressed else 0
+            blocks = self._blob_block_list(blob)
+            dev_off = blocks[s // self.min_alloc] * self.min_alloc + \
+                (s % self.min_alloc)
+            cur = os.pread(self._dev, 1, dev_off)
+            os.pwrite(self._dev, bytes([cur[0] ^ 0xFF]), dev_off)
+            return
+        raise ObjectStoreError(f"corrupt: no extent at {offset}")

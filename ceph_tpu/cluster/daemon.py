@@ -565,11 +565,35 @@ class OSDDaemon:
         self.entity = f"osd.{osd_id}"
         self.keyring = cx.Keyring.load(
             os.path.join(cluster_dir, f"keyring.osd.{osd_id}"))
-        from .filestore import FileStore
         spec = json.load(open(os.path.join(cluster_dir, "cluster.json")))
-        self.store = FileStore(
-            os.path.join(cluster_dir, f"osd.{osd_id}.store"),
-            fsync=bool(spec.get("fsync", True)))
+        store_path = os.path.join(cluster_dir, f"osd.{osd_id}.store")
+        # objectstore backend selection (the reference's osd_objectstore
+        # option, src/common/options.cc): bluestore is the flagship
+        # block-device extent store, filestore the log-structured one
+        backend = spec.get("objectstore", "filestore")
+        # daemons skip the full csum walk at mount by default (the
+        # reference ships bluestore_fsck_on_mount=false: restart
+        # latency must not scale with store size); opt in via the spec
+        fsck_on_mount = bool(spec.get("fsck_on_mount", False))
+        if backend == "bluestore":
+            from .bluestore import BlueStore
+            self.store = BlueStore(
+                store_path, fsync=bool(spec.get("fsync", True)),
+                device_bytes=int(spec.get("bluestore_device_bytes",
+                                          1 << 28)),
+                min_alloc=int(spec.get("bluestore_min_alloc_size",
+                                       4096)),
+                compression=spec.get(
+                    "bluestore_compression_algorithm") or None,
+                fsck_on_mount=fsck_on_mount)
+        elif backend == "memstore":
+            from .objectstore import MemStore
+            self.store = MemStore()
+        else:
+            from .filestore import FileStore
+            self.store = FileStore(
+                store_path, fsync=bool(spec.get("fsync", True)),
+                fsck_on_mount=fsck_on_mount)
         from ..msg.scheduler import MClockScheduler
         self.sched = MClockScheduler()
         self._sched_lock = threading.Lock()

@@ -244,6 +244,13 @@ class MemStore:
     def omap_get(self, coll: Coll, oid: str, key: str) -> bytes:
         return self._get(coll, oid).omap[key]
 
+    def omap_list(self, coll: Coll, oid: str,
+                  start: str = "") -> List[Tuple[str, bytes]]:
+        """All omap rows of an object from ``start`` (sorted) — the
+        ObjectMap::get_iterator role (PG logs live here)."""
+        o = self._get(coll, oid)
+        return [(k, o.omap[k]) for k in sorted(o.omap) if k >= start]
+
     def list_objects(self, coll: Coll) -> List[str]:
         return sorted(self._colls.get(coll, {}))
 

@@ -265,6 +265,19 @@ _TABLE: Tuple[Option, ...] = (
            "encode/decode/recovery consume the staged planes without "
            "host round-trips; the objectstore keeps the same bytes as "
            "the durable tier"),
+    Option("osd_objectstore", TYPE_STR, "filestore",
+           "ObjectStore backend for OSD daemons (reference: "
+           "osd_objectstore, src/common/options.cc): bluestore = "
+           "block-device extent store with allocator/csum/compression/"
+           "deferred writes (cluster/bluestore.py); filestore = "
+           "log-structured store; memstore = RAM (tests)",
+           enum_values=("bluestore", "filestore", "memstore")),
+    Option("bluestore_min_alloc_size", TYPE_INT, 4096,
+           "block granularity of the BlueStore allocator and csum "
+           "unit (reference: bluestore_min_alloc_size)", min=64),
+    Option("bluestore_compression_algorithm", TYPE_STR, "",
+           "compressor plugin for BlueStore blobs ('' = off; "
+           "reference: bluestore_compression_algorithm)"),
     Option("perf_counters_enabled", TYPE_BOOL, True,
            "collect dispatch/cache/bytes counters"),
 )

@@ -50,7 +50,7 @@ def ensure_built(force: bool = False) -> str:
     """Build the shared object if missing or stale; returns its path."""
     srcs = [os.path.join(_NATIVE_DIR, f)
             for f in ("crush_native.cpp", "gf_native.cpp",
-                      "msgqueue.cpp", "Makefile")]
+                      "msgqueue.cpp", "allocator_native.cpp", "Makefile")]
     stale = (not os.path.exists(_SO) or
              any(os.path.getmtime(s) > os.path.getmtime(_SO)
                  for s in srcs if os.path.exists(s)))
@@ -101,6 +101,22 @@ def lib() -> ctypes.CDLL:
             _LIB.ceph_tpu_gf2_xor_regions.argtypes = [
                 _U8P, ctypes.c_int32, ctypes.c_int32, _U8P, _U8P,
                 ctypes.c_int64]
+            _U64P = ctypes.POINTER(ctypes.c_uint64)
+            _LIB.ceph_tpu_alloc_init.restype = None
+            _LIB.ceph_tpu_alloc_init.argtypes = [_U64P, ctypes.c_int64]
+            _LIB.ceph_tpu_alloc_count_free.restype = ctypes.c_int64
+            _LIB.ceph_tpu_alloc_count_free.argtypes = [
+                _U64P, ctypes.c_int64]
+            _LIB.ceph_tpu_alloc_mark.restype = ctypes.c_int
+            _LIB.ceph_tpu_alloc_mark.argtypes = [
+                _U64P, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64]
+            _LIB.ceph_tpu_alloc_release.restype = ctypes.c_int
+            _LIB.ceph_tpu_alloc_release.argtypes = [
+                _U64P, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64]
+            _LIB.ceph_tpu_alloc_runs.restype = ctypes.c_int
+            _LIB.ceph_tpu_alloc_runs.argtypes = [
+                _U64P, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+                _I64P, ctypes.c_int]
             _LIB.ceph_tpu_has_avx2.restype = ctypes.c_int
             _LIB.ceph_tpu_hash2.restype = ctypes.c_uint32
             _LIB.ceph_tpu_hash2.argtypes = [ctypes.c_uint32, ctypes.c_uint32]
@@ -290,6 +306,148 @@ def gf2_xor_regions(bitmat: np.ndarray, planes: np.ndarray) -> np.ndarray:
         bitmat.ctypes.data_as(_U8P), np.int32(R), np.int32(C),
         planes.ctypes.data_as(_U8P), out.ctypes.data_as(_U8P), np.int64(P))
     return out
+
+
+# ---------------------------------------------------------------- allocator --
+
+_U64PTR = ctypes.POINTER(ctypes.c_uint64)
+
+
+class AllocatorError(RuntimeError):
+    pass
+
+
+class BitmapAllocator:
+    """Block-space allocator over a numpy uint64 bitmap (the BlueStore
+    Allocator family role — src/os/bluestore/BitmapAllocator.h).  The
+    bitmap itself is plain numpy so the owning store can rebuild it from
+    object metadata at mount (the post-Pacific BlueStore NCB freelist
+    stance: no persisted freelist, recover allocations from onodes).
+
+    A pure-numpy fallback keeps the store importable without a
+    toolchain; the native path is the default.
+    """
+
+    def __init__(self, n_blocks: int, use_native: bool = True):
+        if n_blocks <= 0:
+            raise ValueError("n_blocks must be positive")
+        self.n_blocks = int(n_blocks)
+        self._words = np.zeros((self.n_blocks + 63) // 64, dtype=np.uint64)
+        self._native = False
+        if use_native:
+            try:
+                lib().ceph_tpu_alloc_init(
+                    self._words.ctypes.data_as(_U64PTR),
+                    np.int64(self.n_blocks))
+                self._native = True
+            except NativeUnavailable:
+                pass
+        if not self._native:
+            rem = self.n_blocks % 64
+            if rem:
+                self._words[-1] = np.uint64(
+                    (0xFFFFFFFFFFFFFFFF << rem) & 0xFFFFFFFFFFFFFFFF)
+
+    @property
+    def free_blocks(self) -> int:
+        if self._native:
+            return int(lib().ceph_tpu_alloc_count_free(
+                self._words.ctypes.data_as(_U64PTR),
+                np.int64(self.n_blocks)))
+        used = int(np.unpackbits(
+            self._words.view(np.uint8)).sum())
+        return self._words.size * 64 - used
+
+    def _bits(self) -> np.ndarray:
+        """Bit array [n_words*64], little-endian bit order per word."""
+        by = self._words.view(np.uint8)
+        return np.unpackbits(by, bitorder="little")
+
+    def allocate(self, want: int, hint: int = 0):
+        """Allocate `want` blocks; returns list of (start, len) runs.
+        Raises AllocatorError when space is insufficient (no partial
+        allocation escapes)."""
+        if want <= 0:
+            return []
+        max_runs = max(16, min(4096, int(want)))
+        if self._native:
+            out = np.empty(2 * max_runs, dtype=np.int64)
+            rc = lib().ceph_tpu_alloc_runs(
+                self._words.ctypes.data_as(_U64PTR),
+                np.int64(self.n_blocks), np.int64(want), np.int64(hint),
+                out.ctypes.data_as(_I64P), np.int32(max_runs))
+            if rc >= 0:
+                return [(int(out[2 * i]), int(out[2 * i + 1]))
+                        for i in range(rc)]
+            if self.free_blocks < want:
+                raise AllocatorError(
+                    f"cannot allocate {want} blocks "
+                    f"({self.free_blocks} free)")
+            # enough space but the run table overflowed (severe
+            # fragmentation): the vectorized path below has no run cap
+        # numpy fallback: greedy first-fit over free runs
+        bits = self._bits()[:self.n_blocks]
+        free_idx = np.flatnonzero(bits == 0)
+        if len(free_idx) < want:
+            raise AllocatorError(
+                f"cannot allocate {want} blocks ({len(free_idx)} free)")
+        order = np.concatenate([free_idx[free_idx >= hint],
+                                free_idx[free_idx < hint]])
+        take = np.sort(order[:want])
+        runs = []
+        run_start = prev = int(take[0])
+        for b in take[1:]:
+            b = int(b)
+            if b == prev + 1:
+                prev = b
+                continue
+            runs.append((run_start, prev - run_start + 1))
+            run_start = prev = b
+        runs.append((run_start, prev - run_start + 1))
+        for s, ln in runs:
+            self.mark(s, ln)
+        return runs
+
+    def mark(self, start: int, length: int) -> None:
+        """Mark [start, start+len) allocated; AllocatorError on overlap
+        (mount-time rebuild uses this to detect double-allocation)."""
+        if self._native:
+            rc = lib().ceph_tpu_alloc_mark(
+                self._words.ctypes.data_as(_U64PTR),
+                np.int64(self.n_blocks), np.int64(start),
+                np.int64(length))
+            if rc != 0:
+                raise AllocatorError(
+                    f"mark [{start},+{length}): overlap/out-of-range")
+            return
+        if start < 0 or length <= 0 or start + length > self.n_blocks:
+            raise AllocatorError(f"mark [{start},+{length}): out of range")
+        for b in range(start, start + length):
+            w, bit = b // 64, b % 64
+            m = np.uint64(1 << bit)
+            if self._words[w] & m:
+                raise AllocatorError(f"mark {b}: already allocated")
+            self._words[w] |= m
+
+    def release(self, start: int, length: int) -> None:
+        if self._native:
+            rc = lib().ceph_tpu_alloc_release(
+                self._words.ctypes.data_as(_U64PTR),
+                np.int64(self.n_blocks), np.int64(start),
+                np.int64(length))
+            if rc != 0:
+                raise AllocatorError(
+                    f"release [{start},+{length}): double free/range")
+            return
+        if start < 0 or length <= 0 or start + length > self.n_blocks:
+            raise AllocatorError(
+                f"release [{start},+{length}): out of range")
+        for b in range(start, start + length):
+            w, bit = b // 64, b % 64
+            m = np.uint64(1 << bit)
+            if not (self._words[w] & m):
+                raise AllocatorError(f"release {b}: double free")
+            self._words[w] &= ~m
 
 
 def gf2_xor_regions_batch(bitmat: np.ndarray,

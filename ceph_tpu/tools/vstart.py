@@ -28,7 +28,12 @@ from ..common import auth as cx
 def build_cluster_dir(cluster_dir: str, n_osds: int = 6,
                       osds_per_host: int = 2,
                       pools: Optional[List[dict]] = None,
-                      fsync: bool = True, n_mons: int = 1) -> None:
+                      fsync: bool = True, n_mons: int = 1,
+                      objectstore: str = "filestore",
+                      bluestore_device_bytes: int = 1 << 28,
+                      bluestore_min_alloc_size: int = 4096,
+                      bluestore_compression: str = "",
+                      fsck_on_mount: bool = False) -> None:
     """Write crushmap.txt, cluster.json and keyrings."""
     os.makedirs(cluster_dir, exist_ok=True)
     from ..placement.builder import TYPE_HOST, build_flat_cluster
@@ -51,7 +56,11 @@ def build_cluster_dir(cluster_dir: str, n_osds: int = 6,
         pools = [{"id": 1, "name": "rep", "type": 1, "size": 3,
                   "pg_num": 16, "crush_rule": 0}]
     json.dump({"pools": pools, "fsync": fsync, "n_osds": n_osds,
-               "n_mons": n_mons},
+               "n_mons": n_mons, "objectstore": objectstore,
+               "bluestore_device_bytes": bluestore_device_bytes,
+               "bluestore_min_alloc_size": bluestore_min_alloc_size,
+               "bluestore_compression_algorithm": bluestore_compression,
+               "fsck_on_mount": fsck_on_mount},
               open(os.path.join(cluster_dir, "cluster.json"), "w"))
     names = ["mon.", "client.admin"] + \
         [f"mon.{r}" for r in range(n_mons)] + \

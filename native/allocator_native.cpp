@@ -1,0 +1,135 @@
+// Block-space bitmap allocator — the BlueStore Allocator family role
+// (reference: src/os/bluestore/BitmapAllocator.h, Allocator.h — re-designed,
+// not ported: state is a caller-owned uint64 bitmap so Python owns
+// persistence/rebuild and the C++ side is pure, reentrant bit-scan math).
+//
+// Bit semantics: bit SET = block allocated, bit CLEAR = free.
+// Words are little-endian uint64; block b lives in words[b >> 6] bit (b & 63).
+//
+// ceph_tpu_alloc_runs: allocate `want` blocks as few contiguous runs,
+// first-fit from `hint` with 64-bit full-word skip, greedy longest-run
+// extension.  Marks bits in place and emits (start,len) run pairs.
+// Returns run count, or -1 on insufficient space / run-table overflow
+// (state is rolled back on failure so the bitmap never leaks).
+#include <cstdint>
+#include <cstring>
+
+extern "C" {
+
+static inline int ctz64(uint64_t v) { return __builtin_ctzll(v); }
+
+int64_t ceph_tpu_alloc_count_free(const uint64_t* words, int64_t n_blocks) {
+    int64_t n_words = (n_blocks + 63) >> 6;
+    int64_t used = 0;
+    for (int64_t i = 0; i < n_words; ++i)
+        used += __builtin_popcountll(words[i]);
+    // bits past n_blocks in the tail word are kept SET by init so they
+    // can never be handed out; they count as "used" here, which cancels
+    // exactly against the (n_words << 6) - n_blocks padding
+    return (n_words << 6) - used;
+}
+
+// Seal tail bits (past n_blocks) as allocated so scans never return them.
+void ceph_tpu_alloc_init(uint64_t* words, int64_t n_blocks) {
+    int64_t n_words = (n_blocks + 63) >> 6;
+    memset(words, 0, (size_t)n_words * 8);
+    int rem = (int)(n_blocks & 63);
+    if (rem)
+        words[n_words - 1] = ~0ULL << rem;
+}
+
+// Mark [start, start+len) allocated.  Returns 0, or -1 if any bit was
+// already set (double allocation — fsck uses this to detect overlap).
+int ceph_tpu_alloc_mark(uint64_t* words, int64_t n_blocks,
+                        int64_t start, int64_t len) {
+    if (start < 0 || len <= 0 || start + len > n_blocks) return -1;
+    for (int64_t b = start; b < start + len;) {
+        int64_t w = b >> 6;
+        int bit = (int)(b & 63);
+        int take = 64 - bit;
+        if (b + take > start + len) take = (int)(start + len - b);
+        uint64_t mask = (take == 64) ? ~0ULL : (((1ULL << take) - 1) << bit);
+        if (words[w] & mask) return -1;
+        words[w] |= mask;
+        b += take;
+    }
+    return 0;
+}
+
+// Free [start, start+len).  Returns 0, or -1 if any bit was already
+// clear (double free).
+int ceph_tpu_alloc_release(uint64_t* words, int64_t n_blocks,
+                           int64_t start, int64_t len) {
+    if (start < 0 || len <= 0 || start + len > n_blocks) return -1;
+    for (int64_t b = start; b < start + len;) {
+        int64_t w = b >> 6;
+        int bit = (int)(b & 63);
+        int take = 64 - bit;
+        if (b + take > start + len) take = (int)(start + len - b);
+        uint64_t mask = (take == 64) ? ~0ULL : (((1ULL << take) - 1) << bit);
+        if ((words[w] & mask) != mask) return -1;
+        words[w] &= ~mask;
+        b += take;
+    }
+    return 0;
+}
+
+// Length of the free run starting exactly at block b (0 if allocated).
+static int64_t run_len_at(const uint64_t* words, int64_t n_bits, int64_t b,
+                          int64_t cap) {
+    int64_t len = 0;
+    while (b < n_bits && len < cap) {
+        int64_t w = b >> 6;
+        int bit = (int)(b & 63);
+        uint64_t v = words[w] >> bit;      // shifted: bit0 = block b
+        int avail = 64 - bit;
+        if (v == 0) { len += avail; b += avail; continue; }
+        int first_set = ctz64(v);
+        len += first_set;
+        return len > cap ? cap : len;
+    }
+    return len > cap ? cap : len;
+}
+
+int ceph_tpu_alloc_runs(uint64_t* words, int64_t n_blocks, int64_t want,
+                        int64_t hint, int64_t* out_runs, int max_runs) {
+    if (want <= 0) return 0;
+    int64_t n_words = (n_blocks + 63) >> 6;
+    int64_t n_bits = n_words << 6;         // tail bits are sealed SET
+    if (hint < 0 || hint >= n_blocks) hint = 0;
+    int nruns = 0;
+    int64_t got = 0;
+    // two passes: [hint, end) then [0, hint)
+    for (int pass = 0; pass < 2 && got < want; ++pass) {
+        int64_t b = pass ? 0 : hint;
+        int64_t end = pass ? hint : n_blocks;
+        while (b < end && got < want) {
+            int64_t w = b >> 6;
+            int bit = (int)(b & 63);
+            uint64_t v = ~(words[w] | ((bit == 0) ? 0ULL
+                                       : ((1ULL << bit) - 1)));
+            if (v == 0) { b = (w + 1) << 6; continue; }   // word full
+            int64_t free_b = (w << 6) + ctz64(v);
+            if (free_b >= end) break;
+            int64_t len = run_len_at(words, n_bits, free_b, want - got);
+            if (free_b + len > end) len = end - free_b;
+            if (len <= 0) { b = free_b + 1; continue; }
+            if (nruns >= max_runs) goto fail;
+            ceph_tpu_alloc_mark(words, n_blocks, free_b, len);
+            out_runs[2 * nruns] = free_b;
+            out_runs[2 * nruns + 1] = len;
+            ++nruns;
+            got += len;
+            b = free_b + len;
+        }
+    }
+    if (got < want) goto fail;
+    return nruns;
+fail:
+    for (int i = 0; i < nruns; ++i)
+        ceph_tpu_alloc_release(words, n_blocks, out_runs[2 * i],
+                               out_runs[2 * i + 1]);
+    return -1;
+}
+
+}  // extern "C"

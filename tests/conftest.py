@@ -52,6 +52,7 @@ SLOW_TESTS = {
     "test_osdmaptool_test_map_pgs",
     "test_scalar_batch_consistency_replicated",
     "test_ec_recovery_after_kill",
+    "test_daemon_cluster_on_bluestore",
 }
 
 
