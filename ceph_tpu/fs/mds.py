@@ -35,16 +35,40 @@ class FSError(IOError):
     pass
 
 
+class ForwardError(FSError):
+    """This MDS is not authoritative for the path — retry against
+    `rank` (the reference forwards the request between MDSs instead,
+    MDSRank::forward; here the client/facade re-routes)."""
+
+    def __init__(self, rank: int, path: str):
+        super().__init__(f"not authoritative for {path}: rank {rank}")
+        self.rank = rank
+        self.path = path
+
+
 class MDS:
-    """Metadata server over (metadata ioctx, data ioctx)."""
+    """Metadata server over (metadata ioctx, data ioctx).
+
+    Single-MDS by default.  In a multi-rank cluster (fs/multimds.py)
+    each rank gets its own journal ("mdlog.<rank>") and an MDSMap;
+    mutations, capabilities and locks are only served for paths whose
+    subtree this rank owns — others raise ForwardError (the request-
+    forwarding role).  Plain reads (stat/listdir/read_file) are served
+    by any rank: dirfrags are shared RADOS objects, and coherence is
+    enforced where it matters, at the cap/lock/mutation layer.
+    """
 
     def __init__(self, meta_ioctx, data_ioctx,
-                 layout: Optional[FileLayout] = None):
+                 layout: Optional[FileLayout] = None,
+                 rank: Optional[int] = None, mdsmap=None):
         self.meta = meta_ioctx
         self.data = data_ioctx
+        self.rank = rank
+        self.mdsmap = mdsmap
         self.layout = layout or FileLayout(
             stripe_unit=1 << 16, stripe_count=1, object_size=1 << 16)
-        self.journal = Journaler(meta_ioctx, "mdlog")
+        jname = "mdlog" if rank is None else f"mdlog.{rank}"
+        self.journal = Journaler(meta_ioctx, jname)
         # ino allocator recovers from the durable InoTable object, not
         # only the (possibly trimmed) journal window (InoTable role)
         self._next_ino = ROOT_INO + 1
@@ -131,6 +155,12 @@ class MDS:
                 self.meta.remove(self._dir_oid(op["ino"]))
             except Exception:
                 pass
+        elif kind == "link_dentry":
+            # destination half of a cross-rank rename (multimds.py):
+            # link an existing inode's dentry into this rank's subtree
+            d = self._read_dir(op["parent"])
+            d[op["name"]] = dict(op["ent"])
+            self._write_dir(op["parent"], d)
         elif kind == "rename":
             src = self._read_dir(op["src_parent"])
             ent = src.pop(op["src_name"], None)
@@ -154,6 +184,53 @@ class MDS:
                 self._apply(json.loads(payload.decode()))
             except FSError:
                 pass           # partially-applied op against lost frag
+
+    # ---------------------------------------------------------- authority --
+    def _check_auth(self, path: str) -> None:
+        """Raise ForwardError when another rank owns this subtree."""
+        if self.rank is None or self.mdsmap is None:
+            return
+        owner = self.mdsmap.auth_rank(path)
+        if owner != self.rank:
+            raise ForwardError(owner, path)
+
+    def subtree_inos(self, path: str) -> List[int]:
+        """Every inode under (and including) the directory at `path` —
+        the set whose session state must move on subtree export."""
+        ent = self._lookup(path)
+        inos = [ent["ino"]]
+        if ent["type"] != "dir":
+            return inos
+        stack = [ent["ino"]]
+        while stack:
+            ino = stack.pop()
+            for child in self._read_dir(ino).values():
+                inos.append(child["ino"])
+                if child["type"] == "dir":
+                    stack.append(child["ino"])
+        return inos
+
+    def export_subtree(self, path: str, to_rank: int) -> List[int]:
+        """Source half of a subtree migration (the Migrator export
+        role, with cap/lock state flushed-and-dropped rather than
+        migrated — clients reacquire against the new rank, the
+        client-reconnect shape): journal an EExport marker, flush
+        every cap under the subtree (buffered writers write back),
+        drop the subtree's locks, return the inode list."""
+        self._check_auth(path)
+        inos = self.subtree_inos(path)
+        self._journal_and_apply({"op": "export", "path": path,
+                                 "to": to_rank})
+        for ino in inos:
+            self._flush_and_drop_caps(ino)
+            self._locks.pop(ino, None)
+        return inos
+
+    def import_subtree(self, path: str, from_rank: int) -> None:
+        """Destination half: journal the EImport marker.  Dirfrags are
+        shared RADOS objects, so authority (not data) is what moves."""
+        self._journal_and_apply({"op": "import", "path": path,
+                                 "from": from_rank})
 
     # -------------------------------------------------------- path logic --
     def _resolve(self, path: str) -> Tuple[int, str]:
@@ -184,6 +261,7 @@ class MDS:
         return f"{ino:016x}.{objno:08x}"
 
     def write_file(self, path: str, data: bytes, offset: int = 0) -> int:
+        self._check_auth(path)
         parent, name = self._resolve(path)
         ent = self._read_dir(parent).get(name)
         if ent is None or ent["type"] != "file":
@@ -291,6 +369,7 @@ class MDS:
         conflicting holders first).  Returns the granted cap string.
         ``want``: subset of "rwc" ("c" upgrades to exclusive when this
         client is alone)."""
+        self._check_auth(path)
         now = time.time() if now is None else now
         if not self._session_live(client, now):
             raise FSError(f"ESTALE: session for {client} expired")
@@ -316,10 +395,20 @@ class MDS:
                                       set(grant)))
         return held[client]
 
+    def acquire_caps_path(self, path: str, client: str, want: str,
+                          now: Optional[float] = None) -> str:
+        """Path-first adapter for the multi-MDS router (ForwardError
+        carries the path, so the router dispatches path-first)."""
+        return self.acquire_caps(client, path, want, now)
+
+    def release_caps_path(self, path: str, client: str) -> None:
+        return self.release_caps(client, path)
+
     def release_caps(self, client: str, path: str) -> None:
         """Voluntary cap return: routed through the revoke path so a
         buffered/caching client flushes AND drops its local cache —
         otherwise a later lone re-grant would serve stale bytes."""
+        self._check_auth(path)
         ino = self._lookup(path)["ino"]
         held = self._caps.get(ino)
         if held and client in held:
@@ -351,6 +440,7 @@ class MDS:
     def setlk(self, path: str, owner: str,
               exclusive: bool = True) -> bool:
         """Try-lock; False on conflict (the F_SETLK no-wait shape)."""
+        self._check_auth(path)
         ent = self._lookup(path)
         ino = ent["ino"]
         holders = self._locks.setdefault(ino, {})
@@ -392,6 +482,7 @@ class MDS:
 
     # ------------------------------------------------------------ the API --
     def mkdir(self, path: str) -> int:
+        self._check_auth(path)
         parent, name = self._resolve(path)
         if not name:
             raise FSError("root exists")
@@ -403,6 +494,7 @@ class MDS:
         return ino
 
     def create(self, path: str) -> int:
+        self._check_auth(path)
         parent, name = self._resolve(path)
         if name in self._read_dir(parent):
             raise FSError(f"exists: {path}")
@@ -421,6 +513,7 @@ class MDS:
         self._caps.pop(ino, None)
 
     def unlink(self, path: str) -> None:
+        self._check_auth(path)
         parent, name = self._resolve(path)
         ent = self._read_dir(parent).get(name)
         if ent is None or ent["type"] != "file":
@@ -441,6 +534,7 @@ class MDS:
         self._locks.pop(ent["ino"], None)
 
     def rmdir(self, path: str) -> None:
+        self._check_auth(path)
         parent, name = self._resolve(path)
         ent = self._read_dir(parent).get(name)
         if ent is None or ent["type"] != "dir":
@@ -452,6 +546,8 @@ class MDS:
         self._locks.pop(ent["ino"], None)   # after the commit, as above
 
     def rename(self, src: str, dst: str) -> None:
+        self._check_auth(src)
+        self._check_auth(dst)
         sp, sn = self._resolve(src)
         dp, dn = self._resolve(dst)
         ent = self._read_dir(sp).get(sn)

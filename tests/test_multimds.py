@@ -1,0 +1,186 @@
+"""Multi-MDS: ranks, subtree authority, migration, balancer, caps.
+
+The round-3 COVERAGE gap ("still single-MDS, no subtree migration").
+Reference roles: src/mds/MDSMap.h (ranks), MDCache subtree auth,
+Migrator.cc (export/import), MDBalancer.cc (load-driven moves),
+MDSRank::forward (wrong-rank requests re-routed).
+"""
+import pytest
+
+from ceph_tpu.client.rados import Rados
+from ceph_tpu.cluster.monitor import Monitor
+from ceph_tpu.fs import MDS, CephFSClient, FSError
+from ceph_tpu.fs.mds import ForwardError
+from ceph_tpu.fs.mdsmap import MDSMap
+from ceph_tpu.fs.multimds import MDBalancer, MDSCluster
+from tests.test_snaps import make_sim
+
+
+@pytest.fixture()
+def pools():
+    sim = make_sim()
+    rados = Rados(sim, Monitor(sim.osdmap)).connect()
+    return rados.open_ioctx("rep"), rados.open_ioctx("rep")
+
+
+def test_mdsmap_longest_prefix_auth(pools):
+    meta, _ = pools
+    m = MDSMap(meta, n_ranks=3)
+    m.set_auth("/a", 1)
+    m.set_auth("/a/deep", 2)
+    assert m.auth_rank("/") == 0
+    assert m.auth_rank("/b/x") == 0
+    assert m.auth_rank("/a") == 1
+    assert m.auth_rank("/a/file") == 1
+    assert m.auth_rank("/a/deep") == 2
+    assert m.auth_rank("/a/deep/er/still") == 2
+    # durable: a reloaded map resolves identically, same epoch
+    m2 = MDSMap(meta, n_ranks=3)
+    assert m2.epoch == m.epoch
+    assert m2.auth_rank("/a/deep/x") == 2
+    with pytest.raises(ValueError):
+        m.set_auth("/a", 99)
+
+
+def test_wrong_rank_forwards(pools):
+    meta, data = pools
+    c = MDSCluster(meta, data, n_ranks=2)
+    c.mkdir("/left")
+    c.migrate("/left", 1)
+    # direct hit on the wrong rank raises ForwardError with the owner
+    with pytest.raises(ForwardError) as ei:
+        c.ranks[0].mkdir("/left/sub")
+    assert ei.value.rank == 1
+    # the router follows the forward transparently
+    c.mkdir("/left/sub")
+    assert c.listdir("/left") == ["sub"]
+    # and the owning rank serves it directly without forwarding
+    c.ranks[1].mkdir("/left/sub2")
+    assert sorted(c.listdir("/left")) == ["sub", "sub2"]
+
+
+def test_migration_moves_authority_and_flushes_caps(pools):
+    meta, data = pools
+    c = MDSCluster(meta, data, n_ranks=2)
+    c.mkdir("/proj")
+    c.create("/proj/f")
+    c.write_file("/proj/f", b"hello world")
+    flushed = []
+    c.open_session("alice", flush_cb=lambda ino, why:
+                   flushed.append((ino, why)))
+    got = c.acquire_caps("alice", "/proj/f", "rwc")
+    assert "c" in got                       # loner gets the cache cap
+    c.migrate("/proj", 1)
+    # export flushed the buffered holder and dropped the cap state
+    assert flushed, "cap holder was not flushed on export"
+    assert c.caps_of("/proj/f") == {}
+    assert c.subtree_map()["/proj"] == 1
+    # IO continues against the new owner; reacquire works
+    assert c.read_file("/proj/f") == b"hello world"
+    assert "r" in c.acquire_caps("alice", "/proj/f", "r")
+    c.write_file("/proj/f", b"HELLO WORLD")
+    assert c.read_file("/proj/f") == b"HELLO WORLD"
+
+
+def test_migration_survives_restart(pools):
+    meta, data = pools
+    c = MDSCluster(meta, data, n_ranks=2)
+    c.mkdir("/stay")
+    c.mkdir("/move")
+    c.create("/move/f")
+    c.write_file("/move/f", b"payload")
+    c.migrate("/move", 1)
+    # a fresh cluster over the same pools resumes the same authority
+    c2 = MDSCluster(meta, data, n_ranks=2)
+    assert c2.subtree_map()["/move"] == 1
+    assert c2.mdsmap.auth_rank("/stay") == 0
+    assert c2.read_file("/move/f") == b"payload"
+    with pytest.raises(ForwardError):
+        c2.ranks[0].create("/move/g")
+    c2.create("/move/g")                     # routed to rank 1
+    assert sorted(c2.listdir("/move")) == ["f", "g"]
+
+
+def test_cross_rank_rename(pools):
+    meta, data = pools
+    c = MDSCluster(meta, data, n_ranks=2)
+    c.mkdir("/a")
+    c.mkdir("/b")
+    c.migrate("/b", 1)
+    c.create("/a/f")
+    c.write_file("/a/f", b"crossing")
+    c.rename("/a/f", "/b/f")
+    assert c.listdir("/a") == []
+    assert c.listdir("/b") == ["f"]
+    assert c.read_file("/b/f") == b"crossing"
+    # collision on the destination is refused before any mutation
+    c.create("/a/g")
+    c.create("/b/g")
+    with pytest.raises(FSError):
+        c.rename("/a/g", "/b/g")
+    assert "g" in c.listdir("/a")
+
+
+def test_two_clients_coherent_across_ranks(pools):
+    meta, data = pools
+    c = MDSCluster(meta, data, n_ranks=2)
+    c.mkdir("/shared")
+    c.migrate("/shared", 1)
+    a = CephFSClient(c, client_id="a")
+    b = CephFSClient(c, client_id="b")
+    c.create("/shared/f")
+    a.write("/shared/f", b"from-a")
+    assert b.read("/shared/f") == b"from-a"   # revoke flushed a's buffer
+    b.write("/shared/f", b"from-b")
+    assert a.read("/shared/f") == b"from-b"
+
+
+def test_cross_rank_rename_drops_locks(pools):
+    """Lock state follows the dentry off the source rank (code-review
+    finding: a stranded exclusive lock would both stop excluding and
+    become unreleasable through routing)."""
+    meta, data = pools
+    c = MDSCluster(meta, data, n_ranks=2)
+    c.mkdir("/a")
+    c.mkdir("/b")
+    c.migrate("/b", 1)
+    c.create("/a/f")
+    assert c.setlk("/a/f", owner="alice", exclusive=True)
+    c.rename("/a/f", "/b/f")
+    # the new owner rank has clean lock state; no phantom exclusion
+    assert c.getlk("/b/f") == {}
+    assert c.setlk("/b/f", owner="bob", exclusive=True)
+    # and the SOURCE rank holds no stale entry for the moved inode
+    ino = c.stat("/b/f")["ino"]
+    assert ino not in c.ranks[0]._locks
+
+
+def test_balancer_moves_hot_subtree(pools):
+    meta, data = pools
+    c = MDSCluster(meta, data, n_ranks=2)
+    c.mkdir("/hot")
+    c.mkdir("/cold")
+    c.create("/hot/f")
+    for _ in range(60):
+        c.read_file("/hot/f")
+    c.listdir("/cold")
+    bal = MDBalancer(c, min_requests=16)
+    assert bal.rank_loads()[0] > 60
+    moved = bal.rebalance()
+    assert ("/hot", 1) in moved
+    assert c.subtree_map()["/hot"] == 1
+    # served by the new rank; balance is now within threshold
+    assert c.read_file("/hot/f") == b""
+    assert bal.rebalance() == []
+
+
+def test_single_mds_unaffected(pools):
+    """rank=None keeps the legacy single-MDS behavior: no authority
+    checks, legacy journal name."""
+    meta, data = pools
+    mds = MDS(meta, data)
+    fs = CephFSClient(mds)
+    fs.mkdir("/solo")
+    fs.write("/solo/f", b"x")
+    assert fs.read("/solo/f") == b"x"
+    assert mds.journal.name == "mdlog"
