@@ -1,2 +1,4 @@
 """Object gateway layer (src/rgw/ role)."""
 from .gateway import Bucket, RGWError, RGWGateway  # noqa: F401
+from .zone import (Period, PeriodSync, Realm, RealmError,  # noqa: F401
+                   Zone, ZoneGroup)
