@@ -57,14 +57,20 @@ from .objectstore import (ChecksumError, Coll, ObjectStoreError,
                           Transaction)
 from .wal_kv import WalDB
 
-_BLOB_HDR = struct.Struct("<BIIHI")      # flags, raw_len, stored_len,
-                                         #   n_runs, n_csums
+_BLOB_HDR = struct.Struct("<BBIIHI")     # flags, comp_id, raw_len,
+                                         #   stored_len, n_runs, n_csums
 _RUN = struct.Struct("<QI")              # start_block, n_blocks
 _EXT = struct.Struct("<QIII")            # obj_off, length, blob_idx,
                                          #   blob_off (into RAW stream)
 _DEF = struct.Struct("<QI")              # dev_byte_off, payload_len
 
 FLAG_COMPRESSED = 1
+
+# per-blob compressor ids (persisted in the blob header, so a remount
+# never has to GUESS which algorithm wrote a blob — the reference
+# records the compressor per blob too, bluestore_blob_t::COMP types)
+_COMP_IDS = {"": 0, "zlib": 1, "lzma": 2, "bz2": 3, "zstd": 4}
+_COMP_NAMES = {v: k for k, v in _COMP_IDS.items()}
 
 
 @dataclass
@@ -77,6 +83,7 @@ class Blob:
     stored_len: int = 0
     runs: List[Tuple[int, int]] = field(default_factory=list)
     csums: List[int] = field(default_factory=list)
+    comp: str = ""                  # compressor that wrote this blob
 
     @property
     def compressed(self) -> bool:
@@ -99,7 +106,8 @@ class Onode:
     def encode(self) -> bytes:
         out = [struct.pack("<QI", self.size, len(self.blobs))]
         for b in self.blobs:
-            out.append(_BLOB_HDR.pack(b.flags, b.raw_len, b.stored_len,
+            out.append(_BLOB_HDR.pack(b.flags, _COMP_IDS[b.comp],
+                                      b.raw_len, b.stored_len,
                                       len(b.runs), len(b.csums)))
             out += [_RUN.pack(*r) for r in b.runs]
             out.append(struct.pack(f"<{len(b.csums)}I", *b.csums))
@@ -113,7 +121,7 @@ class Onode:
         off = 12
         blobs = []
         for _ in range(n_blobs):
-            flags, raw_len, stored_len, n_runs, n_csums = \
+            flags, comp_id, raw_len, stored_len, n_runs, n_csums = \
                 _BLOB_HDR.unpack_from(blob, off)
             off += _BLOB_HDR.size
             runs = []
@@ -122,7 +130,8 @@ class Onode:
                 off += _RUN.size
             csums = list(struct.unpack_from(f"<{n_csums}I", blob, off))
             off += 4 * n_csums
-            blobs.append(Blob(flags, raw_len, stored_len, runs, csums))
+            blobs.append(Blob(flags, raw_len, stored_len, runs, csums,
+                              _COMP_NAMES[comp_id]))
         (n_ext,) = struct.unpack_from("<I", blob, off)
         off += 4
         extents = []
@@ -188,17 +197,16 @@ class BlueStore:
         self.txns_applied = 0
         self.deferred_applied = 0
         self.alloc = BitmapAllocator(self.n_blocks)
-        self._rebuild_allocations()
-        self._replay_deferred()
-        if fsck_on_mount:
-            try:
-                bad = self.fsck()
-            except Exception:
-                self.close()
-                raise
-            if bad:
-                self.close()
-                raise ObjectStoreError(f"fsck on mount: bad objects {bad}")
+        try:
+            self._rebuild_allocations()
+            self._replay_deferred()
+            bad = self.fsck() if fsck_on_mount else []
+        except Exception:
+            self.close()        # no fd leak on a failed mount
+            raise
+        if bad:
+            self.close()
+            raise ObjectStoreError(f"fsck on mount: bad objects {bad}")
 
     # ------------------------------------------------------------- mount --
     def _rebuild_allocations(self) -> None:
@@ -271,9 +279,10 @@ class BlueStore:
         """Read RAW (decompressed) bytes [r0, r1) of a blob."""
         if blob.compressed:
             stored = self._read_stored(blob, 0, blob.stored_len)
-            comp = (self._comp if self._comp is not None
-                    else compressors().factory(self._comp_name or "zlib"))
-            raw = comp.decompress(stored)
+            # the blob header names its own compressor — remount args
+            # never matter for readback
+            raw = compressors().factory(blob.comp or "zlib") \
+                .decompress(stored)
             if len(raw) != blob.raw_len:
                 raise ChecksumError("decompressed length mismatch (EIO)")
             return raw[r0:r1]
@@ -326,6 +335,7 @@ class BlueStore:
         raw_len = len(data)
         stored = data
         flags = 0
+        comp_name = ""
         if (self._comp is not None and raw_len >= self.compress_min):
             c = self._comp.compress(data)
             # only keep a win that saves at least one block
@@ -333,6 +343,7 @@ class BlueStore:
                     (raw_len + self.min_alloc - 1) // self.min_alloc:
                 stored = c
                 flags = FLAG_COMPRESSED
+                comp_name = self._comp_name or ""
         n_blocks = (len(stored) + self.min_alloc - 1) // self.min_alloc
         runs = [(int(s), int(n))
                 for s, n in self.alloc.allocate(n_blocks)]
@@ -345,7 +356,8 @@ class BlueStore:
             chunk = stored[ci * self.min_alloc:(ci + 1) * self.min_alloc]
             csums.append(zlib.crc32(chunk))
             writes.append((blk * self.min_alloc, chunk))
-        return Blob(flags, raw_len, len(stored), runs, csums), writes
+        return Blob(flags, raw_len, len(stored), runs, csums,
+                    comp_name), writes
 
     # ------------------------------------------------------------- write --
     def apply_transaction(self, txn: Transaction) -> None:
@@ -373,7 +385,7 @@ class BlueStore:
                     staged[key] = Onode(cur.size,
                                         [Blob(b.flags, b.raw_len,
                                               b.stored_len, list(b.runs),
-                                              list(b.csums))
+                                              list(b.csums), b.comp)
                                          for b in cur.blobs],
                                         list(cur.extents))
             elif staged[key] is None and create:
@@ -598,6 +610,11 @@ class BlueStore:
             for row, dev_off, payload in def_rows:
                 os.pwrite(self._dev, payload, dev_off)
                 clear.rm("deferred", row)
+            # the rows may only be durably dropped once the in-place
+            # bytes are ON the device — same order as _replay_deferred
+            # (clearing first would lose the write on power cut)
+            if self.fsync:
+                os.fsync(self._dev)
             self.deferred_applied += len(def_rows)
             self.kv.submit(clear)
         for start, n in to_release:

@@ -238,6 +238,25 @@ def test_compression_roundtrip(tmp_path):
     bs2.close()
 
 
+def test_compression_algorithm_is_per_blob(tmp_path):
+    """The blob header records WHICH compressor wrote it: remounting
+    with no (or a different) compression option still reads back
+    correctly (code-review finding: the algorithm was guessed)."""
+    bs = mk(tmp_path, compression="lzma", compress_min=1024)
+    data = b"L" * 32768
+    bs.apply_transaction(Transaction().write_full(C, "o", data))
+    assert bs.stat(C, "o")["stored"] < len(data)
+    bs.close()
+    bs2 = mk(tmp_path)                        # no compression arg at all
+    assert bs2.read(C, "o") == data
+    bs2.apply_transaction(Transaction().write_full(C, "p", b"x" * 100))
+    bs2.close()
+    bs3 = mk(tmp_path, compression="zlib")    # different algorithm
+    assert bs3.read(C, "o") == data
+    assert bs3.fsck() == []
+    bs3.close()
+
+
 def test_incompressible_stays_raw(tmp_path):
     bs = mk(tmp_path, compression="zlib", compress_min=1024)
     data = os.urandom(8192)
