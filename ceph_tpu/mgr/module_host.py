@@ -109,6 +109,18 @@ class MgrModuleHost:
         raise KeyError(f"unknown query {what!r}")
 
     # ------------------------------------------------------- mon commands --
+    def mark_osd_out(self, osd: int) -> None:
+        """Mark an OSD out (weight 0) — with a mon, as a committed
+        incremental; standalone, directly on the sim's map (the
+        `ceph osd out` / devicehealth self-heal path)."""
+        if self.mon is not None:
+            inc = self.mon.next_incremental()
+            inc.new_weight[osd] = 0
+            if not self.mon.commit_incremental(inc):
+                raise RuntimeError(f"osd.{osd} mark-out lost quorum")
+            return
+        self.sim.osdmap.mark_out(osd)      # bumps the epoch itself
+
     def set_pool_pg_num(self, pool_id: int, pg_num: int) -> None:
         """Commit a pg_num change.  With a mon: consensus + durable
         incremental FIRST (no quorum -> RuntimeError, nothing moves),

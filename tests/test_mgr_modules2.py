@@ -1,0 +1,97 @@
+"""Telemetry + devicehealth mgr modules.
+
+Reference roles: src/pybind/mgr/telemetry/module.py (opt-in anonymized
+report, local spool when unreachable), src/pybind/mgr/devicehealth/
+module.py (device metric history, life expectancy, health checks,
+self-heal mark-out).
+"""
+import json
+
+import pytest
+
+from ceph_tpu.mgr import MgrModuleHost
+from ceph_tpu.mgr import devicehealth_module, telemetry_module
+from tests.test_snaps import make_sim
+
+
+@pytest.fixture()
+def host():
+    sim = make_sim()
+    h = MgrModuleHost(sim)
+    telemetry_module.register(h)
+    devicehealth_module.register(h)
+    return h
+
+
+# ------------------------------------------------------------- telemetry --
+
+def test_telemetry_requires_opt_in(host):
+    tel = host.enable("telemetry")
+    with pytest.raises(RuntimeError):
+        tel.send()
+    # ticks do nothing while off
+    for _ in range(10):
+        tel.serve_tick()
+    assert tel.spool == []
+
+
+def test_telemetry_report_shape_and_spool(host):
+    tel = host.enable("telemetry")
+    tel.on()
+    host.sim.put(1, "obj-secret-name", b"z" * 1000)
+    rid = tel.send()
+    assert rid == 1
+    rep = tel.last_report()
+    assert rep["osd"]["count"] > 0
+    assert rep["total_objects"] >= 1
+    assert rep["total_bytes"] >= 1000
+    # anonymized: no object names anywhere in the payload
+    assert "obj-secret-name" not in json.dumps(rep)
+    # `telemetry show` renders without sending
+    shown = json.loads(tel.show())
+    assert shown["pools"] and len(tel.spool) == 1
+    # periodic serve loop spools on its interval
+    for _ in range(telemetry_module.TelemetryModule.INTERVAL_TICKS):
+        tel.serve_tick()
+    assert len(tel.spool) == 2
+    assert tel.spool[1]["report_id"] == 2
+
+
+# ----------------------------------------------------------- devicehealth --
+
+def test_devicehealth_flap_and_error_verdicts(host):
+    dh = host.enable("devicehealth")
+    dh.scrape(now=1.0)
+    assert dh.life_expectancy(0) == devicehealth_module.GOOD
+    assert dh.checks() == {}
+    # two down-flaps degrade the verdict to WARNING
+    for t in range(2):
+        host.sim.kill_osd(1)
+        dh.scrape(now=2.0 + t)
+        host.sim.revive_osd(1)
+        dh.scrape(now=2.5 + t)
+    assert dh.life_expectancy(1) == devicehealth_module.WARNING
+    assert "DEVICE_HEALTH_WARN" in dh.checks()
+    # scrub-found checksum errors mean FAILING
+    dh.record_scrub_errors(2)
+    dh.scrape(now=9.0)
+    assert dh.life_expectancy(2) == devicehealth_module.FAILING
+    assert "DEVICE_HEALTH" in dh.checks()
+    # metric history is bounded
+    for t in range(40):
+        dh.scrape(now=10.0 + t)
+    assert len(dh.metrics[0]) == dh.HISTORY
+
+
+def test_devicehealth_self_heal_marks_out(host):
+    dh = host.enable("devicehealth")
+    dh.scrape(now=1.0)
+    dh.record_scrub_errors(3)
+    # self_heal off: verdict only, no map mutation
+    assert dh.maybe_mark_out() == []
+    assert int(host.sim.osdmap.osd_weight[3]) > 0
+    dh.self_heal = True
+    assert dh.maybe_mark_out() == [3]
+    assert int(host.sim.osdmap.osd_weight[3]) == 0
+    # idempotent: not marked out twice
+    assert dh.maybe_mark_out() == []
