@@ -65,6 +65,7 @@ _EXT = struct.Struct("<QIII")            # obj_off, length, blob_idx,
 _DEF = struct.Struct("<QI")              # dev_byte_off, payload_len
 
 FLAG_COMPRESSED = 1
+ONDISK_FORMAT = 2               # blob headers carry a compressor id
 
 # per-blob compressor ids (persisted in the blob header, so a remount
 # never has to GUESS which algorithm wrote a blob — the reference
@@ -130,8 +131,14 @@ class Onode:
                 off += _RUN.size
             csums = list(struct.unpack_from(f"<{n_csums}I", blob, off))
             off += 4 * n_csums
+            comp = _COMP_NAMES.get(comp_id)
+            if comp is None:
+                # fsck catches ObjectStoreError and reports the object
+                # as bad; a bare KeyError would escape it
+                raise ObjectStoreError(
+                    f"unknown compressor id {comp_id}")
             blobs.append(Blob(flags, raw_len, stored_len, runs, csums,
-                              _COMP_NAMES[comp_id]))
+                              comp))
         (n_ext,) = struct.unpack_from("<I", blob, off)
         off += 4
         extents = []
@@ -170,15 +177,28 @@ class BlueStore:
         os.makedirs(path, exist_ok=True)
         self.kv = WalDB(os.path.join(path, "kv"), fsync=fsync)
         # superblock: geometry is fixed at mkfs; remounts use the stored
-        # values (passing different ones is a config error, not a resize)
+        # values (passing different ones is a config error, not a
+        # resize).  A format version gates incompatible onode layouts
+        # (the ondisk_format/compat_ondisk_format role) — misdecoding
+        # an old store must be a clear refusal, not garbage extents.
         sb = self.kv.get("meta", "superblock")
         if sb is None:
             self.device_bytes = int(device_bytes)
             self.min_alloc = int(min_alloc)
             self.kv.set("meta", "superblock", struct.pack(
-                "<QI", self.device_bytes, self.min_alloc))
+                "<QII", self.device_bytes, self.min_alloc,
+                ONDISK_FORMAT))
+        elif len(sb) == 12:          # v1: no version field, old blobs
+            raise ObjectStoreError(
+                "incompatible on-disk format v1 (pre-versioned blob "
+                f"headers); this build reads format {ONDISK_FORMAT}")
         else:
-            self.device_bytes, self.min_alloc = struct.unpack("<QI", sb)
+            self.device_bytes, self.min_alloc, fmt = \
+                struct.unpack("<QII", sb)
+            if fmt != ONDISK_FORMAT:
+                raise ObjectStoreError(
+                    f"incompatible on-disk format {fmt} "
+                    f"(this build reads {ONDISK_FORMAT})")
         if self.device_bytes % self.min_alloc:
             raise ObjectStoreError("device size not block-aligned")
         self.n_blocks = self.device_bytes // self.min_alloc
@@ -186,6 +206,12 @@ class BlueStore:
         self.compact_extents = compact_extents
         self.deferred_max = (self.min_alloc if deferred_max is None
                              else deferred_max)
+        if compression and compression not in _COMP_IDS:
+            # fail at mkfs/mount, not mid-commit in Onode.encode (a
+            # KeyError there would strike after blocks were allocated)
+            raise ValueError(
+                f"unsupported BlueStore compressor {compression!r}; "
+                f"choose from {sorted(k for k in _COMP_IDS if k)}")
         self._comp = (compressors().factory(compression)
                       if compression else None)
         self._comp_name = compression
