@@ -437,6 +437,72 @@ class RemoteCluster:
             size = obj_size if obj_size is not None else len(buf)
         return buf[:size]
 
+    def delete(self, pool_id: int, name: str) -> int:
+        """Delete an object.  Replicated pools go through the
+        primary's LOGGED delete (delete_object: version + OP_DELETE
+        entry + fan-out — src/osd/PrimaryLogPG.cc delete shape), so a
+        down replica cannot resurrect the object on log-driven
+        recovery.  EC pools delete per shard, mirroring this client's
+        shard-direct write path."""
+        pool = self.osdmap.pools[pool_id]
+        pg = self._pg_for(pool, name)
+        up = self._up(pool, pg)
+        coll = [pool_id, pg]
+        if pool.type != POOL_ERASURE:
+            replicas = [o for o in up if o != ITEM_NONE]
+            if not replicas:
+                raise IOError(f"{name}: no live replica target")
+            try:
+                r = self.osd_client(replicas[0]).call({
+                    "cmd": "delete_object", "coll": coll,
+                    "oid": f"0:{name}", "replicas": replicas})
+            except (OSError, IOError):
+                self.drop_osd_client(replicas[0])
+                raise
+            return int(r["acks"])
+        acks = 0
+        codec = self.codec_for(pool)
+        for shard in range(codec.get_chunk_count()):
+            tgt = up[shard] if shard < len(up) else ITEM_NONE
+            if tgt == ITEM_NONE:
+                continue
+            try:
+                self.osd_client(tgt).call({
+                    "cmd": "delete_shard", "coll": coll,
+                    "oid": f"{shard}:{name}"})
+                acks += 1
+            except (OSError, IOError):
+                self.drop_osd_client(tgt)
+        return acks
+
+    def list_objects(self, pool_id: int) -> List[str]:
+        """Logical object names in a pool: PG-walk each primary's
+        listing, collapsing shard prefixes and snapshot clones (the
+        `rados ls` shape; also the admin CLIs' shared listing)."""
+        pool = self.osdmap.pools[pool_id]
+        names = set()
+        for pg in range(pool.pg_num):
+            ups = self._up(pool, pg)
+            prim = next((o for o in ups if o != ITEM_NONE), None)
+            if prim is None:
+                continue
+            try:
+                listed = self.osd_client(prim).call(
+                    {"cmd": "list_pg", "coll": [pool_id, pg]})
+            except (OSError, IOError):
+                self.drop_osd_client(prim)
+                continue
+            for n in listed:
+                # PG-internal rows ("meta:pglog") carry no shard
+                # prefix; data objects are "<shard>:<name>"
+                if n.startswith("meta:") or ":" not in n:
+                    continue
+                head = n.split(":", 1)[1]
+                if head.startswith("meta:") or "@" in head:
+                    continue
+                names.add(head)
+        return sorted(names)
+
     # ------------------------------------------------------------ recovery --
     def recover_pool(self, pool_id: int) -> Dict:
         """Replicated pools: primary-driven PEERING recovery per PG

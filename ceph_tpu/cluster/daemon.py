@@ -752,12 +752,67 @@ class OSDDaemon:
         if cmd == "delete_shard":
             coll = tuple(req["coll"])
             from .objectstore import Transaction
+
             def rm():
+                txn = Transaction()
                 if self.store.exists(coll, req["oid"]):
-                    self.store.apply_transaction(
-                        Transaction().remove(coll, req["oid"]))
+                    txn.remove(coll, req["oid"])
+                lg = req.get("log")
+                if not lg:
+                    if len(txn):
+                        self.store.apply_transaction(txn)
+                    return True
+                with self._pg_lock(coll):
+                    # replica half of a logged delete: the OP_DELETE
+                    # entry rides the same txn as the removal (mirror
+                    # of put_shard), so recovery can never resurrect
+                    # the object from a log that lacks its delete
+                    from .pglog import OP_DELETE
+                    log = self._pglog(coll)
+                    v = tuple(lg["version"])
+                    prev = tuple(lg.get("prev", (0, 0)))
+                    log.append_txn(
+                        txn, v, req["oid"], op=OP_DELETE,
+                        advance_lc=log.last_complete >= prev)
+                    self.store.apply_transaction(txn)
                 return True
             return self._run_sched(rm, klass)
+        if cmd == "delete_object":
+            # replicated primary delete: version + OP_DELETE log entry
+            # + removal in ONE txn, fanned out to replicas — the
+            # PrimaryLogPG delete shape; without this, a down replica
+            # resurrects the object on log-driven recovery
+            coll = tuple(req["coll"])
+            from .objectstore import Transaction
+            from .pglog import OP_DELETE
+            with self._pg_lock(coll):
+                log = self._pglog(coll)
+                prev = log.log.head
+                version = log.next_version(
+                    int(self._map.get("epoch", prev[0] or 1)))
+
+                def rm_primary():
+                    txn = Transaction()
+                    if self.store.exists(coll, req["oid"]):
+                        txn.remove(coll, req["oid"])
+                    log.append_txn(txn, version, req["oid"],
+                                   op=OP_DELETE)
+                    self.store.apply_transaction(txn)
+                self._run_sched(rm_primary, klass)
+                acks = 1
+                for peer in req["replicas"]:
+                    if peer == self.id:
+                        continue
+                    try:
+                        self.peer_client(peer).call({
+                            "cmd": "delete_shard", "coll": list(coll),
+                            "oid": req["oid"], "klass": klass,
+                            "log": {"version": list(version),
+                                    "prev": list(prev)}})
+                        acks += 1
+                    except (OSError, IOError):
+                        self.drop_peer(peer)
+            return {"acks": acks, "version": list(version)}
         if cmd == "put_object":
             # replicated primary: assign the version, persist object +
             # log entry in ONE txn, fan the versioned write out to

@@ -1,0 +1,193 @@
+"""`ceph` — the cluster admin CLI against a live process cluster.
+
+The reference's main operator surface (src/ceph.in dispatching mon
+commands; outputs modeled on `ceph -s`, `ceph health`, `ceph osd
+tree`, `ceph mon stat`, `ceph pg dump`, `ceph df`).  Talks to the
+daemons of a vstart cluster dir through the authenticated wire client
+(client/remote.py) — the same path any admin tool takes, no in-process
+shortcuts.
+
+    python -m ceph_tpu.tools.ceph_cli --dir /tmp/c1 status
+    python -m ceph_tpu.tools.ceph_cli --dir /tmp/c1 health
+    python -m ceph_tpu.tools.ceph_cli --dir /tmp/c1 mon stat
+    python -m ceph_tpu.tools.ceph_cli --dir /tmp/c1 osd tree
+    python -m ceph_tpu.tools.ceph_cli --dir /tmp/c1 osd out 3
+    python -m ceph_tpu.tools.ceph_cli --dir /tmp/c1 osd pool ls --detail
+    python -m ceph_tpu.tools.ceph_cli --dir /tmp/c1 pg dump 1
+    python -m ceph_tpu.tools.ceph_cli --dir /tmp/c1 df
+    python -m ceph_tpu.tools.ceph_cli --dir /tmp/c1 scrub 1
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import List, Optional
+
+
+def _client(cluster_dir: str):
+    from ..client.remote import RemoteCluster
+    return RemoteCluster(cluster_dir)
+
+
+def _pool_types():
+    from ..cluster.osdmap import POOL_ERASURE, POOL_REPLICATED
+    return {POOL_REPLICATED: "replicated", POOL_ERASURE: "erasure"}
+
+
+def cmd_status(rc, out) -> int:
+    st = rc.status()
+    m = rc.osdmap
+    names = _pool_types()
+    q = {}
+    try:
+        q = rc.mon_call({"cmd": "mon_status"})
+    except Exception:
+        pass
+    out.write("  cluster:\n")
+    health = "HEALTH_OK" if st["n_up"] == st["n_osds"] else "HEALTH_WARN"
+    out.write(f"    health: {health}\n")
+    if q:
+        out.write(f"  mon: rank {q.get('rank')} of "
+                  f"{q.get('n_mons')}, leader {q.get('leader')}, "
+                  f"election epoch {q.get('election_epoch')}\n")
+    out.write(f"  osd: {st['n_osds']} osds: {st['n_up']} up\n")
+    out.write(f"  map: e{st['epoch']}\n")
+    out.write("  pools:\n")
+    for pid, pool in sorted(m.pools.items()):
+        out.write(f"    pool {pid} '{pool.name}' "
+                  f"{names.get(pool.type, pool.type)} "
+                  f"size {pool.size} pg_num {pool.pg_num}\n")
+    return 0
+
+
+def cmd_health(rc, out) -> int:
+    st = rc.status()
+    if st["n_up"] == st["n_osds"]:
+        out.write("HEALTH_OK\n")
+        return 0
+    down = st["n_osds"] - st["n_up"]
+    out.write(f"HEALTH_WARN {down} osds down\n")
+    return 1
+
+
+def cmd_mon_stat(rc, out) -> int:
+    q = rc.mon_call({"cmd": "mon_status"})
+    out.write(f"e{q.get('election_epoch', 0)}: {q.get('n_mons')} mons, "
+              f"leader {q.get('leader')}, committed "
+              f"{q.get('committed')}\n")
+    return 0
+
+
+def cmd_osd_tree(rc, cluster_dir: str, out) -> int:
+    import os
+
+    from ..placement.compiler import compile_crushmap
+    from ..placement.treedump import tree_dump
+    text = open(os.path.join(cluster_dir, "crushmap.txt")).read()
+    cmap = compile_crushmap(text)
+    st = rc.status()
+    up = {i for i in range(st["n_osds"]) if bool(rc.osdmap.osd_up[i])}
+    # tree_dump renders the id/class/weight/name table; append the
+    # up/down STATUS column from the live map (`ceph osd tree` shape)
+    for line in tree_dump(cmap).splitlines():
+        mark = ""
+        token = line.split()
+        for t in token:
+            if t.startswith("osd."):
+                osd = int(t[4:])
+                mark = "  up" if osd in up else "  down"
+                break
+        out.write(line + mark + "\n")
+    return 0
+
+
+def cmd_osd_out(rc, osd: int, out) -> int:
+    r = rc.mon_call({"cmd": "mark_out", "osd": osd})
+    out.write(f"marked out osd.{osd} ({json.dumps(r)})\n")
+    return 0
+
+
+def cmd_pool_ls(rc, detail: bool, out) -> int:
+    names = _pool_types()
+    for pid, pool in sorted(rc.osdmap.pools.items()):
+        if detail:
+            out.write(f"pool {pid} '{pool.name}' "
+                      f"{names.get(pool.type, pool.type)} size "
+                      f"{pool.size} pg_num {pool.pg_num} crush_rule "
+                      f"{pool.crush_rule}\n")
+        else:
+            out.write(f"{pool.name}\n")
+    return 0
+
+
+def cmd_pg_dump(rc, pool_id: int, out) -> int:
+    pool = rc.osdmap.pools[pool_id]
+    out.write("PG  UP  PRIMARY\n")
+    for pg in range(pool.pg_num):
+        ups = rc._up(pool, pg)
+        prim = next((o for o in ups if o >= 0), -1)
+        out.write(f"{pool_id}.{pg}  {ups}  {prim}\n")
+    return 0
+
+
+def cmd_df(rc, out) -> int:
+    out.write("POOL  OBJECTS\n")
+    for pid, pool in sorted(rc.osdmap.pools.items()):
+        out.write(f"{pool.name}  {len(rc.list_objects(pid))}\n")
+    return 0
+
+
+def cmd_scrub(rc, pool_id: int, out) -> int:
+    r = rc.scrub_pool(pool_id)
+    out.write(json.dumps(r) + "\n")
+    return 0
+
+
+def main(argv: Optional[List[str]] = None,
+         out=None) -> int:
+    out = out or sys.stdout
+    ap = argparse.ArgumentParser(prog="ceph")
+    ap.add_argument("--dir", required=True,
+                    help="vstart cluster directory")
+    ap.add_argument("--detail", action="store_true")
+    ap.add_argument("words", nargs="+",
+                    help="command, e.g.: status | health | mon stat | "
+                         "osd tree | osd out N | osd pool ls | "
+                         "pg dump POOL | df | scrub POOL")
+    ns = ap.parse_args(argv)
+    rc = _client(ns.dir)
+    try:
+        w = ns.words
+
+        def arg(i: int) -> str:
+            if len(w) <= i:
+                ap.error(f"{' '.join(w)}: missing operand")
+            return w[i]
+
+        if w[0] in ("status", "-s"):
+            return cmd_status(rc, out)
+        if w[0] == "health":
+            return cmd_health(rc, out)
+        if w[:2] == ["mon", "stat"]:
+            return cmd_mon_stat(rc, out)
+        if w[:2] == ["osd", "tree"]:
+            return cmd_osd_tree(rc, ns.dir, out)
+        if w[:2] == ["osd", "out"]:
+            return cmd_osd_out(rc, int(arg(2)), out)
+        if w[:3] == ["osd", "pool", "ls"]:
+            return cmd_pool_ls(rc, ns.detail, out)
+        if w[:2] == ["pg", "dump"]:
+            return cmd_pg_dump(rc, int(arg(2)), out)
+        if w[0] == "df":
+            return cmd_df(rc, out)
+        if w[0] == "scrub":
+            return cmd_scrub(rc, int(arg(1)), out)
+        ap.error(f"unknown command: {' '.join(w)}")
+        return 2
+    finally:
+        rc.close()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,126 @@
+"""`ceph` + `rados` admin CLIs against a live process cluster.
+
+Reference roles: src/ceph.in (the ceph admin command), src/tools/
+rados/rados.cc (object CLI).  Both drive the authenticated wire
+client — the same path an operator's shell takes.
+"""
+import io
+
+import pytest
+
+from ceph_tpu.tools.ceph_cli import main as ceph_main
+from ceph_tpu.tools.rados_cli import main as rados_main
+from ceph_tpu.tools.vstart import Vstart, build_cluster_dir
+
+N_OSDS = 4
+
+
+@pytest.fixture(scope="module")
+def cluster(tmp_path_factory):
+    d = str(tmp_path_factory.mktemp("clic") / "cluster")
+    build_cluster_dir(d, n_osds=N_OSDS, osds_per_host=2, fsync=False)
+    v = Vstart(d)
+    v.start(N_OSDS, hb_interval=0.25)
+    yield d
+    v.stop()
+
+
+def run_ceph(d, *words):
+    out = io.StringIO()
+    rc = ceph_main(["--dir", d, *words], out=out)
+    return rc, out.getvalue()
+
+
+def run_rados(d, pool, *words, data_in=None):
+    out = io.StringIO()
+    rc = rados_main(["--dir", d, "-p", pool, *words], out=out,
+                    data_in=data_in)
+    return rc, out.getvalue()
+
+
+def test_ceph_status_health_monstat(cluster):
+    rc, txt = run_ceph(cluster, "status")
+    assert rc == 0
+    assert "health: HEALTH_OK" in txt
+    assert f"osd: {N_OSDS} osds: {N_OSDS} up" in txt
+    assert "pool 1 'rep' replicated" in txt
+    rc, txt = run_ceph(cluster, "health")
+    assert rc == 0 and txt.strip() == "HEALTH_OK"
+    rc, txt = run_ceph(cluster, "mon", "stat")
+    assert rc == 0 and "leader" in txt
+
+
+def test_ceph_osd_tree_and_pools(cluster):
+    rc, txt = run_ceph(cluster, "osd", "tree")
+    assert rc == 0
+    for i in range(N_OSDS):
+        assert f"osd.{i}" in txt
+    assert "  up" in txt
+    rc, txt = run_ceph(cluster, "osd", "pool", "ls", "--detail")
+    assert rc == 0 and "pg_num" in txt and "rep" in txt
+
+
+def test_ceph_pg_dump(cluster):
+    rc, txt = run_ceph(cluster, "pg", "dump", "1")
+    assert rc == 0
+    assert "1.0" in txt and "PRIMARY" in txt
+
+
+def test_rados_put_get_ls_rm(cluster):
+    payload = b"cli-payload" * 100
+    rc, txt = run_rados(cluster, "rep", "put", "obj1", "-",
+                        data_in=payload)
+    assert rc == 0 and "wrote" in txt
+    rc, txt = run_rados(cluster, "rep", "get", "obj1", "-")
+    assert rc == 0 and txt.encode("latin-1") == payload
+    rc, txt = run_rados(cluster, "rep", "ls")
+    assert rc == 0 and "obj1" in txt.splitlines()
+    rc, txt = run_rados(cluster, "rep", "rm", "obj1")
+    assert rc == 0
+    rc, txt = run_rados(cluster, "rep", "ls")
+    assert "obj1" not in txt.splitlines()
+
+
+def test_ceph_df_counts_objects(cluster):
+    run_rados(cluster, "rep", "put", "dfobj", "-", data_in=b"x" * 100)
+    rc, txt = run_ceph(cluster, "df")
+    assert rc == 0
+    rep_line = [ln for ln in txt.splitlines() if ln.startswith("rep")]
+    assert rep_line and int(rep_line[0].split()[1]) >= 1
+
+
+def test_delete_is_logged_no_resurrection(tmp_path):
+    """A delete issued while a replica is down must NOT be undone by
+    that replica's log-driven recovery when it returns (code-review
+    finding: shard-direct rm bypassed the PGLog, so the primary's
+    log re-pushed the object).  The logged delete_object path writes
+    OP_DELETE into the PG log, so peering propagates the deletion."""
+    import time
+
+    from ceph_tpu.client.remote import RemoteCluster
+    d = str(tmp_path / "cluster")
+    build_cluster_dir(d, n_osds=3, osds_per_host=1, fsync=False)
+    v = Vstart(d)
+    v.start(3, hb_interval=0.25)
+    try:
+        rc = RemoteCluster(d)
+        assert rc.put(1, "ghost", b"boo" * 500) >= 2
+        pool = rc.osdmap.pools[1]
+        pg = rc._pg_for(pool, "ghost")
+        victim = [o for o in rc._up(pool, pg) if o >= 0][-1]
+        v.kill9(f"osd.{victim}")
+        time.sleep(0.3)
+        assert rc.delete(1, "ghost") >= 1      # logged delete, degraded
+        assert "ghost" not in rc.list_objects(1)
+        v.start_osd(victim, hb_interval=0.25)
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline and not v.alive(
+                f"osd.{victim}"):
+            time.sleep(0.2)
+        rc.refresh_map()
+        rc.recover_pool(1)                     # peering catch-up
+        assert "ghost" not in rc.list_objects(1), \
+            "revived replica resurrected a deleted object"
+        rc.close()
+    finally:
+        v.stop()
