@@ -84,6 +84,14 @@ class Vstart:
     def _spawn(self, *args: str) -> subprocess.Popen:
         env = dict(os.environ)
         env["JAX_PLATFORMS"] = "cpu"      # daemons never touch the TPU
+        # share the persistent XLA compilation cache: dozens of daemon
+        # processes otherwise re-compile the same tiny jitted helpers
+        repo = os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))))
+        env.setdefault("JAX_COMPILATION_CACHE_DIR",
+                       os.path.join(repo, ".jax_cache"))
+        env.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS",
+                       "0.5")
         return subprocess.Popen(
             [sys.executable, "-m", "ceph_tpu.cluster.daemon", *args],
             env=env, stdout=subprocess.DEVNULL,
