@@ -35,6 +35,12 @@ from ..placement.compiler import compile_crushmap
 from ..placement.crush_map import ITEM_NONE
 
 
+class RemoteObjectMissing(IOError):
+    """Every reachable target answered and none holds the object — a
+    definitive ENOENT, distinct from connectivity trouble so existence
+    probes skip the retry sweep (rados ENOENT vs EIO distinction)."""
+
+
 class RemoteCluster:
     def __init__(self, cluster_dir: str, entity: str = "client.admin",
                  ec_profiles: Optional[Dict[str, Dict[str, str]]] = None):
@@ -69,20 +75,27 @@ class RemoteCluster:
         raise IOError(f"no mon reachable: {last}")
 
     def mon_call(self, req: Dict) -> Dict:
-        for attempt in range(2):
+        last: Optional[Exception] = None
+        for attempt in range(3):
             if self.mon is None:
-                self._connect_mon()
+                try:
+                    self._connect_mon()
+                except (OSError, IOError) as e:
+                    last = e
+                    time.sleep(0.05 * (attempt + 1))
+                    continue
             try:
                 return self.mon.call(req)
-            except (OSError, IOError):
+            except (OSError, IOError) as e:
+                last = e
                 try:
                     self.mon.close()
                 except OSError:
                     pass
                 self.mon = None
-                if attempt:
-                    raise
-        raise IOError("mon unreachable")
+                if attempt < 2:
+                    time.sleep(0.05 * (attempt + 1))
+        raise IOError(f"mon unreachable ({last})")
 
     # ---------------------------------------------------------------- map --
     def refresh_map(self) -> None:
@@ -118,6 +131,19 @@ class RemoteCluster:
         c = self._osd_clients.pop(osd, None)
         if c:
             c.close()
+
+    def osd_call(self, osd: int, req: Dict):
+        """One OSD request with a single same-target retry on a FRESH
+        connection: a cached connection may have been killed since its
+        last use (daemon restart, injected socket failure), and that
+        staleness must cost one reconnect, not the whole target."""
+        for attempt in range(2):
+            try:
+                return self.osd_client(osd).call(req)
+            except (OSError, IOError):
+                self.drop_osd_client(osd)
+                if attempt:
+                    raise
 
     # ---------------------------------------------------------- placement --
     def _pg_for(self, pool: PGPool, name: str) -> int:
@@ -292,21 +318,48 @@ class RemoteCluster:
         snapset = self._maybe_cow(pool, pg, name) \
             if "@" not in name else None
         if pool.type != POOL_ERASURE:
-            replicas = [o for o in up if o != ITEM_NONE]
-            if not replicas:
-                raise IOError(f"{name}: no live replica target")
-            primary = replicas[0]
-            try:
-                r = self.osd_client(primary).call({
-                    "cmd": "put_object", "coll": coll,
-                    "oid": f"0:{name}", "data": data,
-                    "replicas": replicas})
-            except (OSError, IOError):
-                self.drop_osd_client(primary)
-                raise
-            if snapset is not None:
-                self._store_snapset(pool, pg, name, snapset)
-            return int(r["acks"])
+            # bounded retry with a map refresh between attempts: a
+            # dropped connection (daemon restart, injected socket
+            # failure) is transient, and the full-object write +
+            # fresh version make the resend idempotent
+            last: Optional[Exception] = None
+            for attempt in range(5):
+                replicas = [o for o in up if o != ITEM_NONE]
+                if not replicas:
+                    # booting cluster / transient all-down map: retry
+                    # against a refreshed map like any other failure
+                    last = IOError(f"{name}: no live replica target")
+                    time.sleep(0.1 * (attempt + 1))
+                    try:
+                        self.refresh_map()
+                    except (OSError, IOError):
+                        pass
+                    up = self._up(pool, pg)
+                    continue
+                primary = replicas[0]
+                try:
+                    r = self.osd_client(primary).call({
+                        "cmd": "put_object", "coll": coll,
+                        "oid": f"0:{name}", "data": data,
+                        "replicas": replicas})
+                except (OSError, IOError) as e:
+                    self.drop_osd_client(primary)
+                    last = e
+                    if attempt < 4:      # no backoff on the last throw
+                        time.sleep(0.05 * (attempt + 1))
+                        try:
+                            self.refresh_map()
+                        except (OSError, IOError):
+                            pass
+                        up = self._up(pool, pg)
+                    continue
+                # snapset persistence is OUTSIDE the retry: the object
+                # write committed, so its failure must surface as its
+                # own error, not masquerade as a dead primary
+                if snapset is not None:
+                    self._store_snapset(pool, pg, name, snapset)
+                return int(r["acks"])
+            raise IOError(f"{name}: put failed after retries ({last})")
         codec = self.codec_for(pool)
         k = codec.get_data_chunk_count()
         n = codec.get_chunk_count()
@@ -329,7 +382,7 @@ class RemoteCluster:
                 if tgt == ITEM_NONE or acked.get(shard) == tgt:
                     continue
                 try:
-                    self.osd_client(tgt).call({
+                    self.osd_call(tgt, {
                         "cmd": "put_shard", "coll": coll,
                         "oid": f"{shard}:{name}",
                         "data": np.asarray(chunks[shard]).tobytes(),
@@ -338,7 +391,7 @@ class RemoteCluster:
                         "attrs": {"size": str(len(data)).encode()}})
                     acked[shard] = tgt
                 except (OSError, IOError):
-                    self.drop_osd_client(tgt)
+                    pass
             mapped = [s for s in range(n)
                       if s < len(up) and up[s] != ITEM_NONE]
             done = all(acked.get(s) == up[s] for s in mapped)
@@ -368,46 +421,75 @@ class RemoteCluster:
 
     def get(self, pool_id: int, name: str,
             size: Optional[int] = None) -> bytes:
+        """Read with bounded whole-read retries: one round can lose to
+        transient connection drops on every holder (socket-failure
+        injection, daemons restarting); the retry refreshes the map
+        and sweeps again before reporting the object unreadable."""
+        last: Optional[Exception] = None
+        for attempt in range(3):
+            try:
+                return self._get_once(pool_id, name, size)
+            except RemoteObjectMissing:
+                raise        # definitive miss (targets answered): no retry
+            except (OSError, IOError) as e:
+                last = e
+                if attempt < 2:      # no backoff on the last throw
+                    time.sleep(0.05 * (attempt + 1))
+                    try:
+                        self.refresh_map()
+                    except (OSError, IOError):
+                        pass
+        raise IOError(f"{name}: unreadable after retries ({last})")
+
+    def _get_once(self, pool_id: int, name: str,
+                  size: Optional[int] = None) -> bytes:
         pool = self.osdmap.pools[pool_id]
         pg = self._pg_for(pool, name)
         up = self._up(pool, pg)
         coll = [pool_id, pg]
         if pool.type != POOL_ERASURE:
             last_err = None
+            conn_errors = 0
             for o in [x for x in up if x != ITEM_NONE] + \
                     [x for x in self.addrs if x not in up]:
                 try:
-                    data = self.osd_client(o).call({
+                    data = self.osd_call(o, {
                         "cmd": "get_shard", "coll": coll,
                         "oid": f"0:{name}"})
                 except (OSError, IOError) as e:
-                    self.drop_osd_client(o)
                     last_err = e
+                    conn_errors += 1
                     continue
                 if data is not None:
                     return data
+            if conn_errors == 0:
+                # every target ANSWERED and none has it: a definitive
+                # miss, not a connectivity problem — callers probing
+                # existence must not pay the retry sweep
+                raise RemoteObjectMissing(f"{name}: no such object")
             raise IOError(f"{name}: no replica served ({last_err})")
         codec = self.codec_for(pool)
         k, n = codec.get_data_chunk_count(), codec.get_chunk_count()
         shards: Dict[int, bytes] = {}
         obj_size: Optional[int] = None
+        conn_errors = 0
         for shard in range(n):
             srcs = [up[shard]] if shard < len(up) and \
                 up[shard] != ITEM_NONE else []
             srcs += [o for o in self.addrs if o not in srcs]
             for o in srcs:
                 try:
-                    d = self.osd_client(o).call({
+                    d = self.osd_call(o, {
                         "cmd": "get_shard", "coll": coll,
                         "oid": f"{shard}:{name}"})
                 except (OSError, IOError):
-                    self.drop_osd_client(o)
+                    conn_errors += 1
                     continue
                 if d is not None:
                     shards[shard] = d
                     if obj_size is None:
                         try:
-                            sz = self.osd_client(o).call({
+                            sz = self.osd_call(o, {
                                 "cmd": "getattr_shard", "coll": coll,
                                 "oid": f"{shard}:{name}",
                                 "key": "size"})
@@ -417,6 +499,8 @@ class RemoteCluster:
                             pass
                     break
         if len(shards) < k:
+            if not shards and conn_errors == 0:
+                raise RemoteObjectMissing(f"{name}: no such object")
             raise IOError(f"{name}: only {len(shards)} shards (< k)")
         want = set(range(k))
         plan = sorted(codec.minimum_to_decode(want, set(shards)))
@@ -449,17 +533,27 @@ class RemoteCluster:
         up = self._up(pool, pg)
         coll = [pool_id, pg]
         if pool.type != POOL_ERASURE:
-            replicas = [o for o in up if o != ITEM_NONE]
-            if not replicas:
-                raise IOError(f"{name}: no live replica target")
-            try:
-                r = self.osd_client(replicas[0]).call({
-                    "cmd": "delete_object", "coll": coll,
-                    "oid": f"0:{name}", "replicas": replicas})
-            except (OSError, IOError):
-                self.drop_osd_client(replicas[0])
-                raise
-            return int(r["acks"])
+            last: Optional[Exception] = None
+            for attempt in range(3):
+                replicas = [o for o in up if o != ITEM_NONE]
+                if not replicas:
+                    raise IOError(f"{name}: no live replica target")
+                try:
+                    r = self.osd_call(replicas[0], {
+                        "cmd": "delete_object", "coll": coll,
+                        "oid": f"0:{name}", "replicas": replicas})
+                    return int(r["acks"])
+                except (OSError, IOError) as e:
+                    last = e
+                    if attempt < 2:
+                        time.sleep(0.05 * (attempt + 1))
+                        try:
+                            self.refresh_map()
+                        except (OSError, IOError):
+                            pass
+                        up = self._up(pool, pg)
+            raise IOError(f"{name}: delete failed after retries "
+                          f"({last})")
         acks = 0
         codec = self.codec_for(pool)
         for shard in range(codec.get_chunk_count()):
@@ -483,15 +577,38 @@ class RemoteCluster:
         names = set()
         for pg in range(pool.pg_num):
             ups = self._up(pool, pg)
-            prim = next((o for o in ups if o != ITEM_NONE), None)
-            if prim is None:
+            members = [o for o in ups if o != ITEM_NONE]
+            if not members:
                 continue
-            try:
-                listed = self.osd_client(prim).call(
-                    {"cmd": "list_pg", "coll": [pool_id, pg]})
-            except (OSError, IOError):
-                self.drop_osd_client(prim)
-                continue
+            # the PRIMARY is the one member guaranteed current (it
+            # applies every write locally before fanning out), so ask
+            # it first; if it is truly unreachable, fall back to the
+            # UNION of the other members' listings — a single replica
+            # that missed a degraded write must not hide the object
+            listed: Optional[List[str]] = None
+            for _ in range(3):
+                try:
+                    listed = self.osd_call(
+                        members[0],
+                        {"cmd": "list_pg", "coll": [pool_id, pg]})
+                    break
+                except (OSError, IOError):
+                    time.sleep(0.05)
+            if listed is None:
+                union: set = set()
+                got_any = False
+                for tgt in members[1:]:
+                    try:
+                        union.update(self.osd_call(
+                            tgt,
+                            {"cmd": "list_pg", "coll": [pool_id, pg]}))
+                        got_any = True
+                    except (OSError, IOError):
+                        pass
+                if not got_any:
+                    raise IOError(
+                        f"pg {pool_id}.{pg}: no member listable")
+                listed = sorted(union)
             for n in listed:
                 # PG-internal rows ("meta:pglog") carry no shard
                 # prefix; data objects are "<shard>:<name>"
@@ -518,12 +635,16 @@ class RemoteCluster:
             members = [o for o in up if o != ITEM_NONE]
             if not members:
                 continue
-            try:
-                r = self.osd_client(members[0]).call({
-                    "cmd": "recover_pg", "coll": [pool_id, pg],
-                    "members": members})
-            except (OSError, IOError):
-                self.drop_osd_client(members[0])
+            r = None
+            for _ in range(3):       # a skipped PG stays unrepaired
+                try:
+                    r = self.osd_call(members[0], {
+                        "cmd": "recover_pg", "coll": [pool_id, pg],
+                        "members": members})
+                    break
+                except (OSError, IOError):
+                    time.sleep(0.05)
+            if r is None:
                 continue
             for key in ("copied", "delta_objects",
                         "backfill_objects", "deletes_applied"):
@@ -551,12 +672,16 @@ class RemoteCluster:
             members = [o for o in up if o != ITEM_NONE]
             if not members:
                 continue
-            try:
-                r = self.osd_client(members[0]).call({
-                    "cmd": "scrub_pg", "coll": [pool_id, pg],
-                    "members": members, "repair": repair})
-            except (OSError, IOError):
-                self.drop_osd_client(members[0])
+            r = None
+            for _ in range(3):       # a skipped PG goes unscrubbed
+                try:
+                    r = self.osd_call(members[0], {
+                        "cmd": "scrub_pg", "coll": [pool_id, pg],
+                        "members": members, "repair": repair})
+                    break
+                except (OSError, IOError):
+                    time.sleep(0.05)
+            if r is None:
                 continue
             totals["objects"] += r["objects"]
             totals["inconsistent"].extend(

@@ -80,16 +80,24 @@ class WireServer:
 
     def __init__(self, sock_path: str, service: str, keyring: cx.Keyring,
                  handler: Callable[[str, Dict[str, Any]], Any],
-                 secret_mode_keyring: Optional[cx.Keyring] = None):
+                 secret_mode_keyring: Optional[cx.Keyring] = None,
+                 inject_socket_failures: int = 0):
         """``handler(entity, request) -> reply_obj`` (may raise).
         ``secret_mode_keyring``: when set (the mon), clients may
         authenticate by entity secret; otherwise only tickets sealed
-        under this service's secret are accepted."""
+        under this service's secret are accepted.
+        ``inject_socket_failures``: fault injection (the reference's
+        ms_inject_socket_failures option, src/common/options.cc) —
+        on average one in N requests has its connection dropped
+        WITHOUT a reply, exercising every client's reconnect/retry
+        path; 0 disables."""
         self.sock_path = sock_path
         self.service = service
         self.keyring = keyring
         self.secret_mode_keyring = secret_mode_keyring
         self.handler = handler
+        self.inject_socket_failures = int(inject_socket_failures)
+        self.injected = 0
         self.auth_failures = 0
         self._stop = threading.Event()
         if os.path.exists(sock_path):
@@ -167,6 +175,13 @@ class WireServer:
                     return
                 if env.type != MSG_REQ:
                     continue
+                if self.inject_socket_failures > 0 and \
+                        secrets.randbelow(
+                            self.inject_socket_failures) == 0:
+                    # drop the connection mid-op, no reply — the
+                    # msgr-failure-injection suite axis
+                    self.injected += 1
+                    return
                 try:
                     req = encoding.loads(env.payload)
                     reply = self.handler(entity, req)
@@ -322,7 +337,9 @@ class MonDaemon:
             os.path.join(cluster_dir, f"mon.{rank}.sock")
         self.server = WireServer(
             sock, "mon.", self.keyring, self._handle,
-            secret_mode_keyring=self.keyring)
+            secret_mode_keyring=self.keyring,
+            inject_socket_failures=int(
+                spec.get("ms_inject_socket_failures", 0)))
         if self.n_mons > 1 and rank == 0:
             # back-compat alias: clients that only know "mon.sock"
             # reach rank 0 through a symlink
@@ -612,7 +629,9 @@ class OSDDaemon:
         self._stop = threading.Event()
         self.server = WireServer(
             os.path.join(cluster_dir, f"osd.{osd_id}.sock"),
-            self.entity, self.keyring, self._handle)
+            self.entity, self.keyring, self._handle,
+            inject_socket_failures=int(
+                spec.get("ms_inject_socket_failures", 0)))
         self._hb_misses: Dict[int, int] = {}
 
     # ----------------------------------------------------------- mon I/O --
@@ -660,9 +679,26 @@ class OSDDaemon:
             c.close()
 
     def boot(self) -> None:
-        mon = self.mon_client()
-        mon.call({"cmd": "osd_boot", "osd": self.id})
-        self._map = mon.call({"cmd": "get_map"})
+        """Announce up + fetch the map (MOSDBoot).  Retries with a
+        fresh mon connection: a transient drop (mon restarting,
+        injected socket failure) at boot must not kill the daemon."""
+        last: Optional[Exception] = None
+        for attempt in range(5):
+            try:
+                mon = self.mon_client()
+                mon.call({"cmd": "osd_boot", "osd": self.id})
+                self._map = mon.call({"cmd": "get_map"})
+                return
+            except (OSError, IOError) as e:
+                last = e
+                if self._mon is not None:
+                    try:
+                        self._mon.close()
+                    except OSError:
+                        pass
+                    self._mon = None
+                time.sleep(0.1 * (attempt + 1))
+        raise IOError(f"osd.{self.id}: boot failed ({last})")
 
     def _pglog(self, coll: Tuple[int, int]):
         from .daemon_pglog import DurablePGLog
@@ -890,7 +926,8 @@ class OSDDaemon:
             return {"osd": self.id,
                     "objects": sum(
                         len(self.store.list_objects(c))
-                        for c in self.store.list_collections())}
+                        for c in self.store.list_collections()),
+                    "injected_failures": self.server.injected}
         if cmd == "fsck":
             return [list(map(str, b)) for b in self.store.fsck()]
         raise ValueError(f"unknown osd command {cmd!r}")
@@ -1139,6 +1176,16 @@ class OSDDaemon:
                 self._mon = None
                 continue
             up = self._map.get("osd_up", [])
+            # spuriously marked down (missed heartbeats during a stall
+            # or injected drops) but clearly alive: re-announce — the
+            # reference OSD re-sends MOSDBoot when it sees itself down
+            # in a newer map (OSD::_committed_osd_maps)
+            if self.id < len(up) and not up[self.id]:
+                try:
+                    self.mon_client().call(
+                        {"cmd": "osd_boot", "osd": self.id})
+                except (OSError, IOError):
+                    self._mon = None
             for peer in range(len(up)):
                 if peer == self.id or not up[peer]:
                     continue

@@ -33,7 +33,8 @@ def build_cluster_dir(cluster_dir: str, n_osds: int = 6,
                       bluestore_device_bytes: int = 1 << 28,
                       bluestore_min_alloc_size: int = 4096,
                       bluestore_compression: str = "",
-                      fsck_on_mount: bool = False) -> None:
+                      fsck_on_mount: bool = False,
+                      ms_inject_socket_failures: int = 0) -> None:
     """Write crushmap.txt, cluster.json and keyrings."""
     os.makedirs(cluster_dir, exist_ok=True)
     from ..placement.builder import TYPE_HOST, build_flat_cluster
@@ -60,7 +61,8 @@ def build_cluster_dir(cluster_dir: str, n_osds: int = 6,
                "bluestore_device_bytes": bluestore_device_bytes,
                "bluestore_min_alloc_size": bluestore_min_alloc_size,
                "bluestore_compression_algorithm": bluestore_compression,
-               "fsck_on_mount": fsck_on_mount},
+               "fsck_on_mount": fsck_on_mount,
+               "ms_inject_socket_failures": ms_inject_socket_failures},
               open(os.path.join(cluster_dir, "cluster.json"), "w"))
     names = ["mon.", "client.admin"] + \
         [f"mon.{r}" for r in range(n_mons)] + \

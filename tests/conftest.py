@@ -72,6 +72,7 @@ SLOW_TESTS = {
     "test_rados_put_get_ls_rm",
     "test_ceph_df_counts_objects",
     "test_delete_is_logged_no_resurrection",
+    "test_workload_survives_socket_failures",
 }
 
 
