@@ -1,0 +1,141 @@
+"""`radosgw-admin` — RGW administration CLI.
+
+The reference's gateway admin tool (src/rgw/rgw_admin.cc): user
+lifecycle (create/info/rm/suspend/enable/key create/list), bucket
+listing and stats, GC listing/processing, and the realm/zonegroup/
+zone/period command family.  Drives the same library objects the
+gateway runs on (UserStore, RGWGateway, Realm), so everything it
+prints is the gateway's own truth.
+
+Library-style invocation (tests and embedders):
+
+    main(["user", "create", "--uid", "alice"], ioctx=io, out=buf)
+
+`--dir/--pool` process-cluster wiring is not exposed because the RGW
+slice runs over librados in-process (the reference links librados
+directly too); callers construct the ioctx.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import List, Optional
+
+
+def main(argv: Optional[List[str]] = None, ioctx=None, out=None) -> int:
+    out = out or sys.stdout
+    if ioctx is None:
+        raise SystemExit("radosgw-admin: an ioctx must be provided "
+                         "(library invocation)")
+    ap = argparse.ArgumentParser(prog="radosgw-admin")
+    ap.add_argument("words", nargs="+")
+    ap.add_argument("--uid")
+    ap.add_argument("--display-name", default="")
+    ap.add_argument("--bucket")
+    ap.add_argument("--realm", default="default")
+    ap.add_argument("--rgw-zonegroup")
+    ap.add_argument("--rgw-zone")
+    ap.add_argument("--master", action="store_true")
+    ap.add_argument("--commit", action="store_true")
+    ns = ap.parse_args(argv)
+    w = ns.words
+    _MIN = {"user": 2, "key": 2, "bucket": 2, "gc": 2, "realm": 2,
+            "zonegroup": 2, "zone": 2, "period": 2}
+    if len(w) < _MIN.get(w[0], 1):
+        ap.error(f"{w[0]}: missing subcommand")
+
+    from ..rgw import Realm, RGWError, RGWGateway
+    from ..rgw.users import UserError, UserStore
+
+    def emit(obj) -> int:
+        out.write(json.dumps(obj, indent=2, sort_keys=True) + "\n")
+        return 0
+
+    users = UserStore(ioctx)
+    gw = RGWGateway(ioctx)
+    try:
+        # ------------------------------------------------------- user --
+        if w[0] == "user":
+            if w[1] == "create":
+                if not ns.uid:
+                    ap.error("user create requires --uid")
+                return emit(users.create(ns.uid, ns.display_name))
+            if w[1] == "info":
+                return emit(users.info(ns.uid))
+            if w[1] == "rm":
+                users.rm(ns.uid)
+                return emit({"removed": ns.uid})
+            if w[1] == "suspend":
+                return emit(users.suspend(ns.uid, True))
+            if w[1] == "enable":
+                return emit(users.suspend(ns.uid, False))
+            if w[1] == "list":
+                return emit(users.list_users())
+        if w[0] == "key" and w[1] == "create":
+            return emit(users.key_create(ns.uid))
+        # ----------------------------------------------------- bucket --
+        if w[0] == "bucket":
+            if w[1] == "list":
+                return emit(gw.list_buckets())
+            if w[1] == "stats":
+                names = [ns.bucket] if ns.bucket else gw.list_buckets()
+                stats = {}
+                for name in names:
+                    b = gw.bucket(name)
+                    objs = b.list_objects(max_keys=1 << 30)["contents"]
+                    stats[name] = {
+                        "num_objects": len(objs),
+                        "size": sum(o["size"] for o in objs)}
+                return emit(stats)
+        # --------------------------------------------------------- gc --
+        if w[0] == "gc":
+            if w[1] == "list":
+                return emit(gw.gc_list())
+            if w[1] == "process":
+                return emit({"reclaimed": gw.gc_process()})
+        # ------------------------------------------- realm/zone/period --
+        if w[0] in ("realm", "zonegroup", "zone", "period"):
+            # constructed only on this family: Realm load-or-create
+            # durably writes a default record, and a failed unrelated
+            # command must not mutate the pool
+            realm = Realm(ioctx, ns.realm)
+            if w[:2] == ["realm", "create"]:
+                return emit({"realm": ns.realm,
+                             "current_period":
+                                 realm.current_period_id})
+            if w[:2] == ["zonegroup", "create"]:
+                if not ns.rgw_zonegroup:
+                    ap.error("zonegroup create requires "
+                             "--rgw-zonegroup")
+                g = realm.create_zonegroup(ns.rgw_zonegroup,
+                                           master=ns.master)
+                return emit(g.to_dict())
+            if w[:2] == ["zone", "create"]:
+                if not (ns.rgw_zonegroup and ns.rgw_zone):
+                    ap.error("zone create requires --rgw-zonegroup "
+                             "and --rgw-zone")
+                z = realm.create_zone(ns.rgw_zonegroup, ns.rgw_zone,
+                                      master=ns.master)
+                return emit(z.to_dict())
+            if w[0] == "period":
+                if w[1] == "update" and not ns.commit:
+                    # staging is already durable; nothing else to do
+                    return emit({"staged": True})
+                if w[1] in ("update", "commit"):
+                    p = realm.commit_period()
+                    return emit(p.to_dict())
+                if w[1] == "list":
+                    return emit(realm.period_history())
+                if w[1] == "get":
+                    p = realm.current_period()
+                    return emit(p.to_dict() if p else None)
+        ap.error(f"unknown command: {' '.join(w)}")
+        return 2
+    except (UserError, RGWError) as e:
+        out.write(str(e) + "\n")
+        return 1
+
+
+if __name__ == "__main__":
+    main()

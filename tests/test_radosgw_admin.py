@@ -1,0 +1,136 @@
+"""radosgw-admin CLI + durable RGW user store.
+
+Reference roles: src/rgw/rgw_admin.cc (user/bucket/gc/realm command
+families), src/rgw/rgw_user.cc (user records + access-key index).
+"""
+import io
+import json
+
+import pytest
+
+from ceph_tpu.client.rados import Rados
+from ceph_tpu.cluster.monitor import Monitor
+from ceph_tpu.rgw import RGWGateway
+from ceph_tpu.rgw.users import UserError, UserStore
+from ceph_tpu.tools.radosgw_admin import main as adm
+from tests.test_snaps import make_sim
+
+
+@pytest.fixture()
+def ioctx():
+    sim = make_sim()
+    return Rados(sim, Monitor(sim.osdmap)).connect().open_ioctx("rep")
+
+
+def run(ioctx, *args):
+    out = io.StringIO()
+    rc = adm(list(args), ioctx=ioctx, out=out)
+    return rc, out.getvalue()
+
+
+def test_user_lifecycle(ioctx):
+    rc, txt = run(ioctx, "user", "create", "--uid", "alice",
+                  "--display-name", "Alice")
+    assert rc == 0
+    rec = json.loads(txt)
+    assert rec["uid"] == "alice" and rec["keys"][0]["access_key"]
+    # duplicate refused
+    rc, txt = run(ioctx, "user", "create", "--uid", "alice")
+    assert rc == 1 and "UserAlreadyExists" in txt
+    rc, txt = run(ioctx, "user", "list")
+    assert json.loads(txt) == ["alice"]
+    rc, txt = run(ioctx, "key", "create", "--uid", "alice")
+    assert rc == 0
+    rc, txt = run(ioctx, "user", "info", "--uid", "alice")
+    assert len(json.loads(txt)["keys"]) == 2
+    rc, txt = run(ioctx, "user", "rm", "--uid", "alice")
+    assert rc == 0
+    rc, txt = run(ioctx, "user", "info", "--uid", "alice")
+    assert rc == 1
+
+
+def test_user_store_feeds_sigv4_frontend(ioctx):
+    """Users created by the admin CLI authenticate against the S3
+    frontend; suspension revokes them."""
+    from ceph_tpu.rgw.auth_s3 import sign_request, verify_request
+    store = UserStore(ioctx)
+    rec = store.create("bob")
+    ak = rec["keys"][0]["access_key"]
+    sk = rec["keys"][0]["secret_key"]
+    users = store.auth_users()
+    assert users[ak]["secret"] == sk
+    headers = {"Host": "x",
+               **sign_request("GET", "/b/o", "", {"Host": "x"}, b"",
+                              ak, sk)}
+    assert verify_request("GET", "/b/o", "", headers, b"", users)
+    # key lookup index resolves, suspension hides the user
+    assert store.lookup_access_key(ak)["uid"] == "bob"
+    store.suspend("bob")
+    assert store.lookup_access_key(ak) is None
+    assert ak not in store.auth_users()
+    # swift view exists too
+    store.suspend("bob", False)
+    assert f"bob:swift" in store.swift_users()
+
+
+def test_bucket_and_gc_commands(ioctx):
+    gw = RGWGateway(ioctx)
+    b = gw.create_bucket("data")
+    b.put_object("a", b"x" * 100)
+    b.put_object("b", b"y" * 50)
+    rc, txt = run(ioctx, "bucket", "list")
+    assert json.loads(txt) == ["data"]
+    rc, txt = run(ioctx, "bucket", "stats", "--bucket", "data")
+    st = json.loads(txt)["data"]
+    assert st["num_objects"] == 2 and st["size"] == 150
+    # gc: overwrite orphans the old generation, process reclaims
+    b.put_object("a", b"z" * 100)
+    rc, txt = run(ioctx, "gc", "list")
+    assert rc == 0
+    rc, txt = run(ioctx, "gc", "process")
+    assert rc == 0
+
+
+def test_realm_command_family(ioctx):
+    rc, txt = run(ioctx, "realm", "create", "--realm", "earth")
+    assert rc == 0
+    rc, txt = run(ioctx, "zonegroup", "create", "--realm", "earth",
+                  "--rgw-zonegroup", "us", "--master")
+    assert rc == 0 and json.loads(txt)["name"] == "us"
+    rc, txt = run(ioctx, "zone", "create", "--realm", "earth",
+                  "--rgw-zonegroup", "us", "--rgw-zone", "us-east",
+                  "--master")
+    assert rc == 0
+    # the reference spelling commits too
+    rc, txt = run(ioctx, "period", "update", "--commit",
+                  "--realm", "earth")
+    p = json.loads(txt)
+    assert rc == 0 and p["epoch"] == 1
+    assert p["zonegroups"]["us"]["master_zone"] == "us-east"
+    rc, txt = run(ioctx, "period", "list", "--realm", "earth")
+    assert json.loads(txt) == [p["period_id"]]
+    rc, txt = run(ioctx, "period", "get", "--realm", "earth")
+    assert json.loads(txt)["period_id"] == p["period_id"]
+
+
+def test_failed_command_does_not_create_realm(ioctx):
+    """An unknown command must not durably mint a default realm as a
+    side effect (code-review finding)."""
+    with pytest.raises(SystemExit):
+        run(ioctx, "user", "frobnicate", "--uid", "x")
+    assert not any(o.startswith("rgw.realm.")
+                   for o in ioctx.list_objects())
+    with pytest.raises(SystemExit):
+        run(ioctx, "user")                    # missing subcommand
+
+
+def test_corrupt_user_record_not_clobbered(ioctx):
+    """A torn/invalid user record reads as CorruptUser, and create()
+    refuses to overwrite it (code-review finding)."""
+    store = UserStore(ioctx)
+    store.create("carol")
+    ioctx.write_full("rgw.user.carol", b"{torn-json")
+    with pytest.raises(UserError, match="CorruptUser"):
+        store.info("carol")
+    with pytest.raises(UserError, match="CorruptUser"):
+        store.create("carol")                 # no silent regeneration

@@ -1,0 +1,88 @@
+"""`rbd` CLI over the librbd slice.
+
+Reference role: src/tools/rbd/ (image lifecycle, snap family, clone
+layering through the CLI).
+"""
+import io
+import json
+
+import pytest
+
+from ceph_tpu.client.rados import Rados
+from ceph_tpu.client.rbd import Image
+from ceph_tpu.cluster.monitor import Monitor
+from ceph_tpu.tools.rbd_cli import main as rbd_main
+from tests.test_snaps import make_sim
+
+
+@pytest.fixture()
+def ioctx():
+    sim = make_sim()
+    return Rados(sim, Monitor(sim.osdmap)).connect().open_ioctx("rep")
+
+
+def run(ioctx, *args):
+    out = io.StringIO()
+    rc = rbd_main(list(args), ioctx=ioctx, out=out)
+    return rc, out.getvalue()
+
+
+def test_image_lifecycle(ioctx):
+    rc, txt = run(ioctx, "create", "disk", "--size", str(1 << 22))
+    assert rc == 0
+    rc, txt = run(ioctx, "ls")
+    assert json.loads(txt) == ["disk"]
+    rc, txt = run(ioctx, "create", "disk", "--size", "1024")
+    assert rc == 1                            # duplicate
+    rc, txt = run(ioctx, "info", "disk")
+    info = json.loads(txt)
+    assert info["size"] == 1 << 22 and info["parent"] is None
+    rc, txt = run(ioctx, "resize", "disk", "--size", str(1 << 23))
+    assert rc == 0
+    assert json.loads(run(ioctx, "info", "disk")[1])["size"] == 1 << 23
+    rc, txt = run(ioctx, "rm", "disk")
+    assert rc == 0
+    assert json.loads(run(ioctx, "ls")[1]) == []
+
+
+def test_snap_and_clone_family(ioctx):
+    run(ioctx, "create", "base", "--size", str(1 << 22))
+    img = Image(ioctx, "base")
+    img.write(0, b"golden-bytes")
+    rc, _ = run(ioctx, "snap", "create", "base@gold")
+    assert rc == 0
+    assert json.loads(run(ioctx, "snap", "ls", "base")[1]) == ["gold"]
+    # mutate, then roll back to the snap
+    Image(ioctx, "base").write(0, b"BROKEN-BYTES")
+    rc, _ = run(ioctx, "snap", "rollback", "base@gold")
+    assert rc == 0
+    assert Image(ioctx, "base").read(0, 12) == b"golden-bytes"
+    # protect + clone + children + flatten
+    rc, _ = run(ioctx, "snap", "protect", "base@gold")
+    assert rc == 0
+    rc, _ = run(ioctx, "clone", "base@gold", "child")
+    assert rc == 0
+    assert json.loads(run(ioctx, "children", "base@gold")[1]) \
+        == ["child"]
+    # children lists only the NAMED snap's clones
+    run(ioctx, "snap", "create", "base@other")
+    run(ioctx, "snap", "protect", "base@other")
+    run(ioctx, "clone", "base@other", "child2")
+    assert json.loads(run(ioctx, "children", "base@gold")[1]) \
+        == ["child"]
+    assert json.loads(run(ioctx, "children", "base@other")[1]) \
+        == ["child2"]
+    run(ioctx, "flatten", "child2")
+    run(ioctx, "snap", "unprotect", "base@other")
+    run(ioctx, "snap", "rm", "base@other")
+    assert Image(ioctx, "child").read(0, 12) == b"golden-bytes"
+    # protected snap cannot be removed while a child exists
+    rc, txt = run(ioctx, "snap", "rm", "base@gold")
+    assert rc == 1
+    rc, _ = run(ioctx, "flatten", "child")
+    assert rc == 0
+    assert json.loads(run(ioctx, "info", "child")[1])["parent"] is None
+    rc, _ = run(ioctx, "snap", "unprotect", "base@gold")
+    assert rc == 0
+    rc, _ = run(ioctx, "snap", "rm", "base@gold")
+    assert rc == 0
