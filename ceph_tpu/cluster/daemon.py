@@ -295,7 +295,8 @@ class MonDaemon:
     src/mon/Elector.h:37, Paxos.{h,cc}, MonitorDBStore.h.
     """
 
-    MUTATIONS = ("osd_boot", "report_failure", "mark_out",
+    MUTATIONS = ("osd_boot", "report_failure", "mark_out", "mark_in",
+                 "pool_create", "pool_rm",
                  "pool_snap_create", "pool_snap_remove")
 
     def __init__(self, cluster_dir: str, rank: int = 0):
@@ -444,10 +445,19 @@ class MonDaemon:
     def map_blob(self) -> Dict[str, Any]:
         from ..placement.compiler import decompile_crushmap
         m = self.mon.osdmap
+        # pools come from the LIVE map (committed incrementals create
+        # and remove them at runtime), not the static bootstrap spec
+        pools = [{"id": p.id, "name": p.name, "type": p.type,
+                  "size": p.size, "min_size": p.min_size,
+                  "pg_num": p.pg_num, "crush_rule": p.crush_rule,
+                  "erasure_code_profile": p.erasure_code_profile,
+                  "stripe_unit": p.stripe_unit}
+                 for p in m.pools.values()]
         return {
             "epoch": m.epoch,
             "crush_text": decompile_crushmap(m.crush),
-            "pools": self.spec["pools"],
+            "pools": pools,
+            "pool_id_max": m.pool_id_max,
             "osd_up": [bool(v) for v in m.osd_up[:m.max_osd]],
             "osd_weight": [int(v) for v in m.osd_weight[:m.max_osd]],
             "addrs": {str(i): os.path.join(self.dir, f"osd.{i}.sock")
@@ -460,7 +470,7 @@ class MonDaemon:
                 str(p["id"]): (self.mon.config_get(
                     f"pool.{p['id']}.snaps") or
                     {"seq": 0, "snaps": {}})
-                for p in self.spec["pools"]},
+                for p in pools},
         }
 
     def _forward_to_leader(self, entity: str,
@@ -525,6 +535,67 @@ class MonDaemon:
                 inc.new_weight[int(req["osd"])] = 0
                 self.mon.commit_incremental(inc)
                 return {"epoch": self.mon.osdmap.epoch}
+            if cmd == "mark_in":
+                inc = self.mon.next_incremental()
+                inc.new_weight[int(req["osd"])] = 0x10000
+                self.mon.commit_incremental(inc)
+                return {"epoch": self.mon.osdmap.epoch}
+            if cmd == "pool_create":
+                # `ceph osd pool create` (OSDMonitor::prepare_new_pool):
+                # the new pool rides one committed incremental, so every
+                # map subscriber learns it atomically
+                m = self.mon.osdmap
+                spec = {"name": req["name"],
+                        "type": int(req.get("type", 1)),
+                        "size": int(req.get("size", 3)),
+                        "min_size": int(req.get("min_size", 2)),
+                        "pg_num": int(req.get("pg_num", 16)),
+                        "crush_rule": int(req.get("crush_rule", 0)),
+                        "erasure_code_profile":
+                            req.get("erasure_code_profile", "")}
+                existing = next((p for p in m.pools.values()
+                                 if p.name == req["name"]), None)
+                if existing is not None:
+                    # idempotent on an identical spec (a retried
+                    # request whose reply was lost must not report a
+                    # committed create as failed); a DIFFERENT spec
+                    # under the same name is a genuine conflict
+                    same = all(getattr(existing, k) == v
+                               for k, v in spec.items())
+                    if same:
+                        return {"pool_id": existing.id,
+                                "epoch": m.epoch, "existed": True}
+                    raise ValueError(
+                        f"pool {req['name']!r} already exists "
+                        "with a different spec")
+                # NEVER reuse a deleted pool's id (data exposure:
+                # surviving objects/snap state would leak into the
+                # new pool) — allocate past the high-water mark
+                pid = max(m.pool_id_max, max(m.pools, default=0)) + 1
+                inc = self.mon.next_incremental()
+                inc.new_pools[pid] = spec
+                if not self.mon.commit_incremental(inc):
+                    raise IOError("pool create: no quorum")
+                return {"pool_id": pid, "epoch": m.epoch,
+                        "existed": False}
+            if cmd == "pool_rm":
+                m = self.mon.osdmap
+                pid = next((p.id for p in m.pools.values()
+                            if p.name == req["name"]), None)
+                if pid is None:
+                    # idempotent: a retried rm whose first reply was
+                    # lost already succeeded
+                    return {"pool_id": None, "epoch": m.epoch,
+                            "existed": False}
+                inc = self.mon.next_incremental()
+                inc.old_pools.append(pid)
+                if not self.mon.commit_incremental(inc):
+                    raise IOError("pool rm: no quorum")
+                # the dead pool's committed snap state goes with it
+                self.mon.config_set(f"pool.{pid}.snaps",
+                                    {"seq": 0, "snaps": {}})
+                return {"pool_id": pid, "epoch": m.epoch,
+                        "existed": True}
             if cmd == "pool_snap_create":
                 # pool snapshot state is COMMITTED mon state (the
                 # pg_pool_t::snap_seq + snaps role, committed through
@@ -1167,6 +1238,31 @@ class OSDDaemon:
                 "inconsistent": inconsistent, "repaired": repaired}
 
     # --------------------------------------------------------- heartbeats --
+    def _purge_dead_pools(self) -> None:
+        """Map-driven PG teardown (the reference removes a deleted
+        pool's PGs when the map lands): drop collections whose pool is
+        gone from the fetched map.  Gated on the monotonic pool-id
+        high-water mark so a collection created by a put that RACED
+        this OSD's stale map (its pool id is above the fetched
+        pool_id_max) is never mistaken for deleted-pool debris."""
+        pool_id_max = int(self._map.get("pool_id_max", 0))
+        if not pool_id_max:
+            return               # pre-upgrade mon: no purge authority
+        live = {int(p["id"]) for p in self._map.get("pools", [])}
+        from .objectstore import Transaction
+        for coll in list(self.store.list_collections()):
+            pid = coll[0]
+            if pid in live or pid > pool_id_max:
+                continue
+            with self._pg_lock(tuple(coll)):
+                txn = Transaction()
+                for oid in self.store.list_objects(coll):
+                    txn.remove(coll, oid)
+                if len(txn):
+                    self.store.apply_transaction(txn)
+            with self._pglog_lock:
+                self._pglogs.pop(tuple(coll), None)
+
     def _heartbeat_loop(self, interval: float, grace: int) -> None:
         while not self._stop.is_set():
             time.sleep(interval)
@@ -1175,6 +1271,7 @@ class OSDDaemon:
             except (OSError, IOError):
                 self._mon = None
                 continue
+            self._purge_dead_pools()
             up = self._map.get("osd_up", [])
             # spuriously marked down (missed heartbeats during a stall
             # or injected drops) but clearly alive: re-announce — the

@@ -157,6 +157,8 @@ class Monitor:
                 for (p, g), temp in inc.new_pg_temp.items()},
             "new_pool_pg_num": {str(k): int(v)
                                 for k, v in inc.new_pool_pg_num.items()},
+            "new_pools": {str(k): v for k, v in inc.new_pools.items()},
+            "old_pools": list(inc.old_pools),
         }).encode()
 
     @staticmethod
@@ -180,6 +182,9 @@ class Monitor:
             new_pool_pg_num={int(k): int(v)
                              for k, v in d.get("new_pool_pg_num",
                                                {}).items()},
+            new_pools={int(k): v
+                       for k, v in d.get("new_pools", {}).items()},
+            old_pools=[int(p) for p in d.get("old_pools", [])],
         )
 
     @classmethod

@@ -146,6 +146,11 @@ class Incremental:
         field(default_factory=dict)
     # pool mutations (OSDMap::Incremental new_pools subset)
     new_pool_pg_num: Dict[int, int] = field(default_factory=dict)
+    # pool creation/removal (new_pools full specs / old_pools):
+    # values are PGPool constructor kwargs so the delta is
+    # JSON-serializable for the mon quorum's decree log
+    new_pools: Dict[int, dict] = field(default_factory=dict)
+    old_pools: List[int] = field(default_factory=list)
 
 
 class OSDMap:
@@ -162,6 +167,10 @@ class OSDMap:
         self.osd_primary_affinity = np.full(n, MAX_PRIMARY_AFFINITY,
                                             dtype=np.int64)
         self.pools: Dict[int, PGPool] = {}
+        # monotonic pool-id high-water mark (the reference's
+        # new_pool_max): a deleted pool's id is NEVER reused, or the
+        # next pool would inherit its surviving objects/snap state
+        self.pool_id_max = 0
         self.pg_temp: Dict[Tuple[int, int], List[int]] = {}
         self.primary_temp: Dict[Tuple[int, int], int] = {}
         self.pg_upmap: Dict[Tuple[int, int], List[int]] = {}
@@ -200,6 +209,16 @@ class OSDMap:
             if pool is not None:
                 pool.pg_num = pg_num
                 pool.pgp_num = pg_num
+        for pid, spec in inc.new_pools.items():
+            self.pools[pid] = PGPool(**{**spec, "id": pid})
+            self.pool_id_max = max(self.pool_id_max, pid)
+        for pid in inc.old_pools:
+            self.pools.pop(pid, None)
+            # stale placement overrides keyed by the dead pool go too
+            for table in (self.pg_temp, self.primary_temp,
+                          self.pg_upmap, self.pg_upmap_items):
+                for key in [k for k in table if k[0] == pid]:
+                    del table[key]
         self.epoch = inc.epoch
 
     def set_osd(self, osd: int, *, exists=True, up=True,
@@ -223,6 +242,7 @@ class OSDMap:
 
     def add_pool(self, pool: PGPool) -> None:
         self.pools[pool.id] = pool
+        self.pool_id_max = max(self.pool_id_max, pool.id)
 
     def exists(self, osd: int) -> bool:
         return 0 <= osd < self.max_osd and bool(self.osd_exists[osd])

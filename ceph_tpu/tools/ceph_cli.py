@@ -108,6 +108,38 @@ def cmd_osd_out(rc, osd: int, out) -> int:
     return 0
 
 
+def cmd_osd_in(rc, osd: int, out) -> int:
+    r = rc.mon_call({"cmd": "mark_in", "osd": osd})
+    out.write(f"marked in osd.{osd} ({json.dumps(r)})\n")
+    return 0
+
+
+def cmd_pool_create(rc, name: str, pg_num: int, ptype: str,
+                    size: int, out) -> int:
+    from ..cluster.osdmap import POOL_ERASURE, POOL_REPLICATED
+    r = rc.mon_call({
+        "cmd": "pool_create", "name": name, "pg_num": pg_num,
+        "type": POOL_ERASURE if ptype == "erasure"
+        else POOL_REPLICATED,
+        "size": size,
+        "crush_rule": 1 if ptype == "erasure" else 0,
+        "erasure_code_profile":
+            "default" if ptype == "erasure" else ""})
+    if r.get("existed"):
+        out.write(f"pool '{name}' already exists (id "
+                  f"{r['pool_id']})\n")
+    else:
+        out.write(f"pool '{name}' created (id {r['pool_id']}, "
+                  f"epoch {r['epoch']})\n")
+    return 0
+
+
+def cmd_pool_rm(rc, name: str, out) -> int:
+    r = rc.mon_call({"cmd": "pool_rm", "name": name})
+    out.write(f"pool '{name}' removed (epoch {r['epoch']})\n")
+    return 0
+
+
 def cmd_pool_ls(rc, detail: bool, out) -> int:
     names = _pool_types()
     for pid, pool in sorted(rc.osdmap.pools.items()):
@@ -151,6 +183,8 @@ def main(argv: Optional[List[str]] = None,
     ap.add_argument("--dir", required=True,
                     help="vstart cluster directory")
     ap.add_argument("--detail", action="store_true")
+    ap.add_argument("--size", type=int, default=3,
+                    help="replica count for `osd pool create`")
     ap.add_argument("words", nargs="+",
                     help="command, e.g.: status | health | mon stat | "
                          "osd tree | osd out N | osd pool ls | "
@@ -158,35 +192,52 @@ def main(argv: Optional[List[str]] = None,
     ns = ap.parse_args(argv)
     rc = _client(ns.dir)
     try:
-        w = ns.words
-
-        def arg(i: int) -> str:
-            if len(w) <= i:
-                ap.error(f"{' '.join(w)}: missing operand")
-            return w[i]
-
-        if w[0] in ("status", "-s"):
-            return cmd_status(rc, out)
-        if w[0] == "health":
-            return cmd_health(rc, out)
-        if w[:2] == ["mon", "stat"]:
-            return cmd_mon_stat(rc, out)
-        if w[:2] == ["osd", "tree"]:
-            return cmd_osd_tree(rc, ns.dir, out)
-        if w[:2] == ["osd", "out"]:
-            return cmd_osd_out(rc, int(arg(2)), out)
-        if w[:3] == ["osd", "pool", "ls"]:
-            return cmd_pool_ls(rc, ns.detail, out)
-        if w[:2] == ["pg", "dump"]:
-            return cmd_pg_dump(rc, int(arg(2)), out)
-        if w[0] == "df":
-            return cmd_df(rc, out)
-        if w[0] == "scrub":
-            return cmd_scrub(rc, int(arg(1)), out)
-        ap.error(f"unknown command: {' '.join(w)}")
-        return 2
+        return _dispatch(ap, ns, rc, out)
+    except (RuntimeError, ValueError, OSError) as e:
+        out.write(f"Error: {e}\n")
+        return 1
     finally:
         rc.close()
+
+
+def _dispatch(ap, ns, rc, out) -> int:
+    w = ns.words
+
+    def arg(i: int) -> str:
+        if len(w) <= i:
+            ap.error(f"{' '.join(w)}: missing operand")
+        return w[i]
+
+    if w[0] in ("status", "-s"):
+        return cmd_status(rc, out)
+    if w[0] == "health":
+        return cmd_health(rc, out)
+    if w[:2] == ["mon", "stat"]:
+        return cmd_mon_stat(rc, out)
+    if w[:2] == ["osd", "tree"]:
+        return cmd_osd_tree(rc, ns.dir, out)
+    if w[:2] == ["osd", "out"]:
+        return cmd_osd_out(rc, int(arg(2)), out)
+    if w[:2] == ["osd", "in"]:
+        return cmd_osd_in(rc, int(arg(2)), out)
+    if w[:3] == ["osd", "pool", "ls"]:
+        return cmd_pool_ls(rc, ns.detail, out)
+    if w[:3] == ["osd", "pool", "create"]:
+        name = arg(3)
+        pg_num = int(w[4]) if len(w) > 4 else 16
+        ptype = w[5] if len(w) > 5 else "replicated"
+        return cmd_pool_create(rc, name, pg_num, ptype,
+                               ns.size, out)
+    if w[:3] == ["osd", "pool", "rm"]:
+        return cmd_pool_rm(rc, arg(3), out)
+    if w[:2] == ["pg", "dump"]:
+        return cmd_pg_dump(rc, int(arg(2)), out)
+    if w[0] == "df":
+        return cmd_df(rc, out)
+    if w[0] == "scrub":
+        return cmd_scrub(rc, int(arg(1)), out)
+    ap.error(f"unknown command: {' '.join(w)}")
+    return 2
 
 
 if __name__ == "__main__":
