@@ -122,6 +122,12 @@ class IoCtx:
         sid = self.snap_lookup(snap_name)
         self._rados._sim.snap_rollback(self.pool_id, oid, sid)
 
+    def snap_rollback_id(self, oid: str, snap_id: int) -> None:
+        """Rollback by snap ID (selfmanaged-snap rollback role —
+        librbd tracks ids, not pool snap names); KeyError when the
+        object has no state at that snap."""
+        self._rados._sim.snap_rollback(self.pool_id, oid, snap_id)
+
     # ------------------------------------------------------------ exec --
     def exec(self, oid: str, cls: str, method: str,
              data: bytes = b"") -> bytes:

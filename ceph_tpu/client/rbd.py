@@ -239,14 +239,13 @@ class Image:
             raise KeyError(snap_name)
         rec = self.snaps[snap_name]
         sid = rec["id"]
-        sim = self.ioctx._rados._sim
         osize = 1 << self.info.order
         snap_objs = -(-rec["size"] // osize)
         covered = set(range(snap_objs)) | set(self._written_objects())
         for objno in sorted(covered):
             oid = self._oid(objno)
             try:
-                sim.snap_rollback(self.ioctx.pool_id, oid, sid)
+                self.ioctx.snap_rollback_id(oid, sid)
             except KeyError:
                 # no state at the snap: rolls back to absent
                 try:

@@ -298,7 +298,10 @@ class RemoteCluster:
 
     def get_snap(self, pool_id: int, name: str, snap_id: int) -> bytes:
         """Read an object AT a snapshot: clone covering it, else the
-        unchanged head (SnapSet resolution)."""
+        unchanged head (SnapSet resolution).  KeyError when the object
+        DID NOT EXIST at that snapshot — a head written at/after the
+        snap with no covering clone means the object was born later,
+        and serving the head would invent post-snap data."""
         pool = self.osdmap.pools[pool_id]
         pg = self._pg_for(pool, name)
         ss = self._snapset_of(pool, pg, name)
@@ -306,6 +309,8 @@ class RemoteCluster:
             for c in ss.get("clones", []):
                 if snap_id in c["snaps"]:
                     return self.get(pool_id, f"{name}@{c['id']}")
+            if int(ss.get("write_seq", 0)) >= snap_id:
+                raise KeyError(f"{name}: no state at snap {snap_id}")
         return self.get(pool_id, name)
 
     # ----------------------------------------------------------------- IO --

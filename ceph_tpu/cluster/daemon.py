@@ -263,7 +263,8 @@ class WireClient:
             env = wire.recv_frame(self.sock, session_key=self.key)
         if env.type == MSG_ERR:
             name, msg = encoding.loads(env.payload)
-            exc = {"IOError": IOError, "KeyError": KeyError,
+            exc = {"IOError": IOError, "OSError": IOError,
+                   "KeyError": KeyError,
                    "AuthError": cx.AuthError,
                    "PermissionError": PermissionError,
                    "ObjectStoreError": IOError}.get(name, RuntimeError)
@@ -797,9 +798,27 @@ class OSDDaemon:
             _, fn = self.sched.dequeue()
         return fn()
 
+    def _check_pool_live(self, coll) -> None:
+        """Refuse mutations into pools the fetched map says are
+        DELETED (same gate as _purge_dead_pools): acking a write the
+        next heartbeat will purge is silent data loss.  Pools newer
+        than this OSD's map (id above its pool_id_max) are accepted —
+        the map is merely stale."""
+        pool_id_max = int(self._map.get("pool_id_max", 0))
+        if not pool_id_max:
+            return
+        pid = int(coll[0])
+        if pid <= pool_id_max and \
+                pid not in {int(p["id"])
+                            for p in self._map.get("pools", [])}:
+            raise IOError(f"pool {pid} does not exist (deleted)")
+
     def _handle(self, entity: str, req: Dict[str, Any]) -> Any:
         cmd = req["cmd"]
         klass = req.get("klass", "client")
+        if cmd in ("put_shard", "put_object", "delete_object",
+                   "setattr_shard"):
+            self._check_pool_live(req["coll"])
         if cmd == "put_shard":
             coll = tuple(req["coll"])
             from .objectstore import Transaction
@@ -984,6 +1003,14 @@ class OSDDaemon:
             coll = tuple(req["coll"])
             try:
                 return self.store.stat(coll, req["oid"])["csum"]
+            except (IOError, KeyError):
+                return None
+        if cmd == "stat_shard":
+            # size/digest without payload transfer (rados_stat role)
+            coll = tuple(req["coll"])
+            try:
+                st = self.store.stat(coll, req["oid"])
+                return {"size": st["size"]}
             except (IOError, KeyError):
                 return None
         if cmd == "scrub_pg":
@@ -1248,6 +1275,10 @@ class OSDDaemon:
         pool_id_max = int(self._map.get("pool_id_max", 0))
         if not pool_id_max:
             return               # pre-upgrade mon: no purge authority
+        epoch = int(self._map.get("epoch", 0))
+        if epoch == getattr(self, "_last_purge_epoch", -1):
+            return               # nothing changed: skip the store scan
+        self._last_purge_epoch = epoch
         live = {int(p["id"]) for p in self._map.get("pools", [])}
         from .objectstore import Transaction
         for coll in list(self.store.list_collections()):

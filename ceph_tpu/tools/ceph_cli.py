@@ -136,7 +136,10 @@ def cmd_pool_create(rc, name: str, pg_num: int, ptype: str,
 
 def cmd_pool_rm(rc, name: str, out) -> int:
     r = rc.mon_call({"cmd": "pool_rm", "name": name})
-    out.write(f"pool '{name}' removed (epoch {r['epoch']})\n")
+    if r.get("existed"):
+        out.write(f"pool '{name}' removed (epoch {r['epoch']})\n")
+    else:
+        out.write(f"pool '{name}' did not exist\n")
     return 0
 
 
