@@ -305,6 +305,12 @@ class RemoteCluster:
         pool = self.osdmap.pools[pool_id]
         pg = self._pg_for(pool, name)
         ss = self._snapset_of(pool, pg, name)
+        if ss is None:
+            # deleted head: its snapset survives in the sidecar object
+            try:
+                ss = json.loads(self.get(pool_id, f"{name}@snapset"))
+            except (RemoteObjectMissing, IOError, ValueError):
+                ss = None
         if ss:
             for c in ss.get("clones", []):
                 if snap_id in c["snaps"]:
@@ -532,9 +538,20 @@ class RemoteCluster:
         entry + fan-out — src/osd/PrimaryLogPG.cc delete shape), so a
         down replica cannot resurrect the object on log-driven
         recovery.  EC pools delete per shard, mirroring this client's
-        shard-direct write path."""
+        shard-direct write path.
+
+        In a snapped pool the head is COW-preserved first and its
+        snapset moves to a sidecar object (the head's xattr dies with
+        it) — deleting an object must not delete its history
+        (make_writeable-on-delete; the sim keeps this in SnapMapper)."""
         pool = self.osdmap.pools[pool_id]
         pg = self._pg_for(pool, name)
+        if "@" not in name:
+            ss = self._maybe_cow(pool, pg, name)
+            if ss is not None and (ss.get("clones") or
+                                   ss.get("write_seq")):
+                self.put(pool_id, f"{name}@snapset",
+                         json.dumps(ss).encode())
         up = self._up(pool, pg)
         coll = [pool_id, pg]
         if pool.type != POOL_ERASURE:

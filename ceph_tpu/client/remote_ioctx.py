@@ -56,8 +56,11 @@ class RemoteIoCtx:
     def write(self, oid: str, data: bytes, offset: int = 0) -> None:
         try:
             cur = bytearray(self._rc.get(self.pool_id, oid))
-        except (RemoteObjectMissing, IOError):
+        except RemoteObjectMissing:
             cur = bytearray()
+        # any OTHER IOError propagates: treating a transient read
+        # failure as "absent" would splice into zeros and ack a write
+        # that silently destroyed the rest of the object
         if len(cur) < offset + len(data):
             cur.extend(b"\0" * (offset + len(data) - len(cur)))
         cur[offset:offset + len(data)] = data
@@ -91,12 +94,14 @@ class RemoteIoCtx:
         req = {"cmd": cmd, "coll": [self.pool_id, pg],
                "oid": f"0:{oid}"}
         errors = 0
+        answers = 0
         for o in members:
             try:
                 r = rc.osd_call(o, req)
             except (OSError, IOError):
                 errors += 1
                 continue
+            answers += 1
             if r is not None:
                 return r
         if errors or len(members) < len(ups):
@@ -105,8 +110,14 @@ class RemoteIoCtx:
                     r = rc.osd_call(o, req)
                 except (OSError, IOError):
                     continue
+                answers += 1
                 if r is not None:
                     return r
+        if answers == 0:
+            # nobody ANSWERED: connectivity trouble, not absence —
+            # reporting ObjectNotFound here would make layered tiers
+            # (bucket index, inodes) treat live data as deleted
+            raise IOError(f"{oid}: no OSD reachable for probe")
         return None
 
     def _exists(self, oid: str) -> bool:
@@ -128,12 +139,29 @@ class RemoteIoCtx:
             if st is not None:
                 return ObjectStat(size=int(st["size"]), n_stripes=1)
             raise ObjectNotFound(oid)
-        # EC: logical size travels as shard metadata (object_info_t)
-        try:
-            data = self._rc.get(self.pool_id, oid)
-        except RemoteObjectMissing:
-            raise ObjectNotFound(oid) from None
-        return ObjectStat(size=len(data), n_stripes=1)
+        # EC: logical size travels as shard metadata (object_info_t) —
+        # one no-payload attr probe, never a full decode
+        rc = self._rc
+        pg = rc._pg_for(pool, oid)
+        ups = rc._up(pool, pg)
+        answers = 0
+        members = [x for x in ups if x >= 0]
+        for shard, o in enumerate(ups):
+            if o < 0:
+                continue
+            try:
+                sz = rc.osd_call(o, {"cmd": "getattr_shard",
+                                     "coll": [self.pool_id, pg],
+                                     "oid": f"{shard}:{oid}",
+                                     "key": "size"})
+            except (OSError, IOError):
+                continue
+            answers += 1
+            if sz is not None:
+                return ObjectStat(size=int(sz), n_stripes=len(members))
+        if answers == 0:
+            raise IOError(f"{oid}: no OSD reachable for stat")
+        raise ObjectNotFound(oid)
 
     def list_objects(self) -> List[str]:
         return self._rc.list_objects(self.pool_id)
@@ -148,8 +176,13 @@ class RemoteIoCtx:
     def snap_rollback_id(self, oid: str, snap_id: int) -> None:
         """Rollback by snap id: restore the object's bytes AT the
         snapshot (client-driven: COW snap read + full-object write);
-        KeyError when the object has no state at that snap."""
-        data = self._rc.get_snap(self.pool_id, oid, snap_id)
+        KeyError when the object has no state at that snap — matching
+        the sim IoCtx contract rbd's roll-back-to-absent path catches."""
+        try:
+            data = self._rc.get_snap(self.pool_id, oid, snap_id)
+        except RemoteObjectMissing:
+            raise KeyError(f"{oid}: no state at snap {snap_id}") \
+                from None
         self._rc.put(self.pool_id, oid, data)
 
     # ----------------------------------------------------- watch/notify --

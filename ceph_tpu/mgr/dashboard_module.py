@@ -1,0 +1,112 @@
+"""Dashboard mgr module (src/pybind/mgr/dashboard role, API slice).
+
+The reference dashboard is a full web UI; its load-bearing layer is
+the REST API the UI consumes (health, OSDs, pools, usage).  This
+module serves that JSON API over HTTP — `/api/health`, `/api/osds`,
+`/api/pools`, `/api/summary` — plus a minimal index page, so the
+cluster is observable from a browser/curl without the prometheus
+scraper.
+"""
+from __future__ import annotations
+
+import http.server
+import json
+import threading
+from typing import Optional
+
+from .module_host import MgrModule
+
+
+class DashboardModule(MgrModule):
+    NAME = "dashboard"
+
+    def __init__(self, host):
+        super().__init__(host)
+        self._server: Optional[http.server.ThreadingHTTPServer] = None
+
+    # --------------------------------------------------------------- api --
+    def api_health(self) -> dict:
+        osd = self.get("osd_stats")
+        n_down = sum(1 for v in osd["up"] if not v)
+        return {"status": "HEALTH_WARN" if n_down else "HEALTH_OK",
+                "checks": ([{"type": "OSD_DOWN",
+                             "message": f"{n_down} osds down"}]
+                           if n_down else [])}
+
+    def api_osds(self) -> list:
+        osd = self.get("osd_stats")
+        return [{"id": i, "up": bool(osd["up"][i]),
+                 "in": bool(osd["in"][i]),
+                 "weight": int(osd["weight"][i])}
+                for i in range(len(osd["up"]))]
+
+    def api_pools(self) -> list:
+        m = self.get("osd_map")
+        stats = self.get("pool_stats")
+        out = []
+        for pid, pool in sorted(m.pools.items()):
+            s = stats.get(pid, {"objects": 0, "bytes": 0})
+            out.append({"id": pid, "name": pool.name,
+                        "type": int(pool.type),
+                        "pg_num": int(pool.pg_num),
+                        "size": int(pool.size),
+                        "objects": s["objects"],
+                        "bytes": s["bytes"]})
+        return out
+
+    def api_summary(self) -> dict:
+        m = self.get("osd_map")
+        return {"epoch": int(m.epoch), "health": self.api_health(),
+                "n_osds": int(m.max_osd),
+                "n_pools": len(m.pools),
+                "mgr_modules": self.host.enabled()}
+
+    # -------------------------------------------------------------- http --
+    def start_http(self, port: int = 0) -> int:
+        mod = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):             # noqa: N802
+                routes = {"/api/health": mod.api_health,
+                          "/api/osds": mod.api_osds,
+                          "/api/pools": mod.api_pools,
+                          "/api/summary": mod.api_summary}
+                path = self.path.rstrip("/") or "/"
+                if path in routes:
+                    body = json.dumps(routes[path]()).encode()
+                    ctype = "application/json"
+                elif path == "/":
+                    body = (b"<html><body><h1>ceph_tpu dashboard"
+                            b"</h1><ul>" +
+                            b"".join(f'<li><a href="{r}">{r}</a></li>'
+                                     .encode() for r in routes) +
+                            b"</ul></body></html>")
+                    ctype = "text/html"
+                else:
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):
+                pass
+
+        self._server = http.server.ThreadingHTTPServer(
+            ("127.0.0.1", port), Handler)
+        threading.Thread(target=self._server.serve_forever,
+                         daemon=True).start()
+        return self._server.server_address[1]
+
+    def stop_http(self) -> None:
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()
+            self._server = None
+
+
+def register(host) -> None:
+    host.register(DashboardModule.NAME, DashboardModule)

@@ -126,6 +126,39 @@ def test_snap_read_of_born_after_object(rc):
     io.remove("newborn")
 
 
+def test_snapped_object_history_survives_delete(rc):
+    """Deleting an object must not delete its snapshot history: the
+    snapset moves to a sidecar when the head (and its xattr) dies
+    (code-review finding; the sim tier keeps this in SnapMapper)."""
+    io = RemoteIoCtx(rc, "rep")
+    io.write_full("doomed", b"precious-v1")
+    sid = io.snap_create("keep")
+    io.remove("doomed")
+    # the head is gone…
+    with pytest.raises(ObjectNotFound):
+        io.read("doomed")
+    # …but the snapshot still serves the pre-delete bytes
+    assert io.read("doomed", snap=sid) == b"precious-v1"
+
+
+def test_rbd_rollback_with_sparse_objects(rc):
+    """snap_rollback over the wire on an image whose tail object was
+    never written: the absent object must stay absent (KeyError
+    contract), not abort the rollback (code-review finding)."""
+    from ceph_tpu.client.rbd import RBD, Image
+    io = RemoteIoCtx(rc, "rep")
+    rbd = RBD(io)
+    rbd.create("sparse-disk", 2 << 22, order=22)   # 2 objects
+    img = Image(io, "sparse-disk")
+    img.write(0, b"only-object-zero")              # object 1 never born
+    img.snap_create("cut")
+    Image(io, "sparse-disk").write(0, b"SCRIBBLED-OVER!!")
+    img2 = Image(io, "sparse-disk")
+    img2.snap_rollback("cut")                      # must not abort
+    assert Image(io, "sparse-disk").read(0, 16) == b"only-object-zero"
+    rbd.remove("sparse-disk")
+
+
 def test_write_to_deleted_pool_refused(cluster, rc):
     """An OSD must not ack a write into a pool its map says is
     deleted — the next heartbeat would purge the acked data (silent
