@@ -21,7 +21,7 @@ from __future__ import annotations
 
 import asyncio
 from concurrent.futures import ThreadPoolExecutor
-from typing import List, Optional
+from typing import Optional
 
 
 class AsyncIoCtx:
@@ -31,6 +31,9 @@ class AsyncIoCtx:
 
     def __init__(self, ioctx, executor: Optional[ThreadPoolExecutor] = None):
         self._io = ioctx
+        # only a pool we CREATED may be shut down by close(): a shared
+        # executor (AsyncRados hands out its own) outlives any one ioctx
+        self._own_pool = executor is None
         self._pool = executor or ThreadPoolExecutor(
             max_workers=8, thread_name_prefix="neorados")
 
@@ -63,7 +66,8 @@ class AsyncIoCtx:
         return self._run(self._io.snap_create, snap_name)
 
     def close(self) -> None:
-        self._pool.shutdown(wait=False)
+        if self._own_pool:
+            self._pool.shutdown(wait=False)
 
 
 class AsyncRados:
@@ -74,7 +78,6 @@ class AsyncRados:
         self._rados = rados
         self._pool = ThreadPoolExecutor(max_workers=8,
                                         thread_name_prefix="neorados")
-        self._ioctxs: List[AsyncIoCtx] = []
 
     async def open_ioctx(self, pool_name: str) -> AsyncIoCtx:
         loop = asyncio.get_running_loop()
@@ -86,9 +89,7 @@ class AsyncRados:
             from .remote_ioctx import RemoteIoCtx
             io = await loop.run_in_executor(
                 self._pool, RemoteIoCtx, self._rados, pool_name)
-        aio = AsyncIoCtx(io, executor=self._pool)
-        self._ioctxs.append(aio)
-        return aio
+        return AsyncIoCtx(io, executor=self._pool)
 
     async def __aenter__(self) -> "AsyncRados":
         return self

@@ -264,6 +264,19 @@ class RemoteCluster:
                 except (OSError, IOError):
                     self.drop_osd_client(o)
             if not exists:
+                # a RECREATED object resumes its sidecar snapset (the
+                # delete path parked it there): the old clones must
+                # ride back onto the new head's attr, or the history
+                # orphans.  The object was ABSENT for snaps since the
+                # deletion, so no clone is minted for them — absent is
+                # exactly what write_seq >= snap reports.
+                try:
+                    side = json.loads(
+                        self.get(pool.id, f"{name}@snapset"))
+                    side["write_seq"] = seq
+                    return side
+                except (RemoteObjectMissing, IOError, ValueError):
+                    pass
                 return {"write_seq": seq, "clones": []} if seq \
                     else None
             ss = {"write_seq": 0, "clones": []}

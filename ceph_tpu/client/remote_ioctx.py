@@ -158,7 +158,10 @@ class RemoteIoCtx:
                 continue
             answers += 1
             if sz is not None:
-                return ObjectStat(size=int(sz), n_stripes=len(members))
+                # n_stripes matches the write path (full-object = 1),
+                # NOT the live shard count — stat must not vary with
+                # cluster health
+                return ObjectStat(size=int(sz), n_stripes=1)
         if answers == 0:
             raise IOError(f"{oid}: no OSD reachable for stat")
         raise ObjectNotFound(oid)

@@ -659,7 +659,7 @@ class OSDDaemon:
         # objectstore backend selection (the reference's osd_objectstore
         # option, src/common/options.cc): bluestore is the flagship
         # block-device extent store, filestore the log-structured one
-        backend = spec.get("objectstore", "filestore")
+        backend = spec.get("objectstore", "bluestore")
         # daemons skip the full csum walk at mount by default (the
         # reference ships bluestore_fsck_on_mount=false: restart
         # latency must not scale with store size); opt in via the spec

@@ -265,7 +265,7 @@ _TABLE: Tuple[Option, ...] = (
            "encode/decode/recovery consume the staged planes without "
            "host round-trips; the objectstore keeps the same bytes as "
            "the durable tier"),
-    Option("osd_objectstore", TYPE_STR, "filestore",
+    Option("osd_objectstore", TYPE_STR, "bluestore",
            "ObjectStore backend for OSD daemons (reference: "
            "osd_objectstore, src/common/options.cc): bluestore = "
            "block-device extent store with allocator/csum/compression/"

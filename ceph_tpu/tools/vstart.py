@@ -29,7 +29,7 @@ def build_cluster_dir(cluster_dir: str, n_osds: int = 6,
                       osds_per_host: int = 2,
                       pools: Optional[List[dict]] = None,
                       fsync: bool = True, n_mons: int = 1,
-                      objectstore: str = "filestore",
+                      objectstore: str = "bluestore",
                       bluestore_device_bytes: int = 1 << 28,
                       bluestore_min_alloc_size: int = 4096,
                       bluestore_compression: str = "",

@@ -139,6 +139,13 @@ def test_snapped_object_history_survives_delete(rc):
         io.read("doomed")
     # …but the snapshot still serves the pre-delete bytes
     assert io.read("doomed", snap=sid) == b"precious-v1"
+    # RECREATING the object must not orphan that history (the sidecar
+    # snapset rides back onto the new head's attr)
+    io.write_full("doomed", b"second-life")
+    assert io.read("doomed") == b"second-life"
+    assert io.read("doomed", snap=sid) == b"precious-v1"
+    sid2 = io.snap_create("after-rebirth")
+    assert io.read("doomed", snap=sid2) == b"second-life"
 
 
 def test_rbd_rollback_with_sparse_objects(rc):
