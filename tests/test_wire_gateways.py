@@ -192,6 +192,40 @@ def test_write_to_deleted_pool_refused(cluster, rc):
     rc.refresh_map()
 
 
+def test_rgw_bucket_on_ec_pool(tmp_path):
+    """Bucket data erasure-coded across daemons: the gateway's IoCtx
+    rides the wire client's EC put/get (stripe → shards → decode), so
+    S3 objects survive losing m OSDs."""
+    from ceph_tpu.rgw import RGWGateway
+    d = str(tmp_path / "cluster")
+    build_cluster_dir(
+        d, n_osds=6, osds_per_host=1, fsync=False,
+        pools=[{"id": 1, "name": "rep", "type": 1, "size": 3,
+                "pg_num": 8, "crush_rule": 0},
+               {"id": 2, "name": "ecdata", "type": 3, "size": 6,
+                "pg_num": 8, "crush_rule": 1,
+                "erasure_code_profile": "default"}])
+    v = Vstart(d)
+    v.start(6, hb_interval=0.25)
+    try:
+        c = RemoteCluster(d, ec_profiles={
+            "default": {"plugin": "jax", "k": "4", "m": "2",
+                        "layout": "bitsliced"}})
+        io = RemoteIoCtx(c, "ecdata")
+        gw = RGWGateway(io)
+        b = gw.create_bucket("ec-bucket")
+        payload = bytes(range(256)) * 64          # 16 KiB
+        b.put_object("striped.bin", payload)
+        assert b.get_object("striped.bin")[0] == payload
+        # m = 2 OSDs die; k = 4 survivors still decode the bucket data
+        v.kill9("osd.0")
+        v.kill9("osd.3")
+        assert b.get_object("striped.bin")[0] == payload
+        c.close()
+    finally:
+        v.stop()
+
+
 def test_rbd_over_daemons(rc):
     """Block images striped across daemon-held objects, including a
     pool-snapshot-backed image snapshot."""
