@@ -903,6 +903,35 @@ class OSDDaemon:
                     self.store.apply_transaction(txn)
                 return True
             return self._run_sched(rm, klass)
+        if cmd == "copy_from":
+            # PrimaryLogPG copy-from (src/osd/PrimaryLogPG.cc
+            # do_copy_from role): the DESTINATION primary pulls the
+            # source object server-side — possibly from another OSD —
+            # and commits it locally + to replicas as a logged write;
+            # the client never carries the payload
+            coll = tuple(req["coll"])
+            self._check_pool_live(coll)
+            src_coll = tuple(req["src_coll"])
+
+            def read_src():
+                src_oid = req["src_oid"]
+                if req.get("src_osd") in (None, self.id):
+                    try:
+                        return self.store.read(src_coll, src_oid)
+                    except IOError:
+                        return None
+                return self._peer_req(int(req["src_osd"]),
+                                      {"cmd": "get_shard",
+                                       "coll": list(src_coll),
+                                       "oid": src_oid})
+            data = read_src()
+            if data is None:
+                raise IOError(f"copy_from: source "
+                              f"{req['src_oid']!r} unreadable")
+            fwd = {"cmd": "put_object", "coll": list(coll),
+                   "oid": req["oid"], "data": bytes(data),
+                   "replicas": req["replicas"], "klass": klass}
+            return self._handle(entity, fwd)
         if cmd == "delete_object":
             # replicated primary delete: version + OP_DELETE log entry
             # + removal in ONE txn, fanned out to replicas — the
