@@ -618,8 +618,11 @@ class RemoteCluster:
             # the PRIMARY is the one member guaranteed current (it
             # applies every write locally before fanning out), so ask
             # it first; if it is truly unreachable, fall back to the
-            # UNION of the other members' listings — a single replica
-            # that missed a degraded write must not hide the object
+            # surviving member with the HIGHEST pg-log head — a plain
+            # union would transiently resurrect objects a stale
+            # replica missed the logged delete for, and a stale
+            # replica alone could hide a degraded write; the log head
+            # identifies the most-current survivor
             listed: Optional[List[str]] = None
             for _ in range(3):
                 try:
@@ -630,20 +633,31 @@ class RemoteCluster:
                 except (OSError, IOError):
                     time.sleep(0.05)
             if listed is None:
-                union: set = set()
-                got_any = False
+                # cheap pg_info probe first, then list only the
+                # best-head member; a member whose probe failed is
+                # still tried last so one blip cannot turn a listable
+                # PG into an error
+                heads = []
                 for tgt in members[1:]:
                     try:
-                        union.update(self.osd_call(
+                        info = self.osd_call(
                             tgt,
-                            {"cmd": "list_pg", "coll": [pool_id, pg]}))
-                        got_any = True
+                            {"cmd": "pg_info", "coll": [pool_id, pg]})
+                        heads.append((tuple(info["head"]), tgt))
                     except (OSError, IOError):
-                        pass
-                if not got_any:
+                        heads.append(((-1, -1), tgt))
+                heads.sort(key=lambda h: h[0], reverse=True)
+                for _, tgt in heads:
+                    try:
+                        listed = self.osd_call(
+                            tgt,
+                            {"cmd": "list_pg", "coll": [pool_id, pg]})
+                        break
+                    except (OSError, IOError):
+                        continue
+                if listed is None:
                     raise IOError(
                         f"pg {pool_id}.{pg}: no member listable")
-                listed = sorted(union)
             for n in listed:
                 # PG-internal rows ("meta:pglog") carry no shard
                 # prefix; data objects are "<shard>:<name>"

@@ -35,7 +35,17 @@ from .remote import RemoteCluster, RemoteObjectMissing
 
 
 class RemoteIoCtx:
-    """IoCtx over one pool of a process cluster."""
+    """IoCtx over one pool of a process cluster.
+
+    Concurrency caveat: `write(offset=...)` (and RadosStriper.write on
+    top of it) is a CLIENT-side read-modify-write — full get, splice,
+    full put — unlike the sim-tier IoCtx, where the OSD applies the
+    offset write server-side.  Two concurrent writers to the same
+    object from different processes can lose updates; callers that
+    share objects across gateways must serialize per object (the
+    module docstring's watch/notify gap makes the same process-local
+    assumption).
+    """
 
     def __init__(self, rc: RemoteCluster, pool_name: str):
         self._rc = rc

@@ -41,12 +41,16 @@ class RadosStriper:
         return f"{soid}.striper"
 
     def _meta(self, soid: str) -> dict:
+        # Only absence (ObjectNotFound is a KeyError) means "no striped
+        # object"; a transient IOError must propagate, or exists() would
+        # answer False and write() would silently reinitialize an
+        # existing object's geometry.
         try:
-            return json.loads(
-                bytes(self.ioctx.read(self._meta_oid(soid))).decode())
-        except Exception:
+            raw = bytes(self.ioctx.read(self._meta_oid(soid)))
+        except KeyError:
             raise StripedObjectError(
                 f"no striped object {soid!r}") from None
+        return json.loads(raw.decode())
 
     def _read_size(self, soid: str) -> int:
         return self._meta(soid)["size"]
@@ -82,7 +86,7 @@ class RadosStriper:
             oid = self._oid(soid, objno)
             try:
                 cur = bytearray(self.ioctx.read(oid))
-            except Exception:
+            except KeyError:        # absent stripe object: fresh write
                 cur = bytearray()
             if len(cur) < ooff + olen:
                 cur.extend(b"\0" * (ooff + olen - len(cur)))
@@ -109,8 +113,9 @@ class RadosStriper:
         for objno, ooff, olen, pos in self._extents(offset, length):
             try:
                 piece = self.ioctx.read(self._oid(soid, objno))
-            except Exception:
-                piece = b""                      # sparse hole
+            except KeyError:
+                piece = b""       # absent object = sparse hole; an
+            #                       IOError propagates (not zeros)
             chunk = bytes(piece)[ooff:ooff + olen]
             out[pos:pos + len(chunk)] = chunk
         return bytes(out)
@@ -147,7 +152,7 @@ class RadosStriper:
             for objno in self._objnos(cur) - keep:
                 try:
                     self.ioctx.remove(self._oid(soid, objno))
-                except Exception:
+                except KeyError:
                     pass
             # clip every surviving object so a regrow reads zeros
             for objno in keep:
@@ -155,7 +160,7 @@ class RadosStriper:
                 oid = self._oid(soid, objno)
                 try:
                     data = bytes(self.ioctx.read(oid))
-                except Exception:
+                except KeyError:
                     continue
                 if len(data) > blen:
                     self.ioctx.write_full(oid, data[:blen])
@@ -167,6 +172,6 @@ class RadosStriper:
         for objno in self._objnos(size):
             try:
                 self.ioctx.remove(self._oid(soid, objno))
-            except Exception:
+            except KeyError:
                 pass
         self.ioctx.remove(self._meta_oid(soid))

@@ -604,6 +604,12 @@ class MonDaemon:
                 pid = int(req["pool"])
                 cur = self.mon.config_get(f"pool.{pid}.snaps") or \
                     {"seq": 0, "snaps": {}}
+                # retry-idempotent (mon_call resends after a lost
+                # reply): an already-present name returns its existing
+                # seq instead of minting a duplicate id
+                for s, n in cur["snaps"].items():
+                    if n == req["name"]:
+                        return {"snap_seq": int(s)}
                 seq = int(cur["seq"]) + 1
                 snaps = dict(cur["snaps"])
                 snaps[str(seq)] = req["name"]

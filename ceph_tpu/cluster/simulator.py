@@ -596,8 +596,18 @@ class ClusterSim:
     def snap_create(self, pool_id: int, snap_name: str) -> int:
         """Pool snapshot: bump the pool's snap context
         (pg_pool_t::snap_seq + snaps; OSDMonitor prepare_pool_op).
-        Clones appear lazily on the next write per object."""
+        Clones appear lazily on the next write per object.
+
+        Idempotent on name (both tiers agree): re-creating an existing
+        snapshot name returns the existing id rather than minting a
+        second snapshot — the reference refuses duplicates outright
+        (EEXIST, OSDMonitor prepare_pool_op), and the process tier's
+        mon_call retry path additionally needs same-name retries to
+        land on one id."""
         pool = self.osdmap.pools[pool_id]
+        for sid, nm in pool.snaps.items():
+            if nm == snap_name:
+                return sid
         pool.snap_seq += 1
         pool.snaps[pool.snap_seq] = snap_name
         return pool.snap_seq
