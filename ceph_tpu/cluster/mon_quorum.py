@@ -11,14 +11,23 @@ msg) -> reply`` is injected (in-process dict calls in unit tests;
 authenticated WireClients in the mon daemon), so the protocol is
 testable without processes and deployable over the wire unchanged.
 
-Safety properties (tested in tests/test_mon_quorum.py):
+Safety properties (tested in tests/test_mon_quorum.py and the
+threaded stress in test_mon_quorum_stress.py):
   * one vote per election epoch, persisted — two leaders cannot both
     win the same epoch;
   * an entry is acknowledged only after a majority stores it, so any
     later winner's vote majority intersects the storing majority and
     the collect phase recovers the entry (no acked commit lost);
-  * a deposed leader's begin/commit carries a stale election epoch and
-    is refused — it cannot reach majority;
+  * a deposed leader's begin AND commit carry a stale election epoch
+    and are refused (both are epoch-gated);
+  * a recovered in-flight tail is RE-ACCEPTED by a majority under the
+    new leader's epoch before it commits (Paxos phase 2 on recovery,
+    src/mon/Paxos.h:57-88): a minority tail from an old epoch can
+    never race a later election into a divergent commit, because the
+    re-accept stamps the chosen value with the newest epoch on a
+    majority, which every later collect majority intersects;
+  * commits apply strictly in version order on every rank, regardless
+    of which thread delivers them;
   * a restarted or lagging node catches up from the leader's log
     (fetch), applying entries in order.
 
@@ -56,6 +65,23 @@ class QuorumNode:
         self.apply_fn = apply_fn
         self.send_fn = send_fn
         self._lock = threading.RLock()
+        # ordered-apply machinery: commits may be delivered on
+        # concurrent wire-handler threads; the log itself grows in
+        # order (version gate under _lock) and this queue + single
+        # drainer guarantees apply_fn sees the same order, without
+        # ever holding a quorum lock across apply_fn (the daemon's
+        # apply path takes its own lock and its propose path re-enters
+        # here — holding our lock across apply would deadlock)
+        self._apply_q: List[Tuple[int, bytes]] = []
+        self._applying = False
+        # ONE in-flight slot is a safety property, not a convenience:
+        # two concurrent propose() calls on the same leader would both
+        # target committed+1 at the same epoch with different values,
+        # and each could reach a majority on a different acceptor
+        # subset — two values committed at one version.  This lock
+        # serializes the whole store->begin->commit span (propose and
+        # the collect re-accept share it).
+        self._propose_lock = threading.Lock()
         self.leader: Optional[int] = None
         # persisted state
         self.election_epoch = int(db.get("quorum", "election_epoch")
@@ -124,10 +150,24 @@ class QuorumNode:
             dout("mon", 10, f"rank {self.rank} lost election epoch "
                             f"{e} ({votes} votes)")
             return False
-        with self._lock:
-            self.leader = self.rank
-        self._collect(voters)
-        # victory: peers learn the leader and catch up
+        # leadership is NOT claimed yet: a client propose racing ahead
+        # of the collect below would claim the very slot collect must
+        # recover (overwriting a majority-accepted tail with a fresh
+        # value at the new epoch — two values committed at one slot).
+        # First adopt the longest committed log among the vote
+        # majority; a failure (voter died) aborts the election.
+        try:
+            best_rank, best_committed = self.rank, self.committed
+            for rank, committed, tail in voters:
+                if committed > best_committed:
+                    best_rank, best_committed = rank, committed
+            if best_committed > self.committed:
+                self._catch_up_from(best_rank, best_committed)
+        except Exception:
+            return False
+        # victory BEFORE the collect re-accept: peers learn the leader
+        # and catch up, so the re-accept round below lands on nodes
+        # whose next slot is ours
         for r in range(self.n_ranks):
             if r == self.rank:
                 continue
@@ -137,6 +177,18 @@ class QuorumNode:
                                  "committed": self.committed})
             except Exception:
                 continue
+        if not self._collect(voters, e):
+            # the recovered tail could not be re-accepted by a
+            # majority under epoch e: the election is NOT complete
+            dout("mon", 5, f"rank {self.rank} won votes for epoch {e}"
+                           f" but collect re-accept failed; yielding")
+            return False
+        with self._lock:
+            if self.election_epoch != e:
+                # a newer election superseded us mid-collect: its
+                # winner (not us) owns the quorum now
+                return False
+            self.leader = self.rank      # open for proposals
         dout("mon", 5, f"rank {self.rank} won election epoch {e} "
                        f"({votes} votes)")
         return True
@@ -149,88 +201,179 @@ class QuorumNode:
         return (v, blob, self._entry_epoch(v)) \
             if blob is not None else None
 
-    def _collect(self, voters) -> None:
-        """Paxos collect: adopt the longest committed log among the
-        vote majority, then re-commit the accepted-but-uncommitted
-        tail with the HIGHEST accept epoch (it may have been
-        acknowledged to a client; a stale minority tail at the same
-        version loses to a later-epoch majority-accepted one)."""
-        best_rank, best_committed = self.rank, self.committed
-        for rank, committed, tail in voters:
-            if committed > best_committed:
-                best_rank, best_committed = rank, committed
-        if best_committed > self.committed:
-            self._catch_up_from(best_rank, best_committed)
+    def _collect(self, voters, e: int) -> bool:
+        """Paxos collect, phase 2 included: pick the accepted-but-
+        uncommitted tail with the HIGHEST accept epoch among the vote
+        majority (it may have been acknowledged to a client; a stale
+        minority tail at the same version loses to a later-epoch one),
+        then RE-ACCEPT it on a majority under our new epoch ``e``
+        before committing.  Committing without the re-accept round is
+        the classic Paxos mistake (src/mon/Paxos.h:57-88): a minority
+        tail recovered here could race a later election that recovers
+        a different, higher-epoch minority tail at the same version —
+        two values committed at one slot.  The re-accept stamps the
+        chosen value with epoch ``e`` on a majority, which every later
+        collect majority intersects, making the choice final.
+
+        Returns False when no majority re-accepts (caller must step
+        down: the election is incomplete)."""
         best_tail: Optional[Tuple[int, bytes, int]] = None
         for rank, committed, tail in voters:
             if tail is None or tail[0] != self.committed + 1:
                 continue              # stale/irrelevant slot
             if best_tail is None or tail[2] > best_tail[2]:
                 best_tail = tail
-        if best_tail is not None:
-            # finish the in-flight slot under our (new) epoch
-            self._commit_entry(best_tail[0], best_tail[1])
-            self._replicate_commit(best_tail[0], best_tail[1])
+        if best_tail is None:
+            return True               # no in-flight slot to finish
+        v, blob = best_tail[0], bytes(best_tail[1])
+        with self._propose_lock:
+            ok = self._reaccept_and_commit(v, blob, e)
+        # apply AFTER releasing _propose_lock (see _commit_no_apply)
+        self._drain_applies()
+        return ok
 
-    def _catch_up_from(self, rank: int, target: int) -> None:
-        rep = self.send_fn(rank, {"q": "fetch",
-                                  "after": self.committed})
-        for v, blob in rep["entries"]:
+    def _reaccept_and_commit(self, v: int, blob: bytes,
+                             e: int) -> bool:
+        with self._lock:
+            # atomic re-check: a concurrent newer leader may have
+            # committed this slot (or deposed us) between picking the
+            # tail and storing — never overwrite a committed entry
             if v != self.committed + 1:
-                continue
-            self._commit_entry(v, bytes(blob))
-
-    # ------------------------------------------------------------ commit --
-    def _commit_entry(self, version: int, value: bytes) -> None:
-        """Persist + mark committed + apply, in that order (replay on
-        restart re-applies anything past the service's state)."""
-        with self._lock:
-            if version != self.committed + 1:
-                return
-            self._store_entry(version, value, self.election_epoch)
-            self.committed = version
-            self._put("committed", str(version).encode())
-        self.apply_fn(version, value)
-
-    def _replicate_commit(self, version: int, value: bytes) -> None:
-        for r in range(self.n_ranks):
-            if r == self.rank:
-                continue
-            try:
-                self.send_fn(r, {"q": "commit", "epoch":
-                                 self.election_epoch,
-                                 "version": version, "value": value})
-            except Exception:
-                continue          # laggard catches up later
-
-    def propose(self, value: bytes) -> bool:
-        """Leader path: begin/accept on a majority, then commit.  The
-        caller may acknowledge its client iff this returns True."""
-        with self._lock:
-            if self.leader != self.rank:
-                raise NotLeader(self.leader)
-            e = self.election_epoch
-            v = self.committed + 1
-            self._store_entry(v, value, e)    # self-accept
+                return True           # slot already finished
+            if self.election_epoch != e:
+                return False          # deposed mid-collect
+            self._store_entry(v, blob, e)      # self re-accept
         acks = 1
         for r in range(self.n_ranks):
             if r == self.rank:
                 continue
             try:
                 rep = self.send_fn(r, {"q": "begin", "epoch": e,
-                                       "version": v, "value": value})
+                                       "version": v, "value": blob,
+                                       "leader": self.rank})
             except Exception:
                 continue
             if rep.get("accepted"):
                 acks += 1
         if acks < self.quorum():
-            # no majority (partition / deposed): the stored entry stays
-            # uncommitted; a future leader's collect may still finish
-            # it, which is safe — we report failure and the caller must
-            # not ack its client
             return False
-        self._commit_entry(v, value)
-        self._replicate_commit(v, value)
+        self._commit_no_apply(v, blob)    # caller holds _propose_lock
+        self._replicate_commit(v, blob, e)
+        return True
+
+    def _catch_up_from(self, rank: int, target: int) -> None:
+        """Fetch + commit the peer's log past ours.  Raises when the
+        peer's response did not reach ``target``: callers that go on
+        to act on "caught up" (the election path) must abort instead
+        of proceeding on a short log."""
+        rep = self.send_fn(rank, {"q": "fetch",
+                                  "after": self.committed})
+        for v, blob in rep["entries"]:
+            if v != self.committed + 1:
+                continue
+            self._commit_entry(v, bytes(blob))
+        if self.committed < target:
+            raise IOError(f"catch-up from mon.{rank} stopped at "
+                          f"{self.committed} < target {target}")
+
+    # ------------------------------------------------------------ commit --
+    def _commit_entry(self, version: int, value: bytes) -> None:
+        """Persist + mark committed + apply, in that order (replay on
+        restart re-applies anything past the service's state).
+
+        The log grows strictly in order (version gate under _lock);
+        applies are queued under the same lock and drained by a single
+        thread so apply_fn observes that same order even when commits
+        arrive on concurrent wire-handler threads.  apply_fn runs with
+        NO quorum lock held (see __init__ note)."""
+        self._commit_no_apply(version, value)
+        self._drain_applies()
+
+    def _commit_no_apply(self, version: int, value: bytes) -> None:
+        """Log/commit-marker half of _commit_entry, for callers that
+        hold _propose_lock: they must release it BEFORE draining
+        applies (apply_fn may take the daemon's lock, and a daemon
+        thread holding that lock may be waiting on _propose_lock —
+        holding _propose_lock across apply_fn is an AB-BA deadlock)."""
+        with self._lock:
+            if version != self.committed + 1:
+                return
+            self._store_entry(version, value, self.election_epoch)
+            self.committed = version
+            self._put("committed", str(version).encode())
+            self._apply_q.append((version, value))
+
+    def _drain_applies(self) -> None:
+        """Single-drainer, in-order apply of queued commits, holding
+        no quorum lock across apply_fn.  A failed apply stays at the
+        queue head so the next drain retries it first — later commits
+        can never apply past a version gap in-process (replay() covers
+        the restart case)."""
+        with self._lock:
+            if self._applying:
+                return            # the active drainer will take it
+            self._applying = True
+        while True:
+            with self._lock:
+                if not self._apply_q:
+                    self._applying = False
+                    return
+                v, blob = self._apply_q[0]
+            try:
+                self.apply_fn(v, blob)
+            except Exception:
+                with self._lock:
+                    self._applying = False
+                raise
+            with self._lock:
+                self._apply_q.pop(0)
+
+    def _replicate_commit(self, version: int, value: bytes,
+                          epoch: int) -> None:
+        for r in range(self.n_ranks):
+            if r == self.rank:
+                continue
+            try:
+                self.send_fn(r, {"q": "commit", "epoch": epoch,
+                                 "version": version, "value": value,
+                                 "leader": self.rank})
+            except Exception:
+                continue          # laggard catches up later
+
+    def propose(self, value: bytes) -> bool:
+        """Leader path: begin/accept on a majority, then commit.  The
+        caller may acknowledge its client iff this returns True.
+        Serialized end-to-end by _propose_lock (one in-flight slot)."""
+        with self._propose_lock:
+            with self._lock:
+                if self.leader != self.rank:
+                    raise NotLeader(self.leader)
+                e = self.election_epoch
+                v = self.committed + 1
+                self._store_entry(v, value, e)    # self-accept
+            acks = 1
+            for r in range(self.n_ranks):
+                if r == self.rank:
+                    continue
+                try:
+                    rep = self.send_fn(r, {"q": "begin", "epoch": e,
+                                           "version": v,
+                                           "value": value,
+                                           "leader": self.rank})
+                except Exception:
+                    continue
+                if rep.get("accepted"):
+                    acks += 1
+            if acks < self.quorum():
+                # no majority (partition / deposed): the stored entry
+                # stays uncommitted; a future leader's collect may
+                # still finish it, which is safe — we report failure
+                # and the caller must not ack its client
+                return False
+            self._commit_no_apply(v, value)
+            self._replicate_commit(v, value, e)
+        # apply AFTER releasing _propose_lock (see _commit_no_apply)
+        self._drain_applies()
         return True
 
     # ---------------------------------------------------------- handlers --
@@ -295,15 +438,20 @@ class QuorumNode:
     def _on_begin(self, msg) -> Dict[str, Any]:
         e, v = int(msg["epoch"]), int(msg["version"])
         with self._lock:
-            if e < self.election_epoch or self.leader is None:
+            if e < self.election_epoch:
+                # deposed leader: stale epoch refused
                 return {"accepted": False,
                         "epoch": self.election_epoch}
             if e > self.election_epoch:
                 # a leader we missed the victory of: adopt it
                 self.election_epoch = e
                 self._put("election_epoch", str(e).encode())
-                self.leader = int(msg.get("leader", -1)) \
-                    if "leader" in msg else self.leader
+            # a begin at epoch e can only come from e's single vote
+            # winner (one persisted vote per epoch), so it is safe to
+            # accept even before the victory message arrives — the
+            # collect re-accept round depends on this
+            if "leader" in msg:
+                self.leader = int(msg["leader"])
             if v != self.committed + 1:
                 return {"accepted": False,
                         "committed": self.committed}
@@ -311,7 +459,19 @@ class QuorumNode:
             return {"accepted": True}
 
     def _on_commit(self, msg) -> None:
-        v = int(msg["version"])
+        e, v = int(msg.get("epoch", 0)), int(msg["version"])
+        with self._lock:
+            if e < self.election_epoch:
+                # a deposed leader's commit is REFUSED: after a new
+                # election this rank may have re-accepted a different
+                # value at the same version; only current-epoch
+                # commits (from the epoch's single winner) apply
+                return
+            if e > self.election_epoch:
+                self.election_epoch = e
+                self._put("election_epoch", str(e).encode())
+            if "leader" in msg:
+                self.leader = int(msg["leader"])
         if v == self.committed + 1:
             self._commit_entry(v, bytes(msg["value"]))
         elif v > self.committed:

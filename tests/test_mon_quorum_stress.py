@@ -1,0 +1,273 @@
+"""Threaded quorum stress: concurrent elections racing commit traffic.
+
+The VERDICT r4 consensus-safety contract: with candidates campaigning
+from multiple threads, partitions coming and going, and client commit
+traffic in flight, there must be EXACTLY ONE committed history — for
+every version, all ranks hold the same value; every acknowledged
+propose survives at exactly one version; applies happen in version
+order on every rank.  Reference: src/mon/Paxos.h:57-88 (collect /
+begin / commit with the mandatory phase-2 re-accept on recovery),
+src/mon/Elector.h:37 (one persisted vote per epoch).
+
+The prior quorum tests (test_mon_quorum.py) are single-threaded and
+sequential; this file is the adversarial-interleaving tier.
+"""
+import random
+import threading
+import time
+from typing import Dict, List, Tuple
+
+from ceph_tpu.cluster.kv import MemDB
+from ceph_tpu.cluster.mon_quorum import (NotLeader, QuorumNode,
+                                         decode_decree, encode_decree)
+
+N = 5
+RUN_SECONDS = 2.5
+
+
+class ChaosNet:
+    """In-process wire with injected delays and partitions."""
+
+    def __init__(self, seed: int):
+        self.nodes: Dict[int, QuorumNode] = {}
+        self.down = set()
+        self._rng = random.Random(seed)
+        self._rng_lock = threading.Lock()
+
+    def send(self, rank, msg):
+        with self._rng_lock:
+            delay = self._rng.random() * 0.002
+            unreachable = rank in self.down
+        if delay > 0.0005:
+            time.sleep(delay)
+        if unreachable or rank not in self.nodes:
+            raise IOError(f"mon.{rank} unreachable")
+        return self.nodes[rank].handle(msg)
+
+
+def _build(seed: int):
+    net = ChaosNet(seed)
+    applied: Dict[int, List[Tuple[int, bytes]]] = {r: [] for r in
+                                                   range(N)}
+    for r in range(N):
+        def mk_apply(rr):
+            return lambda v, blob: applied[rr].append((v, bytes(blob)))
+        net.nodes[r] = QuorumNode(r, N, MemDB(), mk_apply(r), net.send)
+    return net, applied
+
+
+def _log_of(node: QuorumNode) -> List[bytes]:
+    return [node._get_entry(v) for v in range(1, node.committed + 1)]
+
+
+def test_concurrent_elections_one_history():
+    seed = 20260731
+    net, applied = _build(seed)
+    stop = threading.Event()
+    acked: List[bytes] = []
+    acked_lock = threading.Lock()
+    counter = [0]
+
+    def elector(rank: int):
+        rng = random.Random(seed * 31 + rank)
+        node = net.nodes[rank]
+        while not stop.is_set():
+            time.sleep(rng.random() * 0.08)
+            # campaign when leaderless, and occasionally out of spite
+            # (the concurrent-candidate interleavings under test)
+            if node.leader is None or rng.random() < 0.25:
+                try:
+                    node.start_election()
+                except Exception:
+                    pass
+
+    def client(cid: int):
+        rng = random.Random(seed * 77 + cid)
+        while not stop.is_set():
+            time.sleep(rng.random() * 0.02)
+            leaders = [n for n in net.nodes.values()
+                       if n.leader == n.rank]
+            if not leaders:
+                continue
+            node = rng.choice(leaders)
+            with acked_lock:
+                counter[0] += 1
+                val = encode_decree("x", n=counter[0], c=cid)
+            try:
+                ok = node.propose(val)
+            except (NotLeader, Exception):
+                continue
+            if ok:
+                with acked_lock:
+                    acked.append(val)
+
+    def partitioner():
+        rng = random.Random(seed * 13)
+        while not stop.is_set():
+            time.sleep(rng.random() * 0.15)
+            # partition a strict minority so progress stays possible
+            sz = rng.randint(0, (N - 1) // 2)
+            cut = set(rng.sample(range(N), sz))
+            with net._rng_lock:
+                net.down = cut
+            time.sleep(rng.random() * 0.15)
+            with net._rng_lock:
+                net.down = set()
+
+    threads = ([threading.Thread(target=elector, args=(r,))
+                for r in range(N)] +
+               [threading.Thread(target=client, args=(c,))
+                for c in range(2)] +
+               [threading.Thread(target=partitioner)])
+    for t in threads:
+        t.start()
+    time.sleep(RUN_SECONDS)
+    stop.set()
+    for t in threads:
+        t.join(timeout=10)
+        assert not t.is_alive(), "stress thread hung"
+    net.down = set()
+
+    # convergence: elect a stable leader and land one sentinel so
+    # every rank syncs to a common committed point
+    sentinel = encode_decree("sentinel", n=-1)
+    deadline = time.monotonic() + 15
+    done = False
+    while time.monotonic() < deadline and not done:
+        for r in range(N):
+            node = net.nodes[r]
+            try:
+                if node.start_election() and node.propose(sentinel):
+                    node.start_election()    # victory syncs laggards
+                    done = True
+                    break
+            except Exception:
+                continue
+    assert done, "cluster failed to converge after chaos stopped"
+
+    logs = {r: _log_of(net.nodes[r]) for r in range(N)}
+    commits = {r: net.nodes[r].committed for r in range(N)}
+    # 1. exactly one committed history: common prefix is identical
+    floor = min(commits.values())
+    for v in range(floor):
+        vals = {r: logs[r][v] for r in range(N)}
+        assert len(set(vals.values())) == 1, \
+            f"version {v + 1} diverged: " + repr({
+                r: decode_decree(b) for r, b in vals.items()})
+    # ...and beyond the floor, any rank that HAS a committed version
+    # agrees with every other rank that has it
+    ceil = max(commits.values())
+    for v in range(floor, ceil):
+        vals = {r: logs[r][v] for r in range(N) if commits[r] > v}
+        assert len(set(vals.values())) == 1, f"tail {v + 1} diverged"
+    # 2. every acknowledged propose survived, exactly once, on every
+    # rank that reached it
+    full = logs[max(commits, key=commits.get)]
+    for val in acked:
+        assert full.count(val) == 1, \
+            f"acked value lost/duplicated: {decode_decree(val)}"
+    # sentinel landed
+    assert full.count(sentinel) == 1
+    # 3. applies happened strictly in version order with the committed
+    # values (no thread interleaving reordered or double-applied)
+    for r in range(N):
+        versions = [v for v, _ in applied[r]]
+        assert versions == sorted(set(versions)), \
+            f"rank {r} applied out of order: {versions[:20]}..."
+        for v, blob in applied[r]:
+            assert logs[r][v - 1] == blob, \
+                f"rank {r} applied a value that is not the log's v{v}"
+
+
+def test_deposed_leader_commit_refused_by_epoch():
+    """The r4 docstring claim, now true: a deposed leader's COMMIT
+    (not just begin) carries a stale epoch and is refused."""
+    net, applied = _build(7)
+    net_nodes = net.nodes
+    assert net_nodes[0].start_election()
+    e_old = net_nodes[0].election_epoch
+    # depose rank 0 without it noticing
+    net.down.add(0)
+    assert any(net_nodes[1].start_election() for _ in range(3))
+    net.down.discard(0)
+    # old leader pushes a commit with its stale epoch straight at a
+    # peer: must be ignored (no commit, no apply)
+    stale = encode_decree("stale", n=9)
+    net_nodes[2].handle({"q": "commit", "epoch": e_old, "version": 1,
+                         "value": stale, "leader": 0})
+    assert net_nodes[2].committed == 0
+    assert applied[2] == []
+
+
+def test_collect_reaccepts_under_new_epoch():
+    """The recovered tail is re-accepted on a majority with the NEW
+    epoch before committing: after recovery, the surviving acceptors
+    hold the entry stamped with the recovering leader's epoch."""
+    net, applied = _build(11)
+    nodes = net.nodes
+    assert nodes[0].start_election()
+    e1 = nodes[0].election_epoch
+    value = encode_decree("acked", n=42)
+    # leader stores + wins majority accepts, dies before any commit
+    nodes[0]._store_entry(1, value, e1)
+    for r in (1, 2):
+        assert nodes[r].handle({"q": "begin", "epoch": e1,
+                                "version": 1, "value": value,
+                                "leader": 0})["accepted"]
+    net.down.add(0)
+    assert any(nodes[3].start_election() for _ in range(3))
+    e2 = nodes[3].election_epoch
+    assert e2 > e1
+    # recovered AND committed everywhere reachable
+    for r in (1, 2, 3, 4):
+        assert nodes[r].committed == 1
+        assert nodes[r]._get_entry(1) == value
+    # the acceptors' stored epoch for v1 is the NEW epoch (the
+    # re-accept round ran), not the old one
+    assert nodes[3]._entry_epoch(1) == e2
+    reaccepted = [r for r in (1, 2, 4)
+                  if nodes[r]._entry_epoch(1) == e2]
+    assert len(reaccepted) + 1 >= nodes[3].quorum(), \
+        "re-accept under the new epoch did not reach a majority"
+
+
+def test_minority_tail_cannot_split_history():
+    """The exact divergence the r4 review called out: two successive
+    recoveries of DIFFERENT minority tails at the same version must
+    not commit both.  With phase-2 re-accept, the first recovery
+    stamps its choice on a majority at the new epoch, so the second
+    recovery is forced to the same value."""
+    net, applied = _build(23)
+    nodes = net.nodes
+    # epoch e1: rank0 self-accepts A at v1, reaches only rank1
+    assert nodes[0].start_election()
+    e1 = nodes[0].election_epoch
+    a = encode_decree("A", n=1)
+    nodes[0]._store_entry(1, a, e1)
+    assert nodes[1].handle({"q": "begin", "epoch": e1, "version": 1,
+                            "value": a, "leader": 0})["accepted"]
+    # rank0+1 vanish; rank2 wins e2, self-accepts B at v1, reaches
+    # only rank3, then 2+3 vanish too (B is a higher-epoch minority
+    # tail than A)
+    net.down |= {0, 1}
+    assert any(nodes[2].start_election() for _ in range(3))
+    e2 = nodes[2].election_epoch
+    b = encode_decree("B", n=2)
+    nodes[2]._store_entry(1, b, e2)
+    assert nodes[3].handle({"q": "begin", "epoch": e2, "version": 1,
+                            "value": b, "leader": 2})["accepted"]
+    net.down = {2, 3}
+    # recovery #1: rank1 campaigns with {0,1,4} — sees only A
+    assert any(nodes[1].start_election() for _ in range(5))
+    assert nodes[1].committed == 1
+    first = nodes[1]._get_entry(1)
+    assert first == a
+    # recovery #2: full network back; rank 4 campaigns with everyone,
+    # including rank2/3 whose B-tail has the higher ACCEPT epoch —
+    # but A was re-accepted at a newer epoch still, so A must win
+    net.down = set()
+    assert any(nodes[4].start_election() for _ in range(5))
+    for r in range(N):
+        assert nodes[r].committed == 1
+        assert nodes[r]._get_entry(1) == first, \
+            f"rank {r} committed a second value at v1"
