@@ -54,6 +54,11 @@ class RemoteCluster:
         self._osd_clients: Dict[int, WireClient] = {}
         self.ec_profiles = ec_profiles or {}
         self._codecs: Dict[int, object] = {}
+        self._backends: Dict[int, object] = {}
+        self._dev = None            # lazy DeviceShardCache
+        self._staged_attrs: Dict = {}
+        import threading
+        self._client_lock = threading.Lock()
         self.refresh_map()
 
     # ---------------------------------------------------------------- mon --
@@ -118,14 +123,31 @@ class RemoteCluster:
         c = self._osd_clients.get(osd)
         if c is not None:
             return c
-        grant = self.mon_call({"cmd": "get_ticket",
-                               "service": f"osd.{osd}"})
-        key = cx.open_key_box(self.secret, grant["key_box"])
-        c = WireClient(self.addrs[osd], self.entity,
-                       ticket=grant["ticket"], session_key=key,
-                       timeout=10.0)
-        self._osd_clients[osd] = c
-        return c
+        # serialized: concurrent fan-out threads must not race two
+        # connects (and the mon ticket round) for the same OSD
+        with self._client_lock:
+            c = self._osd_clients.get(osd)
+            if c is not None:
+                return c
+            grant = self.mon_call({"cmd": "get_ticket",
+                                   "service": f"osd.{osd}"})
+            key = cx.open_key_box(self.secret, grant["key_box"])
+            c = WireClient(self.addrs[osd], self.entity,
+                           ticket=grant["ticket"], session_key=key,
+                           timeout=10.0)
+            self._osd_clients[osd] = c
+            return c
+
+    def _evict_staging(self, pool_id: int, pg: int, name: str) -> None:
+        """Invalidate this client's staged shards + attrs for one
+        object (called on every overwrite/delete: a dirty staged
+        entry is served unconditionally and flushed later, so leaving
+        one behind would resurrect dead data)."""
+        if self._dev is not None:
+            self._dev.evict_object(pool_id, pg, name)
+        for k in [k for k in self._staged_attrs
+                  if k[0] == pool_id and k[1] == pg and k[2] == name]:
+            self._staged_attrs.pop(k, None)
 
     def drop_osd_client(self, osd: int) -> None:
         c = self._osd_clients.pop(osd, None)
@@ -159,13 +181,45 @@ class RemoteCluster:
     def codec_for(self, pool: PGPool):
         codec = self._codecs.get(pool.id)
         if codec is None:
-            prof = self.ec_profiles.get(pool.erasure_code_profile,
-                                        {"plugin": "jax", "k": "4",
-                                         "m": "2"})
+            prof = dict(self.ec_profiles.get(
+                pool.erasure_code_profile,
+                {"plugin": "jax", "k": "4", "m": "2"}))
             plugin = prof.get("plugin", "jax")
-            codec = ec_registry().factory(plugin, dict(prof))
+            if plugin == "jax" and "layout" not in prof:
+                # cluster default (erasure_code_default_layout):
+                # bitsliced — shard bytes at rest ARE the plane words
+                # the masked-XOR kernel consumes, on daemons too
+                from ..common.options import config
+                prof["layout"] = config().get(
+                    "erasure_code_default_layout")
+            codec = ec_registry().factory(plugin, prof)
             self._codecs[pool.id] = codec
         return codec
+
+    # ------------------------------------------------- EC backend seam --
+    def ec_backend(self, pool_id: int):
+        """The shared ECBackend engine (cluster/ec_backend.py) over
+        this client's wire transport — the same backend class the
+        in-process simulator uses (PGBackend seam,
+        src/osd/PGBackend.cc:571)."""
+        be = self._backends.get(pool_id)
+        if be is None:
+            from ..cluster.ec_backend import ECBackend
+            pool = self.osdmap.pools[pool_id]
+            be = ECBackend(self.codec_for(pool),
+                           WireShardIO(self, pool_id))
+            self._backends[pool_id] = be
+        return be
+
+    @property
+    def dev(self):
+        """Client-side HBM staging of shard plane words (the client is
+        the TPU-attached EC primary; shards it wrote or read stay
+        device-resident and serve zero-copy)."""
+        if self._dev is None:
+            from ..cluster.device_store import DeviceShardCache
+            self._dev = DeviceShardCache()
+        return self._dev
 
     # ----------------------------------------------------------- snapshots --
     def snap_create(self, pool_id: int, name: str) -> int:
@@ -387,7 +441,15 @@ class RemoteCluster:
         codec = self.codec_for(pool)
         k = codec.get_data_chunk_count()
         n = codec.get_chunk_count()
+        self._evict_staging(pool_id, pg, name)
         chunks = codec.encode(set(range(n)), data)
+        # geometry attrs are REWRITTEN on every put: an overwrite of a
+        # stripewise (batched-put) object must not leave stale S/U
+        # behind, or readers would reassemble the new single-stripe
+        # chunks with the old stripe interleave
+        chunk_len = int(np.asarray(chunks[0]).size)
+        obj_attrs = {"size": str(len(data)).encode(),
+                     "S": b"1", "U": str(chunk_len).encode()}
         # EC write contract (VERDICT r3 weak #2): the primary gathers
         # ALL shard commits before acknowledging
         # (src/osd/ECBackend.cc:1150) — transient failures retry
@@ -412,7 +474,7 @@ class RemoteCluster:
                         "data": np.asarray(chunks[shard]).tobytes(),
                         # logical object size travels as shard metadata
                         # so ANY client can unpad reads (object_info_t)
-                        "attrs": {"size": str(len(data)).encode()}})
+                        "attrs": obj_attrs})
                     acked[shard] = tgt
                 except (OSError, IOError):
                     pass
@@ -496,51 +558,117 @@ class RemoteCluster:
         k, n = codec.get_data_chunk_count(), codec.get_chunk_count()
         shards: Dict[int, bytes] = {}
         obj_size: Optional[int] = None
+        geom_s: Optional[int] = None
+        geom_u: Optional[int] = None
         conn_errors = 0
         for shard in range(n):
-            srcs = [up[shard]] if shard < len(up) and \
-                up[shard] != ITEM_NONE else []
-            srcs += [o for o in self.addrs if o not in srcs]
-            for o in srcs:
-                try:
-                    d = self.osd_call(o, {
-                        "cmd": "get_shard", "coll": coll,
-                        "oid": f"{shard}:{name}"})
-                except (OSError, IOError):
-                    conn_errors += 1
-                    continue
-                if d is not None:
-                    shards[shard] = d
-                    if obj_size is None:
-                        try:
-                            sz = self.osd_call(o, {
-                                "cmd": "getattr_shard", "coll": coll,
-                                "oid": f"{shard}:{name}",
-                                "key": "size"})
-                            if sz is not None:
-                                obj_size = int(sz)
-                        except (OSError, IOError):
-                            pass
-                    break
+            # client HBM staging first: a shard this client wrote or
+            # read serves from device words (dirty entries are
+            # authoritative; clean ones validate against the daemon's
+            # stored checksum, one digest RTT, no payload transfer)
+            key = (pool_id, pg, name, shard)
+            staged = self.dev.dirty_get(key)
+            attrs_src = None
+            if staged is None and self.dev.has(key):
+                io = self.ec_backend(pool_id).io
+                dg = io._digest(pg, shard, name)
+                if dg is not None:
+                    staged = self.dev.get(key, dg)
+            if staged is not None:
+                shards[shard] = np.asarray(staged).tobytes()
+                a = self._staged_attrs.get(key)
+                if a:
+                    attrs_src = lambda kk, a=a: a.get(kk)
+                else:
+                    io = self.ec_backend(pool_id).io
+
+                    def attrs_src(kk, shard=shard):
+                        return io.getattr(pg, name, shard, kk)
+            else:
+                srcs = [up[shard]] if shard < len(up) and \
+                    up[shard] != ITEM_NONE else []
+                srcs += [o for o in self.addrs if o not in srcs]
+                for o in srcs:
+                    try:
+                        d = self.osd_call(o, {
+                            "cmd": "get_shard", "coll": coll,
+                            "oid": f"{shard}:{name}"})
+                    except (OSError, IOError):
+                        conn_errors += 1
+                        continue
+                    if d is not None:
+                        shards[shard] = d
+
+                        def attrs_src(kk, o=o, shard=shard):
+                            try:
+                                return self.osd_call(o, {
+                                    "cmd": "getattr_shard",
+                                    "coll": coll,
+                                    "oid": f"{shard}:{name}",
+                                    "key": kk})
+                            except (OSError, IOError):
+                                return None
+                        break
+            if attrs_src is not None and obj_size is None:
+                sz = attrs_src("size")
+                if sz is not None:
+                    obj_size = int(sz)
+                    s_raw, u_raw = attrs_src("S"), attrs_src("U")
+                    if s_raw is not None and u_raw is not None:
+                        geom_s, geom_u = int(s_raw), int(u_raw)
         if len(shards) < k:
             if not shards and conn_errors == 0:
                 raise RemoteObjectMissing(f"{name}: no such object")
             raise IOError(f"{name}: only {len(shards)} shards (< k)")
-        want = set(range(k))
-        plan = sorted(codec.minimum_to_decode(want, set(shards)))
-        stack = np.stack([np.frombuffer(shards[c], dtype=np.uint8)
-                          for c in plan])
-        missing = sorted(want - set(shards))
-        if missing:
-            dec = np.asarray(codec.decode_chunks(plan, stack, missing))
-        data_chunks = []
-        for c in range(k):
-            if c in shards:
-                data_chunks.append(np.frombuffer(shards[c],
-                                                 dtype=np.uint8))
-            else:
-                data_chunks.append(dec[missing.index(c)])
-        buf = np.concatenate(data_chunks).tobytes()
+        be = self.ec_backend(pool_id)
+        plan, missing = be.plan(list(shards))
+        if geom_s is not None and geom_u:
+            # stripewise object (batched put): shard files are S
+            # chunks of U bytes; degraded decode runs per-stripe
+            # geometry — on device in the word domain when the codec
+            # supports it
+            S, U = geom_s, geom_u
+            dec8 = None
+            if missing:
+                if be.words_supported():
+                    import jax.numpy as jnp
+                    stack = np.stack(
+                        [np.frombuffer(shards[c], dtype="<i4")
+                         .reshape(S, U // 4) for c in plan], axis=1)
+                    job = (plan, jnp.asarray(stack), missing)
+                    dec = be.decode_signature_groups([job])[0]
+                    dec8 = np.asarray(dec).view(np.uint8).reshape(
+                        S, len(missing), U)
+                else:
+                    stack = np.stack(
+                        [np.frombuffer(shards[c], dtype=np.uint8)
+                         .reshape(S, U) for c in plan], axis=1)
+                    dec8 = np.asarray(codec.decode_chunks_batch(
+                        plan, stack, missing))
+            cols = []
+            for c in range(k):
+                if c in shards:
+                    cols.append(np.frombuffer(shards[c],
+                                              dtype=np.uint8)
+                                .reshape(S, U))
+                else:
+                    cols.append(dec8[:, missing.index(c)])
+            buf = np.stack(cols, axis=1).reshape(-1).tobytes()
+        else:
+            # legacy single-stripe object: whole shard = one chunk
+            stack = np.stack([np.frombuffer(shards[c], dtype=np.uint8)
+                              for c in plan])
+            if missing:
+                dec = np.asarray(codec.decode_chunks(plan, stack,
+                                                     missing))
+            data_chunks = []
+            for c in range(k):
+                if c in shards:
+                    data_chunks.append(np.frombuffer(shards[c],
+                                                     dtype=np.uint8))
+                else:
+                    data_chunks.append(dec[missing.index(c)])
+            buf = np.concatenate(data_chunks).tobytes()
         if size is None:
             size = obj_size if obj_size is not None else len(buf)
         return buf[:size]
@@ -565,6 +693,7 @@ class RemoteCluster:
                                    ss.get("write_seq")):
                 self.put(pool_id, f"{name}@snapset",
                          json.dumps(ss).encode())
+        self._evict_staging(pool_id, pg, name)
         up = self._up(pool, pg)
         coll = [pool_id, pg]
         if pool.type != POOL_ERASURE:
@@ -740,17 +869,21 @@ class RemoteCluster:
 
     def recover_ec_pool(self, pool_id: int) -> Dict[str, int]:
         """Client-driven EC recovery (the client is the TPU-attached
-        primary): per PG, union every daemon's shard listing, and for
-        each object push surviving copies to their up targets and
-        DECODE lost shards from k survivors (ECBackend recover_object
-        collapsed to gather → decode → push over the wire)."""
+        primary), in three passes: (1) union every daemon's shard
+        listing per PG and fetch only the shards each repair requires;
+        (2) decode ALL objects' lost shards in signature-GROUPED
+        device dispatches — every object that lost the same shard set
+        rebuilds in one masked-XOR kernel call, the bench_recovery
+        machinery on the serving path (src/osd/ECBackend.cc:757 →
+        ECUtil::decode, batched); (3) push surviving copies and
+        rebuilt shards to their up targets."""
         pool = self.osdmap.pools[pool_id]
-        codec = self.codec_for(pool)
-        k = codec.get_data_chunk_count()
-        n = codec.get_chunk_count()
+        be = self.ec_backend(pool_id)
+        codec, k, n = be.codec, be.k, be.n
         stats = {"objects": 0, "shards_copied": 0, "shards_rebuilt": 0}
         live = [o for o in self.addrs
                 if self.osdmap.osd_up[o]]
+        records = []          # per-object repair work items
         for pg in range(pool.pg_num):
             coll = [pool_id, pg]
             holdings: Dict[int, set] = {}
@@ -763,8 +896,8 @@ class RemoteCluster:
             names = set()
             for objs in holdings.values():
                 for oid in objs:
-                    shard_s, name = oid.split(":", 1)
-                    names.add(name)
+                    shard_s, nm = oid.split(":", 1)
+                    names.add(nm)
             up = self._up(pool, pg)
             for name in sorted(names):
                 stats["objects"] += 1
@@ -786,7 +919,8 @@ class RemoteCluster:
                 if lost:
                     fetch |= set(sorted(have_somewhere)[:n])
 
-                def _get(shard):
+                def _get(shard, name=name, coll=coll,
+                         holdings=holdings):
                     oid = f"{shard}:{name}"
                     for o in [x for x, objs in holdings.items()
                               if oid in objs]:
@@ -808,46 +942,262 @@ class RemoteCluster:
                     if d is not None:
                         shards[shard] = d
                 missing = [s for s in lost if s not in shards]
-                rebuilt = set()
                 if missing and len(shards) < k:
                     # fewer than k survivors: the object is UNFOUND —
-                    # callers must see this, a clean-looking stats dict
-                    # would hide data loss
+                    # callers must see this, a clean-looking stats
+                    # dict would hide data loss
                     stats["unrecoverable"] = \
                         stats.get("unrecoverable", 0) + 1
                     continue
-                if missing and len(shards) >= k:
-                    plan = sorted(codec.minimum_to_decode(
-                        set(missing), set(shards)))
-                    stack = np.stack(
-                        [np.frombuffer(shards[c], dtype=np.uint8)
-                         for c in plan])
-                    dec = np.asarray(codec.decode_chunks(
-                        plan, stack, missing))
-                    for i, s in enumerate(missing):
-                        shards[s] = dec[i].tobytes()
-                        rebuilt.add(s)
-                        stats["shards_rebuilt"] += 1
-                # push every shard to its up target if absent there
-                for shard, data in shards.items():
-                    if shard >= len(up) or up[shard] == ITEM_NONE:
+                # stripewise objects (batched put) must decode with
+                # per-stripe plane geometry: the bitsliced plane
+                # regions live inside each U-byte chunk, and viewing
+                # S concatenated chunks as one big chunk scrambles
+                # the plane boundaries.  The attrs also ride along to
+                # the re-homed copies — a recovered shard without its
+                # size/S/U would strand geometry after the original
+                # holders die.
+                S_obj, obj_attrs = 1, {}
+                for o, objs in holdings.items():
+                    probe = next((s for s in shards
+                                  if f"{s}:{name}" in objs), None)
+                    if probe is None:
                         continue
-                    tgt = up[shard]
-                    oid = f"{shard}:{name}"
-                    if oid in holdings.get(tgt, set()):
-                        continue
+                    got_any = False
                     try:
-                        self.osd_client(tgt).call({
-                            "cmd": "put_shard", "coll": coll,
-                            "oid": oid, "data": data,
-                            "klass": "background_recovery"})
-                        holdings.setdefault(tgt, set()).add(oid)
-                        if shard not in rebuilt:
-                            stats["shards_copied"] += 1
+                        for akey in ("size", "S", "U"):
+                            raw = self.osd_client(o).call({
+                                "cmd": "getattr_shard", "coll": coll,
+                                "oid": f"{probe}:{name}",
+                                "key": akey})
+                            if raw is not None:
+                                obj_attrs[akey] = bytes(raw)
+                                got_any = True
                     except (OSError, IOError):
-                        self.drop_osd_client(tgt)
+                        self.drop_osd_client(o)
+                        continue
+                    if got_any:
+                        break       # this holder answered with attrs
+                if "S" in obj_attrs:
+                    S_obj = int(obj_attrs["S"])
+                records.append({"pg": pg, "coll": coll, "name": name,
+                                "up": up, "holdings": holdings,
+                                "shards": shards, "missing": missing,
+                                "S": S_obj, "attrs": obj_attrs,
+                                "rebuilt": set()})
+        # ---- signature-grouped decode of every rebuild, few dispatches
+        jobs, job_recs = [], []
+        for rec in records:
+            missing, shards = rec["missing"], rec["shards"]
+            if not missing:
+                continue
+            plan = sorted(codec.minimum_to_decode(set(missing),
+                                                  set(shards)))
+            L = len(rec["shards"][plan[0]])
+            S_obj = rec["S"]
+            if be.words_supported() and L % 4 == 0 and \
+                    L % max(S_obj, 1) == 0:
+                import jax.numpy as jnp
+                # [S, n_avail, W]: per-stripe plane geometry
+                stack = np.stack(
+                    [np.frombuffer(shards[c], dtype="<i4")
+                     .reshape(S_obj, -1) for c in plan], axis=1)
+                jobs.append((plan, jnp.asarray(stack), missing))
+                job_recs.append(rec)
+            else:
+                stackb = np.stack(
+                    [np.frombuffer(shards[c], dtype=np.uint8)
+                     .reshape(S_obj, -1) for c in plan], axis=1)
+                dec = np.asarray(codec.decode_chunks_batch(
+                    plan, stackb, missing))
+                for i, s in enumerate(missing):
+                    shards[s] = np.ascontiguousarray(
+                        dec[:, i]).tobytes()
+                    rec["rebuilt"].add(s)
+                    stats["shards_rebuilt"] += 1
+        if jobs:
+            decs = be.decode_signature_groups(jobs)
+            for rec, dec in zip(job_recs, decs):
+                out = np.asarray(dec)          # [S, n_erased, W]
+                for i, s in enumerate(rec["missing"]):
+                    rec["shards"][s] = np.ascontiguousarray(
+                        out[:, i]).tobytes()
+                    rec["rebuilt"].add(s)
+                    stats["shards_rebuilt"] += 1
+        # ---- push surviving copies + rebuilt shards to up targets
+        for rec in records:
+            up, holdings = rec["up"], rec["holdings"]
+            for shard, data in rec["shards"].items():
+                if shard >= len(up) or up[shard] == ITEM_NONE:
+                    continue
+                tgt = up[shard]
+                oid = f"{shard}:{rec['name']}"
+                if oid in holdings.get(tgt, set()):
+                    continue
+                try:
+                    self.osd_client(tgt).call({
+                        "cmd": "put_shard", "coll": rec["coll"],
+                        "oid": oid, "data": data,
+                        "attrs": rec["attrs"],
+                        "klass": "background_recovery"})
+                    holdings.setdefault(tgt, set()).add(oid)
+                    if shard not in rec["rebuilt"]:
+                        stats["shards_copied"] += 1
+                except (OSError, IOError):
+                    self.drop_osd_client(tgt)
         return stats
 
+    # ------------------------------------------ batched EC device plane --
+    def put_many(self, pool_id: int, names: List[str],
+                 datas: List[bytes]) -> Dict[str, int]:
+        """Batched EC put: ONE device encode dispatch for all N
+        objects (through the shared ECBackend engine), shard bytes
+        committed to daemons with the gather-all-commits contract,
+        shard plane words staged client-side for zero-copy reads.
+        Falls back to per-object put() for non-EC pools / non-device
+        codecs.  Returns {name: acked shard count}."""
+        pool = self.osdmap.pools[pool_id]
+        be = self.ec_backend(pool_id) \
+            if pool.type == POOL_ERASURE else None
+        if be is None or not be.words_supported():
+            return {n: self.put(pool_id, n, d)
+                    for n, d in zip(names, datas)}
+        snapsets = {}
+        if int(self.pool_snaps.get(pool_id, {}).get("seq", 0) or 0):
+            for name in names:
+                if "@" in name:
+                    continue
+                pg = self._pg_for(pool, name)
+                ss = self._maybe_cow(pool, pg, name)
+                if ss is not None:
+                    snapsets[name] = (pg, ss)
+        from ..cluster.ec_backend import ObjectGeom
+        S, U = be.batch_geometry([len(d) for d in datas],
+                                 pool.stripe_unit)
+        stripe = be.k * U
+        payload = np.zeros(len(names) * S * stripe, dtype=np.uint8)
+        for i, d in enumerate(datas):
+            payload[i * S * stripe:i * S * stripe + len(d)] = \
+                np.frombuffer(d, dtype=np.uint8)
+        geom = ObjectGeom(S * stripe, S, U)
+        pg_of = {n: self._pg_for(pool, n) for n in names}
+        sizes = {n: len(d) for n, d in zip(names, datas)}
+        last: Optional[Exception] = None
+        for attempt in range(3):
+            writes = be.encode_to_writes(pg_of, names, payload, geom,
+                                         durable=True, sizes=sizes)
+            try:
+                acked = be.submit(writes)
+                break
+            except IOError as e:
+                last = e
+                if attempt == 2:
+                    raise
+                time.sleep(0.1 * (attempt + 1))
+                try:
+                    self.refresh_map()
+                except (OSError, IOError):
+                    pass
+        for name, (pg, ss) in snapsets.items():
+            self._store_snapset(pool, pg, name, ss)
+        return {n: len(t) for n, t in acked.items()}
+
+    def put_many_from_device(self, pool_id: int, names: List[str],
+                             payload,
+                             durable: bool = False
+                             ) -> Dict[str, Dict[int, int]]:
+        """Batched EC ingest of an on-device payload ([N*S, k, W]
+        int32 plane words — a TPU producer's output), encoded in ONE
+        dispatch.  ``durable=False`` is staged/WAL mode: the ack means
+        the client's HBM holds the authoritative shards and
+        flush_staged() defers the daemon commit — the BlueStore
+        deferred-write contract at client scope (a client crash before
+        flush loses the staged writes, exactly like an un-flushed
+        writeback cache; use durable=True for commit-on-ack)."""
+        pool = self.osdmap.pools[pool_id]
+        if pool.type != POOL_ERASURE:
+            raise IOError("put_many_from_device requires an EC pool")
+        be = self.ec_backend(pool_id)
+        if not be.words_supported():
+            raise IOError("device put requires the bitsliced jax codec")
+        from ..cluster.ec_backend import ObjectGeom
+        S_total = int(payload.shape[0])
+        if S_total % len(names):
+            raise IOError("payload stripes not divisible by names")
+        S = S_total // len(names)
+        W = int(payload.shape[-1])
+        geom = ObjectGeom(S * be.k * W * 4, S, W * 4)
+        pg_of = {n: self._pg_for(pool, n) for n in names}
+        writes = be.encode_to_writes(pg_of, names, payload, geom,
+                                     durable=durable)
+        return be.submit(writes)
+
+    def flush_staged(self, pool_id: int) -> int:
+        """Write every dirty client-staged shard through to its
+        daemon (the WAL flush half of put_many_from_device).  A shard
+        whose target is unreachable or homeless STAYS dirty — the
+        device copy remains authoritative and a later flush (after
+        the map re-homes it) retries; returns the count flushed."""
+        import zlib
+        pool = self.osdmap.pools[pool_id]
+        n = 0
+        for key, ref in self.dev.dirty_items():
+            pid, pg, name, shard = key
+            if pid != pool_id:
+                continue
+            up = self._up(pool, pg)
+            tgt = up[shard] if shard < len(up) else ITEM_NONE
+            if tgt == ITEM_NONE:
+                continue
+            data = np.asarray(ref).tobytes()
+            attrs = self._staged_attrs.get(key, {})
+            try:
+                self.osd_call(tgt, {"cmd": "put_shard",
+                                    "coll": [pool_id, pg],
+                                    "oid": f"{shard}:{name}",
+                                    "data": data, "attrs": attrs})
+            except (OSError, IOError):
+                continue              # stays dirty; retried next flush
+            self.dev.mark_clean(key, zlib.crc32(data))
+            n += 1
+        return n
+
+    def get_many_to_device(self, pool_id: int, names: List[str]):
+        """Batched EC read returning each object's [S, k, W] device
+        words (client staging hits serve zero-copy; misses upload from
+        daemon bytes; degraded objects decode through the
+        signature-grouped device path).  Healthy same-geometry objects
+        assemble in ONE device dispatch (assemble_many)."""
+        be = self.ec_backend(pool_id)
+        pool = self.osdmap.pools[pool_id]
+        if not be.words_supported():
+            raise IOError("device get requires the bitsliced jax codec")
+        out: List[Optional[object]] = [None] * len(names)
+        healthy: Dict = {}        # (S, W) -> [(idx, data-col refs)]
+        for idx, name in enumerate(names):
+            pg = self._pg_for(pool, name)
+            geom = be.read_geom(pg, name)
+            if geom is None:
+                raise RemoteObjectMissing(f"{name}: no such object")
+            if geom.U == 0:          # legacy single-stripe object
+                raw = self.get(pool_id, name)
+                raw += b"\0" * ((-len(raw)) % (be.k * 4))
+                out[idx] = be.to_words(raw, 1, len(raw) // be.k)
+                continue
+            refs = be.gather_refs(pg, name)
+            if all(c in refs for c in range(be.k)):
+                healthy.setdefault((geom.S, geom.W), []).append(
+                    (idx, [refs[c] for c in range(be.k)]))
+            else:
+                out[idx] = be.assemble_object_words(refs, geom)
+        from ..cluster.device_store import assemble_many
+        for (S, W), items in healthy.items():
+            stacked = assemble_many([r for _, r in items], S, W)
+            for j, (idx, _) in enumerate(items):
+                out[idx] = stacked[j * S:(j + 1) * S]
+        return out
+
+    # ---------------------------------------------------------- status --
     def status(self) -> Dict:
         return self.mon_call({"cmd": "status"})
 
@@ -859,3 +1209,160 @@ class RemoteCluster:
             c.close()
         if self.mon is not None:
             self.mon.close()
+
+
+class WireShardIO:
+    """ShardIO transport over authenticated daemon sockets — the wire
+    half of the PGBackend seam (cluster/ec_backend.py).  Sub-writes
+    fan out concurrently across OSD connections (each WireClient
+    serializes its own socket; distinct targets run in parallel), and
+    every shard this client writes or reads is STAGED in its HBM cache
+    as plane words, validated against the daemon's stored checksum on
+    reuse — the TPU-attached client is the EC primary and serves its
+    own data zero-copy (ARCHITECTURE.md §4; the at-rest-layout
+    property of src/osd/ECBackend.cc:934,1015)."""
+
+    def __init__(self, rc: "RemoteCluster", pool_id: int):
+        self.rc = rc
+        self.pool_id = pool_id
+
+    def _pool(self) -> PGPool:
+        return self.rc.osdmap.pools[self.pool_id]
+
+    def up_set(self, pg: int) -> List[int]:
+        return self.rc._up(self._pool(), pg)
+
+    # ---------------------------------------------------------- writes --
+    def fanout(self, writes):
+        rc = self.rc
+        import concurrent.futures as cf
+        import zlib
+
+        def one(w):
+            key = (self.pool_id, w.pg, w.name, w.shard)
+            data = w.bytes_fn()
+            if data is None:
+                # staged/WAL mode: the client HBM ref is the
+                # authoritative copy until flush_staged() (the
+                # BlueStore deferred-write shape; durability contract
+                # documented on put_many_from_device)
+                rc.dev.put(key, w.ref, None)
+                rc._staged_attrs[key] = w.attrs
+                return w
+            try:
+                rc.osd_call(w.target, {
+                    "cmd": "put_shard",
+                    "coll": [self.pool_id, w.pg],
+                    "oid": f"{w.shard}:{w.name}",
+                    "data": data, "attrs": w.attrs})
+            except (OSError, IOError):
+                return None
+            rc.dev.put(key, w.ref, zlib.crc32(data))
+            rc._staged_attrs[key] = w.attrs
+            return w
+
+        if len(writes) <= 1:
+            results = [one(w) for w in writes]
+        else:
+            with cf.ThreadPoolExecutor(
+                    max_workers=min(8, len(writes))) as ex:
+                results = list(ex.map(one, writes))
+        return [w for w in results if w is not None]
+
+    def purge_shard(self, pg: int, shard: int, name: str,
+                    keep_target) -> None:
+        rc = self.rc
+        rc.dev.evict((self.pool_id, pg, name, shard))
+        for o in list(rc.addrs):
+            if o == keep_target or not rc.osdmap.osd_up[o]:
+                continue
+            try:
+                rc.osd_call(o, {"cmd": "delete_shard",
+                                "coll": [self.pool_id, pg],
+                                "oid": f"{shard}:{name}"})
+            except (OSError, IOError):
+                pass
+
+    # ----------------------------------------------------------- reads --
+    def _digest(self, pg: int, shard: int, name: str) -> Optional[int]:
+        up = self.up_set(pg)
+        srcs = [up[shard]] if shard < len(up) and \
+            up[shard] != ITEM_NONE else []
+        srcs += [o for o in self.rc.addrs if o not in srcs]
+        for o in srcs:
+            try:
+                d = self.rc.osd_call(o, {
+                    "cmd": "digest_shard",
+                    "coll": [self.pool_id, pg],
+                    "oid": f"{shard}:{name}"})
+            except (OSError, IOError):
+                continue
+            if d is not None:
+                return int(d)
+        return None
+
+    def get_shard_ref(self, pg: int, shard: int, name: str):
+        rc = self.rc
+        key = (self.pool_id, pg, name, shard)
+        dirty = rc.dev.dirty_get(key)
+        if dirty is not None:
+            return dirty
+        digest = self._digest(pg, shard, name)
+        if digest is not None:
+            arr = rc.dev.get(key, digest)
+            if arr is not None:
+                return arr
+        data = self.get_shard_bytes(pg, shard, name)
+        if data is None or len(data) % 4:
+            return None
+        import zlib
+        import jax.numpy as jnp
+        from ..cluster.device_store import as_ref
+        ref = as_ref(jnp.asarray(np.frombuffer(data, dtype="<i4")))
+        rc.dev.put(key, ref, zlib.crc32(data))
+        return ref
+
+    def get_shard_bytes(self, pg: int, shard: int,
+                        name: str) -> Optional[bytes]:
+        rc = self.rc
+        dirty = rc.dev.dirty_get((self.pool_id, pg, name, shard))
+        if dirty is not None:
+            return np.asarray(dirty).tobytes()
+        up = self.up_set(pg)
+        srcs = [up[shard]] if shard < len(up) and \
+            up[shard] != ITEM_NONE else []
+        srcs += [o for o in rc.addrs if o not in srcs]
+        for o in srcs:
+            try:
+                d = rc.osd_call(o, {"cmd": "get_shard",
+                                    "coll": [self.pool_id, pg],
+                                    "oid": f"{shard}:{name}"})
+            except (OSError, IOError):
+                continue
+            if d is not None:
+                return d
+        return None
+
+    def getattr(self, pg: int, name: str, shard: int,
+                key: str) -> Optional[bytes]:
+        rc = self.rc
+        akey = (self.pool_id, pg, name, shard)
+        if rc.dev.dirty_get(akey) is not None:
+            raw = rc._staged_attrs.get(akey, {}).get(key)
+            if raw is not None:
+                return raw
+        up = self.up_set(pg)
+        srcs = [up[shard]] if shard < len(up) and \
+            up[shard] != ITEM_NONE else []
+        srcs += [o for o in rc.addrs if o not in srcs]
+        for o in srcs:
+            try:
+                d = rc.osd_call(o, {"cmd": "getattr_shard",
+                                    "coll": [self.pool_id, pg],
+                                    "oid": f"{shard}:{name}",
+                                    "key": key})
+            except (OSError, IOError):
+                continue
+            if d is not None:
+                return d
+        return None

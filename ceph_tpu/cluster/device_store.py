@@ -223,10 +223,21 @@ class DeviceShardCache:
         if self._entries.pop(key, None) is not None:
             self.invalidations += 1
 
+    def evict_object(self, pool_id: int, pg: int, name: str) -> None:
+        """Drop every staged shard of one object (overwrite/delete
+        invalidation: dirty entries are served unconditionally, so a
+        stale dirty entry would resurrect overwritten data)."""
+        for k in [k for k in self._entries
+                  if k[0] == pool_id and k[1] == pg and k[2] == name]:
+            self.evict(k)
+
     def clear(self) -> None:
         self._entries.clear()
 
     # ------------------------------------------------------------- reads --
+    def has(self, key: ShardKey) -> bool:
+        return key in self._entries
+
     def dirty_get(self, key: ShardKey):
         """The staged array IF the entry is dirty (device copy is the
         authoritative one awaiting flush); else None."""

@@ -225,6 +225,79 @@ class ObjectInfo:
     clone_sizes: Dict[int, int] = field(default_factory=dict)
 
 
+class SimShardIO:
+    """In-process ShardIO: the simulator half of the PGBackend seam
+    (cluster/ec_backend.py).  Sub-writes ride each SimOSD's async
+    queue -> mClock -> dispatch (the MOSDECSubOpWrite shape,
+    src/osd/ECBackend.cc:1976); failed/homeless sub-ops purge stale
+    copies so no older shard version is ever servable, and successes
+    supersede strays (peering-time supersession)."""
+
+    def __init__(self, sim: "ClusterSim", pool_id: int):
+        self.sim = sim
+        self.pool_id = pool_id
+
+    def _pool(self):
+        return self.sim.osdmap.pools[self.pool_id]
+
+    def up_set(self, pg: int) -> List[int]:
+        return self.sim.pg_up(self._pool(), pg)
+
+    def fanout(self, writes):
+        from ..msg.scheduler import CLASS_CLIENT
+        sim = self.sim
+        subs, committed = [], []
+        for w in writes:
+            op = {"kind": "put_dev",
+                  "key": (self.pool_id, w.pg, w.name, w.shard),
+                  "klass": CLASS_CLIENT, "data": w.bytes_fn()}
+            try:
+                op_id, ev = sim.services[w.target].call_async(
+                    op, obj=w.ref)
+            except IOError:
+                self.purge_shard(w.pg, w.shard, w.name, None)
+                continue
+            subs.append((w, op_id, ev))
+        for w, op_id, ev in subs:
+            try:
+                sim.services[w.target].wait_async(op_id, ev)
+            except IOError:
+                self.purge_shard(w.pg, w.shard, w.name, None)
+                continue
+            for o in sim.osds:      # success supersedes stale copies
+                if o.id != w.target:
+                    o.delete((self.pool_id, w.pg, w.name, w.shard))
+            committed.append(w)
+        return committed
+
+    def purge_shard(self, pg: int, shard: int, name: str,
+                    keep_target) -> None:
+        for o in self.sim.osds:
+            if o.id != keep_target:
+                o.delete((self.pool_id, pg, name, shard))
+
+    def get_shard_ref(self, pg: int, shard: int, name: str):
+        up = self.up_set(pg)
+        return self.sim._read_shard_dev(self.pool_id, pg, name,
+                                        shard, up)
+
+    def get_shard_bytes(self, pg: int, shard: int,
+                        name: str) -> Optional[bytes]:
+        up = self.up_set(pg)
+        p = self.sim._read_shard(self.pool_id, pg, name, shard, up)
+        return None if p is None else p.tobytes()
+
+    def getattr(self, pg: int, name: str, shard: int,
+                key: str) -> Optional[bytes]:
+        info = self.sim.objects.get((self.pool_id, name))
+        if info is None:
+            return None
+        vals = {"size": info.size, "S": info.n_stripes,
+                "U": info.chunk_size}
+        v = vals.get(key)
+        return None if v is None else str(v).encode()
+
+
 class ClusterSim:
     """OSDMap + memstore OSDs + codec data path, in one process."""
 
@@ -242,6 +315,7 @@ class ClusterSim:
         self._finalizer = weakref.finalize(
             self, ClusterSim._stop_services, self.services)
         self.codecs: Dict[int, object] = {}
+        self._ec_backends: Dict[int, object] = {}
         self.objects: Dict[Tuple[int, str], ObjectInfo] = {}
         self.ec_profiles: Dict[str, Dict[str, str]] = {}
         self.extent_cache = ExtentCache()
@@ -325,6 +399,19 @@ class ClusterSim:
             self.codecs[pool.id] = codec
         return codec
 
+    def ec_backend(self, pool_id: int):
+        """The shared ECBackend engine over this sim's SimShardIO —
+        the SAME class the wire client drives (the PGBackend seam,
+        src/osd/PGBackend.cc:571)."""
+        be = self._ec_backends.get(pool_id)
+        if be is None:
+            from .ec_backend import ECBackend
+            pool = self.osdmap.pools[pool_id]
+            be = ECBackend(self.codec_for(pool),
+                           SimShardIO(self, pool_id))
+            self._ec_backends[pool_id] = be
+        return be
+
     def _sinfo(self, pool: PGPool) -> StripeInfo:
         codec = self.codec_for(pool)
         return StripeInfo(codec.get_data_chunk_count(), pool.stripe_unit)
@@ -391,7 +478,8 @@ class ClusterSim:
                      up: List[int],
                      payload: np.ndarray) -> Optional[int]:
         """Place one host-byte shard on its mapped home (the staged
-        device path fans out through _fanout_shards instead)."""
+        device path fans out through the ECBackend/SimShardIO seam
+        instead)."""
         tgt = up[shard] if shard < len(up) else ITEM_NONE
         if tgt == ITEM_NONE:
             # degraded write: the shard is homeless.  Stale copies of
@@ -459,115 +547,45 @@ class ClusterSim:
                           U: int,
                           dchunks_host: Optional[np.ndarray] = None
                           ) -> List[int]:
-        """Encode the device payload (ONE word-domain dispatch) and
-        stage each shard on its target as a zero-copy column ref: data
-        shards are columns of the client's [S, k, W] word view, parity
-        shards columns of the encode output (shared by
-        put/put_from_device).  Eager flush takes durable bytes from
-        ``dchunks_host`` when the caller already has them, else from
-        one readback per buffer."""
-        from ..msg.scheduler import CLASS_CLIENT
-        from .device_store import ShardRef
-        k = codec.get_data_chunk_count()
-        mm = codec.get_coding_chunk_count()
-        d = self._to_words(payload, S, k, U)
-        par = codec.encode_words_device(d)
-        eager = self.staging_flush == "eager"
-        d_host = p_host = None
-        if eager:
-            d_host = (dchunks_host if dchunks_host is not None
-                      else np.asarray(d))
-            p_host = np.asarray(par)
-
-        def ref_for(shard):
-            return (ShardRef(d, shard, axis=1) if shard < k
-                    else ShardRef(par, shard - k, axis=1))
-
-        def bytes_for(shard):
-            if not eager:
-                return None
-            h, c = (d_host, shard) if shard < k else (p_host, shard - k)
-            return np.ascontiguousarray(h[:, c]).tobytes()
-
-        return self._fanout_shards(pool_id, pg, name, up, k + mm,
-                                   ref_for, bytes_for)
-
-    def _fanout_shards(self, pool_id: int, pg: int, name: str,
-                       up: List[int], n_shards: int, ref_for,
-                       bytes_for) -> List[int]:
-        """Fan out all n sub-writes concurrently, then gather — the
-        MOSDECSubOpWrite shape (src/osd/ECBackend.cc:1976).  Homeless
-        slots, dead targets and failed sub-ops purge stale copies so
-        no older shard version can be served (see _write_shard)."""
-        from ..msg.scheduler import CLASS_CLIENT
-
-        def purge(shard):
-            for o in self.osds:
-                o.delete((pool_id, pg, name, shard))
-
-        subs = []
-        for shard in range(n_shards):
-            tgt = up[shard] if shard < len(up) else ITEM_NONE
-            if tgt == ITEM_NONE:
-                purge(shard)           # homeless: supersede stale copies
-                continue
-            op = {"kind": "put_dev",
-                  "key": (pool_id, pg, name, shard),
-                  "klass": CLASS_CLIENT, "data": bytes_for(shard)}
-            try:
-                op_id, ev = self.services[tgt].call_async(
-                    op, obj=ref_for(shard))
-            except IOError:
-                purge(shard)
-                continue
-            subs.append((shard, tgt, op_id, ev))
-        placed = []
-        for shard, tgt, op_id, ev in subs:
-            try:
-                self.services[tgt].wait_async(op_id, ev)
-            except IOError:
-                purge(shard)           # undetected-dead target
-                continue
-            for o in self.osds:        # success supersedes stale copies
-                if o.id != tgt:
-                    o.delete((pool_id, pg, name, shard))
-            placed.append(tgt)
-        return placed
+        """Encode + fan out one object's shards through the shared
+        ECBackend engine (encode dispatch -> zero-copy column refs ->
+        SimShardIO sub-op fan-out).  Eager flush takes durable bytes
+        from ``dchunks_host`` when the caller already has them, else
+        from one readback per buffer."""
+        from .ec_backend import ObjectGeom
+        be = self.ec_backend(pool_id)
+        geom = ObjectGeom(S * be.k * U, S, U)
+        writes = be.encode_to_writes(
+            {name: pg}, [name], payload, geom,
+            durable=(self.staging_flush == "eager"),
+            d_host=dchunks_host)
+        acked = be.submit_loose(writes)
+        return [t for _, t in sorted(acked.get(name, {}).items())]
 
     def _gather_decode_dev(self, pool: PGPool, name: str,
                            info: ObjectInfo, pg: int, up: List[int]):
-        """Assemble the object payload in the device domain: gather
-        staged shard refs, decode missing data chunks with the
-        masked-XOR kernel, stitch columns — ~one dispatch per stage
-        over shared packed buffers (shared by get / get_to_device; the
-        handle_sub_read_reply -> decode flow,
-        src/osd/ECBackend.cc:1183).  Returns the int32 [S, k, U/4]
-        word-domain stripe view on device (untrimmed — see
+        """Assemble the object payload in the device domain through
+        the shared ECBackend engine: gather staged shard refs, decode
+        missing data chunks with the masked-XOR kernel, stitch columns
+        — ~one dispatch per stage over shared packed buffers (shared
+        by get / get_to_device; the handle_sub_read_reply -> decode
+        flow, src/osd/ECBackend.cc:1183).  Returns the int32
+        [S, k, U/4] word-domain stripe view on device (untrimmed — see
         assemble_object; bytes == the u8 view, little-endian)."""
-        from .device_store import assemble_object, assemble_refs
-        codec = self.codec_for(pool)
-        k = codec.get_data_chunk_count()
-        mm = codec.get_coding_chunk_count()
+        from .ec_backend import ObjectGeom
+        be = self.ec_backend(pool.id)
         U, S = info.chunk_size, info.n_stripes
-        W = U // 4
         files = {}
-        for shard in range(k + mm):
+        for shard in range(be.n):
             r = self._read_shard_dev(pool.id, pg, name, shard, up)
             if r is not None and r.size >= S * U:
                 files[shard] = r
-        missing_data = [c for c in range(k) if c not in files]
-        dec = None
-        if missing_data:
-            try:
-                plan = sorted(codec.minimum_to_decode(set(range(k)),
-                                                      set(files)))
-            except ErasureCodeError:
-                raise IOError(f"object {name}: unrecoverable "
-                              f"(only shards {sorted(files)})")
-            sub = assemble_refs([files[c] for c in plan], S, W)
-            dec = codec.decode_words_device(plan, sub, missing_data)
-        return assemble_object([files.get(c) for c in range(k)], dec,
-                               S, W)
+        try:
+            return be.assemble_object_words(
+                files, ObjectGeom(info.size, S, U))
+        except IOError:
+            raise IOError(f"object {name}: unrecoverable "
+                          f"(only shards {sorted(files)})") from None
 
     def _new_info(self, pool: PGPool, name: str, size: int, chunk: int,
                   n_str: int = 1) -> ObjectInfo:
@@ -1046,7 +1064,6 @@ class ClusterSim:
         (ParallelPGMapper -> one pjit): amortizes per-dispatch cost
         over the whole batch; placement/logging run per object."""
         import jax.numpy as jnp
-        from .device_store import ShardRef
         pool = self.osdmap.pools[pool_id]
         codec = self.codec_for(pool)
         if not self._device_staging(codec):
@@ -1067,36 +1084,25 @@ class ClusterSim:
             raise IOError("put_many_from_device needs stripe-aligned "
                           "objects")
         a = self._to_words(a, N * S, k, U)
-        par = codec.encode_words_device(a)       # ONE dispatch, all N
-        eager = self.staging_flush == "eager"
-        d_host = np.asarray(a) if eager else None
-        p_host = np.asarray(par) if eager else None
-        results: Dict[str, List[int]] = {}
-        for n_i, name in enumerate(names):
+        from .ec_backend import ObjectGeom
+        be = self.ec_backend(pool_id)
+        pg_of: Dict[str, int] = {}
+        for name in names:
             if "@" not in name:
                 self._maybe_clone(pool, name)
-            pg = self.object_pg(pool, name)
-            up = self.pg_up(pool, pg)
-            s0, s1 = n_i * S, (n_i + 1) * S
-
-            def ref_for(shard):
-                src = a if shard < k else par
-                col = shard if shard < k else shard - k
-                return ShardRef(src, col, axis=1, s0=s0, s1=s1)
-
-            def bytes_for(shard):
-                if not eager:
-                    return None
-                h = d_host if shard < k else p_host
-                col = shard if shard < k else shard - k
-                return np.ascontiguousarray(h[s0:s1, col]).tobytes()
-
-            placed = self._fanout_shards(pool_id, pg, name, up,
-                                         pool.size, ref_for, bytes_for)
+            pg_of[name] = self.object_pg(pool, name)
+        writes = be.encode_to_writes(      # ONE dispatch, all N
+            pg_of, names, a, ObjectGeom(obj_bytes, S, U),
+            durable=(self.staging_flush == "eager"))
+        acked = be.submit_loose(writes)
+        results: Dict[str, List[int]] = {}
+        for name in names:
+            placed = [t for _, t in
+                      sorted(acked.get(name, {}).items())]
             self.extent_cache.invalidate_object((pool_id, name))
             self.objects[(pool_id, name)] = self._new_info(
                 pool, name, obj_bytes, U, S)
-            self._log_write(pool_id, pg, name, set(placed))
+            self._log_write(pool_id, pg_of[name], name, set(placed))
             results[name] = placed
         return results
 

@@ -73,6 +73,7 @@ SLOW_TESTS = {
     "test_ceph_df_counts_objects",
     "test_delete_is_logged_no_resurrection",
     "test_workload_survives_socket_failures",
+    "test_wire_recovery_rebuilds_stripewise_in_grouped_dispatch",
 }
 
 
