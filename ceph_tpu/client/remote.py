@@ -115,6 +115,7 @@ class RemoteCluster:
         for p in blob["pools"]:
             m.add_pool(PGPool(**p))
         self.osdmap = m
+        self._up_cache: Dict = {}
         self.addrs = {int(k): v for k, v in blob["addrs"].items()}
         self.pool_snaps = {int(k): v for k, v in
                            blob.get("pool_snaps", {}).items()}
@@ -137,6 +138,19 @@ class RemoteCluster:
                            timeout=10.0)
             self._osd_clients[osd] = c
             return c
+
+    def new_osd_client(self, osd: int) -> WireClient:
+        """A DEDICATED (unshared) authenticated connection to one OSD.
+        Long-blocking calls (notify_wait) hold a connection's lock for
+        their whole wait, so background pollers must not ride the
+        shared per-OSD clients — the ack they need to deliver would
+        serialize behind the very wait it unblocks."""
+        grant = self.mon_call({"cmd": "get_ticket",
+                               "service": f"osd.{osd}"})
+        key = cx.open_key_box(self.secret, grant["key_box"])
+        return WireClient(self.addrs[osd], self.entity,
+                          ticket=grant["ticket"], session_key=key,
+                          timeout=10.0)
 
     def _evict_staging(self, pool_id: int, pg: int, name: str) -> None:
         """Invalidate this client's staged shards + attrs for one
@@ -175,8 +189,18 @@ class RemoteCluster:
         return pool.raw_pg_to_pg(ps)
 
     def _up(self, pool: PGPool, pg: int) -> List[int]:
+        """Memoized per (pool, pg) against the current map epoch —
+        the Objecter's cached-target role: batched surfaces hit the
+        same PGs every round and must not recompute the scalar CRUSH
+        descent each time (refresh_map drops the cache)."""
+        key = (pool.id, pg)
+        hit = self._up_cache.get(key)
+        if hit is not None:
+            return hit
         up, _, acting, _ = self.osdmap.pg_to_up_acting_osds(pool.id, pg)
-        return acting or up
+        r = acting or up
+        self._up_cache[key] = r
+        return r
 
     def codec_for(self, pool: PGPool):
         codec = self._codecs.get(pool.id)
@@ -560,6 +584,7 @@ class RemoteCluster:
         obj_size: Optional[int] = None
         geom_s: Optional[int] = None
         geom_u: Optional[int] = None
+        geom_resolved = False
         conn_errors = 0
         for shard in range(n):
             # client HBM staging first: a shard this client wrote or
@@ -600,26 +625,39 @@ class RemoteCluster:
                         shards[shard] = d
 
                         def attrs_src(kk, o=o, shard=shard):
-                            try:
-                                return self.osd_call(o, {
-                                    "cmd": "getattr_shard",
-                                    "coll": coll,
-                                    "oid": f"{shard}:{name}",
-                                    "key": kk})
-                            except (OSError, IOError):
-                                return None
+                            # propagate wire errors: "attr absent"
+                            # and "holder unreachable" must not be
+                            # conflated (geometry decides assembly)
+                            return self.osd_call(o, {
+                                "cmd": "getattr_shard",
+                                "coll": coll,
+                                "oid": f"{shard}:{name}",
+                                "key": kk})
                         break
-            if attrs_src is not None and obj_size is None:
-                sz = attrs_src("size")
+            if attrs_src is not None and not geom_resolved:
+                try:
+                    sz = attrs_src("size")
+                    s_raw, u_raw = attrs_src("S"), attrs_src("U")
+                except (OSError, IOError):
+                    continue      # try the next shard's holder
                 if sz is not None:
                     obj_size = int(sz)
-                    s_raw, u_raw = attrs_src("S"), attrs_src("U")
-                    if s_raw is not None and u_raw is not None:
-                        geom_s, geom_u = int(s_raw), int(u_raw)
+                # a DEFINITIVE answer: attrs answered (None = a
+                # legacy single-stripe object, values = stripewise)
+                geom_resolved = True
+                if s_raw is not None and u_raw is not None:
+                    geom_s, geom_u = int(s_raw), int(u_raw)
         if len(shards) < k:
             if not shards and conn_errors == 0:
                 raise RemoteObjectMissing(f"{name}: no such object")
             raise IOError(f"{name}: only {len(shards)} shards (< k)")
+        if not geom_resolved and obj_size is None and shards:
+            # shards readable but NO holder answered the attr probes:
+            # assembling with guessed geometry could silently scramble
+            # a stripewise object — error out and let the caller's
+            # retry loop re-sweep
+            raise IOError(f"{name}: shard attrs unreadable "
+                          f"(geometry unknown)")
         be = self.ec_backend(pool_id)
         plan, missing = be.plan(list(shards))
         if geom_s is not None and geom_u:
@@ -869,22 +907,25 @@ class RemoteCluster:
 
     def recover_ec_pool(self, pool_id: int) -> Dict[str, int]:
         """Client-driven EC recovery (the client is the TPU-attached
-        primary), in three passes: (1) union every daemon's shard
-        listing per PG and fetch only the shards each repair requires;
-        (2) decode ALL objects' lost shards in signature-GROUPED
-        device dispatches — every object that lost the same shard set
+        primary), per PG in three passes: (1) union every daemon's
+        shard listing and fetch only the shards each repair requires;
+        (2) decode the PG's lost shards in signature-GROUPED device
+        dispatches — every object that lost the same shard set
         rebuilds in one masked-XOR kernel call, the bench_recovery
         machinery on the serving path (src/osd/ECBackend.cc:757 →
         ECUtil::decode, batched); (3) push surviving copies and
-        rebuilt shards to their up targets."""
+        rebuilt shards to their up targets.  PG-scoped batching keeps
+        client memory bounded by one PG's repair set (objects in one
+        PG share an up set, hence a signature — cross-PG grouping
+        would add residency, not dispatch savings)."""
         pool = self.osdmap.pools[pool_id]
         be = self.ec_backend(pool_id)
         codec, k, n = be.codec, be.k, be.n
         stats = {"objects": 0, "shards_copied": 0, "shards_rebuilt": 0}
         live = [o for o in self.addrs
                 if self.osdmap.osd_up[o]]
-        records = []          # per-object repair work items
         for pg in range(pool.pg_num):
+            records = []      # this PG's repair work items
             coll = [pool_id, pg]
             holdings: Dict[int, set] = {}
             for o in live:
@@ -985,66 +1026,66 @@ class RemoteCluster:
                                 "shards": shards, "missing": missing,
                                 "S": S_obj, "attrs": obj_attrs,
                                 "rebuilt": set()})
-        # ---- signature-grouped decode of every rebuild, few dispatches
-        jobs, job_recs = [], []
-        for rec in records:
-            missing, shards = rec["missing"], rec["shards"]
-            if not missing:
-                continue
-            plan = sorted(codec.minimum_to_decode(set(missing),
-                                                  set(shards)))
-            L = len(rec["shards"][plan[0]])
-            S_obj = rec["S"]
-            if be.words_supported() and L % 4 == 0 and \
-                    L % max(S_obj, 1) == 0:
-                import jax.numpy as jnp
-                # [S, n_avail, W]: per-stripe plane geometry
-                stack = np.stack(
-                    [np.frombuffer(shards[c], dtype="<i4")
-                     .reshape(S_obj, -1) for c in plan], axis=1)
-                jobs.append((plan, jnp.asarray(stack), missing))
-                job_recs.append(rec)
-            else:
-                stackb = np.stack(
-                    [np.frombuffer(shards[c], dtype=np.uint8)
-                     .reshape(S_obj, -1) for c in plan], axis=1)
-                dec = np.asarray(codec.decode_chunks_batch(
-                    plan, stackb, missing))
-                for i, s in enumerate(missing):
-                    shards[s] = np.ascontiguousarray(
-                        dec[:, i]).tobytes()
-                    rec["rebuilt"].add(s)
-                    stats["shards_rebuilt"] += 1
-        if jobs:
-            decs = be.decode_signature_groups(jobs)
-            for rec, dec in zip(job_recs, decs):
-                out = np.asarray(dec)          # [S, n_erased, W]
-                for i, s in enumerate(rec["missing"]):
-                    rec["shards"][s] = np.ascontiguousarray(
-                        out[:, i]).tobytes()
-                    rec["rebuilt"].add(s)
-                    stats["shards_rebuilt"] += 1
-        # ---- push surviving copies + rebuilt shards to up targets
-        for rec in records:
-            up, holdings = rec["up"], rec["holdings"]
-            for shard, data in rec["shards"].items():
-                if shard >= len(up) or up[shard] == ITEM_NONE:
+            # -- signature-grouped decode of this PG's rebuilds
+            jobs, job_recs = [], []
+            for rec in records:
+                missing, shards = rec["missing"], rec["shards"]
+                if not missing:
                     continue
-                tgt = up[shard]
-                oid = f"{shard}:{rec['name']}"
-                if oid in holdings.get(tgt, set()):
-                    continue
-                try:
-                    self.osd_client(tgt).call({
-                        "cmd": "put_shard", "coll": rec["coll"],
-                        "oid": oid, "data": data,
-                        "attrs": rec["attrs"],
-                        "klass": "background_recovery"})
-                    holdings.setdefault(tgt, set()).add(oid)
-                    if shard not in rec["rebuilt"]:
-                        stats["shards_copied"] += 1
-                except (OSError, IOError):
-                    self.drop_osd_client(tgt)
+                plan = sorted(codec.minimum_to_decode(set(missing),
+                                                      set(shards)))
+                L = len(rec["shards"][plan[0]])
+                S_obj = rec["S"]
+                if be.words_supported() and L % 4 == 0 and \
+                        L % max(S_obj, 1) == 0:
+                    import jax.numpy as jnp
+                    # [S, n_avail, W]: per-stripe plane geometry
+                    stack = np.stack(
+                        [np.frombuffer(shards[c], dtype="<i4")
+                         .reshape(S_obj, -1) for c in plan], axis=1)
+                    jobs.append((plan, jnp.asarray(stack), missing))
+                    job_recs.append(rec)
+                else:
+                    stackb = np.stack(
+                        [np.frombuffer(shards[c], dtype=np.uint8)
+                         .reshape(S_obj, -1) for c in plan], axis=1)
+                    dec = np.asarray(codec.decode_chunks_batch(
+                        plan, stackb, missing))
+                    for i, s in enumerate(missing):
+                        shards[s] = np.ascontiguousarray(
+                            dec[:, i]).tobytes()
+                        rec["rebuilt"].add(s)
+                        stats["shards_rebuilt"] += 1
+            if jobs:
+                decs = be.decode_signature_groups(jobs)
+                for rec, dec in zip(job_recs, decs):
+                    out = np.asarray(dec)          # [S, n_erased, W]
+                    for i, s in enumerate(rec["missing"]):
+                        rec["shards"][s] = np.ascontiguousarray(
+                            out[:, i]).tobytes()
+                        rec["rebuilt"].add(s)
+                        stats["shards_rebuilt"] += 1
+            # -- push surviving copies + rebuilt shards to up targets
+            for rec in records:
+                up, holdings = rec["up"], rec["holdings"]
+                for shard, data in rec["shards"].items():
+                    if shard >= len(up) or up[shard] == ITEM_NONE:
+                        continue
+                    tgt = up[shard]
+                    oid = f"{shard}:{rec['name']}"
+                    if oid in holdings.get(tgt, set()):
+                        continue
+                    try:
+                        self.osd_client(tgt).call({
+                            "cmd": "put_shard", "coll": rec["coll"],
+                            "oid": oid, "data": data,
+                            "attrs": rec["attrs"],
+                            "klass": "background_recovery"})
+                        holdings.setdefault(tgt, set()).add(oid)
+                        if shard not in rec["rebuilt"]:
+                            stats["shards_copied"] += 1
+                    except (OSError, IOError):
+                        self.drop_osd_client(tgt)
         return stats
 
     # ------------------------------------------ batched EC device plane --
@@ -1072,35 +1113,51 @@ class RemoteCluster:
                 if ss is not None:
                     snapsets[name] = (pg, ss)
         from ..cluster.ec_backend import ObjectGeom
-        S, U = be.batch_geometry([len(d) for d in datas],
-                                 pool.stripe_unit)
-        stripe = be.k * U
-        payload = np.zeros(len(names) * S * stripe, dtype=np.uint8)
+        # group by stripe-count class: one encode dispatch per class.
+        # Padding EVERY object to the largest object's stripe count
+        # would write-amplify a mixed batch (a 100-byte object shipped
+        # at a 256 MiB object's geometry); same-S objects share one
+        # dispatch with zero amplification beyond their own padding
+        by_class: Dict[int, List[int]] = {}
         for i, d in enumerate(datas):
-            payload[i * S * stripe:i * S * stripe + len(d)] = \
-                np.frombuffer(d, dtype=np.uint8)
-        geom = ObjectGeom(S * stripe, S, U)
-        pg_of = {n: self._pg_for(pool, n) for n in names}
-        sizes = {n: len(d) for n, d in zip(names, datas)}
-        last: Optional[Exception] = None
-        for attempt in range(3):
-            writes = be.encode_to_writes(pg_of, names, payload, geom,
-                                         durable=True, sizes=sizes)
-            try:
-                acked = be.submit(writes)
-                break
-            except IOError as e:
-                last = e
-                if attempt == 2:
-                    raise
-                time.sleep(0.1 * (attempt + 1))
+            Si, U = be.batch_geometry([len(d)], pool.stripe_unit)
+            by_class.setdefault(Si, []).append(i)
+        acked_all: Dict[str, int] = {}
+        for S, idxs in by_class.items():
+            gnames = [names[i] for i in idxs]
+            gdatas = [datas[i] for i in idxs]
+            _, U = be.batch_geometry([len(d) for d in gdatas],
+                                     pool.stripe_unit)
+            stripe = be.k * U
+            payload = np.zeros(len(gnames) * S * stripe,
+                               dtype=np.uint8)
+            for j, d in enumerate(gdatas):
+                payload[j * S * stripe:j * S * stripe + len(d)] = \
+                    np.frombuffer(d, dtype=np.uint8)
+            geom = ObjectGeom(S * stripe, S, U)
+            pg_of = {n: self._pg_for(pool, n) for n in gnames}
+            sizes = {n: len(d) for n, d in zip(gnames, gdatas)}
+            last: Optional[Exception] = None
+            for attempt in range(3):
+                writes = be.encode_to_writes(pg_of, gnames, payload,
+                                             geom, durable=True,
+                                             sizes=sizes)
                 try:
-                    self.refresh_map()
-                except (OSError, IOError):
-                    pass
+                    acked = be.submit(writes)
+                    break
+                except IOError as e:
+                    last = e
+                    if attempt == 2:
+                        raise
+                    time.sleep(0.1 * (attempt + 1))
+                    try:
+                        self.refresh_map()
+                    except (OSError, IOError):
+                        pass
+            acked_all.update({n: len(t) for n, t in acked.items()})
         for name, (pg, ss) in snapsets.items():
             self._store_snapset(pool, pg, name, ss)
-        return {n: len(t) for n, t in acked.items()}
+        return acked_all
 
     def put_many_from_device(self, pool_id: int, names: List[str],
                              payload,
@@ -1120,6 +1177,17 @@ class RemoteCluster:
         be = self.ec_backend(pool_id)
         if not be.words_supported():
             raise IOError("device put requires the bitsliced jax codec")
+        snapsets = {}
+        if int(self.pool_snaps.get(pool_id, {}).get("seq", 0) or 0):
+            # snapped pool: COW each overwritten head first, exactly
+            # like put_many / the sim's put_many_from_device
+            for name in names:
+                if "@" in name:
+                    continue
+                pg = self._pg_for(pool, name)
+                ss = self._maybe_cow(pool, pg, name)
+                if ss is not None:
+                    snapsets[name] = (pg, ss)
         from ..cluster.ec_backend import ObjectGeom
         S_total = int(payload.shape[0])
         if S_total % len(names):
@@ -1130,7 +1198,10 @@ class RemoteCluster:
         pg_of = {n: self._pg_for(pool, n) for n in names}
         writes = be.encode_to_writes(pg_of, names, payload, geom,
                                      durable=durable)
-        return be.submit(writes)
+        acked = be.submit(writes)
+        for name, (pg, ss) in snapsets.items():
+            self._store_snapset(pool, pg, name, ss)
+        return acked
 
     def flush_staged(self, pool_id: int) -> int:
         """Write every dirty client-staged shard through to its
@@ -1174,6 +1245,7 @@ class RemoteCluster:
             raise IOError("device get requires the bitsliced jax codec")
         out: List[Optional[object]] = [None] * len(names)
         healthy: Dict = {}        # (S, W) -> [(idx, data-col refs)]
+        degraded: Dict = {}       # (plan, missing, S, W) -> items
         for idx, name in enumerate(names):
             pg = self._pg_for(pool, name)
             geom = be.read_geom(pg, name)
@@ -1189,13 +1261,88 @@ class RemoteCluster:
                 healthy.setdefault((geom.S, geom.W), []).append(
                     (idx, [refs[c] for c in range(be.k)]))
             else:
-                out[idx] = be.assemble_object_words(refs, geom)
-        from ..cluster.device_store import assemble_many
+                if len(refs) < be.k:
+                    raise IOError(f"{name}: unrecoverable "
+                                  f"(only shards {sorted(refs)})")
+                plan, missing = be.plan(list(refs))
+                degraded.setdefault(
+                    (tuple(plan), tuple(missing), geom.S, geom.W),
+                    []).append((idx, refs))
+        from ..cluster.device_store import (assemble_many,
+                                            assemble_objects_dec)
         for (S, W), items in healthy.items():
             stacked = assemble_many([r for _, r in items], S, W)
             for j, (idx, _) in enumerate(items):
                 out[idx] = stacked[j * S:(j + 1) * S]
+        # degraded objects sharing an erasure signature decode in ONE
+        # grouped dispatch (stack plan columns -> one decode kernel)
+        # and reassemble in ONE more (assemble_objects_dec)
+        for (plan, missing, S, W), items in degraded.items():
+            plan, missing = list(plan), list(missing)
+            stacked = assemble_many(
+                [[refs[c] for c in plan] for _, refs in items], S, W)
+            dec = be.codec.decode_words_device(plan, stacked, missing)
+            stitched = assemble_objects_dec(
+                [[refs.get(c) for c in range(be.k)]
+                 for _, refs in items], dec, S, W)
+            for j, (idx, _) in enumerate(items):
+                out[idx] = stitched[j * S:(j + 1) * S]
         return out
+
+    # ------------------------------------------------------ cls / watch --
+    def exec_cls(self, pool_id: int, name: str, cls: str, method: str,
+                 inp: bytes = b"") -> bytes:
+        """Object-class call ON THE PRIMARY DAEMON (the wire
+        CEPH_OSD_OP_CALL): the method executes inside the OSD process
+        through the same ClassHandler the sim uses, and replicates to
+        the peer replicas (deterministic re-execution)."""
+        pool = self.osdmap.pools[pool_id]
+        if pool.type == POOL_ERASURE:
+            raise IOError("object classes require a replicated pool")
+        pg = self._pg_for(pool, name)
+        members = [o for o in self._up(pool, pg) if o != ITEM_NONE]
+        if not members:
+            raise IOError(f"{name}: no primary for cls call")
+        return self.osd_call(members[0], {
+            "cmd": "exec_cls", "coll": [pool_id, pg],
+            "oid": f"0:{name}", "cls": cls, "method": method,
+            "payload": inp, "replicas": members})
+
+    def _watch_primary(self, pool_id: int, name: str):
+        pool = self.osdmap.pools[pool_id]
+        pg = self._pg_for(pool, name)
+        members = [o for o in self._up(pool, pg) if o != ITEM_NONE]
+        if not members:
+            raise IOError(f"{name}: no primary for watch")
+        return members[0], pg
+
+    def watch_register(self, pool_id: int, name: str):
+        prim, pg = self._watch_primary(pool_id, name)
+        r = self.osd_call(prim, {"cmd": "watch_register",
+                                 "coll": [pool_id, pg],
+                                 "oid": f"0:{name}"})
+        return prim, pg, int(r["cookie"])
+
+    def notify(self, pool_id: int, name: str, payload: bytes = b"",
+               timeout: float = 3.0) -> Dict:
+        """Notify the object's watchers via its primary daemon and
+        gather their acks (Watch/Notify over the wire,
+        src/osd/Watch.cc): watchers that do not ack within the
+        timeout report as None."""
+        prim, pg = self._watch_primary(pool_id, name)
+        r = self.osd_call(prim, {"cmd": "notify",
+                                 "coll": [pool_id, pg],
+                                 "oid": f"0:{name}",
+                                 "payload": payload})
+        if not r["watchers"]:
+            return {"notify_id": r["notify_id"], "acks": {}}
+        w = self.osd_call(prim, {"cmd": "notify_wait",
+                                 "notify_id": r["notify_id"],
+                                 "timeout": timeout})
+        acks = {int(c): a for c, a in w["acks"].items()}
+        for c in w.get("pending", []):
+            acks[int(c)] = None
+        return {"notify_id": r["notify_id"], "acks": acks}
 
     # ---------------------------------------------------------- status --
     def status(self) -> Dict:
@@ -1256,6 +1403,11 @@ class WireShardIO:
                     "oid": f"{w.shard}:{w.name}",
                     "data": data, "attrs": w.attrs})
             except (OSError, IOError):
+                # a pre-existing staged entry for this shard is now
+                # stale relative to the sibling shards that DID land:
+                # drop it, or later reads would mix shard versions
+                rc.dev.evict(key)
+                rc._staged_attrs.pop(key, None)
                 return None
             rc.dev.put(key, w.ref, zlib.crc32(data))
             rc._staged_attrs[key] = w.attrs
@@ -1307,11 +1459,16 @@ class WireShardIO:
         dirty = rc.dev.dirty_get(key)
         if dirty is not None:
             return dirty
-        digest = self._digest(pg, shard, name)
-        if digest is not None:
-            arr = rc.dev.get(key, digest)
-            if arr is not None:
-                return arr
+        if rc.dev.has(key):
+            # the digest RTT only VALIDATES an existing staged entry;
+            # an absent key goes straight to the byte fetch
+            digest = self._digest(pg, shard, name)
+            if digest is not None:
+                arr = rc.dev.get(key, digest)
+                if arr is not None:
+                    return arr
+            else:
+                rc.dev.evict(key)
         data = self.get_shard_bytes(pg, shard, name)
         if data is None or len(data) % 4:
             return None

@@ -267,6 +267,7 @@ class WireClient:
                    "KeyError": KeyError,
                    "AuthError": cx.AuthError,
                    "PermissionError": PermissionError,
+                   "ClsError": IOError,
                    "ObjectStoreError": IOError}.get(name, RuntimeError)
             raise exc(f"{name}: {msg}")
         return encoding.loads(env.payload)
@@ -705,6 +706,15 @@ class OSDDaemon:
         self._mon: Optional[WireClient] = None
         self._map: Dict[str, Any] = {}
         self._stop = threading.Event()
+        # watch/notify state (src/osd/Watch.cc role): in-memory and
+        # connection-equivalent — watches die with the daemon, exactly
+        # as the reference's die with the session; clients re-register
+        self._watch_lock = threading.Lock()
+        self._watchers: Dict[Tuple, Dict[int, list]] = {}
+        self._watch_next = 1
+        self._notify_state: Dict[int, Dict[str, Any]] = {}
+        # in-OSD object classes (ClassHandler, shared with the sim)
+        self._class_handler = None
         self.server = WireServer(
             os.path.join(cluster_dir, f"osd.{osd_id}.sock"),
             self.entity, self.keyring, self._handle,
@@ -1040,6 +1050,108 @@ class OSDDaemon:
                 return self.store.stat(coll, req["oid"])["csum"]
             except (IOError, KeyError):
                 return None
+        if cmd == "watch_register":
+            # Watch role (src/osd/Watch.cc): the object's PRIMARY
+            # keeps the watcher registry; each watcher gets a cookie
+            # and a pending-notification queue it polls (this wire is
+            # request/reply, so delivery is poll-based rather than
+            # connection-push)
+            wk = (tuple(req["coll"]), req["oid"])
+            with self._watch_lock:
+                cookie = self._watch_next
+                self._watch_next += 1
+                self._watchers.setdefault(wk, {})[cookie] = []
+            return {"cookie": cookie}
+        if cmd == "watch_unregister":
+            wk = (tuple(req["coll"]), req["oid"])
+            with self._watch_lock:
+                self._watchers.get(wk, {}).pop(int(req["cookie"]),
+                                               None)
+            return {"ok": True}
+        if cmd == "watch_poll":
+            wk = (tuple(req["coll"]), req["oid"])
+            with self._watch_lock:
+                q = self._watchers.get(wk, {}).get(int(req["cookie"]))
+                if q is None:
+                    # daemon restarted / watch expired: the client
+                    # must re-register (the reference's watch timeout)
+                    return {"gone": True, "events": []}
+                events, q[:] = list(q), []
+            return {"events": events}
+        if cmd == "notify":
+            wk = (tuple(req["coll"]), req["oid"])
+            payload = req.get("payload", b"")
+            with self._watch_lock:
+                nid = self._watch_next
+                self._watch_next += 1
+                watchers = self._watchers.get(wk, {})
+                for cookie, q in watchers.items():
+                    q.append([nid, payload])
+                if watchers:
+                    # zero-watcher notifies allocate NO wait state:
+                    # the notifier returns early and nothing would
+                    # ever pop the entry
+                    self._notify_state[nid] = {"want": set(watchers),
+                                               "acks": {}}
+            return {"notify_id": nid, "watchers": sorted(watchers)}
+        if cmd == "notify_ack":
+            with self._watch_lock:
+                st = self._notify_state.get(int(req["notify_id"]))
+                if st is not None:
+                    st["acks"][int(req["cookie"])] = req.get("ack")
+            return {"ok": True}
+        if cmd == "notify_wait":
+            # gather acks until every watcher answered or timeout —
+            # non-answering watchers are reported pending (the Notify
+            # timeout shape); each connection has its own server
+            # thread, so blocking here is fine
+            nid = int(req["notify_id"])
+            deadline = time.monotonic() + float(req.get("timeout",
+                                                        3.0))
+            while True:
+                with self._watch_lock:
+                    st = self._notify_state.get(nid)
+                    if st is None:
+                        return {"acks": {}, "pending": []}
+                    if set(st["acks"]) >= st["want"] or \
+                            time.monotonic() >= deadline:
+                        self._notify_state.pop(nid, None)
+                        return {"acks": {str(c): a for c, a in
+                                         st["acks"].items()},
+                                "pending": sorted(st["want"] -
+                                                  set(st["acks"]))}
+                time.sleep(0.02)
+        if cmd == "exec_cls":
+            # CEPH_OSD_OP_CALL over the wire: the method runs INSIDE
+            # the primary OSD through the SAME ClassHandler the sim
+            # tier uses (cluster/class_handler.py), then re-executes
+            # on each replica — cls methods are deterministic
+            # functions of (object state, input), so re-execution IS
+            # state-machine replication and replicas converge
+            coll = tuple(req["coll"])
+            self._check_pool_live(coll)
+            if self._class_handler is None:
+                from .class_handler import ClassHandler
+                self._class_handler = ClassHandler()
+
+            def run_cls():
+                out = self._class_handler.call(
+                    self.store, coll, req["oid"], req["cls"],
+                    req["method"], req.get("payload", b""))
+                for rep in req.get("replicas", []):
+                    if rep == self.id:
+                        continue
+                    try:
+                        self._peer_req(rep, {
+                            "cmd": "exec_cls", "coll": list(coll),
+                            "oid": req["oid"], "cls": req["cls"],
+                            "method": req["method"],
+                            "payload": req.get("payload", b""),
+                            "replicas": []})
+                    except (OSError, IOError):
+                        pass      # stale replica heals via recovery
+                return out
+            return self._run_sched(run_cls, klass)
         if cmd == "stat_shard":
             # size/digest without payload transfer (rados_stat role)
             coll = tuple(req["coll"])

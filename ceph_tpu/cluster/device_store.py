@@ -179,6 +179,47 @@ def assemble_object(refs_by_col, dec, S: int, U: int):
     return f(tuple(bufs), dec, spec=tuple(spec), S=S, U=U)
 
 
+def assemble_objects_dec(refs_per_object, dec, S: int, U: int):
+    """[G*S, k, U] device stack of G same-signature DEGRADED objects
+    in ONE dispatch: each object's missing columns (None refs) read
+    its stripe slice of the group decode output ``dec``
+    ([G*S, n_missing, U]).  The grouped-final-assembly half of the
+    signature-batched degraded read — per-object assemble_object
+    calls would pay one dispatch each."""
+    def impl(bufs, dec, spec, n_cols, S, U):
+        import jax.numpy as jnp
+        blocks = []
+        G = len(spec) // n_cols
+        for g in range(G):
+            cols = []
+            di = 0
+            for e in spec[g * n_cols:(g + 1) * n_cols]:
+                if e[0] < 0:
+                    cols.append(dec[g * S:(g + 1) * S, e[1]])
+                    di += 1
+                else:
+                    cols.append(_col(bufs, e, S, U))
+            blocks.append(jnp.stack(cols, axis=1))
+        return jnp.concatenate(blocks)
+    f = _jit("assemble_objs_dec", impl, ("spec", "n_cols", "S", "U"))
+    bufs, index = [], {}
+    spec = []
+    n_cols = len(refs_per_object[0])
+    for refs in refs_per_object:
+        present = [r for r in refs if r is not None]
+        bufs, index, pspec = _dedup(present, index, bufs)
+        pi, di = 0, 0
+        for ref in refs:
+            if ref is None:
+                spec.append((-1, di, 0, 0, 0))
+                di += 1
+            else:
+                spec.append(pspec[pi])
+                pi += 1
+    return f(tuple(bufs), dec, spec=tuple(spec), n_cols=n_cols,
+             S=S, U=U)
+
+
 def assemble_many(refs_per_object, S: int, U: int):
     """[N*S, k, U] batched stripe view of N same-geometry objects in
     ONE dispatch — the read half of the batched client surface

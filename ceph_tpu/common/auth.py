@@ -12,11 +12,13 @@ This module re-creates that shape on the stdlib only:
 
   * Keyring — entity name -> 32-byte secret (mon holds all of them;
     daemons hold their own), JSON file on disk.
-  * seal/unseal — authenticated encryption built from HMAC-SHA256: a
-    CTR keystream (HMAC(k, nonce||counter)) XORed over the plaintext,
-    plus an encrypt-then-MAC tag.  Not a performance cipher; the
-    cryptographic construction (PRF-CTR + EtM) is sound and
-    stdlib-only, which the no-new-dependencies environment requires.
+  * seal/unseal — authenticated encryption.  AES-256-GCM when the
+    `cryptography` package is importable (the reference's secure-mode
+    AES-GCM, src/msg/async/crypto_onwire.cc — hardware AES moves the
+    wire from ~10 MB/s to ~1 GB/s per stream); otherwise a
+    stdlib-only fallback: SHAKE-256 XOF keystream XORed over the
+    plaintext with an encrypt-then-MAC HMAC-SHA256 tag.  Blobs are
+    format-tagged ("G"/"P") so either side can open both.
   * TicketServer (mon side): grant(entity, service) -> (ticket_blob,
     sealed_session_key) where ticket_blob is sealed under the service
     secret and the session key copy under the requesting entity's
@@ -50,14 +52,19 @@ class AuthError(PermissionError):
 
 # ------------------------------------------------ HMAC-CTR sealed boxes ---
 
+try:
+    from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+    _HAVE_AESGCM = True
+except ImportError:                       # stdlib-only environment
+    _HAVE_AESGCM = False
+
+
 def _keystream(key: bytes, nonce: bytes, n: int) -> bytes:
-    out = bytearray()
-    ctr = 0
-    while len(out) < n:
-        out.extend(hmac.new(key, nonce + struct.pack("<Q", ctr),
-                            sha256).digest())
-        ctr += 1
-    return bytes(out[:n])
+    """SHAKE-256 XOF keystream: ONE C call for the whole frame
+    (~170 MB/s) instead of an HMAC invocation per 32 bytes (~10 MB/s
+    with Python-loop overhead on MB-scale secure-mode frames)."""
+    from hashlib import shake_256
+    return shake_256(b"ks" + key + nonce).digest(n)
 
 
 def _xor(a: bytes, b: bytes) -> bytes:
@@ -69,21 +76,40 @@ def _xor(a: bytes, b: bytes) -> bytes:
 
 
 def seal(key: bytes, plaintext: bytes) -> bytes:
-    """nonce | ciphertext | tag — PRF-CTR encryption, encrypt-then-MAC."""
+    """Format-tagged authenticated encryption:
+    "G" | nonce12 | AES-GCM(ct||tag16)          (hardware AES path)
+    "P" | nonce16 | ct | hmac-sha256 tag32      (stdlib fallback)"""
+    if _HAVE_AESGCM:
+        nonce = secrets.token_bytes(12)
+        return b"G" + nonce + AESGCM(key).encrypt(nonce, plaintext,
+                                                  b"seal")
     nonce = secrets.token_bytes(16)
     ct = _xor(plaintext, _keystream(key, nonce, len(plaintext)))
     tag = hmac.new(key, b"seal" + nonce + ct, sha256).digest()
-    return nonce + ct + tag
+    return b"P" + nonce + ct + tag
 
 
 def unseal(key: bytes, blob: bytes) -> bytes:
-    if len(blob) < 48:
-        raise AuthError("sealed blob too short")
-    nonce, ct, tag = blob[:16], blob[16:-32], blob[-32:]
-    want = hmac.new(key, b"seal" + nonce + ct, sha256).digest()
-    if not hmac.compare_digest(tag, want):
-        raise AuthError("sealed blob MAC rejected")
-    return _xor(ct, _keystream(key, nonce, len(ct)))
+    fmt = blob[:1]
+    if fmt == b"G":
+        if not _HAVE_AESGCM:
+            raise AuthError("AES-GCM sealed blob but no AES support")
+        if len(blob) < 29:
+            raise AuthError("sealed blob too short")
+        try:
+            return AESGCM(key).decrypt(blob[1:13], blob[13:], b"seal")
+        except Exception:
+            raise AuthError("sealed blob rejected") from None
+    if fmt == b"P":
+        body = blob[1:]
+        if len(body) < 48:
+            raise AuthError("sealed blob too short")
+        nonce, ct, tag = body[:16], body[16:-32], body[-32:]
+        want = hmac.new(key, b"seal" + nonce + ct, sha256).digest()
+        if not hmac.compare_digest(tag, want):
+            raise AuthError("sealed blob MAC rejected")
+        return _xor(ct, _keystream(key, nonce, len(ct)))
+    raise AuthError(f"unknown sealed-blob format {fmt!r}")
 
 
 # ------------------------------------------------------------- keyring ---

@@ -106,14 +106,17 @@ def test_ec_io_across_processes(tmp_path):
                         "layout": "bitsliced"}})
         rng = np.random.default_rng(2)
         data = rng.integers(0, 256, 30000, dtype=np.uint8).tobytes()
-        # under a loaded host a daemon can exceed one wire timeout;
-        # writes are idempotent, so retry until every shard acks
+        # under a loaded host a daemon can exceed one wire timeout or
+        # drop a heartbeat (mon briefly marks it down and the up set
+        # maps 5/6 shards until it re-boots); writes are idempotent,
+        # so retry with map refreshes until every shard acks
         acks = 0
-        for _ in range(4):
+        for _ in range(10):
             acks = rc.put(2, "big", data)
             if acks == 6:
                 break
-            time.sleep(1.0)
+            time.sleep(1.5)
+            rc.refresh_map()
         assert acks == 6
         assert rc.get(2, "big") == data
         # kill two shard holders: k=4 survivors still decode

@@ -83,6 +83,11 @@ def test_degraded_read_decodes_on_device_path(ec_cluster):
         assert rc2.get(2, n) == dt
     dd = rc2.codec_for(rc2.osdmap.pools[2])._pc
     assert dd.get("decode_dispatches") >= 1
+    # batched device read: degraded objects decode through the
+    # signature-grouped dispatch and reassemble to the same bytes
+    outs = rc2.get_many_to_device(2, names)
+    for out, dt in zip(outs, datas):
+        assert np.asarray(out).tobytes()[:len(dt)] == dt
     rc.close()
     rc2.close()
 
@@ -138,14 +143,15 @@ def test_wire_recovery_rebuilds_stripewise_in_grouped_dispatch(
     time.sleep(0.5)
     rc.refresh_map()
     dispatches0 = rc.codec_for(
-        rc.osdmap.pools[2])._pc.get("decode_dispatches")
+        rc.osdmap.pools[2])._pc.get("decode_dispatches") or 0
     stats = rc.recover_ec_pool(2)
     assert stats["shards_rebuilt"] > 0, stats
     # signature grouping: objects sharing an erasure signature (one
     # per affected PG at most) rebuild in ONE dispatch — the dispatch
     # count is bounded by the PG count (8), not the object count (24)
-    dispatches = rc.codec_for(
-        rc.osdmap.pools[2])._pc.get("decode_dispatches") - dispatches0
+    dispatches = (rc.codec_for(
+        rc.osdmap.pools[2])._pc.get("decode_dispatches") or 0) \
+        - dispatches0
     assert dispatches <= 8, \
         f"{dispatches} decode dispatches for {len(names)} objects"
     # with the dead OSDs still down, every object reads healthy from
