@@ -345,7 +345,11 @@ def _bench_recovery_inner(n_pgs, n_out, n_stripes, stripe, k, m):
         w2 = list(weights)
         for o in out_osds:
             w2[o] = 0
-        after = mapper.map_batch(0, xs, k + m, w2)
+        # epoch-DELTA remap (VERDICT r4 next #3b): a failure epoch
+        # only decreases weights, so only PGs whose cached mapping
+        # contains an out OSD recompute — O(changed), not O(1M)
+        after = mapper.map_batch_delta(0, xs, k + m, weights, w2,
+                                       before_cached)
         moved = (before_cached != after).any(axis=1)
         lost = np.isin(before_cached[:n_stripes], out_set)  # [S, k+m]
         masks_dev, rebuilt, n_sigs = build_masks(lost)
@@ -400,7 +404,7 @@ def _bench_recovery_inner(n_pgs, n_out, n_stripes, stripe, k, m):
     return out_stats
 
 
-def bench_cluster_system(k=8, m=3, obj_bytes=256 << 20, batch_n=16,
+def bench_cluster_system(k=8, m=3, obj_bytes=128 << 20, batch_n=16,
                          rounds=8, n_osds=40, pg_num=64):
     """SYSTEM-level EC throughput: GB/s through ClusterSim's own
     put/get/recovery — placement via the real OSDMap pipeline, every
@@ -517,24 +521,36 @@ def _cluster_system_phases(sim, k, m, obj_bytes, batch_n, rounds):
     # healthy reads are zero-copy by construction (data shards are
     # views of the staged buffers — get_many aliases, it does not
     # move bytes), so the MEANINGFUL read rate is the degraded one:
-    # kill m shard holders, decode through the masked-XOR kernel
-    gname = names[0]
-    holders = sim.put_many_from_device(1, [gname],
-                                       payload[:S])[gname]
-    sync_staged()
-    for o in holders[:m]:
+    # fail m shard holders chosen to degrade as many of the batch
+    # objects as possible, then read the WHOLE degraded subset in one
+    # get_many_to_device — signature-grouped decode, not one dispatch
+    # per object (VERDICT r4 next #6)
+    pool = sim.osdmap.pools[1]
+    obj_up = {nm: set(sim.pg_up(pool, sim.object_pg(pool, nm)))
+              for nm in names}
+    counts = {}
+    for ups in obj_up.values():
+        for o in ups:
+            counts[o] = counts.get(o, 0) + 1
+    holders = sorted(counts, key=counts.get, reverse=True)[:m]
+    # cap the per-round degraded read set: the batched output
+    # materializes len(deg_names)*obj_bytes of HBM per round, and
+    # deferred frees through this tunnel lag behind allocation
+    deg_names = [nm for nm in names
+                 if obj_up[nm] & set(holders)][:8]
+    for o in holders:
         sim.fail_osd(o)            # dead, map not yet updated
-    out = sim.get_to_device(1, gname)      # warm degraded executables
-    np.asarray(out[(0,) * out.ndim])
-    del out
+    outs = sim.get_many_to_device(1, deg_names)   # warm executables
+    np.asarray(outs[(0,) * outs.ndim])
+    del outs
     t0 = time.perf_counter()
     for _ in range(rounds):
-        out = sim.get_to_device(1, gname)
-        out[(0,) * out.ndim].item()
-        del out
+        outs = sim.get_many_to_device(1, deg_names)
+        outs[(0,) * outs.ndim].item()
+        del outs
     t_deg = time.perf_counter() - t0
-    deg_get_gbps = rounds * obj_bytes / t_deg / 1e9
-    for o in holders[:m]:
+    deg_get_gbps = rounds * len(deg_names) * obj_bytes / t_deg / 1e9
+    for o in holders:
         sim.restart_osd(o)
     # the big batch objects are done: drop them so the recovery
     # rounds sweep ONLY recovery-geometry objects and moved_gbps
@@ -580,6 +596,7 @@ def _cluster_system_phases(sim, k, m, obj_bytes, batch_n, rounds):
         "put_gbps": round(put_gbps, 2),
         "put_net_gbps": round(put_net, 2),
         "degraded_get_gbps": round(deg_get_gbps, 2),
+        "degraded_objects": len(deg_names),
         "healthy_get": "zero-copy (shards are views of staged "
                        "buffers; no bytes move)",
         "sync_latency_s": round(sync_lat, 3),
@@ -788,10 +805,11 @@ def bench_process_cluster(k=8, m=3, obj_bytes=256 << 20, batch_n=16,
             "shards_copied": st.get("shards_copied", 0),
             "decode_dispatches": (pc.get("decode_dispatches") or 0)
             - d0,
+            # shard bytes are rS stripes of U each (recovery_obj_bytes
+            # rounds UP to whole stripes, so //k under-prices)
             "moved_gbps": round(
                 (st.get("shards_rebuilt", 0) +
-                 st.get("shards_copied", 0)) * (recovery_obj_bytes
-                                                // k)
+                 st.get("shards_copied", 0)) * rS * U
                 / max(t_rec, 1e-9) / 1e9, 3),
         }
         rc.close()

@@ -596,7 +596,10 @@ class RemoteCluster:
             attrs_src = None
             if staged is None and self.dev.has(key):
                 io = self.ec_backend(pool_id).io
-                dg = io._digest(pg, shard, name)
+                try:
+                    dg = io._digest(pg, shard, name)
+                except (OSError, IOError):
+                    dg = None      # unreachable: fall to wire fetch
                 if dg is not None:
                     staged = self.dev.get(key, dg)
             if staged is not None:
@@ -1244,8 +1247,7 @@ class RemoteCluster:
         if not be.words_supported():
             raise IOError("device get requires the bitsliced jax codec")
         out: List[Optional[object]] = [None] * len(names)
-        healthy: Dict = {}        # (S, W) -> [(idx, data-col refs)]
-        degraded: Dict = {}       # (plan, missing, S, W) -> items
+        items, item_idx = [], []
         for idx, name in enumerate(names):
             pg = self._pg_for(pool, name)
             geom = be.read_geom(pg, name)
@@ -1256,37 +1258,12 @@ class RemoteCluster:
                 raw += b"\0" * ((-len(raw)) % (be.k * 4))
                 out[idx] = be.to_words(raw, 1, len(raw) // be.k)
                 continue
-            refs = be.gather_refs(pg, name)
-            if all(c in refs for c in range(be.k)):
-                healthy.setdefault((geom.S, geom.W), []).append(
-                    (idx, [refs[c] for c in range(be.k)]))
-            else:
-                if len(refs) < be.k:
-                    raise IOError(f"{name}: unrecoverable "
-                                  f"(only shards {sorted(refs)})")
-                plan, missing = be.plan(list(refs))
-                degraded.setdefault(
-                    (tuple(plan), tuple(missing), geom.S, geom.W),
-                    []).append((idx, refs))
-        from ..cluster.device_store import (assemble_many,
-                                            assemble_objects_dec)
-        for (S, W), items in healthy.items():
-            stacked = assemble_many([r for _, r in items], S, W)
-            for j, (idx, _) in enumerate(items):
-                out[idx] = stacked[j * S:(j + 1) * S]
-        # degraded objects sharing an erasure signature decode in ONE
-        # grouped dispatch (stack plan columns -> one decode kernel)
-        # and reassemble in ONE more (assemble_objects_dec)
-        for (plan, missing, S, W), items in degraded.items():
-            plan, missing = list(plan), list(missing)
-            stacked = assemble_many(
-                [[refs[c] for c in plan] for _, refs in items], S, W)
-            dec = be.codec.decode_words_device(plan, stacked, missing)
-            stitched = assemble_objects_dec(
-                [[refs.get(c) for c in range(be.k)]
-                 for _, refs in items], dec, S, W)
-            for j, (idx, _) in enumerate(items):
-                out[idx] = stitched[j * S:(j + 1) * S]
+            items.append((pg, name, geom))
+            item_idx.append(idx)
+        if items:
+            for idx, words in zip(item_idx,
+                                  be.read_many_words(items)):
+                out[idx] = words
         return out
 
     # ------------------------------------------------------ cls / watch --
@@ -1437,10 +1414,16 @@ class WireShardIO:
 
     # ----------------------------------------------------------- reads --
     def _digest(self, pg: int, shard: int, name: str) -> Optional[int]:
+        """Stored checksum from any holder; None = every reachable
+        daemon ANSWERED and none holds the shard (definitive absence).
+        Raises IOError when nobody answered — 'unreachable' must not
+        read as 'absent' (a transient outage would otherwise evict
+        valid client staging)."""
         up = self.up_set(pg)
         srcs = [up[shard]] if shard < len(up) and \
             up[shard] != ITEM_NONE else []
         srcs += [o for o in self.rc.addrs if o not in srcs]
+        answered = False
         for o in srcs:
             try:
                 d = self.rc.osd_call(o, {
@@ -1449,8 +1432,12 @@ class WireShardIO:
                     "oid": f"{shard}:{name}"})
             except (OSError, IOError):
                 continue
+            answered = True
             if d is not None:
                 return int(d)
+        if not answered:
+            raise IOError(f"{name} shard {shard}: no daemon "
+                          f"reachable for digest")
         return None
 
     def get_shard_ref(self, pg: int, shard: int, name: str):
@@ -1462,12 +1449,17 @@ class WireShardIO:
         if rc.dev.has(key):
             # the digest RTT only VALIDATES an existing staged entry;
             # an absent key goes straight to the byte fetch
-            digest = self._digest(pg, shard, name)
-            if digest is not None:
+            try:
+                digest = self._digest(pg, shard, name)
+            except (OSError, IOError):
+                digest = False    # unreachable: keep the entry
+            if digest is not None and digest is not False:
                 arr = rc.dev.get(key, digest)
                 if arr is not None:
                     return arr
-            else:
+            elif digest is None:
+                # definitive absence on the daemons: the staged copy
+                # is an orphan of a deleted/rewritten object
                 rc.dev.evict(key)
         data = self.get_shard_bytes(pg, shard, name)
         if data is None or len(data) % 4:

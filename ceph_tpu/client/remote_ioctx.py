@@ -216,6 +216,16 @@ class RemoteIoCtx:
         prim, pg, cookie = self._rc.watch_register(self.pool_id, oid)
         with self._watch_lock:
             self._watches[(oid, cookie)] = (prim, pg, callback)
+            stopping = self._watch_stop.is_set()
+            t = self._watch_thread
+        if stopping and t is not None:
+            # an unwatch-of-last just told the old poller to exit; it
+            # may not have noticed yet.  Join it OUTSIDE the lock (it
+            # takes the lock each loop) before re-arming, or the new
+            # watch could be left with a stop-flagged poller that
+            # exits immediately — silently unpolled
+            t.join(timeout=10)
+        with self._watch_lock:
             if self._watch_thread is None or \
                     not self._watch_thread.is_alive():
                 self._watch_stop.clear()

@@ -1087,13 +1087,17 @@ class OSDDaemon:
                 watchers = self._watchers.get(wk, {})
                 for cookie, q in watchers.items():
                     q.append([nid, payload])
+                # snapshot INSIDE the lock: `watchers` aliases the
+                # live dict and concurrent register/unregister would
+                # race the iteration
+                w_list = sorted(watchers)
                 if watchers:
                     # zero-watcher notifies allocate NO wait state:
                     # the notifier returns early and nothing would
                     # ever pop the entry
                     self._notify_state[nid] = {"want": set(watchers),
                                                "acks": {}}
-            return {"notify_id": nid, "watchers": sorted(watchers)}
+            return {"notify_id": nid, "watchers": w_list}
         if cmd == "notify_ack":
             with self._watch_lock:
                 st = self._notify_state.get(int(req["notify_id"]))

@@ -179,6 +179,45 @@ def assemble_object(refs_by_col, dec, S: int, U: int):
     return f(tuple(bufs), dec, spec=tuple(spec), S=S, U=U)
 
 
+def assemble_windows(col_bufs, starts, S: int):
+    """[G*S, n_cols, W] stack of G same-geometry objects whose column
+    j lives in ``col_bufs[j]`` = (stripewise buffer [rows, n, W],
+    column index), with per-object window starts as a DYNAMIC operand.
+
+    The static-spec assemblers (assemble_refs/assemble_many) key one
+    XLA executable per exact buffer/window layout — a recovery sweep
+    over hundreds of objects would compile hundreds of one-shot
+    programs (seconds each through a remote-compile tunnel).  Here the
+    layout is static only in (column composition, S, G-bucket): the
+    window POSITIONS travel as data, so every sweep after the first
+    reuses one compiled gather.  G pads to a power-of-two bucket
+    (repeating the last window; callers slice the tail off)."""
+    import numpy as np
+    import jax.numpy as jnp
+    G = int(len(starts))
+    Gp = 1
+    while Gp < G:
+        Gp <<= 1
+    pad = np.full(Gp, starts[-1] if G else 0, dtype=np.int32)
+    pad[:G] = starts
+    def impl(bufs, starts_d, cols, S):
+        idx = (starts_d[:, None] +
+               jnp.arange(S, dtype=jnp.int32)[None]).reshape(-1)
+        return jnp.stack([bufs[bi][idx, col]
+                          for bi, col in cols], axis=1)
+    f = _jit("assemble_windows", impl, ("cols", "S"))
+    bufs, index = [], {}
+    cols = []
+    for buf, col in col_bufs:
+        bi = index.get(id(buf))
+        if bi is None:
+            bi = index[id(buf)] = len(bufs)
+            bufs.append(buf)
+        cols.append((bi, int(col)))
+    out = f(tuple(bufs), jnp.asarray(pad), cols=tuple(cols), S=S)
+    return out[:G * S]
+
+
 def assemble_objects_dec(refs_per_object, dec, S: int, U: int):
     """[G*S, k, U] device stack of G same-signature DEGRADED objects
     in ONE dispatch: each object's missing columns (None refs) read

@@ -1107,10 +1107,11 @@ class ClusterSim:
         return results
 
     def get_many_to_device(self, pool_id: int, names: List[str]):
-        """Batched EC read: N same-geometry HEALTHY objects gathered
-        as ONE [N*S, k, U] device array in a single dispatch.  Any
-        object with a missing data shard falls back to its own
-        degraded get_to_device (decode path)."""
+        """Batched EC read: N same-geometry objects as ONE
+        [N*S, k, U] device array — healthy members gather in a single
+        assemble dispatch; DEGRADED members decode through the shared
+        ECBackend's signature-grouped path (one kernel call per
+        erasure signature, not per object)."""
         from .device_store import assemble_many
         pool = self.osdmap.pools[pool_id]
         codec = self.codec_for(pool)
@@ -1142,18 +1143,24 @@ class ClusterSim:
         out = assemble_many(healthy, S, U // 4) if healthy else None
         if all(r is not None for r in refs_per_obj):
             return out
-        # stitch healthy batch + degraded singles (rare path): degraded
-        # members use the word-domain gather/decode directly so every
-        # part is the same [S, k, W] int32 view
+        # stitch healthy batch + degraded members: degraded objects
+        # decode through the shared ECBackend signature-GROUPED path
+        # (all objects in one PG share an erasure signature, so they
+        # rebuild in one kernel call — not one dispatch per object)
         import jax.numpy as jnp
-        parts, hi = [], 0
+        from .ec_backend import ObjectGeom
+        deg_items = []
         for name, refs in zip(names, refs_per_obj):
             if refs is None:
                 info = self.objects[(pool_id, name)]
-                pg = self.object_pg(pool, name)
-                up = self.pg_up(pool, pg)
-                parts.append(self._gather_decode_dev(pool, name, info,
-                                                     pg, up))
+                deg_items.append((self.object_pg(pool, name), name,
+                                  ObjectGeom(info.size, S, U)))
+        deg_words = iter(self.ec_backend(pool_id)
+                         .read_many_words(deg_items))
+        parts, hi = [], 0
+        for name, refs in zip(names, refs_per_obj):
+            if refs is None:
+                parts.append(next(deg_words))
             else:
                 parts.append(out[hi * S:(hi + 1) * S])
                 hi += 1
@@ -1402,46 +1409,195 @@ class ClusterSim:
             key = (plan, tuple(missing), U)
             groups.setdefault(key, []).append(
                 (name, up, shard_files, info.n_stripes, pg))
+        if dev:
+            self._rebuild_groups_dev(pool_id, codec, k, mm, groups,
+                                     eager, stats)
+            return stats
         for (plan, missing, U), members in groups.items():
             stats["batches"] += 1
-            # batch axis = every damaged stripe of every member object
-            if dev:
-                batch = jnp.concatenate([
-                    assemble_refs([files[c] for c in plan], n_str,
-                                  U // 4)
-                    for name, up, files, n_str, pg in members])
-                rebuilt = codec.decode_words_device(
-                    list(plan), batch, list(missing))
-            else:
-                batch = np.concatenate([
-                    np.stack([np.stack([files[c][s * U:(s + 1) * U]
-                                        for c in plan])
-                              for s in range(n_str)])
-                    for name, up, files, n_str, pg in members])
-                rebuilt = np.asarray(codec.decode_chunks_batch(
-                    list(plan), batch, list(missing)))
+            batch = np.concatenate([
+                np.stack([np.stack([files[c][s * U:(s + 1) * U]
+                                    for c in plan])
+                          for s in range(n_str)])
+                for name, up, files, n_str, pg in members])
+            rebuilt = np.asarray(codec.decode_chunks_batch(
+                list(plan), batch, list(missing)))
             pos = 0
             for name, up, files, n_str, pg in members:
-                part = rebuilt[pos:pos + n_str]      # [S, n_miss, U]
+                part = rebuilt[pos:pos + n_str]
                 pos += n_str
-                part_host = np.asarray(part) if dev and eager else None
                 for i, shard in enumerate(missing):
                     tgt = up[shard] if shard < len(up) else ITEM_NONE
                     if tgt == ITEM_NONE or not self.osds[tgt].alive:
                         continue
-                    if dev:
-                        b = np.ascontiguousarray(
-                            part_host[:, i]).tobytes() if eager \
-                            else None
-                        self.services[tgt].put_device_recovery(
-                            (pool_id, pg, name, shard),
-                            ShardRef(part, i, axis=1), b)
-                    else:
-                        self.services[tgt].put_recovery(
-                            (pool_id, pg, name, shard),
-                            part[:, i].reshape(-1))
+                    self.services[tgt].put_recovery(
+                        (pool_id, pg, name, shard),
+                        part[:, i].reshape(-1))
                     stats["shards_rebuilt"] += 1
         return stats
+
+    def _rebuild_groups_dev(self, pool_id, codec, k, mm, groups,
+                            eager, stats) -> None:
+        """Device rebuild with ONE gather + ONE masked-XOR dispatch
+        per (geometry, buffer-composition) subgroup — the erasure
+        SIGNATURE travels as a dynamic full-width mask operand (the
+        bench_recovery design on the cluster path): per-signature
+        static shapes would pay one XLA compile per signature, seconds
+        each through a remote-compile tunnel.
+
+        The gather reads ALL k+m canonical columns per object (missing
+        columns read whatever the canonical buffer holds — the decode
+        masks are zero at non-available columns, so the values never
+        contribute); the full-width bit-matrix for each object's
+        signature positions the recovery matrix at its available
+        chunks' plane columns, zero-padded to m erased rows."""
+        import jax.numpy as jnp
+        from ..ops import gf, gf2, xor_kernel
+        from .device_store import ShardRef, assemble_windows
+        n = k + mm
+        # flatten the signature groups, then regroup by (stripe count,
+        # canonical buffer composition, W); members whose refs do not
+        # form uniform same-start windows (re-uploaded axis-0 refs,
+        # mixed recovery buffers) fall back to the per-member path —
+        # dropping them would be silent non-repair
+        subs: Dict[Tuple, List] = {}
+        irregular: List[Tuple] = []
+        for (plan, missing, U), members in groups.items():
+            for name, up, files, n_str, pg in members:
+                comp, uniform = [], True
+                by_col = {}
+                s0_seen = None
+                for c, r in files.items():
+                    if getattr(r, "axis", 0) != 1:
+                        uniform = False
+                        break
+                    if s0_seen is None:
+                        s0_seen = r.s0
+                    elif r.s0 != s0_seen:
+                        # per-column starts differ (e.g., one column
+                        # is a prior recovery's rebuilt buffer): the
+                        # single-starts gather would read the WRONG
+                        # rows for that column
+                        uniform = False
+                        break
+                    by_col[c] = (id(r.buf), r.buf, r.idx, r.s0)
+                if not uniform or not by_col:
+                    irregular.append((plan, missing, U, name, up,
+                                      files, n_str, pg))
+                    continue
+                # canonical column inference: a put batch stages data
+                # shard c as column c of one shared buffer and parity
+                # c as column c-k of the encode output, so a MISSING
+                # column's canonical source is derivable from any
+                # present same-class sibling — the composition key
+                # must not encode the missing set, or every erasure
+                # signature becomes its own compile
+                d_src = next(((bid, buf) for c, (bid, buf, idx, _)
+                              in by_col.items()
+                              if c < k and idx == c), None)
+                p_src = next(((bid, buf) for c, (bid, buf, idx, _)
+                              in by_col.items()
+                              if c >= k and idx == c - k), None)
+                anchor = next(iter(by_col.values()))
+                for c in range(n):
+                    if c in by_col:
+                        bid, buf, idx, _ = by_col[c]
+                        comp.append((bid, idx))
+                    elif c < k and d_src is not None:
+                        comp.append((d_src[0], c))
+                    elif c >= k and p_src is not None:
+                        comp.append((p_src[0], c - k))
+                    else:
+                        comp.append((anchor[0], anchor[2]))
+                if d_src is not None:
+                    by_col.setdefault(-1, (d_src[0], d_src[1], 0, 0))
+                if p_src is not None:
+                    by_col.setdefault(-2, (p_src[0], p_src[1], 0, 0))
+                key = (n_str, U, tuple(comp))
+                subs.setdefault(key, []).append(
+                    (name, up, files, n_str, pg, tuple(missing),
+                     tuple(sorted(files)), by_col, anchor))
+        for (n_str, U, comp), mems in subs.items():
+            stats["batches"] += 1
+            W = U // 4
+            # resolve composition ids back to buffers via any member
+            bufmap = {}
+            for mem in mems:
+                for c, (bid, buf, idx, _) in mem[7].items():
+                    bufmap[bid] = buf
+            col_bufs = [(bufmap[bid], idx) for bid, idx in comp]
+            starts = np.array([mem[8][3] for mem in mems],
+                              dtype=np.int32)
+            full = assemble_windows(col_bufs, starts, n_str)
+            # per-object full-width signature tables, one per UNIQUE
+            # signature (host-side; tiny), repeated per stripe
+            sig_tab: Dict[Tuple, np.ndarray] = {}
+            obj_masks = np.zeros((len(mems), 8 * mm, 8 * n),
+                                 dtype=np.int32)
+            for j, mem in enumerate(mems):
+                missing, avail = mem[5], mem[6]
+                sig = (missing, avail)
+                tab = sig_tab.get(sig)
+                if tab is None:
+                    R, used = codec.decode_matrix(list(avail),
+                                                  list(missing))
+                    small = gf.gf8_bitmatrix(R)
+                    big = np.zeros((8 * mm, 8 * n), dtype=np.uint8)
+                    for jj, c in enumerate(used):
+                        big[:8 * len(missing), 8 * c:8 * c + 8] = \
+                            small[:, 8 * jj:8 * jj + 8]
+                    tab = gf2.bitmatrix_masks(big)
+                    sig_tab[sig] = tab
+                obj_masks[j] = tab
+            masks = np.repeat(obj_masks, n_str, axis=0)
+            T = len(mems) * n_str
+            Tp = 1
+            while Tp < T:
+                Tp <<= 1
+            planes = full.reshape(T, 8 * n, W // 8)
+            masks_d = jnp.asarray(masks)
+            if Tp != T:        # pow2 bucket: bounded executable count
+                planes = jnp.concatenate([planes, planes[:Tp - T]])
+                masks_d = jnp.concatenate([masks_d, masks_d[:Tp - T]])
+            rebuilt = xor_kernel.xor_matmul_w32(
+                masks_d, planes)[:T].reshape(T, mm, W)
+            rebuilt_host = np.asarray(rebuilt) if eager else None
+            for j, mem in enumerate(mems):
+                name, up, files, n_str_m, pg, missing = mem[:6]
+                pos = j * n_str
+                for i, shard in enumerate(missing):
+                    tgt = up[shard] if shard < len(up) else ITEM_NONE
+                    if tgt == ITEM_NONE or not self.osds[tgt].alive:
+                        continue
+                    b = np.ascontiguousarray(
+                        rebuilt_host[pos:pos + n_str, i]
+                    ).tobytes() if eager else None
+                    self.services[tgt].put_device_recovery(
+                        (pool_id, pg, name, shard),
+                        ShardRef(rebuilt, i, axis=1, s0=pos,
+                                 s1=pos + n_str), b)
+                    stats["shards_rebuilt"] += 1
+        # per-member fallback for irregular refs: pays a static-spec
+        # assemble (possible compile) per shape, but the path is rare
+        # and silence here would be non-repair
+        from .device_store import assemble_refs
+        for plan, missing, U, name, up, files, n_str, pg in irregular:
+            stats["batches"] += 1
+            sub = assemble_refs([files[c] for c in plan], n_str,
+                                U // 4)
+            rebuilt = codec.decode_words_device(list(plan), sub,
+                                                list(missing))
+            rebuilt_host = np.asarray(rebuilt) if eager else None
+            for i, shard in enumerate(missing):
+                tgt = up[shard] if shard < len(up) else ITEM_NONE
+                if tgt == ITEM_NONE or not self.osds[tgt].alive:
+                    continue
+                b = np.ascontiguousarray(
+                    rebuilt_host[:, i]).tobytes() if eager else None
+                self.services[tgt].put_device_recovery(
+                    (pool_id, pg, name, shard),
+                    ShardRef(rebuilt, i, axis=1), b)
+                stats["shards_rebuilt"] += 1
 
     def recover_delta(self, pool_id: int) -> Dict[str, int]:
         """Log-based delta recovery (the PGLog path the reference

@@ -18,7 +18,11 @@ This module re-creates that shape on the stdlib only:
     wire from ~10 MB/s to ~1 GB/s per stream); otherwise a
     stdlib-only fallback: SHAKE-256 XOF keystream XORed over the
     plaintext with an encrypt-then-MAC HMAC-SHA256 tag.  Blobs are
-    format-tagged ("G"/"P") so either side can open both.
+    format-tagged ("G"/"P"): a host with AES support opens both
+    formats; a stdlib-only host opens only "P", so MIXED-capability
+    deployments must run every peer stdlib-only (all daemons and
+    clients of one cluster share a venv here — heterogeneous installs
+    would need a capability handshake this module does not provide).
   * TicketServer (mon side): grant(entity, service) -> (ticket_blob,
     sealed_session_key) where ticket_blob is sealed under the service
     secret and the session key copy under the requesting entity's

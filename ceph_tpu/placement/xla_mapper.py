@@ -978,6 +978,57 @@ class XlaMapper:
                 self._exact_fallback = scalar_rows
         return self._exact_fallback(ruleno, xs_rows, result_max, weights)
 
+    def map_batch_delta(self, ruleno: int, xs, result_max: int,
+                        old_weights, new_weights,
+                        before: np.ndarray) -> np.ndarray:
+        """Epoch-delta remap: O(changed) instead of O(all PGs) for
+        MONOTONIC device-weight decreases — the mark-out/failure case
+        that drives recovery (the reference pays the full
+        OSDMapMapping sweep here, src/osd/OSDMapMapping.h:18;
+        CrushTester.cc:612 loops every x).
+
+        ``before`` is the cached full mapping under ``old_weights``
+        (a live mon/mgr always holds the current epoch's mapping).
+        Only rows whose mapping CONTAINS a changed device recompute;
+        every other row provably keeps its result:
+
+          * the crush map (bucket weights, items, choose_args) is
+            unchanged, so every straw2 draw sequence is unchanged —
+            each lane SELECTS the same item sequence at every bucket
+            and retry step;
+          * a lane that never ACCEPTED a changed device either never
+            selected it (identical draws), or selected-and-REJECTED
+            it: collision rejection is weight-independent, and the
+            probabilistic is_out rejection (mapper.c:424-438,
+            hash(x,d) & 0xffff >= w) is monotone — a weight that only
+            DECREASES keeps every past rejection a rejection.  By
+            induction the whole retry path, including exhausted
+            (ITEM_NONE) slots, is bit-identical;
+          * a lane that accepted a changed device is exactly a lane
+            whose ``before`` row contains it.
+
+        Weight INCREASES (revive/mark-in) can attract lanes that
+        never probed the device, so there is no sound affected-set
+        short of a sweep — those fall back to the full map_batch."""
+        old = np.asarray(old_weights, dtype=np.int64)
+        new = np.asarray(new_weights, dtype=np.int64)
+        pc = _perf("crush.mapper")
+        if (new > old).any():
+            pc.inc("delta_full_fallbacks")
+            return self.map_batch(ruleno, xs, result_max, new_weights)
+        changed = np.flatnonzero(new != old)
+        if not len(changed):
+            return before.copy()
+        affected = np.isin(before, changed).any(axis=1)
+        rows = np.flatnonzero(affected)
+        pc.inc("delta_calls")
+        pc.inc("delta_affected_lanes", len(rows))
+        out = before.copy()
+        if len(rows):
+            out[rows] = self.map_batch(
+                ruleno, np.asarray(xs)[rows], result_max, new_weights)
+        return out
+
     def map_batch(self, ruleno: int, xs, result_max: int,
                   weights: Sequence[int], mesh=None) -> np.ndarray:
         """[N] x values -> [N, result_max] i32 osd ids (ITEM_NONE padded).

@@ -74,6 +74,8 @@ SLOW_TESTS = {
     "test_delete_is_logged_no_resurrection",
     "test_workload_survives_socket_failures",
     "test_wire_recovery_rebuilds_stripewise_in_grouped_dispatch",
+    "test_delta_equals_full_sweep_on_outs",
+    "test_delta_equals_full_on_fractional_reweight",
 }
 
 
