@@ -57,6 +57,7 @@ class RemoteCluster:
         self._backends: Dict[int, object] = {}
         self._dev = None            # lazy DeviceShardCache
         self._staged_attrs: Dict = {}
+        self._tier_reads: Dict = {}   # client-local warmth counters
         import threading
         self._client_lock = threading.Lock()
         self.refresh_map()
@@ -410,9 +411,144 @@ class RemoteCluster:
                 raise KeyError(f"{name}: no state at snap {snap_id}")
         return self.get(pool_id, name)
 
+    # ------------------------------------------------- cache-tier ops --
+    def tier_add(self, base_id: int, cache_id: int,
+                 mode: str = "writeback") -> None:
+        """Wire a cache pool over a base pool: committed MAP state
+        (quorum incremental — OSDMonitor 'osd tier add')."""
+        self.mon_call({"cmd": "pool_tier_add", "base": base_id,
+                       "cache": cache_id, "mode": mode})
+        self.refresh_map()
+
+    def tier_remove(self, base_id: int, cache_id: int,
+                    force: bool = False) -> None:
+        """Refused until the cache pool is drained (flush + evict) —
+        unwiring with data in the cache strands acknowledged writes
+        out of the read path (the reference's 'osd tier remove'
+        refuses the same way)."""
+        if not force:
+            cached = self.list_objects(cache_id)
+            if cached:
+                raise IOError(
+                    f"tier remove: cache pool still holds "
+                    f"{len(cached)} objects — drain first")
+        self.mon_call({"cmd": "pool_tier_remove", "base": base_id,
+                       "cache": cache_id})
+        self.refresh_map()
+
+    def copy_from(self, dst_pool: int, dst_name: str,
+                  src_pool: int, src_name: str) -> int:
+        """COPY_FROM between pools as an OP: the DESTINATION primary
+        daemon pulls the source object server-side (possibly from
+        another OSD) and commits it as a logged replicated write —
+        the client never carries the payload
+        (src/osd/PrimaryLogPG.cc:5886 do_copy_from; daemon handler
+        cluster/daemon.py 'copy_from')."""
+        dpool = self.osdmap.pools[dst_pool]
+        spool = self.osdmap.pools[src_pool]
+        dpg = self._pg_for(dpool, dst_name)
+        spg = self._pg_for(spool, src_name)
+        dst_members = [o for o in self._up(dpool, dpg)
+                       if o != ITEM_NONE]
+        src_members = [o for o in self._up(spool, spg)
+                       if o != ITEM_NONE]
+        if not dst_members or not src_members:
+            raise IOError("copy_from: no primary")
+        r = self.osd_call(dst_members[0], {
+            "cmd": "copy_from", "coll": [dst_pool, dpg],
+            "oid": f"0:{dst_name}",
+            "src_coll": [src_pool, spg], "src_oid": f"0:{src_name}",
+            "src_osd": src_members[0], "replicas": dst_members})
+        return int(r["acks"])
+
+    def _tier_mark(self, cache_id: int, name: str,
+                   dirty: bool) -> None:
+        pool = self.osdmap.pools[cache_id]
+        pg = self._pg_for(pool, name)
+        blob = b"1" if dirty else b"0"
+        for o in [x for x in self._up(pool, pg) if x != ITEM_NONE]:
+            try:
+                self.osd_call(o, {"cmd": "setattr_shard",
+                                  "coll": [cache_id, pg],
+                                  "oid": f"0:{name}",
+                                  "attrs": {"tier_dirty": blob}})
+            except (OSError, IOError):
+                pass
+
+    def tier_dirty(self, base_id: int, name: str) -> bool:
+        pool = self.osdmap.pools[base_id]
+        cache = self.osdmap.pools[pool.read_tier]
+        pg = self._pg_for(cache, name)
+        for o in [x for x in self._up(cache, pg) if x != ITEM_NONE]:
+            try:
+                raw = self.osd_call(o, {"cmd": "getattr_shard",
+                                        "coll": [cache.id, pg],
+                                        "oid": f"0:{name}",
+                                        "key": "tier_dirty"})
+            except (OSError, IOError):
+                continue
+            return raw == b"1"
+        return False
+
+    def tier_flush(self, base_id: int, name: str) -> int:
+        """Writeback flush: demote a dirty cache object to the base
+        tier as a COPY_FROM op, then mark it clean.
+
+        Concurrency caveat (same single-writer assumption as
+        RemoteIoCtx.write's RMW): a put racing between the copy and
+        the clean-mark can be marked clean unflushed — callers that
+        run multiple agents/writers against one tiered pool must
+        serialize flushes per object."""
+        pool = self.osdmap.pools[base_id]
+        acks = self.copy_from(base_id, name, pool.write_tier, name)
+        self._tier_mark(pool.write_tier, name, False)
+        return acks
+
+    def tier_evict(self, base_id: int, name: str) -> int:
+        """Evict a CLEAN cache object (dirty must flush first)."""
+        pool = self.osdmap.pools[base_id]
+        if self.tier_dirty(base_id, name):
+            raise IOError(f"{name}: dirty, flush before evict")
+        return self.delete(pool.read_tier, name)
+
+    def tier_agent_work(self, base_id: int,
+                        target_objects: int = 0) -> Dict[str, int]:
+        """One agent pass over the cache pool: flush every dirty
+        object; evict the COLDEST clean ones down to target_objects
+        (warmth = this client's read counters — the agent that runs
+        the workload holds the hit history, the sim tier's
+        HitSetHistory role)."""
+        pool = self.osdmap.pools[base_id]
+        cache_id = pool.read_tier
+        stats = {"flushed": 0, "evicted": 0}
+        cached = self.list_objects(cache_id)
+        for nm in cached:
+            if self.tier_dirty(base_id, nm):
+                self.tier_flush(base_id, nm)
+                stats["flushed"] += 1
+        if target_objects and len(cached) > target_objects:
+            cold = sorted(cached, key=lambda nm: self._tier_reads.get(
+                (base_id, nm), 0))
+            for nm in cold[:len(cached) - target_objects]:
+                self.tier_evict(base_id, nm)
+                stats["evicted"] += 1
+        return stats
+
     # ----------------------------------------------------------------- IO --
     def put(self, pool_id: int, name: str, data: bytes) -> int:
         """Returns the number of shard/replica writes acknowledged."""
+        pool = self.osdmap.pools[pool_id]
+        if pool.write_tier >= 0 and "@" not in name:
+            # writeback cache routing (the Objecter consults the
+            # pool's write_tier): the write lands in the cache pool
+            # marked dirty; the agent/flush demotes it later
+            return self._put_inner(pool.write_tier, name, data,
+                                   extra_attrs={"tier_dirty": b"1"})
+        return self._put_inner(pool_id, name, data)
+
+    def _put_inner(self, pool_id: int, name: str, data: bytes,
+                   extra_attrs: Optional[Dict[str, bytes]] = None
+                   ) -> int:
         pool = self.osdmap.pools[pool_id]
         pg = self._pg_for(pool, name)
         up = self._up(pool, pg)
@@ -443,6 +579,7 @@ class RemoteCluster:
                     r = self.osd_client(primary).call({
                         "cmd": "put_object", "coll": coll,
                         "oid": f"0:{name}", "data": data,
+                        "attrs": extra_attrs,
                         "replicas": replicas})
                 except (OSError, IOError) as e:
                     self.drop_osd_client(primary)
@@ -474,6 +611,8 @@ class RemoteCluster:
         chunk_len = int(np.asarray(chunks[0]).size)
         obj_attrs = {"size": str(len(data)).encode(),
                      "S": b"1", "U": str(chunk_len).encode()}
+        if extra_attrs:
+            obj_attrs.update(extra_attrs)
         # EC write contract (VERDICT r3 weak #2): the primary gathers
         # ALL shard commits before acknowledging
         # (src/osd/ECBackend.cc:1150) — transient failures retry
@@ -534,7 +673,38 @@ class RemoteCluster:
         """Read with bounded whole-read retries: one round can lose to
         transient connection drops on every holder (socket-failure
         injection, daemons restarting); the retry refreshes the map
-        and sweeps again before reporting the object unreadable."""
+        and sweeps again before reporting the object unreadable.
+
+        Tiered pools (read_tier set): the read serves from the cache
+        pool; a cache MISS promotes the object through the op engine
+        (COPY_FROM base -> cache, executed by the cache primary
+        daemon — PrimaryLogPG::promote_object, :3932) and then serves
+        the promoted copy."""
+        pool = self.osdmap.pools[pool_id]
+        if pool.read_tier >= 0 and "@" not in name:
+            try:
+                data = self.get(pool.read_tier, name, size)
+                self._tier_reads[(pool_id, name)] = \
+                    self._tier_reads.get((pool_id, name), 0) + 1
+                return data
+            except RemoteObjectMissing:
+                pass
+            try:
+                self.copy_from(pool.read_tier, name, pool_id, name)
+            except (OSError, IOError):
+                # promote failed — could be a TRANSIENT daemon issue,
+                # not absence: fall back to a PROXY READ of the base
+                # tier (Ceph's proxy-read mode); only a definitive
+                # base miss propagates as missing
+                return self._get_base_direct(pool_id, name, size)
+            self._tier_reads[(pool_id, name)] = \
+                self._tier_reads.get((pool_id, name), 0) + 1
+            return self.get(pool.read_tier, name, size)
+        return self._get_base_direct(pool_id, name, size)
+
+    def _get_base_direct(self, pool_id: int, name: str,
+                         size: Optional[int] = None) -> bytes:
+        """The retrying read against ONE pool, no tier routing."""
         last: Optional[Exception] = None
         for attempt in range(3):
             try:
@@ -725,8 +895,19 @@ class RemoteCluster:
         In a snapped pool the head is COW-preserved first and its
         snapset moves to a sidecar object (the head's xattr dies with
         it) — deleting an object must not delete its history
-        (make_writeable-on-delete; the sim keeps this in SnapMapper)."""
+        (make_writeable-on-delete; the sim keeps this in SnapMapper).
+
+        Tiered base pools delete BOTH copies (cache first), or the
+        next read would promote the object back to life."""
         pool = self.osdmap.pools[pool_id]
+        if pool.write_tier >= 0 and "@" not in name:
+            try:
+                self.delete(pool.write_tier, name)
+            except (RemoteObjectMissing, IOError):
+                pass              # not (or no longer) cached
+            self._tier_reads.pop((pool_id, name), None)
+            if name not in self.list_objects(pool_id):
+                return 1
         pg = self._pg_for(pool, name)
         if "@" not in name:
             ss = self._maybe_cow(pool, pg, name)
@@ -1423,7 +1604,7 @@ class WireShardIO:
         srcs = [up[shard]] if shard < len(up) and \
             up[shard] != ITEM_NONE else []
         srcs += [o for o in self.rc.addrs if o not in srcs]
-        answered = False
+        unreached = 0
         for o in srcs:
             try:
                 d = self.rc.osd_call(o, {
@@ -1431,13 +1612,16 @@ class WireShardIO:
                     "coll": [self.pool_id, pg],
                     "oid": f"{shard}:{name}"})
             except (OSError, IOError):
+                unreached += 1
                 continue
-            answered = True
             if d is not None:
                 return int(d)
-        if not answered:
-            raise IOError(f"{name} shard {shard}: no daemon "
-                          f"reachable for digest")
+        if unreached:
+            # ANY unreachable daemon could be the sole holder: only a
+            # full sweep of answers makes absence definitive (a
+            # non-holder's None must not evict a valid staged copy)
+            raise IOError(f"{name} shard {shard}: {unreached} "
+                          f"daemons unreachable for digest")
         return None
 
     def get_shard_ref(self, pg: int, shard: int, name: str):

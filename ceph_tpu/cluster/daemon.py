@@ -299,6 +299,7 @@ class MonDaemon:
 
     MUTATIONS = ("osd_boot", "report_failure", "mark_out", "mark_in",
                  "pool_create", "pool_rm",
+                 "pool_tier_add", "pool_tier_remove",
                  "pool_snap_create", "pool_snap_remove")
 
     def __init__(self, cluster_dir: str, rank: int = 0):
@@ -453,7 +454,10 @@ class MonDaemon:
                   "size": p.size, "min_size": p.min_size,
                   "pg_num": p.pg_num, "crush_rule": p.crush_rule,
                   "erasure_code_profile": p.erasure_code_profile,
-                  "stripe_unit": p.stripe_unit}
+                  "stripe_unit": p.stripe_unit,
+                  "tier_of": p.tier_of, "read_tier": p.read_tier,
+                  "write_tier": p.write_tier,
+                  "cache_mode": p.cache_mode}
                  for p in m.pools.values()]
         return {
             "epoch": m.epoch,
@@ -598,6 +602,42 @@ class MonDaemon:
                                     {"seq": 0, "snaps": {}})
                 return {"pool_id": pid, "epoch": m.epoch,
                         "existed": True}
+            if cmd == "pool_tier_add":
+                # 'osd tier add base cache + cache-mode writeback'
+                # (OSDMonitor prepare_command tier add role): tier
+                # wiring is committed MAP state, a quorum incremental
+                m = self.mon.osdmap
+                base, cache = int(req["base"]), int(req["cache"])
+                mode = req.get("mode", "writeback")
+                if base not in m.pools or cache not in m.pools:
+                    raise ValueError("tier add: no such pool")
+                if m.pools[cache].type != 1:     # POOL_REPLICATED
+                    raise ValueError(
+                        "cache tier must be a replicated pool")
+                if m.pools[base].type != 1:
+                    # whole-object COPY_FROM would read one EC shard
+                    # as the object; refuse rather than corrupt
+                    raise ValueError(
+                        "tiering over an EC base pool unsupported")
+                inc = self.mon.next_incremental()
+                inc.new_pool_tier[cache] = {"tier_of": base,
+                                            "cache_mode": mode}
+                inc.new_pool_tier[base] = {"read_tier": cache,
+                                           "write_tier": cache}
+                if not self.mon.commit_incremental(inc):
+                    raise IOError("tier add: no quorum")
+                return {"epoch": self.mon.osdmap.epoch}
+            if cmd == "pool_tier_remove":
+                m = self.mon.osdmap
+                base, cache = int(req["base"]), int(req["cache"])
+                inc = self.mon.next_incremental()
+                inc.new_pool_tier[cache] = {"tier_of": -1,
+                                            "cache_mode": ""}
+                inc.new_pool_tier[base] = {"read_tier": -1,
+                                           "write_tier": -1}
+                if not self.mon.commit_incremental(inc):
+                    raise IOError("tier remove: no quorum")
+                return {"epoch": self.mon.osdmap.epoch}
             if cmd == "pool_snap_create":
                 # pool snapshot state is COMMITTED mon state (the
                 # pg_pool_t::snap_seq + snaps role, committed through

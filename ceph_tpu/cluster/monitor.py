@@ -159,6 +159,8 @@ class Monitor:
                                 for k, v in inc.new_pool_pg_num.items()},
             "new_pools": {str(k): v for k, v in inc.new_pools.items()},
             "old_pools": list(inc.old_pools),
+            "new_pool_tier": {str(k): v for k, v in
+                              inc.new_pool_tier.items()},
         }).encode()
 
     @staticmethod
@@ -185,6 +187,8 @@ class Monitor:
             new_pools={int(k): v
                        for k, v in d.get("new_pools", {}).items()},
             old_pools=[int(p) for p in d.get("old_pools", [])],
+            new_pool_tier={int(k): v for k, v in
+                           d.get("new_pool_tier", {}).items()},
         )
 
     @classmethod

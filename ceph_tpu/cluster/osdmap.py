@@ -91,6 +91,14 @@ class PGPool:
     # pool snapshot context (pg_pool_t::snap_seq / snaps)
     snap_seq: int = 0
     snaps: Dict[int, str] = field(default_factory=dict)
+    # cache tiering (pg_pool_t::tier_of / read_tier / write_tier,
+    # src/osd/osd_types.h): a CACHE pool carries tier_of = its base
+    # pool; the BASE pool carries read_tier/write_tier = the cache
+    # pool the op engine redirects reads/writes to
+    tier_of: int = -1
+    read_tier: int = -1
+    write_tier: int = -1
+    cache_mode: str = ""
 
     def __post_init__(self):
         if not self.pgp_num:
@@ -151,6 +159,9 @@ class Incremental:
     # JSON-serializable for the mon quorum's decree log
     new_pools: Dict[int, dict] = field(default_factory=dict)
     old_pools: List[int] = field(default_factory=list)
+    # cache-tier wiring: pool id -> {tier_of|read_tier|write_tier|
+    # cache_mode} field updates (OSDMonitor 'osd tier add' role)
+    new_pool_tier: Dict[int, dict] = field(default_factory=dict)
 
 
 class OSDMap:
@@ -212,6 +223,15 @@ class OSDMap:
         for pid, spec in inc.new_pools.items():
             self.pools[pid] = PGPool(**{**spec, "id": pid})
             self.pool_id_max = max(self.pool_id_max, pid)
+        for pid, fields in inc.new_pool_tier.items():
+            pool = self.pools.get(pid)
+            if pool is None:
+                continue
+            for fk in ("tier_of", "read_tier", "write_tier"):
+                if fk in fields:
+                    setattr(pool, fk, int(fields[fk]))
+            if "cache_mode" in fields:
+                pool.cache_mode = str(fields["cache_mode"])
         for pid in inc.old_pools:
             self.pools.pop(pid, None)
             # stale placement overrides keyed by the dead pool go too

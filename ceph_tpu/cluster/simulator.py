@@ -316,6 +316,9 @@ class ClusterSim:
             self, ClusterSim._stop_services, self.services)
         self.codecs: Dict[int, object] = {}
         self._ec_backends: Dict[int, object] = {}
+        self._tier_state: Dict[int, Dict] = {}
+        from ..common.perf_counters import perf as _tier_perf
+        self._pc_tier = _tier_perf("osd.tier")
         self.objects: Dict[Tuple[int, str], ObjectInfo] = {}
         self.ec_profiles: Dict[str, Dict[str, str]] = {}
         self.extent_cache = ExtentCache()
@@ -623,6 +626,10 @@ class ClusterSim:
         mon_call retry path additionally needs same-name retries to
         land on one id."""
         pool = self.osdmap.pools[pool_id]
+        if pool.write_tier >= 0:
+            raise IOError("pool snapshots on a tiered base pool "
+                          "unsupported (COW would run against the "
+                          "cache pool's snap context)")
         for sid, nm in pool.snaps.items():
             if nm == snap_name:
                 return sid
@@ -890,7 +897,129 @@ class ClusterSim:
         return acks
 
     # --------------------------------------------------------------- I/O --
+    # ------------------------------------------------- cache-tier ops --
+    def tier_add(self, base_id: int, cache_id: int,
+                 mode: str = "writeback") -> None:
+        """Wire a cache pool over a base pool (pg_pool_t tier_of /
+        read_tier / write_tier; OSDMonitor 'osd tier add' +
+        'tier cache-mode')."""
+        base, cache = self.osdmap.pools[base_id], \
+            self.osdmap.pools[cache_id]
+        if cache.type != POOL_REPLICATED:
+            raise IOError("cache tier must be a replicated pool")
+        if base.type != POOL_REPLICATED:
+            # the whole-object COPY_FROM op path would read one shard
+            # of an EC object as if it were the object — refuse rather
+            # than corrupt (EC-base tiering needs a sharded copy path;
+            # tracked gap)
+            raise IOError("tiering over an EC base pool unsupported")
+        if base.snap_seq:
+            # tier routing would run COW against the cache pool's
+            # empty snap context and silently skip clones
+            raise IOError("tiering over a snapshotted pool "
+                          "unsupported")
+        cache.tier_of = base_id
+        cache.cache_mode = mode
+        base.read_tier = cache_id
+        base.write_tier = cache_id
+        self._tier_hits(base_id)
+
+    def tier_remove(self, base_id: int, cache_id: int) -> None:
+        """Unwire a tier.  Refused until the cache pool is DRAINED
+        (flush dirty + evict) — the reference's 'osd tier remove'
+        refuses too, because unwiring with data still in the cache
+        strands acknowledged writes out of the read path."""
+        cached = [nm for (pid, nm) in self.objects if pid == cache_id]
+        if cached:
+            raise IOError(f"tier remove: cache pool still holds "
+                          f"{len(cached)} objects — drain first "
+                          f"(tier_agent_work + evict)")
+        self.osdmap.pools[cache_id].tier_of = -1
+        self.osdmap.pools[cache_id].cache_mode = ""
+        self.osdmap.pools[base_id].read_tier = -1
+        self.osdmap.pools[base_id].write_tier = -1
+
+    def copy_from(self, dst_pool: int, dst_name: str,
+                  src_pool: int, src_name: str) -> List[int]:
+        """The COPY_FROM op (src/osd/PrimaryLogPG.cc:5886): the
+        destination reads the source object server-side and commits
+        it as a normal logged write — the building block of tier
+        promote/flush and rbd clone flatten.  Raw (tier-routing
+        bypassed): callers ARE the tier machinery."""
+        data = self._get_raw(src_pool, src_name)
+        return self._put_raw(dst_pool, dst_name, data)
+
+    def _tier_hits(self, base_id: int):
+        st = self._tier_state.setdefault(base_id, None)
+        if st is None:
+            from .tiering import HitSetHistory
+            st = self._tier_state[base_id] = {
+                "dirty": set(), "hits": HitSetHistory()}
+        return st
+
+    def tier_promote(self, base_id: int, name: str) -> None:
+        """Promote on read-miss through the op engine
+        (PrimaryLogPG::promote_object, :3932): COPY_FROM base ->
+        cache; the promoted copy starts CLEAN."""
+        pool = self.osdmap.pools[base_id]
+        self.copy_from(pool.read_tier, name, base_id, name)
+        self._pc_tier.inc("promote_ops")
+
+    def tier_flush(self, base_id: int, name: str) -> None:
+        """Writeback flush: dirty cache object demotes to the base
+        tier as a COPY_FROM (agent_flush -> do_copy_from shape)."""
+        pool = self.osdmap.pools[base_id]
+        self.copy_from(base_id, name, pool.write_tier, name)
+        self._tier_hits(base_id)["dirty"].discard(name)
+        self._pc_tier.inc("flush_ops")
+
+    def tier_evict(self, base_id: int, name: str) -> None:
+        """Evict a CLEAN cache object (agent_evict): dirty objects
+        must flush first."""
+        st = self._tier_hits(base_id)
+        if name in st["dirty"]:
+            raise IOError(f"{name}: dirty, flush before evict")
+        pool = self.osdmap.pools[base_id]
+        self.delete(pool.read_tier, name)
+        self._pc_tier.inc("evict_ops")
+
+    def tier_agent_work(self, base_id: int,
+                        target_objects: int = 0) -> Dict[str, int]:
+        """The tier agent pass: flush every dirty object, then evict
+        cold clean ones down to ``target_objects`` (agent_work)."""
+        st = self._tier_hits(base_id)
+        pool = self.osdmap.pools[base_id]
+        cache_id = pool.read_tier
+        stats = {"flushed": 0, "evicted": 0}
+        for name in sorted(st["dirty"]):
+            self.tier_flush(base_id, name)
+            stats["flushed"] += 1
+        cached = [nm for (pid, nm) in list(self.objects)
+                  if pid == cache_id]
+        if target_objects and len(cached) > target_objects:
+            cold = sorted(cached,
+                          key=lambda nm:
+                          st["hits"].temperature(nm))
+            for nm in cold[:len(cached) - target_objects]:
+                self.tier_evict(base_id, nm)
+                stats["evicted"] += 1
+        return stats
+
     def put(self, pool_id: int, name: str, data: bytes) -> List[int]:
+        pool = self.osdmap.pools[pool_id]
+        if pool.write_tier >= 0 and "@" not in name:
+            # writeback cache: the write LANDS in the cache tier and
+            # marks the object dirty; the base copy goes stale until
+            # the agent/flush demotes (PrimaryLogPG writeback mode)
+            placed = self._put_raw(pool.write_tier, name, data)
+            st = self._tier_hits(pool_id)
+            st["dirty"].add(name)
+            st["hits"].record(name)
+            return placed
+        return self._put_raw(pool_id, name, data)
+
+    def _put_raw(self, pool_id: int, name: str,
+                 data: bytes) -> List[int]:
         pool = self.osdmap.pools[pool_id]
         if "@" not in name:
             self._maybe_clone(pool, name)
@@ -995,6 +1124,23 @@ class ClusterSim:
         return out
 
     def get(self, pool_id: int, name: str) -> bytes:
+        pool = self.osdmap.pools[pool_id]
+        if pool.read_tier >= 0 and "@" not in name:
+            # read through the cache tier: hit serves from cache;
+            # miss PROMOTES through the op engine (COPY_FROM base ->
+            # cache) and then serves the promoted copy
+            st = self._tier_hits(pool_id)
+            if (pool.read_tier, name) in self.objects:
+                st["hits"].record(name)
+                return self._get_raw(pool.read_tier, name)
+            if (pool_id, name) not in self.objects:
+                raise KeyError(f"object {name} not found")
+            self.tier_promote(pool_id, name)
+            st["hits"].record(name)
+            return self._get_raw(pool.read_tier, name)
+        return self._get_raw(pool_id, name)
+
+    def _get_raw(self, pool_id: int, name: str) -> bytes:
         pool = self.osdmap.pools[pool_id]
         info = self.objects[(pool_id, name)]
         pg = self.object_pg(pool, name)
@@ -1257,8 +1403,17 @@ class ClusterSim:
         """Remove an object: shards purged from live OSDs, an OP_DELETE
         log entry recorded so lagging replicas apply it on delta
         recovery.  Snapshotted state survives as clones (the head
-        whiteout semantics: clones trim with their snaps, not here)."""
+        whiteout semantics: clones trim with their snaps, not here).
+        Tiered base pools delete BOTH copies (cache whiteout + base),
+        or the next read would promote the object back to life."""
         pool = self.osdmap.pools[pool_id]
+        if pool.write_tier >= 0 and "@" not in name:
+            st = self._tier_hits(pool_id)
+            st["dirty"].discard(name)
+            if (pool.write_tier, name) in self.objects:
+                self.delete(pool.write_tier, name)
+            if (pool_id, name) not in self.objects:
+                return
         if "@" not in name:
             self._maybe_clone(pool, name)
         info = self.objects.pop((pool_id, name), None)

@@ -1,0 +1,178 @@
+"""COPY_FROM + cache-tier promote/flush as OP PATHS (VERDICT r4 next
+#5): a cache pool fronts a base pool via pg_pool_t tier wiring; reads
+PROMOTE on cache miss through COPY_FROM, writes land dirty in the
+cache, writeback FLUSH demotes via COPY_FROM, evict drops clean
+copies.  Both tiers: the in-process simulator's op engine and the
+live-daemon wire path (the destination primary pulls the source
+server-side).  Reference: src/osd/PrimaryLogPG.cc:3932
+(promote_object), :5886 (COPY_FROM), osd_types.h pg_pool_t tier_of /
+read_tier / write_tier.
+"""
+import numpy as np
+import pytest
+
+from ceph_tpu.cluster.osdmap import OSDMap, PGPool, POOL_REPLICATED
+from ceph_tpu.cluster.simulator import ClusterSim
+from ceph_tpu.placement.crush_map import (RULE_CHOOSELEAF_FIRSTN,
+                                          RULE_EMIT, RULE_TAKE, Rule)
+from tests.test_xla_mapper import TYPE_HOST, build_cluster
+
+BASE, CACHE = 1, 2
+
+
+def make_tiered_sim():
+    cmap, root = build_cluster(n_hosts=6, osds_per_host=2, seed=0)
+    cmap.add_rule(Rule(steps=[(RULE_TAKE, root, 0),
+                              (RULE_CHOOSELEAF_FIRSTN, 0, TYPE_HOST),
+                              (RULE_EMIT, 0, 0)]))
+    om = OSDMap(cmap)
+    om.mark_all_in_up()
+    om.add_pool(PGPool(id=BASE, name="base", type=POOL_REPLICATED,
+                       size=3, pg_num=16, crush_rule=0))
+    om.add_pool(PGPool(id=CACHE, name="cache", type=POOL_REPLICATED,
+                       size=2, pg_num=16, crush_rule=0))
+    sim = ClusterSim(om)
+    sim.tier_add(BASE, CACHE)
+    return sim
+
+
+def test_copy_from_between_pools():
+    sim = make_tiered_sim()
+    sim.tier_remove(BASE, CACHE)       # plain pools for this one
+    data = b"copy-me" * 500
+    sim.put(BASE, "src", data)
+    sim.copy_from(CACHE, "dst", BASE, "src")
+    assert sim.get(CACHE, "dst") == data
+    # the source is untouched
+    assert sim.get(BASE, "src") == data
+
+
+def test_write_lands_dirty_in_cache_and_flush_demotes():
+    sim = make_tiered_sim()
+    data = b"hot-object" * 300
+    sim.put(BASE, "obj", data)
+    # the write landed in the CACHE pool, not the base
+    assert (CACHE, "obj") in sim.objects
+    assert (BASE, "obj") not in sim.objects
+    assert "obj" in sim._tier_hits(BASE)["dirty"]
+    # reads serve from the cache
+    assert sim.get(BASE, "obj") == data
+    # dirty objects refuse evict; flush demotes via COPY_FROM
+    with pytest.raises(IOError):
+        sim.tier_evict(BASE, "obj")
+    sim.tier_flush(BASE, "obj")
+    assert sim.get(BASE, "obj") == data       # still served (cache)
+    assert (BASE, "obj") in sim.objects       # base copy exists now
+    assert "obj" not in sim._tier_hits(BASE)["dirty"]
+    # clean copy can evict; reads then PROMOTE from base
+    pc = sim._pc_tier
+    before = pc.get("promote_ops") or 0
+    sim.tier_evict(BASE, "obj")
+    assert (CACHE, "obj") not in sim.objects
+    assert sim.get(BASE, "obj") == data       # read-miss promote
+    assert (pc.get("promote_ops") or 0) == before + 1
+    assert (CACHE, "obj") in sim.objects      # promoted copy present
+
+
+def test_delete_routes_through_tier_and_remove_requires_drain():
+    sim = make_tiered_sim()
+    sim.put(BASE, "doomed", b"bye" * 200)
+    sim.delete(BASE, "doomed")
+    with pytest.raises(KeyError):
+        sim.get(BASE, "doomed")     # no promote-back-to-life
+    assert (CACHE, "doomed") not in sim.objects
+    # tier_remove refuses while the cache holds data
+    sim.put(BASE, "held", b"x" * 100)
+    with pytest.raises(IOError):
+        sim.tier_remove(BASE, CACHE)
+    sim.tier_agent_work(BASE, target_objects=0)
+    sim.tier_evict(BASE, "held")
+    sim.tier_remove(BASE, CACHE)
+    assert sim.osdmap.pools[BASE].read_tier == -1
+    assert sim.get(BASE, "held") == b"x" * 100   # flushed copy serves
+
+
+def test_tier_add_refuses_unsafe_configs():
+    sim = make_tiered_sim()
+    sim.tier_remove(BASE, CACHE)
+    sim.snap_create(BASE, "s1")
+    with pytest.raises(IOError):
+        sim.tier_add(BASE, CACHE)    # snapshotted base refused
+
+
+def test_read_promotes_cold_base_object():
+    sim = make_tiered_sim()
+    # object written straight into the base (pre-tiering data)
+    data = b"cold" * 400
+    sim._put_raw(BASE, "cold", data)
+    assert (CACHE, "cold") not in sim.objects
+    assert sim.get(BASE, "cold") == data
+    assert (CACHE, "cold") in sim.objects     # promoted on read-miss
+
+
+def test_agent_pass_flushes_then_evicts_cold():
+    sim = make_tiered_sim()
+    for i in range(6):
+        sim.put(BASE, f"o{i}", f"payload-{i}".encode() * 100)
+    # make two objects HOT so the agent keeps them: temperature is
+    # membership across ROTATED hit sets, so age the write-time set
+    # first, then touch only the hot pair in the fresh one
+    sim._tier_hits(BASE)["hits"].rotate()
+    for _ in range(5):
+        sim.get(BASE, "o0")
+        sim.get(BASE, "o1")
+    stats = sim.tier_agent_work(BASE, target_objects=2)
+    assert stats["flushed"] == 6
+    assert stats["evicted"] == 4
+    cached = {nm for (pid, nm) in sim.objects if pid == CACHE}
+    assert cached == {"o0", "o1"}
+    # every object still reads correctly (evicted ones re-promote)
+    for i in range(6):
+        assert sim.get(BASE, f"o{i}") == f"payload-{i}".encode() * 100
+
+
+def test_wire_tier_promote_and_flush(tmp_path):
+    """The same op paths against LIVE daemons: tier wiring committed
+    through the mon quorum, COPY_FROM executed by the destination
+    primary daemon."""
+    import time
+    from ceph_tpu.client.remote import RemoteCluster
+    from ceph_tpu.tools.vstart import Vstart, build_cluster_dir
+    d = str(tmp_path / "tier")
+    build_cluster_dir(
+        d, n_osds=4, osds_per_host=2, fsync=False,
+        pools=[{"id": 1, "name": "base", "type": 1, "size": 3,
+                "pg_num": 8, "crush_rule": 0},
+               {"id": 2, "name": "cache", "type": 1, "size": 2,
+                "pg_num": 8, "crush_rule": 0}])
+    v = Vstart(d)
+    v.start(4, hb_interval=0.25)
+    try:
+        rc = RemoteCluster(d)
+        rc.tier_add(1, 2)
+        assert rc.osdmap.pools[1].read_tier == 2
+        assert rc.osdmap.pools[2].tier_of == 1
+        data = b"wire-hot" * 500
+        rc.put(1, "obj", data)
+        # landed in the cache pool, dirty
+        assert "obj" in rc.list_objects(2)
+        assert "obj" not in rc.list_objects(1)
+        assert rc.tier_dirty(1, "obj")
+        assert rc.get(1, "obj") == data
+        # flush demotes server-side (COPY_FROM on the daemons)
+        rc.tier_flush(1, "obj")
+        assert "obj" in rc.list_objects(1)
+        assert not rc.tier_dirty(1, "obj")
+        # evict, then a read PROMOTES it back via the cache primary
+        rc.tier_evict(1, "obj")
+        assert "obj" not in rc.list_objects(2)
+        assert rc.get(1, "obj") == data
+        assert "obj" in rc.list_objects(2)
+        # a SECOND client sees the same tier state from the map
+        rc2 = RemoteCluster(d)
+        assert rc2.osdmap.pools[1].write_tier == 2
+        assert rc2.get(1, "obj") == data
+        rc.close()
+        rc2.close()
+    finally:
+        v.stop()
