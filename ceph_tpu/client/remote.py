@@ -541,7 +541,11 @@ class RemoteCluster:
         if pool.write_tier >= 0 and "@" not in name:
             # writeback cache routing (the Objecter consults the
             # pool's write_tier): the write lands in the cache pool
-            # marked dirty; the agent/flush demotes it later
+            # marked dirty; the agent/flush demotes it later.  Writes
+            # count as warmth like the sim's HitSet record, or the
+            # agent would evict just-written objects first
+            self._tier_reads[(pool_id, name)] = \
+                self._tier_reads.get((pool_id, name), 0) + 1
             return self._put_inner(pool.write_tier, name, data,
                                    extra_attrs={"tier_dirty": b"1"})
         return self._put_inner(pool_id, name, data)
@@ -901,13 +905,16 @@ class RemoteCluster:
         next read would promote the object back to life."""
         pool = self.osdmap.pools[pool_id]
         if pool.write_tier >= 0 and "@" not in name:
+            # delete the cache copy FIRST — a real failure here must
+            # surface (a surviving cache copy would keep serving, and
+            # a later flush would resurrect the object in the base);
+            # then fall through to the base delete, which is
+            # idempotent on absence
             try:
                 self.delete(pool.write_tier, name)
-            except (RemoteObjectMissing, IOError):
+            except RemoteObjectMissing:
                 pass              # not (or no longer) cached
             self._tier_reads.pop((pool_id, name), None)
-            if name not in self.list_objects(pool_id):
-                return 1
         pg = self._pg_for(pool, name)
         if "@" not in name:
             ss = self._maybe_cow(pool, pg, name)

@@ -300,7 +300,8 @@ class MonDaemon:
     MUTATIONS = ("osd_boot", "report_failure", "mark_out", "mark_in",
                  "pool_create", "pool_rm",
                  "pool_tier_add", "pool_tier_remove",
-                 "pool_snap_create", "pool_snap_remove")
+                 "pool_snap_create", "pool_snap_remove",
+                 "config_set")
 
     def __init__(self, cluster_dir: str, rank: int = 0):
         self.dir = cluster_dir
@@ -609,6 +610,12 @@ class MonDaemon:
                 m = self.mon.osdmap
                 base, cache = int(req["base"]), int(req["cache"])
                 mode = req.get("mode", "writeback")
+                if mode != "writeback":
+                    raise ValueError(
+                        f"cache mode {mode!r} not implemented "
+                        f"(writeback only)")
+                if base == cache:
+                    raise ValueError("tier add: base == cache")
                 if base not in m.pools or cache not in m.pools:
                     raise ValueError("tier add: no such pool")
                 if m.pools[cache].type != 1:     # POOL_REPLICATED
@@ -619,6 +626,18 @@ class MonDaemon:
                     # as the object; refuse rather than corrupt
                     raise ValueError(
                         "tiering over an EC base pool unsupported")
+                if m.pools[base].read_tier >= 0 or \
+                        m.pools[cache].tier_of >= 0:
+                    raise ValueError("tier add: pool already tiered")
+                snaps = self.mon.config_get(
+                    f"pool.{base}.snaps") or {}
+                if snaps.get("snaps") or m.pools[base].snaps:
+                    # tier routing would run COW against the cache
+                    # pool's empty snap context and skip clones (the
+                    # snap SEQ may outlive deleted snapshots; only
+                    # LIVE snapshots make tiering unsafe)
+                    raise ValueError(
+                        "tiering over a snapshotted pool unsupported")
                 inc = self.mon.next_incremental()
                 inc.new_pool_tier[cache] = {"tier_of": base,
                                             "cache_mode": mode}
@@ -643,6 +662,11 @@ class MonDaemon:
                 # pg_pool_t::snap_seq + snaps role, committed through
                 # the quorum's config decree path)
                 pid = int(req["pool"])
+                if self.mon.osdmap.pools.get(pid) is not None and \
+                        self.mon.osdmap.pools[pid].write_tier >= 0:
+                    raise ValueError(
+                        "pool snapshots on a tiered base pool "
+                        "unsupported")
                 cur = self.mon.config_get(f"pool.{pid}.snaps") or \
                     {"seq": 0, "snaps": {}}
                 # retry-idempotent (mon_call resends after a lost
@@ -674,6 +698,15 @@ class MonDaemon:
                 pid = int(req["pool"])
                 return self.mon.config_get(f"pool.{pid}.snaps") or \
                     {"seq": 0, "snaps": {}}
+            if cmd == "config_set":
+                # central config db (ConfigMonitor role): committed
+                # through the quorum's decree path like every other
+                # mon mutation
+                if not self.mon.config_set(req["key"], req["value"]):
+                    raise IOError("config set: no quorum")
+                return {"ok": True}
+            if cmd == "config_get":
+                return {"value": self.mon.config_get(req["key"])}
             if cmd == "status":
                 m = self.mon.osdmap
                 return {"epoch": m.epoch,

@@ -905,6 +905,13 @@ class ClusterSim:
         'tier cache-mode')."""
         base, cache = self.osdmap.pools[base_id], \
             self.osdmap.pools[cache_id]
+        if mode != "writeback":
+            raise IOError(f"cache mode {mode!r} not implemented "
+                          f"(writeback only)")
+        if base_id == cache_id:
+            raise IOError("tier add: base == cache")
+        if base.read_tier >= 0 or cache.tier_of >= 0:
+            raise IOError("tier add: pool already tiered")
         if cache.type != POOL_REPLICATED:
             raise IOError("cache tier must be a replicated pool")
         if base.type != POOL_REPLICATED:
@@ -913,9 +920,10 @@ class ClusterSim:
             # than corrupt (EC-base tiering needs a sharded copy path;
             # tracked gap)
             raise IOError("tiering over an EC base pool unsupported")
-        if base.snap_seq:
+        if base.snaps:
             # tier routing would run COW against the cache pool's
-            # empty snap context and silently skip clones
+            # empty snap context and silently skip clones (seq may
+            # outlive deleted snapshots; live snaps are the hazard)
             raise IOError("tiering over a snapshotted pool "
                           "unsupported")
         cache.tier_of = base_id

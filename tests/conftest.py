@@ -76,6 +76,8 @@ SLOW_TESTS = {
     "test_wire_recovery_rebuilds_stripewise_in_grouped_dispatch",
     "test_delta_equals_full_sweep_on_outs",
     "test_delta_equals_full_on_fractional_reweight",
+    "test_rolling_upgrade_under_io",
+    "test_multi_mon_rolling_restart",
 }
 
 

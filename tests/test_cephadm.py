@@ -1,0 +1,113 @@
+"""cephadm analog: declarative deploy + health-gated rolling ops
+(VERDICT r4 next #7).  Reference roles: src/cephadm/cephadm
+(bootstrap/apply/upgrade sequencing), src/ceph-volume (store
+provisioning — played by build_cluster_dir inside deploy).
+"""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from ceph_tpu.tools.cephadm import CephAdm, ClusterSpec
+
+
+def _spec(n_mons=1):
+    return ClusterSpec(
+        name="t", version="1.0", mons=n_mons,
+        hosts=[{"name": f"h{i}", "osds": 2} for i in range(2)],
+        pools=[{"id": 1, "name": "rep", "type": 1, "size": 3,
+                "pg_num": 8, "crush_rule": 0}])
+
+
+def test_spec_driven_deploy_and_status(tmp_path):
+    d = str(tmp_path / "c")
+    adm = CephAdm.deploy(_spec(), d)
+    try:
+        st = adm.status()
+        assert st["health_ok"]
+        assert st["n_up"] == 4
+        assert st["spec"]["version"] == "1.0"
+        assert set(st["versions"]) == {f"osd.{i}" for i in range(4)}
+        assert all(v == "1.0" for v in st["versions"].values())
+        # the spec round-trips from committed mon state
+        spec = adm.spec()
+        assert spec.n_osds == 4 and spec.osds_per_host == 2
+    finally:
+        adm.stop()
+
+
+def test_rolling_upgrade_under_io(tmp_path):
+    """The rolling-restart-under-IO contract: client writes/reads run
+    THROUGH the whole upgrade; every daemon cycles exactly once,
+    health-gated; versions flip per daemon; no acknowledged write is
+    lost."""
+    d = str(tmp_path / "c")
+    adm = CephAdm.deploy(_spec(), d)
+    stop = threading.Event()
+    acked = {}
+    errors = []
+
+    def workload():
+        from ceph_tpu.client.remote import RemoteCluster
+        rc = None
+        rng = np.random.default_rng(9)
+        i = 0
+        while not stop.is_set():
+            if rc is None:
+                try:
+                    rc = RemoteCluster(d)
+                except IOError:
+                    # the mon itself may be mid-cycle: reconnect
+                    time.sleep(0.2)
+                    continue
+            name = f"w{i}"
+            data = rng.integers(0, 256, 2000,
+                                dtype=np.uint8).tobytes()
+            try:
+                rc.put(1, name, data)
+                acked[name] = data
+            except IOError:
+                pass          # unacked writes carry no promise
+            i += 1
+            time.sleep(0.05)
+        if rc is not None:
+            rc.close()
+
+    t = threading.Thread(target=workload)
+    t.start()
+    try:
+        res = adm.upgrade("2.0", timeout=120)
+        assert set(res["restarted"]) >= {f"osd.{i}" for i in range(4)}
+    finally:
+        stop.set()
+        t.join(timeout=30)
+    try:
+        st = adm.status()
+        assert st["health_ok"]
+        assert all(v == "2.0" for v in st["versions"].values())
+        assert st["spec"]["version"] == "2.0"
+        # every acknowledged write survived the full rolling cycle
+        from ceph_tpu.client.remote import RemoteCluster
+        rc = RemoteCluster(d)
+        assert len(acked) > 0, "workload never acked a write"
+        for name, data in acked.items():
+            assert rc.get(1, name) == data, name
+        rc.close()
+    finally:
+        adm.stop()
+
+
+def test_multi_mon_rolling_restart(tmp_path):
+    """Mons cycle first and one at a time; the quorum survives every
+    single-mon outage (majority stays up)."""
+    d = str(tmp_path / "c3")
+    adm = CephAdm.deploy(_spec(n_mons=3), d, timeout=90)
+    try:
+        res = adm.rolling_restart(timeout=120)
+        assert [r for r in res["restarted"]
+                if r.startswith("mon")] == [f"mon.{r}"
+                                            for r in range(3)]
+        assert adm.health_ok()
+    finally:
+        adm.stop()
