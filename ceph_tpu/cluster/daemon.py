@@ -627,7 +627,10 @@ class MonDaemon:
                     raise ValueError(
                         "tiering over an EC base pool unsupported")
                 if m.pools[base].read_tier >= 0 or \
-                        m.pools[cache].tier_of >= 0:
+                        m.pools[base].tier_of >= 0 or \
+                        m.pools[cache].tier_of >= 0 or \
+                        m.pools[cache].read_tier >= 0:
+                    # no re-tiering and no tier CHAINS
                     raise ValueError("tier add: pool already tiered")
                 snaps = self.mon.config_get(
                     f"pool.{base}.snaps") or {}

@@ -910,7 +910,10 @@ class ClusterSim:
                           f"(writeback only)")
         if base_id == cache_id:
             raise IOError("tier add: base == cache")
-        if base.read_tier >= 0 or cache.tier_of >= 0:
+        if base.read_tier >= 0 or base.tier_of >= 0 or \
+                cache.tier_of >= 0 or cache.read_tier >= 0:
+            # no re-tiering AND no chains: a pool that is itself a
+            # cache (or already fronted) would misroute puts/reads
             raise IOError("tier add: pool already tiered")
         if cache.type != POOL_REPLICATED:
             raise IOError("cache tier must be a replicated pool")

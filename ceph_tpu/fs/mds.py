@@ -289,6 +289,13 @@ class MDS:
         ent = self._lookup(path)
         if ent["type"] != "file":
             raise FSError(f"not a file: {path}")
+        return self.read_ino(ent, offset, length)
+
+    def read_ino(self, ent: dict, offset: int = 0,
+                 length: Optional[int] = None) -> bytes:
+        """Read file content from an INODE record alone — the half a
+        replica-holding non-auth rank can serve without any path
+        authority (data objects live in the shared data pool)."""
         size = ent.get("size", 0)
         if length is None:
             length = max(0, size - offset)

@@ -143,6 +143,34 @@ class CephAdm:
             self._drop_rc()
             return False
 
+    def _wait_mon_rejoined(self, rank: int, n_mons: int,
+                           timeout: float) -> None:
+        """Poll the JUST-RESTARTED mon's own socket until it reports
+        a leader (single-mon: until it serves at all)."""
+        from ..cluster.daemon import WireClient
+        from ..common import auth as cx
+        ring = cx.Keyring.load(
+            os.path.join(self.dir, "keyring.client"))
+        sock = os.path.join(
+            self.dir, f"mon.{rank}.sock" if n_mons > 1 else "mon.sock")
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            try:
+                c = WireClient(sock, "client.admin",
+                               secret=ring.secret("client.admin"),
+                               timeout=3.0)
+                try:
+                    st = c.call({"cmd": "mon_status"})
+                finally:
+                    c.close()
+                if n_mons == 1 or st.get("leader") is not None:
+                    return
+            except (OSError, IOError, cx.AuthError):
+                pass
+            time.sleep(0.3)
+        raise HealthGateTimeout(
+            f"mon.{rank} did not rejoin within {timeout}s")
+
     def wait_health(self, timeout: float = 60.0) -> None:
         deadline = time.monotonic() + timeout
         while time.monotonic() < deadline:
@@ -167,7 +195,11 @@ class CephAdm:
                 "cmd": "config_get",
                 "key": f"cephadm/version/osd.{i}"})["value"]
         st = rc.mon_call({"cmd": "status"})
-        return {"spec": spec.__dict__, "health_ok": self.health_ok(),
+        ms = rc.mon_call({"cmd": "mon_status"})
+        healthy = st["n_up"] >= st["n_osds"] and (
+            ms.get("n_mons", 1) <= 1 or
+            ms.get("leader") is not None)
+        return {"spec": spec.__dict__, "health_ok": healthy,
                 "n_up": st["n_up"], "versions": versions}
 
     def rolling_restart(self, version: Optional[str] = None,
@@ -183,15 +215,14 @@ class CephAdm:
         # OSDs — each gated
         for rank in range(spec.mons):
             name = f"mon.{rank}" if spec.mons > 1 else "mon"
-            if spec.mons > 1:
-                self.v.kill9(name)
-                self._drop_rc()
-                time.sleep(0.3)
-                self.v.start_mon(rank)
-            else:
-                self.v.kill9("mon")
-                self._drop_rc()
-                self.v.start_mon()
+            self.v.kill9(name)
+            self._drop_rc()
+            time.sleep(0.3)
+            self.v.start_mon(rank)
+            # the restarted mon itself must REJOIN (know the leader)
+            # before the next one goes down — a surviving peer
+            # reporting a leader is not the restarted rank's health
+            self._wait_mon_rejoined(rank, spec.mons, timeout)
             self.wait_health(timeout=timeout)
             restarted.append(name)
         for i in range(spec.n_osds):

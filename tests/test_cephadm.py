@@ -69,6 +69,9 @@ def test_rolling_upgrade_under_io(tmp_path):
                 acked[name] = data
             except IOError:
                 pass          # unacked writes carry no promise
+            except Exception as e:     # anything else is a TEST bug
+                errors.append(e)
+                return
             i += 1
             time.sleep(0.05)
         if rc is not None:
@@ -82,6 +85,7 @@ def test_rolling_upgrade_under_io(tmp_path):
     finally:
         stop.set()
         t.join(timeout=30)
+    assert not errors, f"workload thread died: {errors[0]!r}"
     try:
         st = adm.status()
         assert st["health_ok"]

