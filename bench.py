@@ -37,6 +37,21 @@ import time
 
 import numpy as np
 
+# persistent XLA compilation cache (same dir the test harness uses):
+# a fresh bench process otherwise re-compiles every executable through
+# the driver tunnel at seconds each, which both slows the run and
+# muddies warm-phase timing
+try:
+    import jax as _jax
+    _jax.config.update(
+        "jax_compilation_cache_dir",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     ".jax_cache"))
+    _jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                       0.5)
+except Exception:
+    pass
+
 
 def _chained_xor_time(masks, words, iters_pair=(64, 576), reps=3):
     """Marginal seconds per masked-XOR dispatch: the output's first word
@@ -587,7 +602,16 @@ def _cluster_system_phases(sim, k, m, obj_bytes, batch_n, rounds):
         sync_staged()
         return st, time.perf_counter() - t0, n_objs, rS
 
-    kill_round("warm")
+    _, _, n_warm, _ = kill_round("warm")
+    # the warm objects exist only to warm executables: drop them so
+    # the timed round's sweep sees ONE uniform fresh batch (their
+    # recovered shards live in rebuilt buffers whose mixed
+    # compositions would push the timed round onto one-off compiles)
+    for i in range(n_warm):
+        try:
+            sim.delete(1, f"rv-warm-{i}")
+        except (IOError, KeyError):
+            pass
     stats, rec_s, n_rec, rS = kill_round("timed")
     objs = len([1 for (pid, _) in sim.objects if pid == 1])
     shard_bytes = rS * (1 << 20)     # per recovery-object shard bytes
@@ -717,8 +741,10 @@ def bench_process_cluster(k=8, m=3, obj_bytes=256 << 20, batch_n=16,
                  for kk in fl_keys}
         t_rb = time.perf_counter() - t0
         fl_bytes = sum(len(b) for b in blobs.values())
-        t0 = time.perf_counter()
-        for kk, data in blobs.items():
+        import concurrent.futures as cf
+
+        def _push(item):
+            kk, data = item
             _, pg, nm, shard = kk
             up = rc._up(pool, pg)
             tgt = up[shard] if shard < len(up) else -1
@@ -727,6 +753,9 @@ def bench_process_cluster(k=8, m=3, obj_bytes=256 << 20, batch_n=16,
                     "cmd": "put_shard", "coll": [1, pg],
                     "oid": f"{shard}:{nm}", "data": data,
                     "attrs": rc._staged_attrs.get(kk, {})})
+        t0 = time.perf_counter()
+        with cf.ThreadPoolExecutor(max_workers=8) as ex:
+            list(ex.map(_push, blobs.items()))
         t_sock = time.perf_counter() - t0
         out["flush_readback_gbps"] = round(
             fl_bytes / max(t_rb, 1e-9) / 1e9, 3)
@@ -830,6 +859,9 @@ def main():
             bench_ec_decode(codec, data), 3)
     except Exception as e:
         print(f"# decode bench failed: {e}", file=sys.stderr)
+    # the kernel benches' GiB-scale operands must not stay referenced
+    # through main's frame while the cluster phases allocate
+    del codec, data
     try:
         # runs EARLY with clean HBM: the mapper sections below leave
         # deferred-freed buffers the tunnel reclaims slowly

@@ -1399,10 +1399,16 @@ class RemoteCluster:
         daemon (the WAL flush half of put_many_from_device).  A shard
         whose target is unreachable or homeless STAYS dirty — the
         device copy remains authoritative and a later flush (after
-        the map re-homes it) retries; returns the count flushed."""
+        the map re-homes it) retries; returns the count flushed.
+
+        Fan-out: up to 8 worker threads push shards concurrently;
+        each WireClient serializes its own socket, so the effective
+        socket parallelism is min(8, distinct targets) and same-target
+        shards queue on that connection's lock."""
+        import concurrent.futures as cf
         import zlib
         pool = self.osdmap.pools[pool_id]
-        n = 0
+        work = []
         for key, ref in self.dev.dirty_items():
             pid, pg, name, shard = key
             if pid != pool_id:
@@ -1411,6 +1417,12 @@ class RemoteCluster:
             tgt = up[shard] if shard < len(up) else ITEM_NONE
             if tgt == ITEM_NONE:
                 continue
+            work.append((key, ref, pg, name, shard, tgt))
+        if not work:
+            return 0
+
+        def one(item):
+            key, ref, pg, name, shard, tgt = item
             data = np.asarray(ref).tobytes()
             attrs = self._staged_attrs.get(key, {})
             try:
@@ -1419,10 +1431,15 @@ class RemoteCluster:
                                     "oid": f"{shard}:{name}",
                                     "data": data, "attrs": attrs})
             except (OSError, IOError):
-                continue              # stays dirty; retried next flush
+                return 0          # stays dirty; retried next flush
             self.dev.mark_clean(key, zlib.crc32(data))
-            n += 1
-        return n
+            return 1
+
+        if len(work) == 1:
+            return one(work[0])
+        with cf.ThreadPoolExecutor(
+                max_workers=min(8, len(work))) as ex:
+            return sum(ex.map(one, work))
 
     def get_many_to_device(self, pool_id: int, names: List[str]):
         """Batched EC read returning each object's [S, k, W] device

@@ -40,6 +40,10 @@ from .pglog import OP_DELETE, PGLog, Version, ZERO
 
 ShardKey = Tuple[int, int, str, int]   # (pool, pg, object, shard)
 
+# HBM budget for one recovery window-gather ([G, S, k+m, U] chunks of
+# the rebuild sweep materialize at most this many bytes each)
+REBUILD_GATHER_BUDGET = 1 << 30
+
 
 class _StoreView:
     """Dict-style view of a SimOSD's shards (test/debug surface):
@@ -1617,9 +1621,6 @@ class ClusterSim:
         contribute); the full-width bit-matrix for each object's
         signature positions the recovery matrix at its available
         chunks' plane columns, zero-padded to m erased rows."""
-        import jax.numpy as jnp
-        from ..ops import gf, gf2, xor_kernel
-        from .device_store import ShardRef, assemble_windows
         n = k + mm
         # flatten the signature groups, then regroup by (stripe count,
         # canonical buffer composition, W); members whose refs do not
@@ -1683,70 +1684,33 @@ class ClusterSim:
                 subs.setdefault(key, []).append(
                     (name, up, files, n_str, pg, tuple(missing),
                      tuple(sorted(files)), by_col, anchor))
-        for (n_str, U, comp), mems in subs.items():
-            stats["batches"] += 1
+        for (n_str, U, comp), all_mems in subs.items():
             W = U // 4
             # resolve composition ids back to buffers via any member
             bufmap = {}
-            for mem in mems:
+            for mem in all_mems:
                 for c, (bid, buf, idx, _) in mem[7].items():
                     bufmap[bid] = buf
             col_bufs = [(bufmap[bid], idx) for bid, idx in comp]
-            starts = np.array([mem[8][3] for mem in mems],
-                              dtype=np.int32)
-            full = assemble_windows(col_bufs, starts, n_str)
-            # per-object full-width signature tables, one per UNIQUE
-            # signature (host-side; tiny), repeated per stripe
-            sig_tab: Dict[Tuple, np.ndarray] = {}
-            obj_masks = np.zeros((len(mems), 8 * mm, 8 * n),
-                                 dtype=np.int32)
-            for j, mem in enumerate(mems):
-                missing, avail = mem[5], mem[6]
-                sig = (missing, avail)
-                tab = sig_tab.get(sig)
-                if tab is None:
-                    R, used = codec.decode_matrix(list(avail),
-                                                  list(missing))
-                    small = gf.gf8_bitmatrix(R)
-                    big = np.zeros((8 * mm, 8 * n), dtype=np.uint8)
-                    for jj, c in enumerate(used):
-                        big[:8 * len(missing), 8 * c:8 * c + 8] = \
-                            small[:, 8 * jj:8 * jj + 8]
-                    tab = gf2.bitmatrix_masks(big)
-                    sig_tab[sig] = tab
-                obj_masks[j] = tab
-            masks = np.repeat(obj_masks, n_str, axis=0)
-            T = len(mems) * n_str
-            Tp = 1
-            while Tp < T:
-                Tp <<= 1
-            planes = full.reshape(T, 8 * n, W // 8)
-            masks_d = jnp.asarray(masks)
-            if Tp != T:        # pow2 bucket: bounded executable count
-                planes = jnp.concatenate([planes, planes[:Tp - T]])
-                masks_d = jnp.concatenate([masks_d, masks_d[:Tp - T]])
-            rebuilt = xor_kernel.xor_matmul_w32(
-                masks_d, planes)[:T].reshape(T, mm, W)
-            rebuilt_host = np.asarray(rebuilt) if eager else None
-            for j, mem in enumerate(mems):
-                name, up, files, n_str_m, pg, missing = mem[:6]
-                pos = j * n_str
-                for i, shard in enumerate(missing):
-                    tgt = up[shard] if shard < len(up) else ITEM_NONE
-                    if tgt == ITEM_NONE or not self.osds[tgt].alive:
-                        continue
-                    b = np.ascontiguousarray(
-                        rebuilt_host[pos:pos + n_str, i]
-                    ).tobytes() if eager else None
-                    self.services[tgt].put_device_recovery(
-                        (pool_id, pg, name, shard),
-                        ShardRef(rebuilt, i, axis=1, s0=pos,
-                                 s1=pos + n_str), b)
-                    stats["shards_rebuilt"] += 1
+            # bound PEAK HBM per chunk: the window stack (G*S*n*U) is
+            # joined by its pow2-pad copy (≤2x) and the rebuilt output
+            # while both are live, so the per-member price is ~3x the
+            # stack bytes — chunk members to fit the budget (chunk
+            # sizes repeat, so the executables still amortize)
+            per_mem = max(1, 3 * n_str * n * U)
+            g_cap = max(1, REBUILD_GATHER_BUDGET // per_mem)
+            g_cap = 1 << (g_cap.bit_length() - 1)     # pow2 bucket
+            chunks = [all_mems[i:i + g_cap]
+                      for i in range(0, len(all_mems), g_cap)]
+            for mems in chunks:
+                self._rebuild_chunk_dev(pool_id, codec, k, mm, n,
+                                        comp, col_bufs, mems, n_str,
+                                        U, W, eager, stats)
+
         # per-member fallback for irregular refs: pays a static-spec
         # assemble (possible compile) per shape, but the path is rare
         # and silence here would be non-repair
-        from .device_store import assemble_refs
+        from .device_store import ShardRef, assemble_refs
         for plan, missing, U, name, up, files, n_str, pg in irregular:
             stats["batches"] += 1
             sub = assemble_refs([files[c] for c in plan], n_str,
@@ -1763,6 +1727,65 @@ class ClusterSim:
                 self.services[tgt].put_device_recovery(
                     (pool_id, pg, name, shard),
                     ShardRef(rebuilt, i, axis=1), b)
+                stats["shards_rebuilt"] += 1
+
+    def _rebuild_chunk_dev(self, pool_id, codec, k, mm, n, comp,
+                           col_bufs, mems, n_str, U, W, eager,
+                           stats) -> None:
+        import jax.numpy as jnp
+        from ..ops import gf, gf2, xor_kernel
+        from .device_store import ShardRef, assemble_windows
+        stats["batches"] += 1
+        starts = np.array([mem[8][3] for mem in mems],
+                          dtype=np.int32)
+        full = assemble_windows(col_bufs, starts, n_str)
+        # per-object full-width signature tables, one per UNIQUE
+        # signature (host-side; tiny), repeated per stripe
+        sig_tab: Dict[Tuple, np.ndarray] = {}
+        obj_masks = np.zeros((len(mems), 8 * mm, 8 * n),
+                             dtype=np.int32)
+        for j, mem in enumerate(mems):
+            missing, avail = mem[5], mem[6]
+            sig = (missing, avail)
+            tab = sig_tab.get(sig)
+            if tab is None:
+                R, used = codec.decode_matrix(list(avail),
+                                              list(missing))
+                small = gf.gf8_bitmatrix(R)
+                big = np.zeros((8 * mm, 8 * n), dtype=np.uint8)
+                for jj, c in enumerate(used):
+                    big[:8 * len(missing), 8 * c:8 * c + 8] = \
+                        small[:, 8 * jj:8 * jj + 8]
+                tab = gf2.bitmatrix_masks(big)
+                sig_tab[sig] = tab
+            obj_masks[j] = tab
+        masks = np.repeat(obj_masks, n_str, axis=0)
+        T = len(mems) * n_str
+        Tp = 1
+        while Tp < T:
+            Tp <<= 1
+        planes = full.reshape(T, 8 * n, W // 8)
+        masks_d = jnp.asarray(masks)
+        if Tp != T:        # pow2 bucket: bounded executable count
+            planes = jnp.concatenate([planes, planes[:Tp - T]])
+            masks_d = jnp.concatenate([masks_d, masks_d[:Tp - T]])
+        rebuilt = xor_kernel.xor_matmul_w32(
+            masks_d, planes)[:T].reshape(T, mm, W)
+        rebuilt_host = np.asarray(rebuilt) if eager else None
+        for j, mem in enumerate(mems):
+            name, up, files, n_str_m, pg, missing = mem[:6]
+            pos = j * n_str
+            for i, shard in enumerate(missing):
+                tgt = up[shard] if shard < len(up) else ITEM_NONE
+                if tgt == ITEM_NONE or not self.osds[tgt].alive:
+                    continue
+                b = np.ascontiguousarray(
+                    rebuilt_host[pos:pos + n_str, i]
+                ).tobytes() if eager else None
+                self.services[tgt].put_device_recovery(
+                    (pool_id, pg, name, shard),
+                    ShardRef(rebuilt, i, axis=1, s0=pos,
+                             s1=pos + n_str), b)
                 stats["shards_rebuilt"] += 1
 
     def recover_delta(self, pool_id: int) -> Dict[str, int]:

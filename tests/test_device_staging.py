@@ -268,3 +268,33 @@ def test_rmw_overwrite_coherent_with_staging():
     data[8192:8192 + len(patch)] = patch
     assert sim.get(1, "obj") == bytes(data)
     sim.shutdown()
+
+
+def test_recovery_irregular_refs_fallback():
+    """Recovery over shards whose HBM staging was dropped (re-uploaded
+    axis-0 refs — the 'irregular' composition): the per-member
+    fallback path must rebuild byte-exact rather than silently skip
+    (a NameError hid here until this test)."""
+    import jax.numpy as jnp
+    import numpy as np
+    from tests.test_simulator import make_sim
+    sim = make_sim(n_hosts=20, osds_per_host=2)
+    sim.staging_flush = "staged"
+    k, U, S = 4, 1 << 16, 4
+    names = [f"ir{i}" for i in range(6)]
+    block = jnp.arange(k * (U // 4), dtype=jnp.int32
+                       ).reshape(1, k, U // 4)
+    payload = jnp.tile(block, (6 * S, 1, 1))
+    res = sim.put_many_from_device(2, names, payload)
+    sim.flush_all()
+    for o in sim.osds:
+        o.dev.clear()          # force re-upload (axis-0) refs
+    victims = sorted({o for p in res.values() for o in p})[:2]
+    for o in victims:
+        sim.kill_osd(o)
+        sim.out_osd(o)
+    st = sim.recover_all(2)
+    assert st["shards_rebuilt"] > 0, st
+    for i, nm in enumerate(names):
+        assert sim.get(2, nm) == np.asarray(
+            payload[i * S:(i + 1) * S]).tobytes(), nm
