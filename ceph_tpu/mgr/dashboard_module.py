@@ -1,11 +1,15 @@
 """Dashboard mgr module (src/pybind/mgr/dashboard role, API slice).
 
 The reference dashboard is a full web UI; its load-bearing layer is
-the REST API the UI consumes (health, OSDs, pools, usage).  This
-module serves that JSON API over HTTP — `/api/health`, `/api/osds`,
-`/api/pools`, `/api/summary` — plus a minimal index page, so the
-cluster is observable from a browser/curl without the prometheus
-scraper.
+the REST API the UI consumes.  This module serves that JSON API over
+HTTP — `/api/health`, `/api/osds`, `/api/pools`, `/api/summary`,
+`/api/pgs` (per-PG placement + degraded/undersized state rollup, the
+PG page), `/api/perf` (the live perf-counter collection, the daemon
+perf panel), `/api/crush` (the `ceph osd tree` view), `/api/config`
+(`config show` with per-option provenance) — plus a minimal index
+page, so the cluster is observable from a browser/curl without the
+prometheus scraper.  Read-only by design: mutations go through the
+mon quorum paths (`ceph` CLI / cephadm), not the dashboard.
 """
 from __future__ import annotations
 
@@ -61,6 +65,59 @@ class DashboardModule(MgrModule):
                 "n_pools": len(m.pools),
                 "mgr_modules": self.host.enabled()}
 
+    def api_pgs(self) -> dict:
+        """Per-PG placement + state rollup (the dashboard PG page /
+        `ceph pg dump` summary).  The map pipeline filters down OSDs
+        to ITEM_NONE holes, so a hole means a mapped member is
+        down/unmappable — Ceph's compound `active+undersized+degraded`
+        (fewer copies than size exist until recovery re-homes)."""
+        from ..placement.crush_map import ITEM_NONE
+        dump = self.get("pg_dump")
+        pools = {}
+        states = {"active+clean": 0, "active+undersized+degraded": 0,
+                  "down": 0}
+        for pid, d in sorted(dump.items()):
+            rows = []
+            for pg, ups in enumerate(d["up"]):
+                # positions are SHARD slots for EC pools: holes stay
+                # in place as null (like `ceph pg dump`'s NONE), so a
+                # consumer can tell WHICH shard is missing
+                ups = [int(o) for o in ups]
+                n_live = sum(1 for o in ups if o != ITEM_NONE)
+                if n_live == 0:
+                    state = "down"        # no copy mapped anywhere
+                elif n_live == len(ups):
+                    state = "active+clean"
+                else:
+                    state = "active+undersized+degraded"
+                states[state] += 1
+                rows.append({"pg": f"{pid}.{pg}",
+                             "up": [None if o == ITEM_NONE else o
+                                    for o in ups],
+                             "primary": int(d["primary"][pg]),
+                             "state": state})
+            pools[str(pid)] = rows
+        return {"states": states, "pgs": pools}
+
+    def api_perf(self) -> dict:
+        """The live perf-counter collection (`perf dump` over HTTP —
+        encode/decode dispatch+byte counters, mapper lanes, tier
+        promote/flush/evict ops, ...)."""
+        from ..common.perf_counters import perf
+        return perf().dump()
+
+    def api_crush(self) -> dict:
+        """The CRUSH hierarchy (`ceph osd tree` rows + raw text)."""
+        m = self.get("osd_map")
+        from ..placement.treedump import tree_dump
+        text = tree_dump(m.crush)
+        return {"tree": text.splitlines()}
+
+    def api_config(self) -> dict:
+        """`config show`: every option's value + provenance layer."""
+        from ..common.options import config
+        return config().dump()
+
     # -------------------------------------------------------------- http --
     def start_http(self, port: int = 0) -> int:
         mod = self
@@ -70,7 +127,11 @@ class DashboardModule(MgrModule):
                 routes = {"/api/health": mod.api_health,
                           "/api/osds": mod.api_osds,
                           "/api/pools": mod.api_pools,
-                          "/api/summary": mod.api_summary}
+                          "/api/summary": mod.api_summary,
+                          "/api/pgs": mod.api_pgs,
+                          "/api/perf": mod.api_perf,
+                          "/api/crush": mod.api_crush,
+                          "/api/config": mod.api_config}
                 path = self.path.rstrip("/") or "/"
                 if path in routes:
                     body = json.dumps(routes[path]()).encode()

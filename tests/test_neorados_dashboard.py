@@ -109,3 +109,63 @@ def test_dashboard_api():
         assert get("/api/nope")[0] == 404
     finally:
         dash.stop_http()
+
+
+def test_dashboard_pg_perf_crush_config():
+    """The r5 dashboard endpoints: PG state rollup reacts to a kill,
+    perf carries live counters, crush shows the tree, config carries
+    provenance."""
+    sim = make_sim()
+    host = MgrModuleHost(sim)
+    dashboard_module.register(host)
+    dash = host.enable("dashboard")
+    sim.put(1, "obj", b"z" * 500)
+    port = dash.start_http()
+    try:
+        def get(path):
+            c = http.client.HTTPConnection("127.0.0.1", port,
+                                           timeout=10)
+            c.request("GET", path)
+            r = c.getresponse()
+            body = r.read()
+            c.close()
+            return r.status, json.loads(body)
+        st, pgs = get("/api/pgs")
+        assert st == 200
+        total = sum(pgs["states"].values())
+        assert total == sum(len(v) for v in pgs["pgs"].values())
+        assert pgs["states"]["active+clean"] == total
+        # a killed OSD leaves holes in every PG mapping it: the map
+        # pipeline reports them as undersized+degraded
+        sim.kill_osd(0)
+        _, pgs2 = get("/api/pgs")
+        assert pgs2["states"]["active+undersized+degraded"] > 0
+        # EC shard positions survive as nulls so the missing SHARD is
+        # identifiable (ceph pg dump keeps NONE in place)
+        assert any(r["state"] == "active+undersized+degraded" and
+                   None in r["up"]
+                   for rows in pgs2["pgs"].values() for r in rows)
+        sim.revive_osd(0)
+        # every OSD down -> PGs report DOWN, not active-anything
+        for o in range(len(sim.osds)):
+            sim.osdmap.mark_down(o)
+        _, pgs3 = get("/api/pgs")
+        assert pgs3["states"]["down"] > 0
+        assert pgs3["states"]["active+clean"] == 0
+        for o in range(len(sim.osds)):
+            sim.osdmap.osd_up[o] = True
+        sim.osdmap.bump_epoch()
+        st, perf = get("/api/perf")
+        assert st == 200 and isinstance(perf, dict) and perf
+        st, crush = get("/api/crush")
+        assert st == 200 and any("host" in ln for ln in crush["tree"])
+        st, cfg = get("/api/config")
+        assert st == 200
+        assert "erasure_code_default_layout" in cfg
+        assert cfg["erasure_code_default_layout"]["value"] == \
+            "bitsliced"
+        assert "source" in cfg["erasure_code_default_layout"] or \
+            any("default" in str(v).lower()
+                for v in cfg["erasure_code_default_layout"].values())
+    finally:
+        dash.stop_http()
