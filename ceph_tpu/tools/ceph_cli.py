@@ -114,6 +114,57 @@ def cmd_osd_in(rc, osd: int, out) -> int:
     return 0
 
 
+def _pool_id(rc, name_or_id: str) -> int:
+    # name match FIRST across every pool, numeric id only as a
+    # fallback — a pool literally named "2" must win over pool id 2
+    for pid, p in rc.osdmap.pools.items():
+        if p.name == name_or_id:
+            return pid
+    for pid in rc.osdmap.pools:
+        if str(pid) == name_or_id:
+            return pid
+    raise ValueError(f"no pool {name_or_id!r}")
+
+
+def cmd_tier_add(rc, base: str, cache: str, out) -> int:
+    rc.tier_add(_pool_id(rc, base), _pool_id(rc, cache))
+    out.write(f"pool '{cache}' is now (and will remain) a tier of "
+              f"'{base}'\n")
+    return 0
+
+
+def cmd_tier_remove(rc, base: str, cache: str, out) -> int:
+    try:
+        rc.tier_remove(_pool_id(rc, base), _pool_id(rc, cache))
+    except IOError as e:
+        out.write(f"Error: {e} (run `osd tier agent {base} 0` to "
+                  f"flush+evict everything)\n")
+        return 1
+    out.write(f"pool '{cache}' is no longer a tier of '{base}'\n")
+    return 0
+
+
+def cmd_tier_agent(rc, base: str, target: Optional[str],
+                   out) -> int:
+    """One agent pass: flush dirty; with TARGET, also evict clean
+    objects down to that count (0 = drain the cache completely)."""
+    b = _pool_id(rc, base)
+    if target is None:
+        st = rc.tier_agent_work(b)
+    else:
+        st = rc.tier_agent_work(b, target_objects=int(target))
+        if int(target) == 0:
+            # target 0 means DRAIN: tier_agent_work's evictor keeps
+            # `target` objects, so finish by evicting the remainder
+            cache_id = rc.osdmap.pools[b].read_tier
+            for nm in rc.list_objects(cache_id):
+                rc.tier_evict(b, nm)
+                st["evicted"] += 1
+    out.write(f"tier agent on '{base}': flushed {st['flushed']}, "
+              f"evicted {st['evicted']}\n")
+    return 0
+
+
 def cmd_pool_create(rc, name: str, pg_num: int, ptype: str,
                     size: int, out) -> int:
     from ..cluster.osdmap import POOL_ERASURE, POOL_REPLICATED
@@ -191,6 +242,8 @@ def main(argv: Optional[List[str]] = None,
     ap.add_argument("words", nargs="+",
                     help="command, e.g.: status | health | mon stat | "
                          "osd tree | osd out N | osd pool ls | "
+                         "osd tier add|remove BASE CACHE | "
+                         "osd tier agent BASE [TARGET] | "
                          "pg dump POOL | df | scrub POOL")
     ns = ap.parse_args(argv)
     rc = _client(ns.dir)
@@ -233,6 +286,13 @@ def _dispatch(ap, ns, rc, out) -> int:
                                ns.size, out)
     if w[:3] == ["osd", "pool", "rm"]:
         return cmd_pool_rm(rc, arg(3), out)
+    if w[:3] == ["osd", "tier", "add"]:
+        return cmd_tier_add(rc, arg(3), arg(4), out)
+    if w[:3] == ["osd", "tier", "remove"]:
+        return cmd_tier_remove(rc, arg(3), arg(4), out)
+    if w[:3] == ["osd", "tier", "agent"]:
+        return cmd_tier_agent(rc, arg(3),
+                              w[4] if len(w) > 4 else None, out)
     if w[:2] == ["pg", "dump"]:
         return cmd_pg_dump(rc, int(arg(2)), out)
     if w[0] == "df":

@@ -179,6 +179,9 @@ def main(argv=None) -> int:
     ap.add_argument("--osds", type=int, default=6)
     ap.add_argument("action", choices=["start", "stop", "status"])
     args = ap.parse_args(argv)
+    # daemons spawn with the repo as cwd: a relative dir from the
+    # operator's shell must resolve from HERE, not from there
+    args.dir = os.path.abspath(args.dir)
     if args.action == "start":
         if not os.path.exists(os.path.join(args.dir, "cluster.json")):
             build_cluster_dir(args.dir, n_osds=args.osds)

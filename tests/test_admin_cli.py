@@ -124,3 +124,32 @@ def test_delete_is_logged_no_resurrection(tmp_path):
         rc.close()
     finally:
         v.stop()
+
+
+def test_ceph_osd_tier_cli(cluster):
+    """`ceph osd tier add/agent/remove` against the live cluster:
+    the r5 cache-tiering op paths from the operator's shell."""
+    rc, _ = run_ceph(cluster, "osd", "pool", "create", "tbase", "8")
+    assert rc == 0
+    rc, _ = run_ceph(cluster, "osd", "pool", "create", "tcache", "8")
+    assert rc == 0
+    rc, txt = run_ceph(cluster, "osd", "tier", "add", "tbase",
+                       "tcache")
+    assert rc == 0 and "tier of 'tbase'" in txt
+    # a write routes into the cache; drain refusal then agent+evict
+    rc2, _ = run_rados(cluster, "tbase", "put", "obj", "-",
+                       data_in=b"tiered-bytes")
+    assert rc2 == 0
+    rc2, txt = run_ceph(cluster, "osd", "tier", "remove", "tbase",
+                        "tcache")
+    assert rc2 == 1 and "drain first" in txt
+    # agent with target 0 DRAINS: flush + evict everything, so the
+    # whole operator flow completes from the CLI alone
+    rc2, txt = run_ceph(cluster, "osd", "tier", "agent", "tbase", "0")
+    assert rc2 == 0 and "flushed 1" in txt and "evicted 1" in txt
+    rc2, txt = run_ceph(cluster, "osd", "tier", "remove", "tbase",
+                        "tcache")
+    assert rc2 == 0 and "no longer a tier" in txt
+    # the flushed copy serves from the base after unwiring
+    rc2, txt = run_rados(cluster, "tbase", "get", "obj", "-")
+    assert rc2 == 0 and "tiered-bytes" in txt
