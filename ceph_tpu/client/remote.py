@@ -27,6 +27,7 @@ from typing import Dict, List, Optional
 import numpy as np
 
 from ..common import auth as cx
+from ..common.op_tracker import tracker as _op_tracker
 from ..cluster.daemon import WireClient
 from ..cluster.osdmap import OSDMap, PGPool, POOL_ERASURE
 from ..ec import instance as ec_registry
@@ -58,9 +59,51 @@ class RemoteCluster:
         self._dev = None            # lazy DeviceShardCache
         self._staged_attrs: Dict = {}
         self._tier_reads: Dict = {}   # client-local warmth counters
+        self._admin = None          # opt-in objecter.asok (serve_admin)
+        self._admin_path: Optional[str] = None
         import threading
         self._client_lock = threading.Lock()
         self.refresh_map()
+
+    def serve_admin(self, name: str = "objecter") -> str:
+        """Opt-in client admin socket (`<dir>/<name>.asok`): a
+        long-running client process (the TPU host) exposes its own
+        tracked-op and perf-dump surfaces so `ceph daemon objecter
+        dump_historic_ops | perf dump` works, matching the reference's
+        client asok workflow.  Idempotent for the same name; a second
+        call with a different name raises rather than returning a path
+        that was never served."""
+        from ..common.admin import AdminServer
+        path = os.path.join(self.dir, f"{name}.asok")
+        if self._admin is not None:
+            if path != self._admin_path:
+                raise RuntimeError(
+                    f"already serving {self._admin_path}")
+            return self._admin_path
+        srv = AdminServer()
+        srv.serve(path)          # a failed bind leaves us retryable
+        self._admin = srv
+        self._admin_path = path
+        return path
+
+    def _tracked(self, optype: str, pool_id: int, name: str, fn):
+        """Wrap one top-level client op with an OpTracker record.
+        Nested calls (tier routing recursion) ride the parent's
+        record instead of opening their own."""
+        tr = _op_tracker()
+        if tr.current() is not None:
+            return fn()
+        top = tr.create(optype, service="objecter", pool=pool_id,
+                        obj=name)
+        error = None
+        try:
+            with tr.track(top):
+                return fn()
+        except BaseException as e:
+            error = type(e).__name__
+            raise
+        finally:
+            tr.finish(top, error=error)
 
     # ---------------------------------------------------------------- mon --
     def _mon_socks(self) -> List[str]:
@@ -537,6 +580,11 @@ class RemoteCluster:
     # ----------------------------------------------------------------- IO --
     def put(self, pool_id: int, name: str, data: bytes) -> int:
         """Returns the number of shard/replica writes acknowledged."""
+        return self._tracked("put", pool_id, name,
+                             lambda: self._put_routed(pool_id, name,
+                                                      data))
+
+    def _put_routed(self, pool_id: int, name: str, data: bytes) -> int:
         pool = self.osdmap.pools[pool_id]
         if pool.write_tier >= 0 and "@" not in name:
             # writeback cache routing (the Objecter consults the
@@ -684,6 +732,12 @@ class RemoteCluster:
         (COPY_FROM base -> cache, executed by the cache primary
         daemon — PrimaryLogPG::promote_object, :3932) and then serves
         the promoted copy."""
+        return self._tracked("get", pool_id, name,
+                             lambda: self._get_routed(pool_id, name,
+                                                      size))
+
+    def _get_routed(self, pool_id: int, name: str,
+                    size: Optional[int] = None) -> bytes:
         pool = self.osdmap.pools[pool_id]
         if pool.read_tier >= 0 and "@" not in name:
             try:
@@ -1538,6 +1592,10 @@ class RemoteCluster:
             c.close()
         if self.mon is not None:
             self.mon.close()
+        if self._admin is not None:
+            self._admin.close()
+            self._admin = None
+            self._admin_path = None
 
 
 class WireShardIO:

@@ -37,6 +37,8 @@ import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from ..common import auth as cx
+from ..common.admin import AdminServer
+from ..common.op_tracker import mark_active, tracker as _op_tracker
 from ..msg import encoding
 from ..msg.queue import Envelope
 from ..msg import wire
@@ -345,6 +347,12 @@ class MonDaemon:
             secret_mode_keyring=self.keyring,
             inject_socket_failures=int(
                 spec.get("ms_inject_socket_failures", 0)))
+        # per-daemon admin socket (`ceph daemon mon.N ...` — the
+        # AdminSocket surface: perf dump, config, tracked-op dumps)
+        self.admin = AdminServer()
+        self.admin.serve(os.path.join(
+            cluster_dir, "mon.asok" if self.n_mons == 1
+            else f"mon.{rank}.asok"))
         if self.n_mons > 1 and rank == 0:
             # back-compat alias: clients that only know "mon.sock"
             # reach rank 0 through a symlink
@@ -796,6 +804,14 @@ class OSDDaemon:
             self.entity, self.keyring, self._handle,
             inject_socket_failures=int(
                 spec.get("ms_inject_socket_failures", 0)))
+        # per-daemon admin socket (`ceph daemon osd.N dump_historic_ops
+        # | perf dump | ...` — each OSD process owns its tracker state;
+        # instantiate the tracker eagerly so its perf group and dump
+        # surfaces exist before the first tracked op arrives)
+        _op_tracker()
+        self.admin = AdminServer()
+        self.admin.serve(os.path.join(cluster_dir,
+                                      f"osd.{osd_id}.asok"))
         self._hb_misses: Dict[int, int] = {}
 
     # ----------------------------------------------------------- mon I/O --
@@ -888,6 +904,7 @@ class OSDDaemon:
         with self._sched_lock:
             self.sched.enqueue(op, klass=klass)
             _, fn = self.sched.dequeue()
+        mark_active("dispatched_device", osd=self.id, klass=klass)
         return fn()
 
     def _check_pool_live(self, coll) -> None:
@@ -905,7 +922,34 @@ class OSDDaemon:
                             for p in self._map.get("pools", [])}:
             raise IOError(f"pool {pid} does not exist (deleted)")
 
+    # wire data-path commands that get a TrackedOp (control traffic —
+    # maps, watches, pg queries — stays untracked: high-rate, never the
+    # ops an operator hunts with dump_historic_ops)
+    _TRACKED_CMDS = frozenset((
+        "put_shard", "get_shard", "delete_shard", "setattr_shard",
+        "getattr_shard", "stat_shard", "digest_shard", "copy_from",
+        "put_object", "delete_object", "exec_cls"))
+
     def _handle(self, entity: str, req: Dict[str, Any]) -> Any:
+        cmd = req["cmd"]
+        if cmd not in self._TRACKED_CMDS:
+            return self._handle_inner(entity, req)
+        tr = _op_tracker()
+        top = tr.create(cmd, service=self.entity, client=entity,
+                        oid=req.get("oid"))
+        top.mark_event("reached_osd", osd=self.id,
+                       klass=req.get("klass", "client"))
+        error = None
+        try:
+            with tr.track(top):
+                return self._handle_inner(entity, req)
+        except BaseException as e:
+            error = type(e).__name__
+            raise
+        finally:
+            tr.finish(top, error=error)
+
+    def _handle_inner(self, entity: str, req: Dict[str, Any]) -> Any:
         cmd = req["cmd"]
         klass = req.get("klass", "client")
         if cmd in ("put_shard", "put_object", "delete_object",

@@ -383,6 +383,21 @@ class Monitor:
                 "PG_DEGRADED", "HEALTH_WARN",
                 f"{degraded} pgs with unfilled slots, "
                 f"{stale} pgs with stale replicas"))
+        # SLOW_OPS (the HealthMonitor "N slow ops" rollup): ops
+        # currently blocked past op_tracker_complaint_time plus
+        # recently completed slow ops, attributed per daemon from this
+        # process's tracker — which sees everything in the in-process
+        # sim; daemonized OSDs expose theirs via the per-daemon asok
+        # (dump_historic_slow_ops), not yet reported up to the mon
+        from ..common.op_tracker import tracker as _op_tracker
+        slow = _op_tracker().slow_ops_summary()
+        if slow["num"]:
+            daemons = ",".join(slow["daemons"]) or "unknown"
+            checks.append(HealthCheck(
+                "SLOW_OPS", "HEALTH_WARN",
+                f"{slow['num']} slow ops, oldest one blocked for "
+                f"{slow['oldest_s']:.3f} sec, daemons [{daemons}] "
+                f"have slow ops"))
         return checks
 
     def health_status(self, sim=None) -> str:

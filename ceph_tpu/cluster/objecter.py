@@ -17,6 +17,7 @@ import copy
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Tuple
 
+from ..common.op_tracker import tracker as _op_tracker
 from ..common.perf_counters import perf as _perf
 from ..common.tracer import tracer as _tracer
 from ..placement.crush_map import ITEM_NONE
@@ -72,44 +73,69 @@ class Objecter:
         return primary is not None and self.sim.osds[primary].alive
 
     # -------------------------------------------------------------- ops --
-    def _submit(self, op, pool_id: int, name: str):
+    def _submit(self, op, pool_id: int, name: str, optype: str = "op"):
         """op_submit: compute target, send; on stale target refresh the
         map and resend (bounded).  Traced (the jspan threaded through
-        ops, src/osd/PrimaryLogPG.cc:11060 role)."""
+        ops, src/osd/PrimaryLogPG.cc:11060 role) and TRACKED: the op
+        gets a lifecycle record, active for the duration of the data-
+        path call so the OSD service / device layers tag it."""
         self._pc.inc("op_submit")
-        with _tracer().start_span("objecter.op", pool=pool_id,
-                                  obj=name) as span:
-            for attempt in range(self.max_retries):
-                if self._target_current(pool_id, name):
-                    try:
-                        result = op()
-                        span.set_tag("attempts", attempt + 1)
-                        return result
-                    except IOError:
-                        self._pc.inc("op_eio_retries")
-                else:
-                    self._pc.inc("op_resends")
-                got = self.maybe_update_map()
-                if not got and attempt:
-                    # nothing new from the mon and still failing
-                    span.set_tag("error", "no_usable_target")
-                    raise TooManyRetries(
-                        f"{name}: no usable target at epoch "
-                        f"{self.osdmap.epoch}")
-            span.set_tag("error", "retries_exhausted")
-            raise TooManyRetries(f"{name}: gave up after "
-                                 f"{self.max_retries} resends")
+        tr = _op_tracker()
+        top = tr.create(optype, service="objecter", pool=pool_id,
+                        obj=name)
+        error = None
+        try:
+            with _tracer().start_span("objecter.op", pool=pool_id,
+                                      obj=name) as span:
+                for attempt in range(self.max_retries):
+                    if self._target_current(pool_id, name):
+                        try:
+                            with tr.track(top):
+                                result = op()
+                            span.set_tag("attempts", attempt + 1)
+                            return result
+                        except IOError:
+                            self._pc.inc("op_eio_retries")
+                            top.mark_event("eio_retry", attempt=attempt)
+                    else:
+                        self._pc.inc("op_resends")
+                        top.mark_event("resend",
+                                       epoch=self.osdmap.epoch)
+                    got = self.maybe_update_map()
+                    if got:
+                        # map-wait stall resolved: new epochs arrived
+                        top.mark_event("map_update", epochs=got,
+                                       epoch=self.osdmap.epoch)
+                    if not got and attempt:
+                        # nothing new from the mon and still failing
+                        span.set_tag("error", "no_usable_target")
+                        error = "no_usable_target"
+                        raise TooManyRetries(
+                            f"{name}: no usable target at epoch "
+                            f"{self.osdmap.epoch}")
+                span.set_tag("error", "retries_exhausted")
+                error = "retries_exhausted"
+                raise TooManyRetries(f"{name}: gave up after "
+                                     f"{self.max_retries} resends")
+        except BaseException as e:
+            if error is None:
+                error = type(e).__name__
+            raise
+        finally:
+            tr.finish(top, error=error)
 
     def put(self, pool_id: int, name: str, data: bytes) -> List[int]:
         return self._submit(
-            lambda: self.sim.put(pool_id, name, data), pool_id, name)
+            lambda: self.sim.put(pool_id, name, data), pool_id, name,
+            optype="put")
 
     def get(self, pool_id: int, name: str) -> bytes:
         return self._submit(
-            lambda: self.sim.get(pool_id, name), pool_id, name)
+            lambda: self.sim.get(pool_id, name), pool_id, name,
+            optype="get")
 
     def write(self, pool_id: int, name: str, offset: int,
               data: bytes) -> List[int]:
         return self._submit(
             lambda: self.sim.write(pool_id, name, offset, data),
-            pool_id, name)
+            pool_id, name, optype="write")

@@ -18,10 +18,13 @@ from __future__ import annotations
 
 import itertools
 import threading
+import time
 from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from ..common.op_tracker import tracker as _op_tracker
+from ..common.perf_counters import perf as _perf
 from ..msg import encoding
 from ..msg.dispatcher import BatchingDispatcher
 from ..msg.queue import Envelope, MessageQueue, QueueClosed, QueueFull
@@ -50,19 +53,33 @@ class OSDService:
         # segment" of a real messenger frame — device payloads never
         # serialize through the wire path in-process)
         self._op_objs: Dict[int, Any] = {}
+        # dispatch-latency histogram + slow-op test hook: one shared
+        # "osd.service" group (per-OSD families would explode the
+        # exporter); per-OSD attribution rides the tracked-op events
+        self._pc = _perf("osd.service")
+        # test hook: seconds to sleep inside _execute (models a stalled
+        # device dispatch; drives the SLOW_OPS acceptance path)
+        self.inject_execute_delay = 0.0
         self.dispatcher = BatchingDispatcher(
             self.in_q, self._handle, linger=0.0,
             name=f"osd.{osd.id}").start()
 
     # ------------------------------------------------------- server side --
     def _handle(self, batch: List[Envelope]) -> None:
-        # fast dispatch: envelopes land in the QoS scheduler first
+        # fast dispatch: envelopes land in the QoS scheduler first.
+        # batch occupancy is THE feed-the-MXU knob, so it lands on every
+        # tracked op in the batch (dispatcher thread -> mark by id)
+        trk = _op_tracker()
+        depth = self.in_q.stats()["depth"]
         for env in batch:
             op = encoding.loads(env.payload)
             with self._lock:
                 obj = self._op_objs.pop(env.id, None)
             if obj is not None:
                 op["_obj"] = obj
+            trk.mark(op.get("track_id"), "reached_osd",
+                     osd=self.osd.id, batch_occupancy=len(batch),
+                     queue_depth=depth)
             self.sched.enqueue((env.id, op),
                                klass=op.get("klass", CLASS_CLIENT))
         # dequeue_op in scheduler order
@@ -83,6 +100,19 @@ class OSDService:
                 ev.set()
 
     def _execute(self, op: Dict[str, Any]):
+        _op_tracker().mark(op.get("track_id"), "dispatched_device",
+                           osd=self.osd.id, kind=op["kind"])
+        t0 = time.perf_counter()
+        try:
+            if self.inject_execute_delay > 0:
+                time.sleep(self.inject_execute_delay)
+            return self._execute_inner(op)
+        finally:
+            # device-dispatch latency distribution (the encode/store
+            # stage averages hide; acceptance histogram family)
+            self._pc.hinc("dispatch_s", time.perf_counter() - t0)
+
+    def _execute_inner(self, op: Dict[str, Any]):
         kind = op["kind"]
         key: ShardKey = tuple(op["key"])   # typed encoding lists it
         if kind == "put":
@@ -112,6 +142,13 @@ class OSDService:
             self._events[op_id] = ev
             if obj is not None:
                 self._op_objs[op_id] = obj
+        top = _op_tracker().current()
+        if top is not None:
+            # ride the tracked-op id on the control frame so the
+            # dispatcher thread can mark events on the same record
+            op = dict(op, track_id=top.op_id)
+            top.mark_event("queued", osd=self.osd.id,
+                           queue_depth=self.in_q.stats()["depth"])
         payload = encoding.dumps(op)
         try:
             self.in_q.push(Envelope(MSG_OSD_OP, op_id, -1, payload),

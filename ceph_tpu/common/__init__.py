@@ -1,7 +1,11 @@
 from .admin import AdminServer, admin_request
+from .op_tracker import OpTracker, TrackedOp, tracker
 from .options import Option, OptionError, Options, config
-from .perf_counters import PerfCounters, PerfCountersCollection, perf
+from .perf_counters import (PerfCounters, PerfCountersCollection,
+                            PerfHistogram, perf)
 
 __all__ = ["AdminServer", "admin_request",
+           "OpTracker", "TrackedOp", "tracker",
            "Option", "OptionError", "Options", "config",
-           "PerfCounters", "PerfCountersCollection", "perf"]
+           "PerfCounters", "PerfCountersCollection", "PerfHistogram",
+           "perf"]

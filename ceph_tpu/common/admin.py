@@ -41,6 +41,13 @@ class AdminServer:
         self.register("trace dump", lambda a: tracer().dump())
         self.register("trace reset",
                       lambda a: (tracer().reset(), {"success": True})[1])
+        from .op_tracker import tracker
+        self.register("dump_ops_in_flight",
+                      lambda a: tracker().dump_ops_in_flight())
+        self.register("dump_historic_ops",
+                      lambda a: tracker().dump_historic_ops())
+        self.register("dump_historic_slow_ops",
+                      lambda a: tracker().dump_historic_slow_ops())
         self.register("help", lambda a: sorted(self._handlers))
 
     @staticmethod

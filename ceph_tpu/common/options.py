@@ -280,6 +280,21 @@ _TABLE: Tuple[Option, ...] = (
            "reference: bluestore_compression_algorithm)"),
     Option("perf_counters_enabled", TYPE_BOOL, True,
            "collect dispatch/cache/bytes counters"),
+    Option("op_tracker_enabled", TYPE_BOOL, True,
+           "track per-op lifecycle events (objecter -> OSD queue -> "
+           "device dispatch; reference: osd_enable_op_tracker)"),
+    Option("op_tracker_complaint_time", TYPE_FLOAT, 30.0,
+           "seconds before an op counts as slow (reference: "
+           "osd_op_complaint_time)", min=0.0),
+    Option("op_tracker_history_size", TYPE_INT, 100,
+           "completed ops kept for dump_historic_ops (reference: "
+           "osd_op_history_size)", min=1),
+    Option("op_tracker_history_slow_size", TYPE_INT, 20,
+           "slow ops kept for dump_historic_slow_ops (reference: "
+           "osd_op_history_slow_op_size)", min=1),
+    Option("op_tracker_max_inflight", TYPE_INT, 1024,
+           "bound on the in-flight tracking table; ops past it run "
+           "untracked (counted as op_tracker.ops_untracked)", min=1),
 )
 
 _config: Optional[Options] = None

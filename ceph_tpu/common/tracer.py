@@ -26,7 +26,9 @@ class Span:
     span_id: int
     parent_id: Optional[int]
     name: str
-    start: float
+    start: float                 # perf_counter (duration arithmetic)
+    ts: float = 0.0              # wall clock at start: correlates spans
+    #                              with log lines and tracked-op events
     end: Optional[float] = None
     tags: Dict[str, Any] = field(default_factory=dict)
 
@@ -61,7 +63,8 @@ class Tracer:
             trace_id=parent.trace_id if parent else next(_ids),
             span_id=next(_ids),
             parent_id=parent.span_id if parent else None,
-            name=name, start=time.perf_counter(), tags=dict(tags))
+            name=name, start=time.perf_counter(), ts=time.time(),
+            tags=dict(tags))
         stack = getattr(self._tls, "stack", None)
         if stack is None:
             stack = self._tls.stack = []
@@ -83,6 +86,7 @@ class Tracer:
         return [{
             "trace_id": s.trace_id, "span_id": s.span_id,
             "parent_id": s.parent_id, "name": s.name,
+            "ts": round(s.ts, 6),
             "duration_s": round(s.duration or 0.0, 9), "tags": s.tags,
         } for s in spans]
 

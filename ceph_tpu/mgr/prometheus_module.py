@@ -13,12 +13,20 @@ import http.server
 import threading
 from typing import List, Optional
 
-from ..common.perf_counters import perf as _perf
+from ..common.perf_counters import (COUNTER, GAUGE, HISTOGRAM, TIME_AVG,
+                                    perf as _perf)
 from .module_host import MgrModule
 
 
 def _esc(v: str) -> str:
-    return v.replace("\\", "\\\\").replace('"', '\\"')
+    return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _fmt_le(bound) -> str:
+    """Prometheus le label: trim float noise, keep +Inf literal."""
+    if isinstance(bound, str):
+        return bound
+    return repr(float(bound))
 
 
 class PrometheusModule(MgrModule):
@@ -70,16 +78,48 @@ class PrometheusModule(MgrModule):
         metric("ceph_health_status",
                "0=HEALTH_OK 1=HEALTH_WARN 2=HEALTH_ERR", "gauge",
                [({}, 1 if n_down else 0)])
-        # process perf counters (the exporter's daemon-perf families)
-        for group, counters in sorted(_perf().dump().items()):
-            for cname, value in sorted(counters.items()):
-                if not isinstance(value, (int, float)):
-                    continue
+        # process perf counters (the exporter's daemon-perf families),
+        # rendered by DECLARED type: counters stay counters, gauges
+        # gauges, TIME_AVG surfaces its long-run average as a gauge,
+        # and histograms become full `_bucket`/`_sum`/`_count` families
+        # (cumulative buckets; the +Inf bucket equals `_count`)
+        for group, counters in sorted(_perf().dump_typed().items()):
+            for cname, (typ, value) in sorted(counters.items()):
                 safe = f"ceph_tpu_{group}_{cname}".replace(".", "_") \
                     .replace("-", "_")
-                metric(safe, f"perf counter {group}.{cname}", "counter",
-                       [({}, value)])
+                help_ = f"perf counter {group}.{cname}"
+                if typ == HISTOGRAM:
+                    self._render_histogram(lines, safe, help_, value)
+                elif typ == TIME_AVG:
+                    metric(safe, help_ + " (long-run avg seconds)",
+                           "gauge", [({}, value["avgtime"])])
+                elif isinstance(value, (int, float)) and \
+                        not isinstance(value, bool):
+                    metric(safe, help_,
+                           "gauge" if typ == GAUGE else "counter",
+                           [({}, value)])
         return "\n".join(lines) + "\n"
+
+    @staticmethod
+    def _render_histogram(lines: List[str], name: str, help_: str,
+                          dumped) -> None:
+        """One Prometheus histogram family from a PerfHistogram dump
+        ({count, sum, buckets: [[le, n], ...]} with non-cumulative
+        counts; le ascending, '+Inf' last when populated)."""
+        lines.append(f"# HELP {name} {help_}")
+        lines.append(f"# TYPE {name} histogram")
+        cum = 0
+        saw_inf = False
+        for le, n in dumped["buckets"]:
+            cum += n
+            saw_inf = saw_inf or le == "+Inf"
+            lines.append(
+                f'{name}_bucket{{le="{_fmt_le(le)}"}} {cum}')
+        if not saw_inf:
+            lines.append(f'{name}_bucket{{le="+Inf"}} '
+                         f'{dumped["count"]}')
+        lines.append(f'{name}_sum {dumped["sum"]}')
+        lines.append(f'{name}_count {dumped["count"]}')
 
     # -------------------------------------------------------------- http --
     def start_http(self, port: int = 0) -> int:

@@ -20,12 +20,15 @@ compiled call over [batch, k, chunk_bytes] stripes.
 """
 from __future__ import annotations
 
+import collections
 import functools
+import threading
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..common.op_tracker import mark_active as _mark_active
 from . import gf
 
 
@@ -57,16 +60,46 @@ def bitplane_matmul(bitmat: jax.Array, data: jax.Array) -> jax.Array:
     return pack_bits((acc & 1).astype(jnp.uint8))
 
 
-@functools.lru_cache(maxsize=4096)
+_MATRIX_CACHE_SIZE = 4096
+
+
+@functools.lru_cache(maxsize=_MATRIX_CACHE_SIZE)
 def _bitmatrix_device(key: bytes, m: int, k: int) -> jax.Array:
     mat = np.frombuffer(key, dtype=np.uint8).reshape(m, k)
     return jnp.asarray(gf.gf8_bitmatrix(mat))
 
 
+# content keys already materialized on device: the per-call compiled/
+# cached tag must come from THIS call's key, not the global lru miss
+# counter (reading that before/after the call mis-tags ops when another
+# thread's miss lands in between).  Same capacity and per-access
+# recency update as the lru above, so eviction tracks it and a
+# re-materialized matrix is tagged compiled again.  Locked: OSD
+# dispatcher threads hit this concurrently and the compound
+# insert/move/evict is not atomic under the GIL.
+_seen_matrices: collections.OrderedDict = collections.OrderedDict()
+_seen_lock = threading.Lock()
+
+
 def matrix_to_device(A: np.ndarray) -> jax.Array:
-    """Host GF(2^8) matrix -> device bit-matrix, cached by content."""
+    """Host GF(2^8) matrix -> device bit-matrix, cached by content.
+
+    A first-seen matrix means a NEW encode/decode matrix reached the
+    device plane — the compile-vs-cached proxy tagged onto the active
+    tracked op (a fresh matrix usually also means a fresh XLA constant
+    fold)."""
     A = np.ascontiguousarray(A, dtype=np.uint8)
-    return _bitmatrix_device(A.tobytes(), *A.shape)
+    key = (A.tobytes(), A.shape)
+    with _seen_lock:
+        compiled = key not in _seen_matrices
+        _seen_matrices[key] = True
+        _seen_matrices.move_to_end(key)
+        while len(_seen_matrices) > _MATRIX_CACHE_SIZE:
+            _seen_matrices.popitem(last=False)
+    out = _bitmatrix_device(key[0], *A.shape)
+    _mark_active("dispatched_device", component="ec.gf_jax",
+                 compiled=compiled)
+    return out
 
 
 def gf8_matmul(A: np.ndarray, data) -> jax.Array:

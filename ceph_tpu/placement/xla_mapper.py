@@ -43,6 +43,7 @@ jax.config.update("jax_enable_x64", True)
 import jax.numpy as jnp  # noqa: E402
 from jax import lax  # noqa: E402
 
+from ..common.op_tracker import mark_active as _mark_active  # noqa: E402
 from ..common.options import config as _config  # noqa: E402
 from ..common.perf_counters import perf as _perf  # noqa: E402
 from ..ops import hashing  # noqa: E402
@@ -931,6 +932,11 @@ class XlaMapper:
         from ..parallel.mesh import mesh_cache_key
         key = (ruleno, result_max,
                mesh_cache_key(mesh) if mesh is not None else None)
+        # compile-vs-cached tagged onto whatever client op triggered
+        # this dispatch (a fresh executable is seconds of latency the
+        # op's latency histogram must be able to explain)
+        _mark_active("dispatched_device", component="crush.mapper",
+                     compiled=key not in self._jitted)
         if key not in self._jitted:
             inner = functools.partial(self._trace_rule, ruleno, result_max)
 
@@ -1054,6 +1060,8 @@ class XlaMapper:
                     self._fast = FastMapper(
                         self.cmap, choose_args_key=self.choose_args_key,
                         strategy=self.tables.strategy)
+                _mark_active("dispatched_device",
+                             component="crush.fastmap", lanes=len(xs))
                 with pc.time("fast_map_s"):
                     out, inc = self._fast.map_batch(
                         ruleno, xs, result_max, weights, mesh=mesh)

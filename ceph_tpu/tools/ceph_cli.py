@@ -16,6 +16,17 @@ shortcuts.
     python -m ceph_tpu.tools.ceph_cli --dir /tmp/c1 pg dump 1
     python -m ceph_tpu.tools.ceph_cli --dir /tmp/c1 df
     python -m ceph_tpu.tools.ceph_cli --dir /tmp/c1 scrub 1
+
+`daemon` subcommands talk to a single daemon's admin socket
+(`<dir>/<name>.asok`, the `ceph daemon <name> ...` workflow —
+src/ceph.in admin_socket path), not the mon.  mon/OSD daemons serve
+theirs at startup; a long-running client process opts in with
+`RemoteCluster.serve_admin()` (-> `<dir>/objecter.asok`):
+
+    ... daemon osd.0 dump_ops_in_flight
+    ... daemon osd.0 dump_historic_ops
+    ... daemon osd.0 dump_historic_slow_ops
+    ... daemon objecter perf dump
 """
 from __future__ import annotations
 
@@ -230,6 +241,39 @@ def cmd_scrub(rc, pool_id: int, out) -> int:
     return 0
 
 
+DAEMON_COMMANDS = ("dump_ops_in_flight", "dump_historic_ops",
+                   "dump_historic_slow_ops", "perf dump", "perf reset",
+                   "config show", "config get", "config set",
+                   "trace dump", "trace reset", "help")
+
+
+def cmd_daemon(cluster_dir: str, name: str, words: List[str],
+               out) -> int:
+    """`ceph daemon <osd.N|mon.N|objecter> <command...>` over the
+    daemon's admin socket (admin_socket JSON protocol, common/admin.py).
+    Multi-word admin prefixes ("perf dump") are joined; a trailing
+    KEY[=VALUE] pair becomes the request's key/value args."""
+    import os
+
+    from ..common.admin import admin_request
+    path = os.path.join(cluster_dir, f"{name}.asok")
+    if not os.path.exists(path):
+        out.write(f"Error: no admin socket for {name!r} "
+                  f"(expected {path})\n")
+        return 1
+    req = {"prefix": " ".join(words)}
+    # `config get KEY` / `config set KEY VALUE` style trailing args
+    if len(words) >= 3 and " ".join(words[:2]) in DAEMON_COMMANDS:
+        req["prefix"] = " ".join(words[:2])
+        req["key"] = words[2]
+        if len(words) >= 4:
+            req["value"] = words[3]
+    reply = admin_request(path, req)
+    out.write(json.dumps(reply.get("result", reply), indent=2,
+                         sort_keys=True, default=str) + "\n")
+    return 0 if "error" not in reply else 1
+
+
 def main(argv: Optional[List[str]] = None,
          out=None) -> int:
     out = out or sys.stdout
@@ -244,8 +288,21 @@ def main(argv: Optional[List[str]] = None,
                          "osd tree | osd out N | osd pool ls | "
                          "osd tier add|remove BASE CACHE | "
                          "osd tier agent BASE [TARGET] | "
-                         "pg dump POOL | df | scrub POOL")
+                         "pg dump POOL | df | scrub POOL | "
+                         "daemon NAME dump_ops_in_flight|"
+                         "dump_historic_ops|dump_historic_slow_ops|"
+                         "perf dump")
     ns = ap.parse_args(argv)
+    if ns.words[0] == "daemon":
+        # admin-socket path: talks to ONE daemon directly, needs no
+        # mon connection (and must work while the mon is down)
+        if len(ns.words) < 3:
+            ap.error("daemon NAME COMMAND...")
+        try:
+            return cmd_daemon(ns.dir, ns.words[1], ns.words[2:], out)
+        except (RuntimeError, ValueError, OSError) as e:
+            out.write(f"Error: {e}\n")
+            return 1
     rc = _client(ns.dir)
     try:
         return _dispatch(ap, ns, rc, out)

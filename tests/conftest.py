@@ -70,6 +70,7 @@ SLOW_TESTS = {
     "test_ceph_osd_tree_and_pools",
     "test_ceph_pg_dump",
     "test_rados_put_get_ls_rm",
+    "test_daemon_admin_socket_commands",
     "test_ceph_df_counts_objects",
     "test_delete_is_logged_no_resurrection",
     "test_workload_survives_socket_failures",
@@ -84,6 +85,9 @@ SLOW_TESTS = {
 def pytest_configure(config):
     config.addinivalue_line(
         "markers", "slow: JAX-compile-heavy test (quick tier skips)")
+    config.addinivalue_line(
+        "markers", "smoke: fast end-to-end pipeline check "
+        "(scripts/check_observability.py; `pytest -m smoke`)")
 
 
 def pytest_collection_modifyitems(config, items):

@@ -126,6 +126,61 @@ def test_delete_is_logged_no_resurrection(tmp_path):
         v.stop()
 
 
+def test_daemon_admin_socket_commands(cluster):
+    """`ceph daemon <name> dump_historic_ops | perf dump | ...` hits
+    the per-daemon admin socket (ISSUE 1: the operator workflow for
+    tracked ops; each OSD process owns its own tracker state)."""
+    import json as _json
+    run_rados(cluster, "rep", "put", "trackedobj", "-",
+              data_in=b"t" * 4096)
+    # the op landed on SOME osds; the historic rings across the
+    # cluster must hold its shard writes
+    total, inflight_shape_ok = 0, False
+    for i in range(N_OSDS):
+        rc, txt = run_ceph(cluster, "daemon", f"osd.{i}",
+                           "dump_historic_ops")
+        assert rc == 0, txt
+        dump = _json.loads(txt)
+        total += dump["num_ops"]
+        for op in dump["ops"]:
+            assert {"initiated", "reached_osd", "done"} <= \
+                {e["event"] for e in op["events"]}
+        rc, txt = run_ceph(cluster, "daemon", f"osd.{i}",
+                           "dump_ops_in_flight")
+        assert rc == 0
+        inflight_shape_ok |= "num_ops" in _json.loads(txt)
+    assert total >= 1 and inflight_shape_ok
+    rc, txt = run_ceph(cluster, "daemon", "osd.0", "perf", "dump")
+    assert rc == 0 and "op_tracker" in _json.loads(txt)
+    rc, txt = run_ceph(cluster, "daemon", "mon",
+                       "dump_historic_slow_ops")
+    assert rc == 0 and _json.loads(txt)["num_ops"] == 0
+    rc, txt = run_ceph(cluster, "daemon", "osd.0", "config", "get",
+                       "op_tracker_complaint_time")
+    assert rc == 0 and \
+        _json.loads(txt)["op_tracker_complaint_time"] == 30.0
+    # `daemon objecter ...`: a long-running client process serves its
+    # own asok; the CLI puts above ran in THIS process, so its tracker
+    # holds their client-side records
+    from ceph_tpu.client.remote import RemoteCluster
+    rcl = RemoteCluster(cluster)
+    try:
+        rcl.serve_admin()
+        rc, txt = run_ceph(cluster, "daemon", "objecter",
+                           "dump_historic_ops")
+        assert rc == 0
+        objs = [op.get("obj") for op in _json.loads(txt)["ops"]]
+        assert "trackedobj" in objs
+        rc, txt = run_ceph(cluster, "daemon", "objecter", "perf",
+                           "dump")
+        assert rc == 0 and "op_tracker" in _json.loads(txt)
+    finally:
+        rcl.close()
+    # no such daemon -> polite error, nonzero rc
+    rc, txt = run_ceph(cluster, "daemon", "osd.99", "perf", "dump")
+    assert rc == 1 and "no admin socket" in txt
+
+
 def test_ceph_osd_tier_cli(cluster):
     """`ceph osd tier add/agent/remove` against the live cluster:
     the r5 cache-tiering op paths from the operator's shell."""

@@ -1,0 +1,337 @@
+"""OpTracker lifecycle tracking + PerfHistogram + Prometheus histograms.
+
+ISSUE 1 observability: every client op carries a typed event trail
+(initiated -> queued -> reached_osd -> dispatched_device -> done)
+through the real objecter/OSD-service pipeline, slow ops land in
+bounded rings and feed the SLOW_OPS health check, and per-stage
+latencies render as Prometheus histogram families.  Reference roles:
+src/common/TrackedOp.{h,cc}, src/common/perf_histogram.h,
+src/mgr/ActivePyModules.cc slow-op reports.
+"""
+import math
+import time
+
+import pytest
+
+from ceph_tpu.cluster.monitor import Monitor
+from ceph_tpu.cluster.objecter import Objecter
+from ceph_tpu.common import AdminServer, config, perf
+from ceph_tpu.common.op_tracker import tracker
+from ceph_tpu.common.perf_counters import PerfCounters, PerfHistogram
+from ceph_tpu.common.tracer import tracer
+from ceph_tpu.mgr import MgrModuleHost, prometheus_module
+from ceph_tpu.mgr.prometheus_module import PrometheusModule, _esc
+from tests.test_snaps import make_sim
+
+
+@pytest.fixture
+def trk():
+    """Fresh global tracker state, restored afterwards (the tracker is
+    process-wide; leaked slow ops would poison later health checks)."""
+    tracker().reset()
+    yield tracker()
+    tracker().reset()
+    # restore defaults THROUGH set() so the op_tracker config cache
+    # (observer-fed) sees them; clear() alone does not notify
+    config().set("op_tracker_enabled", True)
+    config().set("op_tracker_complaint_time", 30.0)
+    config().set("op_tracker_max_inflight", 1024)
+    for key in ("op_tracker_enabled", "op_tracker_complaint_time",
+                "op_tracker_max_inflight"):
+        config().clear(key)
+
+
+# ------------------------------------------------------ PerfHistogram ---
+
+def test_histogram_bucket_boundaries():
+    h = PerfHistogram(base=1e-6, n_buckets=28)
+    assert h.bucket_index(0.0) == 0
+    assert h.bucket_index(1e-6) == 0          # le bound inclusive
+    assert h.bucket_index(2e-6) == 1          # exact power stays low
+    assert h.bucket_index(2.1e-6) == 2
+    assert h.bucket_index(1e9) == 28          # overflow bucket
+    # every bound value lands in its own bucket, one past it moves up
+    for i, b in enumerate(h.bounds()[:8]):
+        assert h.bucket_index(b) == i
+        assert h.bucket_index(b * 1.01) == i + 1
+
+
+def test_histogram_record_dump_reset():
+    h = PerfHistogram(base=1e-6, n_buckets=10)
+    for v in (1e-6, 3e-6, 3e-6, 5.0):         # 5.0 overflows 10 buckets
+        h.record(v)
+    d = h.dump()
+    assert d["count"] == 4
+    assert d["sum"] == pytest.approx(5.000007, rel=1e-6)
+    les = [le for le, _ in d["buckets"]]
+    assert les[-1] == "+Inf"                  # overflow listed last
+    assert sum(n for _, n in d["buckets"]) == 4
+    h.reset()
+    assert h.dump() == {"count": 0, "sum": 0.0, "buckets": []}
+    with pytest.raises(ValueError):
+        PerfHistogram(base=0.0)
+
+
+def test_set_refuses_to_retype_declared_counters():
+    pc = PerfCounters("t_retype")
+    pc.inc("ops")
+    pc.tinc("lat_s", 0.5)
+    pc.hinc("dist_s", 0.5)
+    for key in ("ops", "lat_s", "dist_s"):
+        with pytest.raises(ValueError):
+            pc.set(key, 7)
+    assert pc.get("ops") == 1                 # untouched by the raise
+    pc.set("depth", 3)                        # fresh gauge: fine
+    pc.set("depth", 4)                        # re-set of a gauge: fine
+    assert pc.get("depth") == 4
+    # tinc/hinc must not clobber a declared counter either (same
+    # defect class: silent retype changes the dump shape mid-scrape)
+    with pytest.raises(ValueError):
+        pc.tinc("ops", 0.5)
+    with pytest.raises(ValueError):
+        pc.hinc("ops", 0.5)
+    with pytest.raises(ValueError):
+        pc.hinc("lat_s", 0.5)
+    with pytest.raises(ValueError):
+        pc.inc("lat_s")
+    with pytest.raises(ValueError):
+        pc.inc("dist_s")
+    pc.inc("depth", -1)                       # inc on a gauge: fine
+    assert pc.get("depth") == 3
+    assert pc.get("ops") == 1
+    assert pc.type_of("lat_s") == "time_avg"
+
+
+# ------------------------------------------------------------- tracer ---
+
+def test_tracer_spans_carry_wall_clock_ts():
+    tracer().reset()
+    t0 = time.time()
+    with tracer().start_span("obs.test", k="v"):
+        pass
+    t1 = time.time()
+    span = tracer().dump()[-1]
+    assert span["name"] == "obs.test"
+    assert t0 - 1e-3 <= span["ts"] <= t1 + 1e-3
+    tracer().reset()
+
+
+# ---------------------------------------------------- tracker lifecycle ---
+
+def test_tracked_op_lifecycle_and_dumps(trk):
+    op = trk.create("put", service="objecter", pool=1, obj="o1")
+    assert op.tracked
+    inflight = trk.dump_ops_in_flight()
+    assert inflight["num_ops"] == 1
+    assert inflight["ops"][0]["obj"] == "o1"
+    assert not inflight["ops"][0]["slow"]
+    with trk.track(op):
+        assert trk.current() is op
+        op.mark_event("queued", osd=3)
+    assert trk.current() is None
+    trk.mark(op.op_id, "reached_osd", osd=3)  # cross-thread style
+    trk.mark(99999, "reached_osd")            # unknown id: dropped
+    trk.finish(op)
+    trk.finish(op)                            # double finish: no-op
+    trk.mark(op.op_id, "late")                # finished id: dropped
+    assert trk.dump_ops_in_flight()["num_ops"] == 0
+    hist = trk.dump_historic_ops()
+    assert hist["num_ops"] == 1
+    rec = hist["ops"][0]
+    assert [e["event"] for e in rec["events"]] == \
+        ["initiated", "queued", "reached_osd", "done"]
+    assert all("ts" in e and "dt_s" in e for e in rec["events"])
+    assert rec["duration_s"] >= 0
+    assert trk.dump_historic_slow_ops()["num_ops"] == 0
+
+
+def test_tracker_disabled_and_inflight_bound(trk):
+    config().set("op_tracker_enabled", False)
+    op = trk.create("put")
+    assert not op.tracked
+    op.mark_event("queued")                   # all no-ops
+    trk.finish(op)
+    assert trk.dump_historic_ops()["num_ops"] == 0
+    config().set("op_tracker_enabled", True)
+
+    config().set("op_tracker_max_inflight", 2)
+    ops = [trk.create("put", obj=f"o{i}") for i in range(3)]
+    assert [o.tracked for o in ops] == [True, True, False]
+    before = perf("op_tracker").get("ops_untracked") or 0
+    assert before >= 1
+    for o in ops:
+        trk.finish(o)
+    assert trk.dump_historic_ops()["num_ops"] == 2
+
+
+def test_history_ring_size_changes_at_runtime(trk):
+    """`config set op_tracker_history_size N` must take effect on a
+    live tracker (the rings rebuild; newest ops are kept)."""
+    for i in range(6):
+        trk.finish(trk.create("put", obj=f"r{i}"))
+    assert trk.dump_historic_ops()["num_ops"] == 6
+    config().set("op_tracker_history_size", 3)
+    try:
+        hist = trk.dump_historic_ops()
+        assert hist["size"] == 3 and hist["num_ops"] == 3
+        assert [op["obj"] for op in hist["ops"]] == ["r3", "r4", "r5"]
+        trk.finish(trk.create("put", obj="r6"))
+        assert [op["obj"] for op in trk.dump_historic_ops()["ops"]] == \
+            ["r4", "r5", "r6"]
+    finally:
+        config().set("op_tracker_history_size", 100)
+        config().clear("op_tracker_history_size")
+
+
+def test_admin_socket_dump_commands(trk):
+    srv = AdminServer()
+    open_op = trk.create("get", obj="pending")
+    done_op = trk.create("put", obj="landed")
+    trk.finish(done_op)
+    r = srv.handle({"prefix": "dump_ops_in_flight"})["result"]
+    assert r["num_ops"] == 1 and r["ops"][0]["obj"] == "pending"
+    r = srv.handle({"prefix": "dump_historic_ops"})["result"]
+    assert r["num_ops"] == 1 and r["ops"][0]["obj"] == "landed"
+    r = srv.handle({"prefix": "dump_historic_slow_ops"})["result"]
+    assert r["num_ops"] == 0 and r["complaint_time_s"] == 30.0
+    trk.finish(open_op)
+
+
+# ------------------------------------------- end-to-end slow-op path ---
+
+def test_slow_op_surfaces_everywhere(trk):
+    """Acceptance: an injected device-dispatch delay makes the op slow;
+    it must land in dump_historic_slow_ops with per-stage timestamps,
+    bump the slow-op counter, raise SLOW_OPS in Monitor.health(), and
+    the Prometheus payload must carry valid latency histograms."""
+    sim = make_sim()
+    mon = Monitor(sim.osdmap)
+    client = Objecter(sim, mon)
+    client.put(1, "warm", b"w" * 2048)        # a fast op for contrast
+    assert not any(c.code == "SLOW_OPS" for c in mon.health())
+
+    slow_before = perf("op_tracker").get("slow_ops") or 0
+    config().set("op_tracker_complaint_time", 0.01)
+    for svc in sim.services:
+        svc.inject_execute_delay = 0.02
+    try:
+        client.put(1, "laggard", b"l" * 2048)
+    finally:
+        for svc in sim.services:
+            svc.inject_execute_delay = 0.0
+
+    slow = trk.dump_historic_slow_ops()
+    assert slow["num_ops"] >= 1
+    rec = next(op for op in slow["ops"] if op.get("obj") == "laggard")
+    # first occurrence per stage: a replicated put fans out to several
+    # shards, so later shards' "queued" may interleave after an earlier
+    # shard's "reached_osd" — only the first of each stage is ordered
+    events = {}
+    for e in rec["events"]:
+        events.setdefault(e["event"], e)
+    for stage in ("initiated", "queued", "reached_osd",
+                  "dispatched_device", "done"):
+        assert stage in events, f"missing {stage}"
+        assert events[stage]["ts"] > 0
+    # per-stage ordering: timestamps are monotone along the pipeline
+    assert events["initiated"]["dt_s"] <= events["queued"]["dt_s"] \
+        <= events["reached_osd"]["dt_s"] \
+        <= events["dispatched_device"]["dt_s"] <= events["done"]["dt_s"]
+    assert events["reached_osd"]["batch_occupancy"] >= 1
+    assert rec["duration_s"] >= 0.02
+    assert (perf("op_tracker").get("slow_ops") or 0) > slow_before
+
+    checks = [c for c in mon.health() if c.code == "SLOW_OPS"]
+    assert len(checks) == 1
+    assert checks[0].severity == "HEALTH_WARN"
+    assert "osd." in checks[0].summary        # daemon attribution
+
+    host = MgrModuleHost(sim)
+    prometheus_module.register(host)
+    text = host.enable("prometheus").render()
+    for family in ("ceph_tpu_objecter_op_e2e_s",
+                   "ceph_tpu_osd_service_dispatch_s"):
+        assert f"# TYPE {family} histogram" in text
+
+
+# -------------------------------------------- Prometheus exposition ---
+
+def _bucket_samples(text, family):
+    out = []
+    for line in text.splitlines():
+        if line.startswith(f'{family}_bucket{{le="'):
+            le, value = line.split('le="', 1)[1].split('"} ')
+            out.append((le, int(value)))
+    return out
+
+
+def test_prometheus_histogram_family_is_cumulative(trk):
+    pc = perf("t_prom_hist")
+    for v in (1e-6, 3e-6, 3e-6, 0.5, 1e12):   # 1e12 -> +Inf bucket
+        pc.hinc("obs_s", v)
+    sim = make_sim()
+    host = MgrModuleHost(sim)
+    prometheus_module.register(host)
+    text = host.enable("prometheus").render()
+    family = "ceph_tpu_t_prom_hist_obs_s"
+    assert f"# TYPE {family} histogram" in text
+    buckets = _bucket_samples(text, family)
+    counts = [n for _, n in buckets]
+    assert counts == sorted(counts)           # cumulative
+    assert buckets[-1][0] == "+Inf"
+    assert buckets[-1][1] == 5                # +Inf bucket == _count
+    assert f"{family}_count 5" in text
+    finite = [float(le) for le, _ in buckets[:-1]]
+    assert finite == sorted(finite)           # le ascending
+    # the log2 grid: each populated bound is a power of two over base
+    for le in finite:
+        assert math.log2(le / 1e-6) == pytest.approx(
+            round(math.log2(le / 1e-6)), abs=1e-9)
+
+
+def test_prometheus_histogram_inf_bucket_synthesized(trk):
+    """A histogram with no overflow observations still renders +Inf
+    (required: +Inf bucket must always equal _count)."""
+    lines = []
+    PrometheusModule._render_histogram(
+        lines, "fam", "h",
+        {"count": 3, "sum": 0.25, "buckets": [[1e-6, 1], [4e-6, 2]]})
+    assert 'fam_bucket{le="+Inf"} 3' in lines
+    assert lines.index('fam_bucket{le="+Inf"} 3') > \
+        lines.index('fam_bucket{le="4e-06"} 3')
+    assert "fam_sum 0.25" in lines and "fam_count 3" in lines
+
+
+def test_prometheus_time_avg_renders_as_gauge(trk):
+    pc = perf("t_prom_avg")
+    pc.tinc("lat_s", 0.5)
+    pc.tinc("lat_s", 1.5)
+    sim = make_sim()
+    host = MgrModuleHost(sim)
+    prometheus_module.register(host)
+    text = host.enable("prometheus").render()
+    assert "# TYPE ceph_tpu_t_prom_avg_lat_s gauge" in text
+    assert "ceph_tpu_t_prom_avg_lat_s 1.0" in text
+
+
+def test_prometheus_label_escaping():
+    assert _esc('a"b') == 'a\\"b'
+    assert _esc("a\\b") == "a\\\\b"
+    assert _esc("a\nb") == "a\\nb"
+    assert _esc('p\\q"r\ns') == 'p\\\\q\\"r\\ns'
+
+
+# ------------------------------------------------------- smoke script ---
+
+@pytest.mark.smoke
+def test_check_observability_script(trk):
+    """The CI smoke script, run in-process (fast marker, no extra job)."""
+    import importlib.util
+    import pathlib
+    path = pathlib.Path(__file__).resolve().parents[1] / "scripts" \
+        / "check_observability.py"
+    spec = importlib.util.spec_from_file_location(
+        "check_observability", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    assert mod.main() == 0
