@@ -1,0 +1,28 @@
+"""cephtpu-lint — AST-based static analysis for the framework's own
+invariants.
+
+PR 1 added *runtime* guards (lockdep cycle detection, immutable
+perf-counter types); this package is the *static* counterpart: the
+properties the two TPU inner loops (CRUSH mapping, GF(2^8) EC) and the
+daemon plane depend on are checked at lint time, across every module,
+before any test runs.  Five rule families (ids are stable and
+suppressable via ``# noqa: CTL###`` or the checked-in baseline):
+
+  CTL1xx  JAX hot-path hygiene (host syncs / tracer branches /
+          per-call jit inside jit-reachable code)
+  CTL2xx  GF(2^8)/CRUSH dtype invariants (implicit dtypes that drift
+          under jax_enable_x64; unpinned array ingestion in ops/)
+  CTL3xx  concurrency (static lock-order inversions against the same
+          edge model common/lockdep.py enforces at runtime; raw
+          threading locks in daemon-plane modules)
+  CTL4xx  perf-counter / config registry hygiene
+  CTL5xx  admin-command registry (dispatched vs registered)
+
+Entry points: ``scripts/lint.py`` (CI driver), ``ceph_tpu.tools.
+ceph_cli lint`` (operator surface), ``ceph_tpu.analysis.runner.run``
+(library).  Reference role: src/test/static-analysis + the sanitizer
+wiring — regressions caught by machinery, not review.
+"""
+from .core import Finding, LintError, Rule  # noqa: F401
+from .registry import RuleRegistry, instance  # noqa: F401
+from .runner import run  # noqa: F401

@@ -1,0 +1,220 @@
+"""Shared AST analyses: import-alias-aware name resolution and the
+jit-reachability ("hot") call graph the CTL1xx/CTL2xx rules key off.
+
+Everything here is intentionally module-local and name-based: a call
+``dt.bucket_row(...)`` marks every same-module function NAMED
+``bucket_row`` — an over-approximation that is cheap, deterministic,
+and right for this codebase's idiom (helpers live next to the jitted
+entry points that trace them).
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+# canonical (post-alias) spellings
+JIT_NAMES = {"jax.jit", "jax.pjit"}
+# combinators whose function arguments are traced (treated as hot but
+# NOT as directly-jitted roots: their params may be static Python)
+TRACE_COMBINATORS = {
+    "jax.vmap", "jax.pmap", "jax.checkpoint", "jax.remat",
+    "jax.grad", "jax.value_and_grad",
+    "jax.lax.scan", "jax.lax.while_loop", "jax.lax.fori_loop",
+    "jax.lax.switch", "jax.lax.cond", "jax.lax.map",
+    "jax.lax.associative_scan",
+}
+PARTIAL_NAMES = {"functools.partial", "partial"}
+
+
+def import_aliases(tree: ast.AST) -> Dict[str, str]:
+    """local name -> canonical dotted prefix, e.g. {'_jax': 'jax',
+    'jnp': 'jax.numpy', 'lax': 'jax.lax', 'np': 'numpy',
+    'jit': 'jax.jit'}."""
+    out: Dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                out[a.asname or a.name.split(".")[0]] = \
+                    a.name if a.asname else a.name.split(".")[0]
+        elif isinstance(node, ast.ImportFrom) and node.module \
+                and node.level == 0:
+            for a in node.names:
+                out[a.asname or a.name] = f"{node.module}.{a.name}"
+    return out
+
+
+def dotted(node: ast.AST) -> Optional[str]:
+    """'a.b.c' for Name/Attribute chains, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def resolve(node: ast.AST, aliases: Dict[str, str]) -> Optional[str]:
+    """Alias-normalized dotted name ('_jax.jit' -> 'jax.jit')."""
+    d = dotted(node)
+    if d is None:
+        return None
+    head, _, rest = d.partition(".")
+    head = aliases.get(head, head)
+    return f"{head}.{rest}" if rest else head
+
+
+def is_jit_expr(node: ast.AST, aliases: Dict[str, str]) -> bool:
+    """True for ``jax.jit`` / ``functools.partial(jax.jit, ...)``."""
+    if resolve(node, aliases) in JIT_NAMES:
+        return True
+    if isinstance(node, ast.Call) and \
+            resolve(node.func, aliases) in PARTIAL_NAMES and node.args:
+        return resolve(node.args[0], aliases) in JIT_NAMES
+    return False
+
+
+def _tail(name: str) -> str:
+    return name.rsplit(".", 1)[-1]
+
+
+class HotInfo:
+    """jit-reachability result for one module.
+
+    ``hot``     — FunctionDefs traced under jit (roots + combinator
+                  targets + everything they reach in-module).
+    ``direct``  — FunctionDefs whose PARAMETERS are traced values
+                  (jit-decorated / jax.jit(f) targets), mapped to the
+                  set of their statically-marked parameter names (None
+                  when the static spec could not be resolved).
+    """
+
+    def __init__(self) -> None:
+        self.hot: Set[ast.AST] = set()
+        self.direct: Dict[ast.AST, Optional[Set[str]]] = {}
+
+
+def _static_params(fn: ast.AST, spec: ast.Call) -> Optional[Set[str]]:
+    """Parameter names marked static by a jit call/partial ``spec``;
+    None when not statically resolvable (conservative: skip checks)."""
+    names: Set[str] = set()
+    args = [a.arg for a in fn.args.posonlyargs + fn.args.args]
+    for kw in spec.keywords:
+        if kw.arg == "static_argnames":
+            v = kw.value
+            elts = v.elts if isinstance(v, (ast.Tuple, ast.List)) \
+                else [v]
+            for e in elts:
+                if isinstance(e, ast.Constant) and \
+                        isinstance(e.value, str):
+                    names.add(e.value)
+                else:
+                    return None
+        elif kw.arg == "static_argnums":
+            v = kw.value
+            elts = v.elts if isinstance(v, (ast.Tuple, ast.List)) \
+                else [v]
+            for e in elts:
+                if isinstance(e, ast.Constant) and \
+                        isinstance(e.value, int) and \
+                        0 <= e.value < len(args):
+                    names.add(args[e.value])
+                else:
+                    return None
+    return names
+
+
+def hot_functions(mod) -> HotInfo:
+    """Compute (and cache on the module) the jit-reachable set."""
+    cached = mod._cache.get("hot")
+    if cached is not None:
+        return cached
+    tree = mod.tree
+    aliases = import_aliases(tree)
+    info = HotInfo()
+
+    funcs: Dict[str, List[ast.AST]] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            funcs.setdefault(node.name, []).append(node)
+
+    # name -> callee name, for `g = functools.partial(f, ...)`
+    partial_alias: Dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and isinstance(node.value, ast.Call) \
+                and resolve(node.value.func, aliases) in PARTIAL_NAMES \
+                and node.value.args:
+            base = dotted(node.value.args[0])
+            if base:
+                partial_alias[node.targets[0].id] = _tail(base)
+
+    def mark_direct(fn: ast.AST, spec: Optional[ast.Call]) -> None:
+        info.hot.add(fn)
+        statics = _static_params(fn, spec) if spec is not None \
+            else set()
+        info.direct.setdefault(fn, statics)
+
+    # roots: decorated functions
+    for flist in funcs.values():
+        for fn in flist:
+            for dec in fn.decorator_list:
+                if is_jit_expr(dec, aliases):
+                    spec = dec if isinstance(dec, ast.Call) else None
+                    mark_direct(fn, spec)
+
+    # roots: jax.jit(f, ...) / combinator(f, ...) call forms
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        cn = resolve(node.func, aliases)
+        if cn in JIT_NAMES:
+            for a in node.args[:1]:
+                base = dotted(a)
+                if base:
+                    for fn in funcs.get(_tail(base), ()):
+                        mark_direct(fn, node)
+        elif cn in TRACE_COMBINATORS:
+            for a in node.args:
+                base = dotted(a)
+                if base:
+                    for fn in funcs.get(_tail(base), ()):
+                        info.hot.add(fn)
+
+    # propagate through the in-module call graph to a fixed point
+    changed = True
+    while changed:
+        changed = False
+        for fn in list(info.hot):
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                base = dotted(node.func)
+                if base is None:
+                    continue
+                callee = partial_alias.get(_tail(base), _tail(base))
+                for target in funcs.get(callee, ()):
+                    if target not in info.hot:
+                        info.hot.add(target)
+                        changed = True
+
+    mod._cache["hot"] = info
+    return info
+
+
+def walk_functions(tree: ast.AST
+                   ) -> Iterator[Tuple[ast.AST, Optional[str]]]:
+    """Yield (FunctionDef, enclosing class name) pairs."""
+    def visit(node: ast.AST, cls: Optional[str]) -> Iterator:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.ClassDef):
+                yield from visit(child, child.name)
+            elif isinstance(child,
+                            (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield child, cls
+                yield from visit(child, cls)
+            else:
+                yield from visit(child, cls)
+    yield from visit(tree, None)

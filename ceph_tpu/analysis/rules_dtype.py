@@ -1,0 +1,103 @@
+"""CTL2xx — GF(2^8) / CRUSH dtype invariants.
+
+"Accelerating XOR-based Erasure Coding using Program Optimization
+Techniques" measures exactly this failure class: XOR/GF throughput is
+dominated by keeping the math in the narrow integer domain, and one
+silently-widened dtype (uint8 -> int32/int64) multiplies the moved
+bytes.  In this tree the hazard is concrete: importing
+placement/xla_mapper.py enables ``jax_enable_x64`` process-wide, after
+which every ``jnp.arange(n)``-style constructor WITHOUT an explicit
+dtype materializes int64/float64 — 64-bit integer ops XLA must emulate
+on TPU — and every ``jnp.asarray(caller_data)`` in ops/ inherits
+whatever dtype the caller happened to hold.
+
+  CTL201  implicit-dtype jnp constructor (arange/zeros/ones/empty) in
+          ops/ or placement/
+  CTL202  jnp.asarray/jnp.array of a bare function parameter without a
+          pinned dtype in ops/ (GF math ingesting caller-typed data)
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List
+
+from . import astutil
+from .core import Finding, ParsedModule, Rule
+
+# constructor -> positional index of its dtype parameter:
+# zeros/ones/empty(shape, dtype), asarray/array(obj, dtype) but
+# arange(start, stop, step, dtype) — `jnp.arange(1, n)` has NO dtype
+_CTORS = {"jax.numpy.arange": 3, "jax.numpy.zeros": 1,
+          "jax.numpy.ones": 1, "jax.numpy.empty": 1}
+_INGEST = {"jax.numpy.asarray": 1, "jax.numpy.array": 1}
+
+
+def _has_dtype(call: ast.Call, dtype_pos: int) -> bool:
+    return any(kw.arg == "dtype" for kw in call.keywords) or \
+        len(call.args) > dtype_pos
+
+
+class ImplicitDtypeRule(Rule):
+    rule_id = "CTL201"
+    name = "gf-implicit-dtype"
+    description = ("jnp.arange/zeros/ones/empty without dtype= on the "
+                   "GF/CRUSH data path drifts under jax_enable_x64")
+
+    def check_module(self, mod: ParsedModule) -> Iterable[Finding]:
+        parts = mod.parts()
+        if mod.evidence or not ({"ops", "placement"} & set(parts)):
+            return ()
+        aliases = astutil.import_aliases(mod.tree)
+        out: List[Finding] = []
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            cn = astutil.resolve(node.func, aliases)
+            if cn in _CTORS and not _has_dtype(node, _CTORS[cn]):
+                short = cn.replace("jax.numpy", "jnp")
+                out.append(self.finding(
+                    mod, node.lineno,
+                    f"{short}() without dtype= materializes "
+                    f"int64/float64 under jax_enable_x64 (emulated "
+                    f"64-bit ops on TPU) — pin the dtype"))
+        return out
+
+
+class UnpinnedIngestRule(Rule):
+    rule_id = "CTL202"
+    name = "gf-unpinned-ingest"
+    description = ("jnp.asarray(param) without dtype in ops/: GF math "
+                   "silently runs in the caller's dtype")
+
+    def check_module(self, mod: ParsedModule) -> Iterable[Finding]:
+        if mod.evidence or "ops" not in mod.parts():
+            return ()
+        aliases = astutil.import_aliases(mod.tree)
+        out: List[Finding] = []
+        seen = set()                      # nested-function walk dedup
+        for fn, _cls in astutil.walk_functions(mod.tree):
+            params = {p.arg for p in fn.args.posonlyargs + fn.args.args}
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                cn = astutil.resolve(node.func, aliases)
+                if cn in _INGEST and \
+                        not _has_dtype(node, _INGEST[cn]) and \
+                        len(node.args) == 1 and \
+                        isinstance(node.args[0], ast.Name) and \
+                        node.args[0].id in params and \
+                        (node.lineno, node.args[0].id) not in seen:
+                    seen.add((node.lineno, node.args[0].id))
+                    short = cn.replace("jax.numpy", "jnp")
+                    out.append(self.finding(
+                        mod, node.lineno,
+                        f"{short}({node.args[0].id}) without dtype= "
+                        f"ingests caller-typed data into GF math "
+                        f"(uint8 work silently widens to int32/int64)"
+                        f" — pin the contract dtype"))
+        return out
+
+
+def register(reg) -> None:
+    reg.add(ImplicitDtypeRule.rule_id, ImplicitDtypeRule)
+    reg.add(UnpinnedIngestRule.rule_id, UnpinnedIngestRule)

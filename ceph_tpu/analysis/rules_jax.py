@@ -1,0 +1,137 @@
+"""CTL1xx — JAX hot-path hygiene.
+
+The CRUSH and GF(2^8) inner loops only stay fast while they remain
+single compiled programs: one stray host sync inside a jitted path
+serializes the device pipeline, one Python branch on a tracer throws
+``TracerBoolConversionError`` at trace time (or silently bakes in one
+branch), and one per-call ``jax.jit`` wrapper retraces on every
+invocation.  These rules walk the jit-reachable call graph
+(analysis/astutil.py) and flag exactly those three classes.
+
+  CTL101  host sync / host-numpy call inside jit-reachable code
+  CTL102  Python control flow on a traced parameter of a jitted
+          function (statically-marked args are exempt)
+  CTL103  jax.jit(...) built and invoked in one expression — a fresh
+          executable (and a retrace) per call
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Set, Tuple
+
+from . import astutil
+from .core import Finding, ParsedModule, Rule
+
+# method calls that force a device->host readback on an array
+_SYNC_ATTRS = {"item", "tolist", "block_until_ready", "copy_to_host"}
+
+
+class HostSyncRule(Rule):
+    rule_id = "CTL101"
+    name = "jax-host-sync"
+    description = ("host sync (np.*, .item()/.tolist()/"
+                   ".block_until_ready()) inside jit-reachable code")
+
+    def check_module(self, mod: ParsedModule) -> Iterable[Finding]:
+        if mod.evidence:
+            return ()
+        info = astutil.hot_functions(mod)
+        if not info.hot:
+            return ()
+        aliases = astutil.import_aliases(mod.tree)
+        out: List[Finding] = []
+        seen: Set[Tuple[int, str]] = set()   # nested-hot dedup
+        for fn in info.hot:
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                msg = None
+                if isinstance(node.func, ast.Attribute) and \
+                        node.func.attr in _SYNC_ATTRS:
+                    msg = (f".{node.func.attr}() inside jit-reachable "
+                           f"code forces a host sync")
+                else:
+                    cn = astutil.resolve(node.func, aliases)
+                    if cn and cn.split(".")[0] == "numpy":
+                        msg = (f"host numpy call {cn}() inside "
+                               f"jit-reachable code (host sync / "
+                               f"tracer leak)")
+                if msg and (node.lineno, msg) not in seen:
+                    seen.add((node.lineno, msg))
+                    out.append(self.finding(mod, node.lineno, msg))
+        return out
+
+
+def _param_names(fn: ast.AST) -> Set[str]:
+    a = fn.args
+    return {p.arg for p in a.posonlyargs + a.args + a.kwonlyargs} | \
+        ({a.vararg.arg} if a.vararg else set()) | \
+        ({a.kwarg.arg} if a.kwarg else set())
+
+
+class TracerBranchRule(Rule):
+    rule_id = "CTL102"
+    name = "jax-tracer-branch"
+    description = ("Python if/while/assert on a traced parameter of a "
+                   "jitted function (use jnp.where / lax.cond)")
+
+    def check_module(self, mod: ParsedModule) -> Iterable[Finding]:
+        if mod.evidence:
+            return ()
+        info = astutil.hot_functions(mod)
+        out: List[Finding] = []
+        for fn, statics in info.direct.items():
+            if statics is None:
+                continue      # unresolvable static spec: stay quiet
+            traced = _param_names(fn) - statics
+            if not traced:
+                continue
+            for node in ast.walk(fn):
+                if isinstance(node, (ast.If, ast.While)):
+                    test = node.test
+                elif isinstance(node, ast.Assert):
+                    test = node.test
+                else:
+                    continue
+                names = {n.id for n in ast.walk(test)
+                         if isinstance(n, ast.Name)}
+                hits = sorted(names & traced)
+                if hits:
+                    kind = type(node).__name__.lower()
+                    out.append(self.finding(
+                        mod, node.lineno,
+                        f"Python {kind} on traced value(s) "
+                        f"{', '.join(hits)} in jitted "
+                        f"{getattr(fn, 'name', '<fn>')}() — branches "
+                        f"must be jnp.where/lax.cond (or mark the "
+                        f"arg static)"))
+        return out
+
+
+class JitPerCallRule(Rule):
+    rule_id = "CTL103"
+    name = "jax-jit-per-call"
+    description = ("jax.jit(...) constructed and called in one "
+                   "expression: a fresh executable per invocation")
+
+    def check_module(self, mod: ParsedModule) -> Iterable[Finding]:
+        if mod.evidence:
+            return ()
+        aliases = astutil.import_aliases(mod.tree)
+        out: List[Finding] = []
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Call) and \
+                    astutil.is_jit_expr(node.func.func, aliases):
+                out.append(self.finding(
+                    mod, node.lineno,
+                    "jax.jit(f)(...) builds a fresh wrapper (and "
+                    "retraces) on every call — hoist the jitted "
+                    "callable to module/instance scope"))
+        return out
+
+
+def register(reg) -> None:
+    reg.add(HostSyncRule.rule_id, HostSyncRule)
+    reg.add(TracerBranchRule.rule_id, TracerBranchRule)
+    reg.add(JitPerCallRule.rule_id, JitPerCallRule)

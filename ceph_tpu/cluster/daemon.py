@@ -38,6 +38,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from ..common import auth as cx
 from ..common.admin import AdminServer
+from ..common.lockdep import LockdepLock
 from ..common.op_tracker import mark_active, tracker as _op_tracker
 from ..msg import encoding
 from ..msg.queue import Envelope
@@ -253,7 +254,7 @@ class WireClient:
         if env.type != MSG_AUTH_OK:
             raise cx.AuthError("handshake rejected")
         self._id = 0
-        self._lock = threading.Lock()
+        self._lock = LockdepLock("wire.client", recursive=False)
 
     def call(self, req: Dict[str, Any]) -> Any:
         with self._lock:
@@ -328,7 +329,7 @@ class MonDaemon:
         # RLock: the leader's propose path re-enters through the
         # quorum's local apply (handle -> commit_incremental ->
         # propose -> _commit_entry -> _apply_decree)
-        self._lock = threading.RLock()
+        self._lock = LockdepLock("mon.daemon")
         self._stop = threading.Event()
         self.quorum = None
         self._peer_mons: Dict[int, WireClient] = {}
@@ -521,10 +522,42 @@ class MonDaemon:
             inner = dict(req["req"])
             orig = inner.pop("fwd_entity")
             return {"reply": self._handle(orig, inner)}
-        if (self.quorum is not None and cmd in self.MUTATIONS and
-                self.quorum.leader != self.rank):
+        if (self.quorum is not None and
+                cmd in self.MUTATIONS + ("report_slow_ops", "health")
+                and self.quorum.leader != self.rank):
+            # slow-op rollup state is leader-local (transient health,
+            # not a quorum decree): reports AND health queries both
+            # forward so they meet on the same mon no matter which
+            # socket each caller happened to connect to
             return self._forward_to_leader(entity, req)
         with self._lock:
+            if cmd == "report_slow_ops":
+                # daemonized OSDs roll their OpTracker slow-op
+                # summaries up into this mon's SLOW_OPS health check
+                # (the reference mon's per-daemon health report
+                # ingestion); under _lock — wire handlers run on
+                # per-connection threads
+                if not entity.startswith("osd."):
+                    raise cx.AuthError(
+                        f"{entity} may not report slow ops")
+                self.mon.record_daemon_slow_ops(
+                    entity, req.get("summary") or {})
+                return {"ok": True}
+            if cmd == "health":
+                # PG_DEGRADED needs the batched mapper (a compile in
+                # this daemon) — opt-in via {"pgs": True}
+                checks = self.mon.health(
+                    include_pg_state=bool(req.get("pgs", False)))
+                worst = "HEALTH_OK"
+                if any(c.severity == "HEALTH_ERR" for c in checks):
+                    worst = "HEALTH_ERR"
+                elif checks:
+                    worst = "HEALTH_WARN"
+                return {"status": worst,
+                        "checks": [{"code": c.code,
+                                    "severity": c.severity,
+                                    "summary": c.summary}
+                                   for c in checks]}
             if cmd == "get_ticket":
                 service = req["service"]
                 ticket, key_box = self.tickets.grant(entity, service)
@@ -776,24 +809,24 @@ class OSDDaemon:
                 fsck_on_mount=fsck_on_mount)
         from ..msg.scheduler import MClockScheduler
         self.sched = MClockScheduler()
-        self._sched_lock = threading.Lock()
+        self._sched_lock = LockdepLock("osd.sched", recursive=False)
         # durable per-PG op logs (process-tier PGLog, daemon_pglog.py)
         from .daemon_pglog import DurablePGLog
         self._pglogs: Dict[Tuple[int, int], DurablePGLog] = {}
-        self._pglog_lock = threading.Lock()
+        self._pglog_lock = LockdepLock("osd.pglog", recursive=False)
         # per-PG write serialization (the reference's PG lock): version
         # assignment + log append + apply must be atomic per PG across
         # the thread-per-connection wire server
-        self._pg_locks: Dict[Tuple[int, int], threading.Lock] = {}
+        self._pg_locks: Dict[Tuple[int, int], LockdepLock] = {}
         self._peers: Dict[int, WireClient] = {}
-        self._peer_lock = threading.Lock()
+        self._peer_lock = LockdepLock("osd.peer", recursive=False)
         self._mon: Optional[WireClient] = None
         self._map: Dict[str, Any] = {}
         self._stop = threading.Event()
         # watch/notify state (src/osd/Watch.cc role): in-memory and
         # connection-equivalent — watches die with the daemon, exactly
         # as the reference's die with the session; clients re-register
-        self._watch_lock = threading.Lock()
+        self._watch_lock = LockdepLock("osd.watch", recursive=False)
         self._watchers: Dict[Tuple, Dict[int, list]] = {}
         self._watch_next = 1
         self._notify_state: Dict[int, Dict[str, Any]] = {}
@@ -813,6 +846,7 @@ class OSDDaemon:
         self.admin.serve(os.path.join(cluster_dir,
                                       f"osd.{osd_id}.asok"))
         self._hb_misses: Dict[int, int] = {}
+        self._slow_reported = 0       # last slow-op count sent to mon
 
     # ----------------------------------------------------------- mon I/O --
     def _mon_socks(self) -> List[str]:
@@ -889,11 +923,13 @@ class OSDDaemon:
                                                         coll)
             return log
 
-    def _pg_lock(self, coll: Tuple[int, int]) -> threading.Lock:
+    def _pg_lock(self, coll: Tuple[int, int]) -> LockdepLock:
         with self._pglog_lock:
             lk = self._pg_locks.get(coll)
             if lk is None:
-                lk = self._pg_locks[coll] = threading.Lock()
+                lk = self._pg_locks[coll] = LockdepLock(
+                    f"osd.pg.{coll[0]}.{coll[1]}",
+                    recursive=False)
             return lk
 
     # ------------------------------------------------------------ serving --
@@ -1565,6 +1601,25 @@ class OSDDaemon:
             with self._pglog_lock:
                 self._pglogs.pop(tuple(coll), None)
 
+    def _report_slow_ops(self) -> None:
+        """Roll this process's slow-op summary up to the mon (PR 1's
+        known gap: daemon trackers were only visible on their own
+        asok).  Sent when nonzero, plus one zero report to clear the
+        mon entry once the window drains."""
+        try:
+            s = _op_tracker().slow_ops_summary()
+        except Exception:
+            return
+        n = int(s.get("num", 0))
+        if n == 0 and not self._slow_reported:
+            return
+        try:
+            self.mon_client().call({"cmd": "report_slow_ops",
+                                    "osd": self.id, "summary": s})
+            self._slow_reported = n
+        except (OSError, IOError):
+            self._mon = None
+
     def _heartbeat_loop(self, interval: float, grace: int) -> None:
         while not self._stop.is_set():
             time.sleep(interval)
@@ -1573,6 +1628,7 @@ class OSDDaemon:
             except (OSError, IOError):
                 self._mon = None
                 continue
+            self._report_slow_ops()
             self._purge_dead_pools()
             up = self._map.get("osd_up", [])
             # spuriously marked down (missed heartbeats during a stall

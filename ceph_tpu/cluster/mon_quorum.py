@@ -39,9 +39,9 @@ timeouts rather than a deferral subprotocol.
 from __future__ import annotations
 
 import json
-import threading
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
+from ..common.lockdep import LockdepLock
 from ..common.log import dout
 
 SendFn = Callable[[int, Dict[str, Any]], Dict[str, Any]]
@@ -64,7 +64,7 @@ class QuorumNode:
         self.db = db
         self.apply_fn = apply_fn
         self.send_fn = send_fn
-        self._lock = threading.RLock()
+        self._lock = LockdepLock("mon.quorum")
         # ordered-apply machinery: commits may be delivered on
         # concurrent wire-handler threads; the log itself grows in
         # order (version gate under _lock) and this queue + single
@@ -81,7 +81,8 @@ class QuorumNode:
         # subset — two values committed at one version.  This lock
         # serializes the whole store->begin->commit span (propose and
         # the collect re-accept share it).
-        self._propose_lock = threading.Lock()
+        self._propose_lock = LockdepLock("mon.propose",
+                                         recursive=False)
         self.leader: Optional[int] = None
         # persisted state
         self.election_epoch = int(db.get("quorum", "election_epoch")

@@ -131,6 +131,11 @@ class Monitor:
         # including this one — so proposal success implies local state
         # is already updated
         self._proposer = proposer
+        # slow-op rollup from daemonized OSDs (each OSD process owns
+        # its OWN OpTracker; its heartbeat reports slow_ops_summary()
+        # so SLOW_OPS covers the whole cluster, not just this
+        # process's tracker): daemon entity -> last nonzero summary
+        self._daemon_slow: Dict[str, Dict[str, Any]] = {}
 
     def set_proposer(self,
                      fn: Optional[Callable[[Tuple], bool]]) -> None:
@@ -332,9 +337,17 @@ class Monitor:
         return True
 
     # ------------------------------------------------------------ health --
-    def health(self, sim=None) -> List[HealthCheck]:
+    def health(self, sim=None,
+               include_pg_state: bool = True) -> List[HealthCheck]:
         """HealthMonitor analog over the current map (+ optional sim
-        shard state for degraded-PG detection)."""
+        shard state for degraded-PG detection).
+
+        ``include_pg_state=False`` skips the PG_DEGRADED sweep: it
+        runs the batched device mapper over every pool, which is the
+        right cost in-process but compiles the mapper inside a mon
+        DAEMON whose only other duties are map/auth bookkeeping — the
+        wire `health` command defaults it off and lets callers opt
+        in (``{"cmd": "health", "pgs": True}``)."""
         checks: List[HealthCheck] = []
         om = self.osdmap
         exists = om.osd_exists
@@ -348,7 +361,7 @@ class Monitor:
                 "OSD_OUT", "HEALTH_WARN", f"{out} osds out"))
         degraded = 0
         ups = {}
-        for pid in om.pools:
+        for pid in (om.pools if include_pg_state else ()):
             up, _ = om.map_pgs_batch(pid)
             ups[pid] = up
             holes = (up == ITEM_NONE).any(axis=1)
@@ -385,20 +398,47 @@ class Monitor:
                 f"{stale} pgs with stale replicas"))
         # SLOW_OPS (the HealthMonitor "N slow ops" rollup): ops
         # currently blocked past op_tracker_complaint_time plus
-        # recently completed slow ops, attributed per daemon from this
-        # process's tracker — which sees everything in the in-process
-        # sim; daemonized OSDs expose theirs via the per-daemon asok
-        # (dump_historic_slow_ops), not yet reported up to the mon
+        # recently completed slow ops, from this process's tracker
+        # (which sees everything in the in-process sim) MERGED with
+        # the summaries daemonized OSDs report over the wire
+        # (report_slow_ops on their heartbeat) — their trackers live
+        # in other processes
+        import time as _time
         from ..common.op_tracker import tracker as _op_tracker
         slow = _op_tracker().slow_ops_summary()
-        if slow["num"]:
-            daemons = ",".join(slow["daemons"]) or "unknown"
+        num = int(slow["num"])
+        oldest = float(slow["oldest_s"])
+        daemons = list(slow["daemons"])
+        now = _time.time()
+        for entity, rep in sorted(self._daemon_slow.items()):
+            if now - float(rep.get("ts", now)) > 600.0:
+                continue              # reporter gone silent: stale
+            num += int(rep.get("num", 0))
+            oldest = max(oldest, float(rep.get("oldest_s", 0.0)))
+            for d in rep.get("daemons") or [entity]:
+                if d not in daemons:
+                    daemons.append(d)
+        if num:
+            names = ",".join(sorted(daemons)) or "unknown"
             checks.append(HealthCheck(
                 "SLOW_OPS", "HEALTH_WARN",
-                f"{slow['num']} slow ops, oldest one blocked for "
-                f"{slow['oldest_s']:.3f} sec, daemons [{daemons}] "
+                f"{num} slow ops, oldest one blocked for "
+                f"{oldest:.3f} sec, daemons [{names}] "
                 f"have slow ops"))
         return checks
+
+    def record_daemon_slow_ops(self, daemon: str,
+                               summary: Dict[str, Any]) -> None:
+        """Ingest one daemon's ``slow_ops_summary()`` (reported over
+        the wire on its heartbeat).  A zero report clears the entry —
+        an OSD whose slow window drained stops contributing; a daemon
+        that stops reporting entirely ages out of health() after 600s."""
+        import time as _time
+        if summary and int(summary.get("num", 0)) > 0:
+            self._daemon_slow[daemon] = dict(summary,
+                                             ts=_time.time())
+        else:
+            self._daemon_slow.pop(daemon, None)
 
     def health_status(self, sim=None) -> str:
         checks = self.health(sim)

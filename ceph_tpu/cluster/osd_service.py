@@ -23,6 +23,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from ..common.lockdep import LockdepLock
 from ..common.op_tracker import tracker as _op_tracker
 from ..common.perf_counters import perf as _perf
 from ..msg import encoding
@@ -45,7 +46,7 @@ class OSDService:
                                  capacity_bytes=capacity_bytes)
         self.sched = MClockScheduler()
         self._ids = itertools.count(1)
-        self._lock = threading.Lock()
+        self._lock = LockdepLock("osd.service", recursive=False)
         self._events: Dict[int, threading.Event] = {}
         self._results: Dict[int, Any] = {}
         # device-array side table: the control frame rides the native

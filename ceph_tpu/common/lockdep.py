@@ -57,6 +57,13 @@ def _held() -> List[str]:
     return _tls.held
 
 
+def held_locks() -> List[str]:
+    """This thread's currently-held lock names, outermost first
+    (test/debug surface: proves the stack unwinds on exception
+    paths — a stale entry would poison every later order check)."""
+    return list(_held())
+
+
 def _reaches(src: str, dst: str) -> bool:
     """DFS over the order graph (callers hold _graph_lock)."""
     stack, seen = [src], set()
@@ -71,14 +78,20 @@ def _reaches(src: str, dst: str) -> bool:
     return False
 
 
-def _before_acquire(name: str) -> None:
+def _before_acquire(name: str, recursive: bool = True) -> None:
     held = _held()
     if not held:
         return
     with _graph_lock:
         for h in held:
             if h == name:
-                continue               # recursive re-acquire
+                if recursive:
+                    continue           # recursive re-acquire
+                # a non-recursive lock re-acquired by its own holder
+                # would deadlock right here — abort loudly instead
+                raise LockOrderError(
+                    f"recursive acquire of non-recursive lock "
+                    f"{name!r} (self-deadlock)")
             # adding h -> name: a cycle exists iff name already
             # reaches h
             if _reaches(name, h):
@@ -90,15 +103,23 @@ def _before_acquire(name: str) -> None:
 
 
 class LockdepLock:
-    """threading.RLock wrapper with order registration."""
+    """Lock wrapper with order registration.  ``recursive=True``
+    (default) wraps an RLock; ``recursive=False`` wraps a plain Lock —
+    converted daemon-plane locks keep their original self-deadlock
+    semantics (and with lockdep enabled, a same-thread re-acquire
+    raises LockOrderError instead of hanging).  Non-recursive locks
+    need per-instance names: same-name re-acquire is indistinguishable
+    from recursion."""
 
-    def __init__(self, name: str):
+    def __init__(self, name: str, recursive: bool = True):
         self.name = name
-        self._lock = threading.RLock()
+        self.recursive = recursive
+        self._lock = threading.RLock() if recursive else \
+            threading.Lock()
 
     def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
         if _enabled:
-            _before_acquire(self.name)
+            _before_acquire(self.name, self.recursive)
         got = self._lock.acquire(blocking, timeout)
         if got:
             _held().append(self.name)

@@ -18,6 +18,7 @@ from __future__ import annotations
 import threading
 from typing import Callable, Dict, List, Optional, Sequence
 
+from ..common.lockdep import LockdepLock
 from ..common.perf_counters import perf as _perf
 from .queue import Envelope, MessageQueue
 
@@ -81,7 +82,7 @@ class ShardFanout:
                  ack_q: MessageQueue):
         self.shard_queues = list(shard_queues)
         self.ack_q = ack_q
-        self._lock = threading.Lock()
+        self._lock = LockdepLock("msg.fanout", recursive=False)
         self._pending: Dict[int, Dict] = {}
         self._pc = _perf("msg.fanout")
 

@@ -104,4 +104,5 @@ def matrix_to_device(A: np.ndarray) -> jax.Array:
 
 def gf8_matmul(A: np.ndarray, data) -> jax.Array:
     """Convenience: numpy GF matrix x device/host data."""
-    return bitplane_matmul(matrix_to_device(A), jnp.asarray(data))
+    return bitplane_matmul(matrix_to_device(A),
+                           jnp.asarray(data, dtype=jnp.uint8))

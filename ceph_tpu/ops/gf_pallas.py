@@ -94,7 +94,7 @@ def bitplane_matmul(bitmat, data) -> jax.Array:
     data [..., k, L] uint8; leading axes flattened to one batch dim;
     L padded to the tile size and cropped after.
     """
-    data = jnp.asarray(data)
+    data = jnp.asarray(data, dtype=jnp.uint8)
     lead = data.shape[:-2]
     k, L = data.shape[-2], data.shape[-1]
     B = int(np.prod(lead)) if lead else 1

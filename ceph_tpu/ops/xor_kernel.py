@@ -157,7 +157,7 @@ def xor_matmul_w32(masks, words) -> jax.Array:
 def xor_matmul(masks, planes) -> jax.Array:
     """uint8-domain entry: planes [..., C, P] uint8 (P % 4 == 0) ->
     [..., R, P] uint8 on device."""
-    planes = jnp.asarray(planes)
+    planes = jnp.asarray(planes, dtype=jnp.uint8)
     out = xor_matmul_w32(masks, _u8_to_i32(planes))
     return _i32_to_u8(out)
 

@@ -99,6 +99,12 @@ def _approx_numer_f32(u):
     return jnp.float32(_2P44_F) * (jnp.float32(16.0) - log2v)
 
 
+# one module-level jitted wrapper: jax.jit keys its executable cache on
+# backend+shape, so the CPU-then-TPU process re-traces per platform
+# without building a fresh wrapper (and a retrace) per call
+_approx_numer_dev = jax.jit(_approx_numer_f32)
+
+
 @functools.lru_cache(maxsize=None)
 def _approx_error_bound(backend: str) -> float:
     """Max |approx - LUT| of THIS backend's poly evaluation, measured by
@@ -114,9 +120,8 @@ def _approx_error_bound(backend: str) -> float:
     candidate window narrow (~4 u-steps at host weights) so the exact
     top-K re-check below almost never overflows K.
     """
-    import jax as _jax
     u = jnp.arange(65536, dtype=jnp.int32)
-    na = np.asarray(_jax.jit(_approx_numer_f32)(u)).astype(np.float64)
+    na = np.asarray(_approx_numer_dev(u)).astype(np.float64)
     n_exact = (-lntable.straw2_ln_lut()).astype(np.float64)
     d = float(np.abs(na - n_exact).max())
     return 1.25 * d + float(2 ** 20)
@@ -293,7 +298,8 @@ class _DevLevel:
                     self.w_lo[r], self.sizes[r], self.child_row[r],
                     self.child_type[r], self.child_escape[r],
                     self.child_leafrow[r], self.margin[r])
-        oh = (row[:, None] == jnp.arange(self.Bl)).astype(jnp.float32)
+        oh = (row[:, None] ==
+              jnp.arange(self.Bl, dtype=jnp.int32)).astype(jnp.float32)
         items = (oh @ self.items_f).astype(jnp.int32)
         ids = (oh @ self.ids_f).astype(jnp.int32).astype(jnp.uint32)
         w_hi = oh @ self.w_hi
@@ -313,7 +319,7 @@ class _DevLevel:
             jj = j[:, None]
             return tuple(jnp.take_along_axis(t, jj, axis=1)[:, 0]
                          for t in tables)
-        sel = (j[:, None] == jnp.arange(self.Sl))
+        sel = (j[:, None] == jnp.arange(self.Sl, dtype=jnp.int32))
         out = []
         for t in tables:
             if t.dtype == jnp.bool_:
@@ -333,7 +339,7 @@ def _weight_at(weights, item, strategy):
     idx = jnp.clip(item, 0, n - 1)
     if strategy == "gather":
         return weights[idx].astype(jnp.int64)
-    oh = (idx[:, None] == jnp.arange(n)).astype(jnp.float32)
+    oh = (idx[:, None] == jnp.arange(n, dtype=jnp.int32)).astype(jnp.float32)
     return (oh @ weights.astype(jnp.float32)).astype(jnp.int64)
 
 
@@ -379,7 +385,8 @@ def _straw2_select(dt: DeviceTables, u, w_hi, w_lo, sizes, margin,
 
     Exact mode: full-width LUT math (CEPH_TPU_SELECT=exact)."""
     Sl = u.shape[1]
-    valid = ((w_hi > 0) | (w_lo > 0)) & (jnp.arange(Sl) < sizes[:, None])
+    valid = ((w_hi > 0) | (w_lo > 0)) & \
+        (jnp.arange(Sl, dtype=jnp.int32) < sizes[:, None])
     if exact:
         a = dt.ln_numer(u)
         w = w_hi.astype(jnp.float64) * 65536.0 + w_lo.astype(jnp.float64)
@@ -683,7 +690,7 @@ class _FastChoose:
         l_dev, l_st, l_is_out = leaf_pack
         N = l_dev.shape[0]
         NONE = jnp.int32(ITEM_NONE)
-        slot_ids = jnp.arange(out2.shape[1])
+        slot_ids = jnp.arange(out2.shape[1], dtype=jnp.int32)
         ldev = jnp.full((N,), NONE)
         lok = jnp.zeros((N,), dtype=bool)
         ldone = jnp.zeros((N,), dtype=bool)
@@ -714,7 +721,7 @@ class _FastChoose:
         out2 = jnp.full((N, R_out), NONE)
         outpos = jnp.zeros((N,), dtype=jnp.int32)
         incomplete = jnp.zeros((N,), dtype=bool)
-        slot_ids = jnp.arange(R_out)
+        slot_ids = jnp.arange(R_out, dtype=jnp.int32)
         for rep in range(spec.numrep):
             g = rep if self.per_rep else 0
             placed = jnp.zeros((N,), dtype=bool)
@@ -772,7 +779,8 @@ class _FastChoose:
         limit = min(spec.numrep, count_limit)
         NONE = jnp.int32(ITEM_NONE)
         UNDEF = jnp.int32(ITEM_UNDEF)
-        active = jnp.broadcast_to(jnp.arange(R_out) < limit, (N, R_out))
+        active = jnp.broadcast_to(
+            jnp.arange(R_out, dtype=jnp.int32) < limit, (N, R_out))
         out = jnp.where(active, UNDEF, NONE)
         out2 = jnp.where(active, UNDEF, NONE)
         dummy_pos = jnp.zeros((N,), dtype=jnp.int32)
@@ -950,7 +958,7 @@ class FastMapper:
         result = jnp.full((N, result_max), NONE)
         rpos = jnp.zeros((N,), dtype=jnp.int32)
         incomplete = jnp.zeros((N,), dtype=bool)
-        res_ids = jnp.arange(result_max)
+        res_ids = jnp.arange(result_max, dtype=jnp.int32)
         pend_out = None            # (vals [N, n], count [N]) awaiting emit
         x = xs.astype(jnp.int32)
         for entry in plan:

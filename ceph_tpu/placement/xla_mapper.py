@@ -287,7 +287,8 @@ class DeviceTables:
 
     # ---- per-lane accessors (called under vmap; bidx is a scalar) -------
     def bucket_onehot(self, bidx):
-        return (jnp.arange(self.B) == bidx).astype(jnp.float32)
+        return (jnp.arange(self.B, dtype=jnp.int32) == bidx) \
+            .astype(jnp.float32)
 
     def bucket_row(self, bidx, pos):
         """(items [S] i32, hash_ids [S] u32, weights [S] f64, size i32)."""
@@ -303,7 +304,8 @@ class DeviceTables:
         w_hi = jnp.einsum("b,bps->ps", ohb, self.ws_hi)         # [P,S]
         w_lo = jnp.einsum("b,bps->ps", ohb, self.ws_lo)
         pos_c = jnp.minimum(pos, self.P - 1)
-        psel = (jnp.arange(self.P) == pos_c).astype(jnp.float64)
+        psel = (jnp.arange(self.P, dtype=jnp.int32) == pos_c) \
+            .astype(jnp.float64)
         w = psel @ (w_hi.astype(jnp.float64) * 65536.0 +
                     w_lo.astype(jnp.float64))                   # [S]
         size = (ohb @ self.sizes_f).astype(jnp.int32)
@@ -324,7 +326,7 @@ class DeviceTables:
         """items_row[idx] without a gather."""
         if self.strategy == "gather":
             return items_row[idx]
-        sel = (jnp.arange(self.S) == idx)
+        sel = (jnp.arange(self.S, dtype=jnp.int32) == idx)
         return jnp.where(sel, items_row, 0).sum(dtype=jnp.int32)
 
     # ---- exact draw numerator: 2^48 - crush_ln(u) -----------------------
@@ -390,7 +392,7 @@ def _straw2_choose(dt: DeviceTables, bidx, x, r, pos):
     q = q + ((q + 1.0) * w <= a)
     inf = jnp.float64(jnp.inf)
     q = jnp.where(w > 0, q, inf)
-    q = jnp.where(jnp.arange(S) < size, q, inf)
+    q = jnp.where(jnp.arange(S, dtype=jnp.int32) < size, q, inf)
     return dt.item_at(items_row, jnp.argmin(q))
 
 
@@ -442,8 +444,8 @@ def _list_choose(dt: DeviceTables, bidx, x, r):
         jnp.broadcast_to(_u32(r), (S,)),
         jnp.broadcast_to(dt.bucket_ids[bidx], (S,))) & jnp.uint32(0xFFFF)
     draw = (h.astype(jnp.int64) * sums) >> 16
-    ok = (draw < w.astype(jnp.int64)) & (jnp.arange(S) < size)
-    idx = jnp.max(jnp.where(ok, jnp.arange(S), -1))
+    ok = (draw < w.astype(jnp.int64)) & (jnp.arange(S, dtype=jnp.int32) < size)
+    idx = jnp.max(jnp.where(ok, jnp.arange(S, dtype=jnp.int32), -1))
     return dt.item_at(items_row, jnp.maximum(idx, 0))
 
 
@@ -504,7 +506,8 @@ def _straw_choose(dt: DeviceTables, bidx, x, r):
         items_row.astype(jnp.uint32),
         jnp.broadcast_to(_u32(r), (S,))) & jnp.uint32(0xFFFF)
     draw = h.astype(jnp.int64) * straws            # <= 2^48, exact
-    draw = jnp.where(jnp.arange(S) < size, draw, jnp.int64(-1))
+    draw = jnp.where(jnp.arange(S, dtype=jnp.int32) < size,
+                     draw, jnp.int64(-1))
     return dt.item_at(items_row, jnp.argmax(draw))
 
 
@@ -602,7 +605,8 @@ def _leaf_firstn(cm, dt, bucket_item, weights, x, sub_r, recurse_tries,
         ftotal, done, ok, dev = s
         r = rep_base + sub_r + ftotal
         item, status = _descend(cm, dt, -1 - bucket_item, 0, x, r, pos)
-        collide = jnp.any((jnp.arange(R) < outpos) & (out2 == item))
+        collide = jnp.any((jnp.arange(R, dtype=jnp.int32) <
+                           outpos) & (out2 == item))
         out_dev = jnp.where(status == _OK, _is_out(weights, item, x), False)
         success = (status == _OK) & (~collide) & (~out_dev)
         hard_fail = status == _SKIP
@@ -638,7 +642,8 @@ def _choose_firstn(cm, dt, root_item, target_type: int, numrep: int,
             r = rep + ftotal  # parent_r == 0 at rule level
             item, status = _descend(
                 cm, dt, -1 - root_item, target_type, x, r, outpos)
-            collide = jnp.any((jnp.arange(R) < outpos) & (out == item))
+            collide = jnp.any((jnp.arange(R, dtype=jnp.int32) <
+                               outpos) & (out == item))
             reject = status == _REJECT
             skip = status == _SKIP
             leaf = jnp.int32(ITEM_NONE)
@@ -709,7 +714,7 @@ def _choose_indep(cm, dt, root_item, target_type: int, numrep: int,
     R = numrep
     UNDEF = jnp.int32(ITEM_UNDEF)
     NONE = jnp.int32(ITEM_NONE)
-    active = jnp.arange(R) < out_size_limit
+    active = jnp.arange(R, dtype=jnp.int32) < out_size_limit
     out = jnp.where(active, UNDEF, NONE)
     out2 = jnp.where(active, UNDEF, NONE)
 
@@ -903,8 +908,9 @@ class XlaMapper:
                                 got = jnp.minimum(numrep,
                                                   result_max - osize)
                             vals = o2 if leaf else o
-                            idx = osize + jnp.arange(numrep)
-                            valid = live & (jnp.arange(numrep) < got)
+                            idx = osize + jnp.arange(numrep, dtype=jnp.int32)
+                            valid = live & (jnp.arange(
+                                numrep, dtype=jnp.int32) < got)
                             idx = jnp.where(valid, idx, result_max)
                             new_items = new_items.at[idx].set(
                                 jnp.where(valid, vals, ITEM_NONE),
@@ -915,8 +921,8 @@ class XlaMapper:
                     for src in sources:
                         n_src = src["items"].shape[0]
                         take = jnp.minimum(src["count"], result_max - rpos)
-                        idx = rpos + jnp.arange(n_src)
-                        valid = jnp.arange(n_src) < take
+                        idx = rpos + jnp.arange(n_src, dtype=jnp.int32)
+                        valid = jnp.arange(n_src, dtype=jnp.int32) < take
                         idx = jnp.where(valid, idx, result_max)
                         result = result.at[idx].set(
                             jnp.where(valid, src["items"][:n_src],

@@ -278,8 +278,9 @@ def main(argv: Optional[List[str]] = None,
          out=None) -> int:
     out = out or sys.stdout
     ap = argparse.ArgumentParser(prog="ceph")
-    ap.add_argument("--dir", required=True,
-                    help="vstart cluster directory")
+    ap.add_argument("--dir", default=None,
+                    help="vstart cluster directory (required for "
+                         "every command except `lint`)")
     ap.add_argument("--detail", action="store_true")
     ap.add_argument("--size", type=int, default=3,
                     help="replica count for `osd pool create`")
@@ -291,8 +292,18 @@ def main(argv: Optional[List[str]] = None,
                          "pg dump POOL | df | scrub POOL | "
                          "daemon NAME dump_ops_in_flight|"
                          "dump_historic_ops|dump_historic_slow_ops|"
-                         "perf dump")
-    ns = ap.parse_args(argv)
+                         "perf dump | lint [--check|--json|...]")
+    ns, extra = ap.parse_known_args(argv)
+    if ns.words[0] == "lint":
+        # static-analysis surface (ceph_tpu/analysis): needs no
+        # cluster — unknown flags pass through to the lint driver
+        # (`ceph lint --check`, `ceph lint --json`, ...)
+        from ..analysis.runner import main as lint_main
+        return lint_main(ns.words[1:] + extra, out=out)
+    if extra:
+        ap.error(f"unrecognized arguments: {' '.join(extra)}")
+    if ns.dir is None:
+        ap.error("--dir is required for cluster commands")
     if ns.words[0] == "daemon":
         # admin-socket path: talks to ONE daemon directly, needs no
         # mon connection (and must work while the mon is down)

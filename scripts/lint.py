@@ -1,0 +1,33 @@
+#!/usr/bin/env python3
+"""cephtpu-lint driver — the static-analysis CI gate.
+
+Thin wrapper over ceph_tpu.analysis.runner (also surfaced as
+``ceph_tpu.tools.ceph_cli lint``).  Typical invocations::
+
+    python scripts/lint.py                   # human-readable report
+    python scripts/lint.py --check           # CI gate: exit 1 on any
+                                             # unsuppressed finding
+    python scripts/lint.py --json            # machine-readable (shape
+                                             # documented in runner.py)
+    python scripts/lint.py --select CTL3     # one rule family
+    python scripts/lint.py --list-rules
+    python scripts/lint.py --write-baseline  # grandfather current
+                                             # findings (review the
+                                             # diff!)
+
+Suppression: inline ``# noqa: CTL###`` next to a deliberate
+exception (preferred), or an entry in scripts/lint_baseline.json.
+The tier-1 test tests/test_lint.py::test_tree_is_lint_clean runs the
+equivalent of ``--check`` on every pytest run, so a new violation
+fails the suite before review.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from ceph_tpu.analysis.runner import main  # noqa: E402
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -39,6 +39,31 @@ else:
         pass                      # older jax: cache simply not enabled
 
 
+# -------------------------------------------------------------- lockdep --
+# Runtime lock-order checking for the WHOLE test session: every
+# LockdepLock acquisition (daemon plane, dispatcher, quorum — the
+# modules the static CTL302 rule keeps raw-lock-free) validates
+# against the global order graph, so a genuine inversion aborts the
+# offending test instead of deadlocking CI.  The static counterpart
+# is scripts/lint.py (CTL301).  Subprocesses spawned by vstart do NOT
+# inherit this (they never import conftest) — by design: they run the
+# production default (disabled, near-zero overhead).
+from ceph_tpu.common import lockdep as _lockdep  # noqa: E402
+
+_lockdep.enable()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _lockdep_enabled_everywhere():
+    """Re-enable per test: the lockdep unit tests disable() in their
+    cleanup, which must not switch checking off for the rest of the
+    session."""
+    _lockdep.enable()
+    yield
+
+
 # ---------------------------------------------------------- test tiering --
 # The suite's latency is dominated by a handful of JAX-compile-heavy
 # tests (VERDICT r2 weak #8).  They are marked `slow` here by name so a
@@ -79,6 +104,10 @@ SLOW_TESTS = {
     "test_delta_equals_full_on_fractional_reweight",
     "test_rolling_upgrade_under_io",
     "test_multi_mon_rolling_restart",
+    # spawns a 1-mon + 3-OSD process cluster (~17 s); the fast tier
+    # covers the same rollup logic via the Monitor-merge unit test in
+    # test_op_tracker.py
+    "test_daemon_slow_ops_roll_up_to_mon",
 }
 
 

@@ -252,3 +252,34 @@ def test_lockdep_detects_inversion():
     finally:
         lockdep.disable()
         lockdep.reset()
+
+
+def test_lockdep_unwinds_held_stack_on_exception():
+    """Held-lock bookkeeping must unwind when a `with` body raises: a
+    stale held entry would poison every later order check on this
+    thread (phantom edges, false inversions) — the exception path the
+    runtime checker's own `with` protocol has to get right."""
+    import pytest
+    from ceph_tpu.common import lockdep
+    lockdep.enable()
+    a = lockdep.LockdepLock("ld_exc_a")
+    b = lockdep.LockdepLock("ld_exc_b")
+    with pytest.raises(ValueError, match="boom"):
+        with a:
+            with b:
+                assert lockdep.held_locks() == ["ld_exc_a",
+                                                "ld_exc_b"]
+                raise ValueError("boom")
+    assert lockdep.held_locks() == []
+    # the a -> b edge recorded before the raise survives the unwind:
+    # the opposite order is still an inversion
+    with b:
+        with pytest.raises(lockdep.LockOrderError):
+            a.acquire()
+        # a failed acquire must leave no phantom held entry either
+        assert lockdep.held_locks() == ["ld_exc_b"]
+    assert lockdep.held_locks() == []
+    # and the locks stay usable in the recorded order
+    with a:
+        with b:
+            pass

@@ -1,0 +1,445 @@
+"""cephtpu-lint — the analysis framework itself plus the tier-1 gate.
+
+Per rule family: at least one fixture-verified TRUE POSITIVE, a
+negative (clean idiom stays clean), plus framework tests for
+``# noqa: CTL###`` suppression, baseline round-trip, the registry's
+EC-plugin-style contract, and finally the gate: the real tree must be
+lint-clean against the committed baseline on every pytest run.
+"""
+import json
+import pathlib
+import textwrap
+
+import pytest
+
+from ceph_tpu.analysis import baseline as baseline_mod
+from ceph_tpu.analysis import runner
+from ceph_tpu.analysis.core import Finding, LintError
+from ceph_tpu.analysis.registry import RuleRegistry
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+
+
+def write(tmp, rel, src):
+    p = tmp / rel
+    p.parent.mkdir(parents=True, exist_ok=True)
+    p.write_text(textwrap.dedent(src))
+    return p
+
+
+def lint(tmp, select=None, paths=None, evidence=None, baseline=None):
+    return runner.run(str(tmp), paths=paths or ["."],
+                      evidence_paths=evidence or [],
+                      select=select, baseline=baseline)
+
+
+def rules_of(res):
+    return [f.rule for f in res.findings]
+
+
+# ------------------------------------------- CTL1xx: JAX hot paths ---
+
+def test_ctl101_host_sync_in_jit_positive_and_negative(tmp_path):
+    write(tmp_path, "mod.py", """\
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        def helper(y):
+            return float(np.asarray(y).sum())     # hot via f
+
+        @jax.jit
+        def f(x):
+            x.block_until_ready()
+            return helper(x) + jnp.sum(x)
+
+        def host_only(x):
+            return np.asarray(x).item()           # not jit-reachable
+        """)
+    res = lint(tmp_path, select=["CTL101"])
+    msgs = [f.msg for f in res.findings]
+    assert len(res.findings) == 2, msgs
+    assert any("block_until_ready" in m for m in msgs)
+    assert any("numpy.asarray" in m for m in msgs)
+    assert all(f.line < 12 for f in res.findings), \
+        "host_only() is not jit-reachable and must stay clean"
+
+
+def test_ctl102_tracer_branch_and_static_arg_exemption(tmp_path):
+    write(tmp_path, "mod.py", """\
+        import functools
+        import jax
+
+        @jax.jit
+        def f(x):
+            if x > 0:                 # tracer branch
+                return x
+            return -x
+
+        @functools.partial(jax.jit, static_argnames=("n",))
+        def g(x, n):
+            if n > 2:                 # static: legitimate
+                return x * n
+            return x
+        """)
+    res = lint(tmp_path, select=["CTL102"])
+    assert rules_of(res) == ["CTL102"]
+    assert res.findings[0].line == 6
+    assert "x" in res.findings[0].msg
+
+
+def test_ctl103_jit_per_call(tmp_path):
+    write(tmp_path, "mod.py", """\
+        import jax
+
+        def per_call(x):
+            return jax.jit(lambda v: v + 1)(x)    # fresh wrapper
+
+        _hoisted = jax.jit(lambda v: v + 1)       # fine
+
+        def cached(x):
+            return _hoisted(x)
+        """)
+    res = lint(tmp_path, select=["CTL103"])
+    assert rules_of(res) == ["CTL103"]
+    assert res.findings[0].line == 4
+
+
+# --------------------------------------- CTL2xx: dtype invariants ---
+
+def test_ctl201_implicit_dtype_scoped_to_ops_placement(tmp_path):
+    src = """\
+        import jax.numpy as jnp
+        BAD = jnp.arange(8)
+        ALSO_BAD = jnp.arange(1, 8)         # stop is NOT a dtype
+        GOOD = jnp.arange(8, dtype=jnp.uint8)
+        ALSO_GOOD = jnp.zeros((4,), dtype=jnp.int32)
+        POS_GOOD = jnp.zeros((4,), jnp.int32)   # positional dtype
+        """
+    write(tmp_path, "ops/gfx.py", src)
+    write(tmp_path, "placement/mapx.py", src)
+    write(tmp_path, "other/hostx.py", src)      # out of scope
+    res = lint(tmp_path, select=["CTL201"])
+    assert rules_of(res) == ["CTL201"] * 4
+    assert {f.path for f in res.findings} == \
+        {"ops/gfx.py", "placement/mapx.py"}
+    assert sorted(f.line for f in res.findings) == [2, 2, 3, 3]
+
+
+def test_ctl202_unpinned_param_ingest_in_ops(tmp_path):
+    write(tmp_path, "ops/ing.py", """\
+        import jax.numpy as jnp
+
+        def encode(data):
+            return jnp.asarray(data)              # caller dtype leaks
+
+        def encode_pinned(data):
+            return jnp.asarray(data, jnp.uint8)   # positional dtype
+
+        def local_ok():
+            staged = [1, 2]
+            return jnp.asarray(staged)            # not a parameter
+        """)
+    res = lint(tmp_path, select=["CTL202"])
+    assert rules_of(res) == ["CTL202"]
+    assert res.findings[0].line == 4
+
+
+# ------------------------------------------- CTL3xx: concurrency ---
+
+def test_ctl301_cross_module_lock_order_inversion(tmp_path):
+    write(tmp_path, "cluster/locks_a.py", """\
+        from ceph_tpu.common.lockdep import LockdepLock
+        A = LockdepLock("fix.a")
+        B = LockdepLock("fix.b")
+
+        def forward():
+            with A:
+                with B:
+                    pass
+        """)
+    write(tmp_path, "cluster/locks_b.py", """\
+        from ceph_tpu.common.lockdep import LockdepLock
+        A = LockdepLock("fix.a")
+        B = LockdepLock("fix.b")
+
+        def reverse():
+            with B:
+                with A:
+                    pass
+        """)
+    res = lint(tmp_path, select=["CTL301"])
+    assert rules_of(res) == ["CTL301"]
+    assert "fix.a" in res.findings[0].msg and \
+        "fix.b" in res.findings[0].msg
+
+    # consistent order across both modules: clean
+    (tmp_path / "cluster/locks_b.py").write_text(textwrap.dedent("""\
+        from ceph_tpu.common.lockdep import LockdepLock
+        A = LockdepLock("fix.a")
+        B = LockdepLock("fix.b")
+
+        def same_way():
+            with A:
+                with B:
+                    pass
+        """))
+    assert not lint(tmp_path, select=["CTL301"]).findings
+
+
+def test_ctl302_raw_lock_scope_and_exemptions(tmp_path):
+    raw = """\
+        import threading
+
+        class Svc:
+            def __init__(self):
+                self._lock = threading.Lock()
+        """
+    write(tmp_path, "cluster/svc.py", raw)
+    write(tmp_path, "cluster/bluestore.py", raw)   # engine-exempt
+    write(tmp_path, "common/subst.py", raw)        # out of scope
+    write(tmp_path, "msg/fan.py", """\
+        from ceph_tpu.common.lockdep import LockdepLock
+
+        class Fan:
+            def __init__(self):
+                self._lock = LockdepLock("msg.fan")   # the fix
+        """)
+    res = lint(tmp_path, select=["CTL302"])
+    assert [(f.path, f.rule) for f in res.findings] == \
+        [("cluster/svc.py", "CTL302")]
+
+
+# --------------------------------- CTL4xx: perf/config hygiene ---
+
+def test_ctl401_undeclared_config_key(tmp_path):
+    write(tmp_path, "pkg/options.py", """\
+        TABLE = (
+            Option("declared_knob", "int", 4),
+        )
+        """)
+    write(tmp_path, "pkg/user.py", """\
+        from .options import config
+
+        def f():
+            a = config().get("declared_knob")
+            b = config().get("misspelled_knob")
+            return a, b
+        """)
+    res = lint(tmp_path, select=["CTL401"])
+    assert rules_of(res) == ["CTL401"]
+    assert "misspelled_knob" in res.findings[0].msg
+    assert res.findings[0].line == 5
+
+
+def test_ctl402_perf_type_conflict_across_modules(tmp_path):
+    write(tmp_path, "pkg/m1.py", """\
+        from ceph_tpu.common.perf_counters import perf as _perf
+        pc = _perf("grp")
+
+        def f():
+            pc.inc("mixed")
+            pc.inc("clean_counter")
+        """)
+    write(tmp_path, "pkg/m2.py", """\
+        from ceph_tpu.common.perf_counters import perf as _perf
+
+        class C:
+            def __init__(self):
+                self._pc = _perf("grp")
+
+            def g(self):
+                self._pc.tinc("mixed", 0.5)    # clash with m1 inc
+                self._pc.tinc("clean_avg", 0.5)
+        """)
+    res = lint(tmp_path, select=["CTL402"])
+    assert rules_of(res) == ["CTL402"]
+    assert "grp.mixed" in res.findings[0].msg
+
+
+def test_ctl403_read_never_written(tmp_path):
+    write(tmp_path, "pkg/reader.py", """\
+        from ceph_tpu.common.perf_counters import perf
+
+        def peek():
+            return (perf("grp").get("stale_name"),
+                    perf("grp").get("live_name"))
+        """)
+    write(tmp_path, "pkg/writer.py", """\
+        from ceph_tpu.common.perf_counters import perf
+
+        def bump():
+            perf("grp").inc("live_name")
+        """)
+    res = lint(tmp_path, select=["CTL403"])
+    assert rules_of(res) == ["CTL403"]
+    assert "grp.stale_name" in res.findings[0].msg
+
+
+# ------------------------------------ CTL5xx: admin registry ---
+
+def test_ctl501_dispatch_without_register(tmp_path):
+    write(tmp_path, "pkg/srv.py", """\
+        def wire(server):
+            server.register("perf dump", lambda a: {})
+        """)
+    write(tmp_path, "pkg/cli.py", """\
+        def call(sock):
+            return admin_request(sock, {"prefix": "perf dmup"})
+        """)
+    res = lint(tmp_path, select=["CTL501"])
+    assert rules_of(res) == ["CTL501"]
+    assert "perf dmup" in res.findings[0].msg
+
+
+def test_ctl502_register_without_dispatch_tests_count(tmp_path):
+    write(tmp_path, "pkg/srv.py", """\
+        def wire(server):
+            server.register("exercised", lambda a: {})
+            server.register("lonely", lambda a: {})
+        """)
+    write(tmp_path, "tests/test_srv.py", """\
+        def test_cmd(srv):
+            assert srv.handle({"prefix": "exercised"})
+        """)
+    res = lint(tmp_path, select=["CTL502"], paths=["pkg"],
+               evidence=["tests"])
+    assert rules_of(res) == ["CTL502"]
+    assert "lonely" in res.findings[0].msg
+
+
+# ------------------------------------------- framework behavior ---
+
+def test_noqa_inline_suppression(tmp_path):
+    write(tmp_path, "cluster/svc.py", """\
+        import threading
+
+        L1 = threading.Lock()  # noqa: CTL302 -- leaf lock, measured
+        L2 = threading.Lock()  # noqa
+        L3 = threading.Lock()  # noqa: CTL999 (wrong code: still fires)
+        L4 = threading.Lock()  # noqa: E402
+        """)
+    # a flake8-style code list must NOT blanket-suppress CTL rules
+    res = lint(tmp_path, select=["CTL302"])
+    assert [f.line for f in res.findings] == [5, 6]
+    assert len(res.noqa) == 2
+
+
+def test_baseline_round_trip(tmp_path):
+    mod = write(tmp_path, "cluster/svc.py", """\
+        import threading
+        L = threading.Lock()
+        """)
+    res = lint(tmp_path, select=["CTL302"])
+    assert len(res.findings) == 1
+
+    bpath = tmp_path / "lint_baseline.json"
+    baseline_mod.save(str(bpath), res.findings)
+    data = json.loads(bpath.read_text())
+    assert [e["rule"] for e in data["findings"]] == ["CTL302"]
+
+    # baselined: reported separately, not a failure
+    res2 = lint(tmp_path, select=["CTL302"], baseline=str(bpath))
+    assert not res2.findings and len(res2.baselined) == 1 and \
+        not res2.stale_baseline
+
+    # the finding moves lines -> still matched (identity is msg-based)
+    mod.write_text("import threading\n# pushed down\nL = "
+                   "threading.Lock()\n")
+    res3 = lint(tmp_path, select=["CTL302"], baseline=str(bpath))
+    assert not res3.findings and len(res3.baselined) == 1
+
+    # fixed for real -> the baseline entry goes stale (visible rot)
+    mod.write_text("from ceph_tpu.common.lockdep import "
+                   "LockdepLock\nL = LockdepLock('x')\n")
+    res4 = lint(tmp_path, select=["CTL302"], baseline=str(bpath))
+    assert not res4.findings and res4.stale_baseline
+
+    # a run scoped to ANOTHER family cannot see CTL302 findings, so
+    # the entry is out of scope — not stale
+    res5 = lint(tmp_path, select=["CTL1"], baseline=str(bpath))
+    assert not res5.stale_baseline
+
+
+def test_write_baseline_select_preserves_other_families(tmp_path):
+    """`--write-baseline --select CTL3` must not silently drop the
+    other families' grandfathered entries."""
+    import io
+    write(tmp_path, "cluster/svc.py",
+          "import threading\nL = threading.Lock()\n")
+    write(tmp_path, "ops/gfx.py",
+          "import jax.numpy as jnp\nA = jnp.arange(8)\n")
+    bpath = tmp_path / "base.json"
+    baseline_mod.save(str(bpath), [
+        ("CTL201", "ops/gfx.py",
+         "jnp.arange() without dtype= materializes int64/float64 "
+         "under jax_enable_x64 (emulated 64-bit ops on TPU) — pin "
+         "the dtype")])
+    out = io.StringIO()
+    rc = runner.main(["--root", str(tmp_path), "--write-baseline",
+                      "--baseline", str(bpath),
+                      "--select", "CTL302", "."], out=out)
+    assert rc == 0
+    entries = baseline_mod.load(str(bpath))
+    rules = sorted(r for r, _, _ in entries)
+    assert rules == ["CTL201", "CTL302"], rules
+
+
+def test_registry_mirrors_plugin_contract():
+    reg = RuleRegistry.instance()
+    ids = reg.names()
+    # one rule family minimum per the five invariant classes
+    for family in ("CTL1", "CTL2", "CTL3", "CTL4", "CTL5"):
+        assert any(r.startswith(family) for r in ids), family
+    with pytest.raises(LintError, match="already registered"):
+        reg.add("CTL301", type(reg.factory("CTL301")))
+    with pytest.raises(LintError, match="version"):
+        reg.add("CTL999", type(reg.factory("CTL301")),
+                version="0.0.0-elsewhere")
+    with pytest.raises(LintError, match="unknown lint rule"):
+        reg.factory("CTL888")
+    with pytest.raises(LintError, match="no rules match"):
+        reg.create(["XYZ9"])
+
+
+def test_cli_json_and_check_exit_codes(tmp_path, capsys):
+    import io
+    write(tmp_path, "cluster/svc.py",
+          "import threading\nL = threading.Lock()\n")
+    out = io.StringIO()
+    rc = runner.main(["--root", str(tmp_path), "--json", "--check",
+                      "--select", "CTL302", "."], out=out)
+    assert rc == 1
+    payload = json.loads(out.getvalue())
+    assert payload["count"] == 1
+    assert payload["findings"][0]["rule"] == "CTL302"
+    assert "CTL302" in payload["rules"]
+
+    out = io.StringIO()
+    rc = runner.main(["--root", str(tmp_path), "--check",
+                      "--select", "CTL301", "."], out=out)
+    assert rc == 0
+
+
+def test_syntax_error_is_a_finding(tmp_path):
+    write(tmp_path, "broken.py", "def f(:\n")
+    res = lint(tmp_path)
+    assert [f.rule for f in res.findings] == ["CTL000"]
+
+
+# ----------------------------------------------- the tier-1 gate ---
+
+def test_tree_is_lint_clean():
+    """`scripts/lint.py --check` equivalent, run on every pytest run:
+    a new violation anywhere in ceph_tpu/ or scripts/ fails the suite
+    before review.  The committed baseline is capped small so every
+    grandfathered exception stays reviewable."""
+    res = runner.run(
+        str(REPO),
+        baseline=str(REPO / "scripts" / "lint_baseline.json"))
+    assert not res.findings, "new lint findings:\n" + \
+        "\n".join(f.render() for f in res.findings)
+    assert len(res.baselined) <= 10, \
+        "baseline grew past the 10-entry budget — fix, don't hide"
+    assert not res.stale_baseline, \
+        f"stale baseline entries: {res.stale_baseline}"

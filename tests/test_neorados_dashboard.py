@@ -83,7 +83,11 @@ def test_dashboard_api():
     port = dash.start_http()
     try:
         def get(path):
-            c = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+            # 60s: /api/pgs compiles the batched mapper on first
+            # hit — a cold-cache compile on a 1-core host blows a
+            # 10s budget (pre-existing flake)
+            c = http.client.HTTPConnection("127.0.0.1", port,
+                                           timeout=60)
             c.request("GET", path)
             r = c.getresponse()
             body = r.read()
@@ -124,7 +128,7 @@ def test_dashboard_pg_perf_crush_config():
     try:
         def get(path):
             c = http.client.HTTPConnection("127.0.0.1", port,
-                                           timeout=10)
+                                           timeout=60)
             c.request("GET", path)
             r = c.getresponse()
             body = r.read()

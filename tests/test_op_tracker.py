@@ -321,6 +321,44 @@ def test_prometheus_label_escaping():
     assert _esc('p\\q"r\ns') == 'p\\\\q\\"r\\ns'
 
 
+# -------------------------------------------- daemon slow-op rollup ---
+
+def test_daemon_slow_ops_roll_up_into_mon_health(trk):
+    """PR 1's known gap, closed: daemonized OSDs own their trackers in
+    other processes, so the mon's SLOW_OPS check must merge the
+    summaries they report over the wire (report_slow_ops on the OSD
+    heartbeat -> Monitor.record_daemon_slow_ops) with its local
+    tracker.  A zero report clears the daemon's contribution."""
+    sim = make_sim()
+    mon = Monitor(sim.osdmap)
+    assert not any(c.code == "SLOW_OPS" for c in mon.health())
+
+    # two daemons report; counts sum, daemons union, oldest is max
+    mon.record_daemon_slow_ops("osd.7", {
+        "num": 3, "blocked": 1, "recent": 2, "oldest_s": 42.5,
+        "daemons": ["osd.7"], "by_daemon": {"osd.7": 3}})
+    mon.record_daemon_slow_ops("osd.2", {
+        "num": 1, "blocked": 0, "recent": 1, "oldest_s": 7.0,
+        "daemons": ["osd.2"], "by_daemon": {"osd.2": 1}})
+    checks = [c for c in mon.health() if c.code == "SLOW_OPS"]
+    assert len(checks) == 1
+    assert checks[0].severity == "HEALTH_WARN"
+    assert "4 slow ops" in checks[0].summary
+    assert "42.500" in checks[0].summary
+    assert "osd.2" in checks[0].summary
+    assert "osd.7" in checks[0].summary
+
+    # one daemon drains -> its share drops out; the other remains
+    mon.record_daemon_slow_ops("osd.7", {"num": 0})
+    checks = [c for c in mon.health() if c.code == "SLOW_OPS"]
+    assert len(checks) == 1 and "1 slow ops" in checks[0].summary
+    assert "osd.7" not in checks[0].summary
+
+    # a reporter gone silent for > 600s ages out entirely
+    mon._daemon_slow["osd.2"]["ts"] -= 601.0
+    assert not any(c.code == "SLOW_OPS" for c in mon.health())
+
+
 # ------------------------------------------------------- smoke script ---
 
 @pytest.mark.smoke

@@ -35,6 +35,39 @@ def _client(d):
     return RemoteCluster(d)
 
 
+def test_daemon_slow_ops_roll_up_to_mon(tmp_path, monkeypatch):
+    """ISSUE 2 satellite (PR 1's known gap): each OSD process owns its
+    own OpTracker, so its slow ops used to be visible only on its own
+    asok.  Now the OSD heartbeat reports slow_ops_summary() to the mon
+    (report_slow_ops) and the mon's SLOW_OPS health check covers the
+    whole daemon cluster.  complaint_time=0 via env (inherited by the
+    spawned daemons) makes every tracked op count as slow."""
+    monkeypatch.setenv("CEPH_TPU_OP_TRACKER_COMPLAINT_TIME", "0")
+    d = str(tmp_path / "cluster")
+    build_cluster_dir(d, n_osds=3, osds_per_host=1, fsync=False)
+    v = Vstart(d)
+    v.start(3, hb_interval=0.25)
+    try:
+        rc = _client(d)
+        for i in range(4):
+            assert rc.put(1, f"slow{i}", b"x" * 512) >= 1
+        deadline = time.monotonic() + 30
+        codes = {}
+        while time.monotonic() < deadline:
+            h = rc.mon_call({"cmd": "health"})
+            codes = {c["code"]: c for c in h["checks"]}
+            if "SLOW_OPS" in codes:
+                break
+            time.sleep(0.3)
+        assert "SLOW_OPS" in codes, f"no rollup; checks: {codes}"
+        assert h["status"] in ("HEALTH_WARN", "HEALTH_ERR")
+        # attribution names the reporting daemon(s), not "unknown"
+        assert "osd." in codes["SLOW_OPS"]["summary"]
+        rc.close()
+    finally:
+        v.stop()
+
+
 def test_replicated_io_and_sigkill_recovery(cluster):
     d, v = cluster
     rc = _client(d)
