@@ -1,0 +1,136 @@
+"""CTL6xx — faultpoint registry closure.
+
+The fault-injection registry (common/faults.py) is a string-keyed
+dispatch seam like the admin registry: ``faults.declare("name", doc)``
+on one side, ``faults.fire("name", ...)`` on the other, and nothing
+ties them together until a thrash run arms the point.  A typo'd fire
+name silently never fires (the dict-miss fast path eats it), which is
+the worst failure mode a fault-injection system can have — the soak
+"passes" while injecting nothing.  And a ``faults.fire`` inside
+jit-reachable code is a host-side branch in a traced program: it
+either burns the compiled path or bakes one outcome in at trace time.
+
+  CTL601  a literal ``faults.fire("name")`` whose name no
+          ``faults.declare("name", ...)`` site declares
+  CTL602  ``faults.fire`` reachable under jit (reuses the CTL1xx
+          jit-reachability graph, analysis/astutil.py)
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from . import astutil
+from .core import Finding, ParsedModule, Rule
+
+
+def _faults_recv(node: ast.AST, aliases: Dict[str, str]) -> bool:
+    """True when the attribute receiver is the faults module/registry
+    (``faults.fire``, an aliased import, or ``faults.registry()``)."""
+    r = astutil.resolve(node, aliases)
+    if r is not None and (r == "faults" or r.endswith(".faults") or
+                          r.endswith("faults.registry")):
+        return True
+    # registry() call receiver: faults.registry().fire(...)
+    if isinstance(node, ast.Call):
+        rc = astutil.resolve(node.func, aliases)
+        return rc is not None and rc.endswith("registry")
+    return False
+
+
+def _collect(mod: ParsedModule):
+    """(declared, fired) literal faultpoint names with sites — once
+    per module, shared by CTL601/CTL602 (the rules_admin pattern)."""
+    cached = mod._cache.get("faultpoints")
+    if cached is not None:
+        return cached
+    aliases = astutil.import_aliases(mod.tree)
+    declared: Dict[str, Tuple[str, int]] = {}
+    fired: Dict[str, Tuple[str, int]] = {}
+    for node in ast.walk(mod.tree):
+        if not (isinstance(node, ast.Call) and
+                isinstance(node.func, ast.Attribute)):
+            continue
+        if node.func.attr not in ("declare", "fire", "arm"):
+            continue
+        if not _faults_recv(node.func.value, aliases):
+            continue
+        if not (node.args and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)):
+            continue
+        name = node.args[0].value
+        if node.func.attr == "declare":
+            declared.setdefault(name, (mod.relpath, node.lineno))
+        elif node.func.attr == "fire":
+            fired.setdefault(name, (mod.relpath, node.lineno))
+    mod._cache["faultpoints"] = (declared, fired)
+    return declared, fired
+
+
+class UndeclaredFireRule(Rule):
+    rule_id = "CTL601"
+    name = "faultpoint-fire-undeclared"
+    description = ("faults.fire() names a faultpoint no "
+                   "faults.declare() site declares — the dict-miss "
+                   "fast path silently never fires it")
+
+    def __init__(self) -> None:
+        self.declared: Set[str] = set()
+        self.fired: Dict[str, List[Tuple[str, int]]] = {}
+
+    def check_module(self, mod: ParsedModule) -> Iterable[Finding]:
+        declared, fired = _collect(mod)
+        self.declared.update(declared)       # evidence declares count
+        if not mod.evidence:
+            for name, site in fired.items():
+                self.fired.setdefault(name, []).append(site)
+        return ()
+
+    def finish(self) -> Iterable[Finding]:
+        out: List[Finding] = []
+        for name in sorted(set(self.fired) - self.declared):
+            for path, line in self.fired[name]:
+                out.append(Finding(
+                    self.rule_id, path, line,
+                    f"faultpoint {name!r} is fired here but no "
+                    f"faults.declare() site declares it — arming "
+                    f"raises and the fire is a silent no-op"))
+        return out
+
+
+class FireInJitRule(Rule):
+    rule_id = "CTL602"
+    name = "faultpoint-fire-in-jit"
+    description = ("faults.fire() inside jit-reachable code: a host "
+                   "branch in a traced program (bakes one outcome in "
+                   "at trace time)")
+
+    def check_module(self, mod: ParsedModule) -> Iterable[Finding]:
+        if mod.evidence:
+            return ()
+        info = astutil.hot_functions(mod)
+        if not info.hot:
+            return ()
+        aliases = astutil.import_aliases(mod.tree)
+        out: List[Finding] = []
+        seen: Set[int] = set()               # nested-hot dedup
+        for fn in info.hot:
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Call) and \
+                        isinstance(node.func, ast.Attribute) and \
+                        node.func.attr == "fire" and \
+                        _faults_recv(node.func.value, aliases) and \
+                        node.lineno not in seen:
+                    seen.add(node.lineno)
+                    out.append(self.finding(
+                        mod, node.lineno,
+                        f"faults.fire() inside jit-reachable "
+                        f"{getattr(fn, 'name', '<fn>')}() — the "
+                        f"branch is traced once and baked in; inject "
+                        f"at the dispatch boundary instead"))
+        return out
+
+
+def register(reg) -> None:
+    reg.add(UndeclaredFireRule.rule_id, UndeclaredFireRule)
+    reg.add(FireInJitRule.rule_id, FireInJitRule)

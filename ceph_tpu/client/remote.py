@@ -21,12 +21,12 @@ from __future__ import annotations
 
 import json
 import os
-import time
 from typing import Dict, List, Optional
 
 import numpy as np
 
 from ..common import auth as cx
+from ..common.backoff import ExpBackoff
 from ..common.op_tracker import tracker as _op_tracker
 from ..cluster.daemon import WireClient
 from ..cluster.osdmap import OSDMap, PGPool, POOL_ERASURE
@@ -63,6 +63,13 @@ class RemoteCluster:
         self._admin_path: Optional[str] = None
         import threading
         self._client_lock = threading.Lock()
+        # every retry sweep in this client paces itself here:
+        # exponential with deterministic per-entity jitter, so N
+        # clients hammering a recovering daemon decorrelate instead
+        # of stampeding in lockstep (and seeded runs reproduce)
+        import zlib as _zlib
+        self._backoff = ExpBackoff(
+            base=0.05, cap=1.0, seed=_zlib.crc32(entity.encode()))
         self.refresh_map()
 
     def serve_admin(self, name: str = "objecter") -> str:
@@ -131,7 +138,7 @@ class RemoteCluster:
                     self._connect_mon()
                 except (OSError, IOError) as e:
                     last = e
-                    time.sleep(0.05 * (attempt + 1))
+                    self._backoff.sleep(attempt)
                     continue
             try:
                 return self.mon.call(req)
@@ -143,7 +150,7 @@ class RemoteCluster:
                     pass
                 self.mon = None
                 if attempt < 2:
-                    time.sleep(0.05 * (attempt + 1))
+                    self._backoff.sleep(attempt)
         raise IOError(f"mon unreachable ({last})")
 
     # ---------------------------------------------------------------- map --
@@ -619,7 +626,7 @@ class RemoteCluster:
                     # booting cluster / transient all-down map: retry
                     # against a refreshed map like any other failure
                     last = IOError(f"{name}: no live replica target")
-                    time.sleep(0.1 * (attempt + 1))
+                    self._backoff.sleep(attempt)
                     try:
                         self.refresh_map()
                     except (OSError, IOError):
@@ -637,7 +644,7 @@ class RemoteCluster:
                     self.drop_osd_client(primary)
                     last = e
                     if attempt < 4:      # no backoff on the last throw
-                        time.sleep(0.05 * (attempt + 1))
+                        self._backoff.sleep(attempt)
                         try:
                             self.refresh_map()
                         except (OSError, IOError):
@@ -700,7 +707,7 @@ class RemoteCluster:
                 break
             # transient shard failure: re-pull the map (the target may
             # have been marked down/re-homed) and resend the misses
-            time.sleep(0.1 * (attempt + 1))
+            self._backoff.sleep(attempt)
             try:
                 self.refresh_map()
             except (OSError, IOError):
@@ -772,7 +779,7 @@ class RemoteCluster:
             except (OSError, IOError) as e:
                 last = e
                 if attempt < 2:      # no backoff on the last throw
-                    time.sleep(0.05 * (attempt + 1))
+                    self._backoff.sleep(attempt)
                     try:
                         self.refresh_map()
                     except (OSError, IOError):
@@ -993,7 +1000,7 @@ class RemoteCluster:
                 except (OSError, IOError) as e:
                     last = e
                     if attempt < 2:
-                        time.sleep(0.05 * (attempt + 1))
+                        self._backoff.sleep(attempt)
                         try:
                             self.refresh_map()
                         except (OSError, IOError):
@@ -1036,14 +1043,14 @@ class RemoteCluster:
             # replica alone could hide a degraded write; the log head
             # identifies the most-current survivor
             listed: Optional[List[str]] = None
-            for _ in range(3):
+            for attempt in range(3):
                 try:
                     listed = self.osd_call(
                         members[0],
                         {"cmd": "list_pg", "coll": [pool_id, pg]})
                     break
                 except (OSError, IOError):
-                    time.sleep(0.05)
+                    self._backoff.sleep(attempt)
             if listed is None:
                 # cheap pg_info probe first, then list only the
                 # best-head member; a member whose probe failed is
@@ -1097,14 +1104,14 @@ class RemoteCluster:
             if not members:
                 continue
             r = None
-            for _ in range(3):       # a skipped PG stays unrepaired
+            for attempt in range(3):  # a skipped PG stays unrepaired
                 try:
                     r = self.osd_call(members[0], {
                         "cmd": "recover_pg", "coll": [pool_id, pg],
                         "members": members})
                     break
                 except (OSError, IOError):
-                    time.sleep(0.05)
+                    self._backoff.sleep(attempt)
             if r is None:
                 continue
             for key in ("copied", "delta_objects",
@@ -1134,14 +1141,14 @@ class RemoteCluster:
             if not members:
                 continue
             r = None
-            for _ in range(3):       # a skipped PG goes unscrubbed
+            for attempt in range(3):  # a skipped PG goes unscrubbed
                 try:
                     r = self.osd_call(members[0], {
                         "cmd": "scrub_pg", "coll": [pool_id, pg],
                         "members": members, "repair": repair})
                     break
                 except (OSError, IOError):
-                    time.sleep(0.05)
+                    self._backoff.sleep(attempt)
             if r is None:
                 continue
             totals["objects"] += r["objects"]
@@ -1394,7 +1401,7 @@ class RemoteCluster:
                     last = e
                     if attempt == 2:
                         raise
-                    time.sleep(0.1 * (attempt + 1))
+                    self._backoff.sleep(attempt)
                     try:
                         self.refresh_map()
                     except (OSError, IOError):

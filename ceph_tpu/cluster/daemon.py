@@ -34,15 +34,36 @@ import secrets
 import socket
 import threading
 import time
+import zlib
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from ..common import auth as cx
+from ..common import faults
 from ..common.admin import AdminServer
+from ..common.backoff import ExpBackoff
 from ..common.lockdep import LockdepLock
 from ..common.op_tracker import mark_active, tracker as _op_tracker
 from ..msg import encoding
 from ..msg.queue import Envelope
 from ..msg import wire
+
+# daemon-tier faultpoints: the ms_inject_socket_failures option is now
+# a registry client (name + status field kept for compat), and the
+# thrasher's crash/hang axes fire at the op-dispatch phase boundary
+# (select a phase by arming with match={"cmd": "put_shard"})
+faults.declare("wire.inject_socket_failures",
+               "drop the connection mid-request without a reply — the "
+               "reference's ms_inject_socket_failures axis, armed "
+               "one-in-N from the cluster spec; every client path "
+               "must reconnect and retry")
+faults.declare("daemon.crash_op",
+               "kill this daemon process (os._exit) as a wire op "
+               "arrives — the thrashosds kill_osd axis at a chosen "
+               "phase (arm with match={'cmd': ...})")
+faults.declare("daemon.hang_op",
+               "stall a wire op for params['seconds'] (default 0.5) "
+               "before dispatch — the stalled-daemon axis feeding the "
+               "SLOW_OPS / heartbeat pipelines")
 
 # message types
 MSG_AUTH_NONCE = 0x01
@@ -93,7 +114,14 @@ class WireServer:
         ms_inject_socket_failures option, src/common/options.cc) —
         on average one in N requests has its connection dropped
         WITHOUT a reply, exercising every client's reconnect/retry
-        path; 0 disables."""
+        path; 0 disables.  Implemented on the faultpoint registry
+        (``wire.inject_socket_failures``, seeded from the service
+        name so runs reproduce); the registry is process-wide, so the
+        last arm in a multi-server process sets the schedule and every
+        server in that process drops — daemon processes host exactly
+        one server.  The option is only the boot-time arming path: a
+        runtime ``fault_injection`` asok arm works identically on a
+        daemon whose spec option was 0."""
         self.sock_path = sock_path
         self.service = service
         self.keyring = keyring
@@ -101,6 +129,10 @@ class WireServer:
         self.handler = handler
         self.inject_socket_failures = int(inject_socket_failures)
         self.injected = 0
+        if self.inject_socket_failures > 0:
+            faults.arm("wire.inject_socket_failures", mode="one_in",
+                       n=self.inject_socket_failures,
+                       seed=zlib.crc32(service.encode()))
         self.auth_failures = 0
         self._stop = threading.Event()
         if os.path.exists(sock_path):
@@ -174,15 +206,24 @@ class WireServer:
             while not self._stop.is_set():
                 try:
                     env = wire.recv_frame(conn, session_key=key)
-                except (wire.WireClosed, OSError):
+                except OSError:
+                    # covers clean closes (WireClosed) AND rejected
+                    # frames (WireError is an IOError == OSError):
+                    # a poisoned frame (flip_bit) drops the
+                    # connection, the client's retry path reconnects
                     return
                 if env.type != MSG_REQ:
                     continue
-                if self.inject_socket_failures > 0 and \
-                        secrets.randbelow(
-                            self.inject_socket_failures) == 0:
+                if faults.fire("wire.inject_socket_failures",
+                               service=self.service) is not None:
                     # drop the connection mid-op, no reply — the
-                    # msgr-failure-injection suite axis
+                    # msgr-failure-injection suite axis, now a
+                    # registry client (fire counts on perf("faults")).
+                    # No option gate here: armed-or-not lives in the
+                    # registry alone, so a runtime asok arm works on a
+                    # daemon whose spec option was 0 (an arm that
+                    # silently injected nothing would be exactly the
+                    # CTL601 failure mode)
                     self.injected += 1
                     return
                 try:
@@ -895,8 +936,11 @@ class OSDDaemon:
     def boot(self) -> None:
         """Announce up + fetch the map (MOSDBoot).  Retries with a
         fresh mon connection: a transient drop (mon restarting,
-        injected socket failure) at boot must not kill the daemon."""
+        injected socket failure) at boot must not kill the daemon.
+        Exponential backoff with per-daemon jitter — N OSDs booting
+        against one recovering mon must not stampede in lockstep."""
         last: Optional[Exception] = None
+        backoff = ExpBackoff(base=0.1, cap=1.0, seed=self.id)
         for attempt in range(5):
             try:
                 mon = self.mon_client()
@@ -911,7 +955,7 @@ class OSDDaemon:
                     except OSError:
                         pass
                     self._mon = None
-                time.sleep(0.1 * (attempt + 1))
+                backoff.sleep(attempt)
         raise IOError(f"osd.{self.id}: boot failed ({last})")
 
     def _pglog(self, coll: Tuple[int, int]):
@@ -968,6 +1012,16 @@ class OSDDaemon:
 
     def _handle(self, entity: str, req: Dict[str, Any]) -> Any:
         cmd = req["cmd"]
+        inj = faults.fire("daemon.hang_op", cmd=cmd)
+        if inj is not None:
+            # stalled dispatch: ops pile up behind this connection's
+            # thread; the OpTracker complaint window / peer heartbeats
+            # are what notice
+            time.sleep(float(inj.get("seconds", 0.5)))
+        if faults.fire("daemon.crash_op", cmd=cmd) is not None:
+            # process death mid-op: no reply, no cleanup — exactly the
+            # thrasher's kill -9; durable state must carry the cluster
+            os._exit(17)
         if cmd not in self._TRACKED_CMDS:
             return self._handle_inner(entity, req)
         tr = _op_tracker()

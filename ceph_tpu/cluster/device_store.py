@@ -30,7 +30,15 @@ from __future__ import annotations
 from dataclasses import dataclass
 from typing import Dict, Iterable, Optional, Tuple
 
+from ..common import faults
+
 ShardKey = Tuple[int, int, str, int]
+
+faults.declare("device.staging_drop",
+               "evict a CLEAN staged HBM entry at read time (forced "
+               "re-upload from the durable bytes) — models HBM "
+               "pressure/invalidation racing the read path; dirty "
+               "entries are never dropped (they are the only copy)")
 
 
 @dataclass(frozen=True)
@@ -331,6 +339,13 @@ class DeviceShardCache:
         the bytes underneath) drops the stale staging."""
         e = self._entries.get(key)
         if e is None:
+            self.misses += 1
+            return None
+        if e.csum is not None and \
+                faults.fire("device.staging_drop") is not None:
+            # clean entries only: a dirty entry is the authoritative
+            # copy awaiting flush and must never be injected away
+            self.evict(key)
             self.misses += 1
             return None
         if e.csum is not None and e.csum != store_csum:

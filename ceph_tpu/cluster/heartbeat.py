@@ -13,7 +13,7 @@ timers.
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Dict, List, Set
+from typing import Dict, List, Optional, Set
 
 from .monitor import Monitor
 
@@ -28,10 +28,15 @@ class HeartbeatMonitor:
     """Drives ping rounds over a ClusterSim's OSD liveness."""
 
     def __init__(self, sim, mon: Monitor,
-                 cfg: HeartbeatConfig = HeartbeatConfig()):
+                 cfg: Optional[HeartbeatConfig] = None):
         self.sim = sim
         self.mon = mon
-        self.cfg = cfg
+        # None -> a FRESH config per monitor: the old
+        # `cfg=HeartbeatConfig()` default was evaluated once at class
+        # definition, so every default-constructed monitor SHARED one
+        # mutable instance (a test tweaking grace_ticks on its monitor
+        # silently retuned every other default monitor in the process)
+        self.cfg = cfg if cfg is not None else HeartbeatConfig()
         self.missed: Dict[int, Dict[int, int]] = {}   # target -> {peer: n}
         self.marked_down: List[int] = []
 

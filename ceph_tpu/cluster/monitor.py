@@ -29,9 +29,16 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from ..common import faults
 from ..common.options import LEVEL_FILE, OptionError, config
 from ..placement.crush_map import ITEM_NONE
 from .osdmap import Incremental, OSDMap
+
+faults.declare("mon.map_churn",
+               "piggyback an extra empty epoch bump on a committed "
+               "incremental — map churn without state change, forcing "
+               "every subscriber through its catch-up/resend path "
+               "(the thrash-map-epochs axis)")
 
 
 # ------------------------------------------------------------- consensus ---
@@ -244,11 +251,23 @@ class Monitor:
         if self._proposer is not None:
             # wire quorum: commit applies on every rank (incl. here)
             # through apply_committed_incremental before this returns
-            return self._proposer(("osdmap", inc))
-        if not self.paxos.propose(("osdmap", inc)):
-            return False
-        self.apply_committed_incremental(inc, paxos_marker=True)
-        return True
+            ok = self._proposer(("osdmap", inc))
+        else:
+            if not self.paxos.propose(("osdmap", inc)):
+                return False
+            self.apply_committed_incremental(inc, paxos_marker=True)
+            ok = True
+        if ok and not getattr(self, "_churning", False) and \
+                faults.fire("mon.map_churn") is not None:
+            # one extra EMPTY epoch: subscribers must catch up again.
+            # Reentrancy-guarded — the churn commit re-enters here and
+            # an `always` schedule would otherwise recurse forever.
+            self._churning = True
+            try:
+                self.commit_incremental(self.next_incremental())
+            finally:
+                self._churning = False
+        return ok
 
     def apply_committed_incremental(self, inc: Incremental,
                                     paxos_marker: bool = False) -> None:

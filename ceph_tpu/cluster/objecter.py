@@ -17,6 +17,7 @@ import copy
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Tuple
 
+from ..common.backoff import ExpBackoff, TickClock
 from ..common.op_tracker import tracker as _op_tracker
 from ..common.perf_counters import perf as _perf
 from ..common.tracer import tracer as _tracer
@@ -34,12 +35,19 @@ class Objecter:
     """Client with a cached map; submits ops with retry-on-map-change."""
 
     def __init__(self, sim: ClusterSim, mon: Monitor,
-                 max_retries: int = 8):
+                 max_retries: int = 8, seed: int = 0):
         self.sim = sim
         self.mon = mon
         # the client's PRIVATE map copy, caught up via incrementals
         self.osdmap = copy.deepcopy(sim.osdmap)
         self.max_retries = max_retries
+        # retry pacing: deterministic exponential backoff with jitter
+        # on a SIM-TICK clock — retries are instantaneous in wall time
+        # but carry a reproducible schedule (the thrasher's clock; a
+        # wall sleep here would make seeded soaks unreproducible)
+        self.clock = TickClock()
+        self._backoff = ExpBackoff(base=0.05, cap=2.0, seed=seed,
+                                   sleep=self.clock.sleep)
         self._pc = _perf("objecter")
 
     # ------------------------------------------------------------- maps --
@@ -88,6 +96,7 @@ class Objecter:
             with _tracer().start_span("objecter.op", pool=pool_id,
                                       obj=name) as span:
                 for attempt in range(self.max_retries):
+                    transient = False
                     if self._target_current(pool_id, name):
                         try:
                             with tr.track(top):
@@ -95,8 +104,12 @@ class Objecter:
                             span.set_tag("attempts", attempt + 1)
                             return result
                         except IOError:
+                            # transient failure at a CURRENT target
+                            # (EIO, injected drop): worth retrying on
+                            # its own, map progress or not
                             self._pc.inc("op_eio_retries")
                             top.mark_event("eio_retry", attempt=attempt)
+                            transient = True
                     else:
                         self._pc.inc("op_resends")
                         top.mark_event("resend",
@@ -106,13 +119,20 @@ class Objecter:
                         # map-wait stall resolved: new epochs arrived
                         top.mark_event("map_update", epochs=got,
                                        epoch=self.osdmap.epoch)
-                    if not got and attempt:
-                        # nothing new from the mon and still failing
+                    if not got and not transient and attempt:
+                        # stale target and the mon has nothing newer:
+                        # no amount of resending reaches a daemon the
+                        # map doesn't know about
                         span.set_tag("error", "no_usable_target")
                         error = "no_usable_target"
                         raise TooManyRetries(
                             f"{name}: no usable target at epoch "
                             f"{self.osdmap.epoch}")
+                    if attempt + 1 < self.max_retries:
+                        # deterministic exponential backoff with
+                        # jitter, on the sim-tick clock (no wall wait)
+                        self._pc.tinc("op_backoff_wait_s",
+                                      self._backoff.sleep(attempt))
                 span.set_tag("error", "retries_exhausted")
                 error = "retries_exhausted"
                 raise TooManyRetries(f"{name}: gave up after "
@@ -124,10 +144,29 @@ class Objecter:
         finally:
             tr.finish(top, error=error)
 
+    def _durable(self, pool_id: int, placed: List[int]) -> List[int]:
+        """The client half of the EC write contract
+        (src/osd/ECBackend.cc:1150 gather-all-commits, as the wire
+        client already enforces): a write that landed fewer than k
+        shards is NOT recoverable and must not ack — raising here
+        sends it back through the resend loop (stale copies were
+        purged, so the full rewrite is idempotent)."""
+        from .osdmap import POOL_ERASURE
+        pool = self.sim.osdmap.pools[pool_id]
+        if pool.type == POOL_ERASURE:
+            k = self.sim.codec_for(pool).get_data_chunk_count()
+            if len(placed) < k:
+                raise IOError(
+                    f"EC write degraded below k "
+                    f"({len(placed)} < {k} shards committed): "
+                    f"un-ackable, resend")
+        return placed
+
     def put(self, pool_id: int, name: str, data: bytes) -> List[int]:
         return self._submit(
-            lambda: self.sim.put(pool_id, name, data), pool_id, name,
-            optype="put")
+            lambda: self._durable(pool_id,
+                                  self.sim.put(pool_id, name, data)),
+            pool_id, name, optype="put")
 
     def get(self, pool_id: int, name: str) -> bytes:
         return self._submit(
@@ -137,5 +176,7 @@ class Objecter:
     def write(self, pool_id: int, name: str, offset: int,
               data: bytes) -> List[int]:
         return self._submit(
-            lambda: self.sim.write(pool_id, name, offset, data),
+            lambda: self._durable(pool_id,
+                                  self.sim.write(pool_id, name,
+                                                 offset, data)),
             pool_id, name, optype="write")

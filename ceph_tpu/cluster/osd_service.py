@@ -23,10 +23,17 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from ..common import faults
 from ..common.lockdep import LockdepLock
 from ..common.op_tracker import tracker as _op_tracker
 from ..common.perf_counters import perf as _perf
 from ..msg import encoding
+
+faults.declare("msg.drop_op",
+               "drop an op at the in-process messenger boundary "
+               "(queue admission raises IOError, no dispatch) — the "
+               "sim tier's frame-drop axis: sub-writes degrade and "
+               "recovery must repair, reads fail over")
 from ..msg.dispatcher import BatchingDispatcher
 from ..msg.queue import Envelope, MessageQueue, QueueClosed, QueueFull
 from ..msg.scheduler import CLASS_CLIENT, CLASS_RECOVERY, MClockScheduler
@@ -137,6 +144,12 @@ class OSDService:
         """Enqueue an op without waiting (the MOSDECSubOp fan-out
         shape: a primary keeps k+m sub-ops in flight concurrently,
         src/osd/ECBackend.cc:1976).  Pair with wait_async()."""
+        if faults.fire("msg.drop_op", osd=self.osd.id,
+                       kind=op.get("kind")) is not None:
+            # fires on the SUBMITTING thread (deterministic order for
+            # seeded thrash runs), before any state is registered
+            raise IOError(f"osd.{self.osd.id}: op dropped "
+                          f"(fault injected)")
         op_id = next(self._ids)
         ev = threading.Event()
         with self._lock:

@@ -28,6 +28,7 @@ from typing import Dict, List, Optional, Set, Tuple
 
 import numpy as np
 
+from ..common import faults
 from ..ec import instance as ec_registry
 from ..ec.interface import ErasureCodeError
 from ..ops import hashing
@@ -43,6 +44,18 @@ ShardKey = Tuple[int, int, str, int]   # (pool, pg, object, shard)
 # HBM budget for one recovery window-gather ([G, S, k+m, U] chunks of
 # the rebuild sweep materialize at most this many bytes each)
 REBUILD_GATHER_BUDGET = 1 << 30
+
+# device-store faultpoints (the bluestore read-error-injection role,
+# bluestore_debug_inject_read_err): armed by the thrasher, disarmed in
+# production — each fire site is a single dict-miss check when off
+faults.declare("device.eio",
+               "a shard read returns EIO (None) — degraded-read "
+               "decode / replica failover / recovery retry must "
+               "absorb it (bluestore read-error injection role)")
+faults.declare("device.read_corruption",
+               "a shard read returns payload bytes with one bit "
+               "flipped — models media corruption below the checksum "
+               "tier; deep scrub's parity re-encode is the detector")
 
 
 class _StoreView:
@@ -119,6 +132,8 @@ class SimOSD:
     def get(self, key: ShardKey) -> Optional[np.ndarray]:
         if not self.alive:
             return None
+        if faults.fire("device.eio", osd=self.id) is not None:
+            return None      # injected EIO: same face as a bad csum
         dirty = self.dev.dirty_get(key)
         if dirty is not None:
             # dirty staged entry IS the authoritative copy (WAL role):
@@ -131,6 +146,14 @@ class SimOSD:
             return None      # EIO: serve nothing, not bad bytes
         except ObjectStoreError:
             return None
+        if data and faults.fire("device.read_corruption",
+                                osd=self.id) is not None:
+            # sub-checksum media corruption: one flipped bit in a COPY
+            # (the durable bytes stay intact; deep scrub catches the
+            # served lie via parity re-encode)
+            buf = bytearray(data)
+            buf[0] ^= 0x01
+            return np.frombuffer(bytes(buf), dtype=np.uint8)
         # read-only view over the immutable bytes: shard readers never
         # mutate in place, and skipping the copy halves read traffic
         return np.frombuffer(data, dtype=np.uint8)
@@ -179,6 +202,8 @@ class SimOSD:
         durable bytes (checksum-verified) and stage for next time."""
         if not self.alive:
             return None
+        if faults.fire("device.eio", osd=self.id) is not None:
+            return None      # injected EIO on the device read path
         coll, oid = self._split(key)
         arr = self.dev.get(key, self._csum(coll, oid))
         if arr is not None:
@@ -474,9 +499,14 @@ class ClusterSim:
     def _read_shard(self, pool_id: int, pg: int, name: str, shard: int,
                     up: List[int]) -> Optional[np.ndarray]:
         """Up set first, then any live OSD (stale-map/pre-recovery).
-        Reads travel through the OSD's queue/scheduler front end."""
+        Reads travel through the OSD's queue/scheduler front end; a
+        dropped op (msg.drop_op injection) reads as source-unavailable
+        and fails over to the next holder."""
         for o in self._shard_sources(up, shard):
-            p = self.services[o].get((pool_id, pg, name, shard))
+            try:
+                p = self.services[o].get((pool_id, pg, name, shard))
+            except IOError:
+                continue
             if p is not None:
                 return p
         return None
@@ -526,7 +556,10 @@ class ClusterSim:
         for o in self._shard_sources(up, shard):
             if not self.osds[o].has(key):
                 continue
-            a = self.services[o].get_device(key)
+            try:
+                a = self.services[o].get_device(key)
+            except IOError:
+                continue       # dropped op: next holder
             if a is not None:
                 return a
         return None
@@ -1164,7 +1197,11 @@ class ClusterSim:
             sources = [o for o in up if o != ITEM_NONE] + \
                 [o.id for o in self.osds]
             for o in sources:
-                payload = self.services[o].get((pool_id, pg, name, 0))
+                try:
+                    payload = self.services[o].get(
+                        (pool_id, pg, name, 0))
+                except IOError:
+                    continue   # dropped op: replica failover
                 if payload is not None:
                     return payload.tobytes()[:info.size]
             raise IOError(f"object {name}: no replica available")
@@ -1521,8 +1558,11 @@ class ClusterSim:
                 for o in up:
                     if o != ITEM_NONE and self.osds[o].alive and \
                             self.osds[o].get((pool_id, pg, name, 0)) is None:
-                        self.services[o].put_recovery(
-                            (pool_id, pg, name, 0), payload)
+                        try:
+                            self.services[o].put_recovery(
+                                (pool_id, pg, name, 0), payload)
+                        except IOError:
+                            continue      # dropped push: next pass
                         stats["shards_copied"] += 1
             return stats
 
@@ -1559,14 +1599,17 @@ class ClusterSim:
                 tgt = up[shard] if shard < len(up) else ITEM_NONE
                 if tgt != ITEM_NONE and self.osds[tgt].alive and \
                         not self.osds[tgt].has((pool_id, pg, name, shard)):
-                    if dev:
-                        self.services[tgt].put_device_recovery(
-                            (pool_id, pg, name, shard), payload,
-                            np.asarray(payload).tobytes() if eager
-                            else None)
-                    else:
-                        self.services[tgt].put_recovery(
-                            (pool_id, pg, name, shard), payload)
+                    try:
+                        if dev:
+                            self.services[tgt].put_device_recovery(
+                                (pool_id, pg, name, shard), payload,
+                                np.asarray(payload).tobytes() if eager
+                                else None)
+                        else:
+                            self.services[tgt].put_recovery(
+                                (pool_id, pg, name, shard), payload)
+                    except IOError:
+                        continue          # dropped push: next pass
                     stats["shards_copied"] += 1
             if not missing:
                 continue
@@ -1600,9 +1643,12 @@ class ClusterSim:
                     tgt = up[shard] if shard < len(up) else ITEM_NONE
                     if tgt == ITEM_NONE or not self.osds[tgt].alive:
                         continue
-                    self.services[tgt].put_recovery(
-                        (pool_id, pg, name, shard),
-                        part[:, i].reshape(-1))
+                    try:
+                        self.services[tgt].put_recovery(
+                            (pool_id, pg, name, shard),
+                            part[:, i].reshape(-1))
+                    except IOError:
+                        continue          # dropped push: next pass
                     stats["shards_rebuilt"] += 1
         return stats
 
@@ -1724,9 +1770,12 @@ class ClusterSim:
                     continue
                 b = np.ascontiguousarray(
                     rebuilt_host[:, i]).tobytes() if eager else None
-                self.services[tgt].put_device_recovery(
-                    (pool_id, pg, name, shard),
-                    ShardRef(rebuilt, i, axis=1), b)
+                try:
+                    self.services[tgt].put_device_recovery(
+                        (pool_id, pg, name, shard),
+                        ShardRef(rebuilt, i, axis=1), b)
+                except IOError:
+                    continue              # dropped push: next pass
                 stats["shards_rebuilt"] += 1
 
     def _rebuild_chunk_dev(self, pool_id, codec, k, mm, n, comp,
@@ -1782,10 +1831,13 @@ class ClusterSim:
                 b = np.ascontiguousarray(
                     rebuilt_host[pos:pos + n_str, i]
                 ).tobytes() if eager else None
-                self.services[tgt].put_device_recovery(
-                    (pool_id, pg, name, shard),
-                    ShardRef(rebuilt, i, axis=1, s0=pos,
-                             s1=pos + n_str), b)
+                try:
+                    self.services[tgt].put_device_recovery(
+                        (pool_id, pg, name, shard),
+                        ShardRef(rebuilt, i, axis=1, s0=pos,
+                                 s1=pos + n_str), b)
+                except IOError:
+                    continue              # dropped push: next pass
                 stats["shards_rebuilt"] += 1
 
     def recover_delta(self, pool_id: int) -> Dict[str, int]:
@@ -1881,8 +1933,12 @@ class ClusterSim:
                     ok = False       # undetected-dead member stays stale
                     continue
                 if self.osds[o].get((pool.id, pg, name, 0)) is None:
-                    self.services[o].put_recovery(
-                        (pool.id, pg, name, 0), payload)
+                    try:
+                        self.services[o].put_recovery(
+                            (pool.id, pg, name, 0), payload)
+                    except IOError:
+                        ok = False        # dropped push: gap stays
+                        continue
                     stats["shards_copied"] += 1
             return ok
         codec = self.codec_for(pool)
@@ -1906,14 +1962,18 @@ class ClusterSim:
                 if tgt != ITEM_NONE and self.osds[tgt].alive and \
                         not self.osds[tgt].has(
                             (pool.id, pg, name, shard)):
-                    if dev:
-                        self.services[tgt].put_device_recovery(
-                            (pool.id, pg, name, shard), f,
-                            np.asarray(f).tobytes() if eager else None)
-                    else:
-                        self.services[tgt].put_recovery(
-                            (pool.id, pg, name, shard), f)
-                    stats["shards_copied"] += 1
+                    try:
+                        if dev:
+                            self.services[tgt].put_device_recovery(
+                                (pool.id, pg, name, shard), f,
+                                np.asarray(f).tobytes() if eager
+                                else None)
+                        else:
+                            self.services[tgt].put_recovery(
+                                (pool.id, pg, name, shard), f)
+                        stats["shards_copied"] += 1
+                    except IOError:
+                        ok = False        # dropped push: gap stays
         if not missing:
             return True
         try:
@@ -1937,15 +1997,20 @@ class ClusterSim:
             if tgt == ITEM_NONE or not self.osds[tgt].alive:
                 ok = False
                 continue
-            if dev:
-                b = np.ascontiguousarray(dec_host[:, i]).tobytes() \
-                    if eager else None
-                self.services[tgt].put_device_recovery(
-                    (pool.id, pg, name, shard),
-                    ShardRef(dec, i, axis=1), b)
-            else:
-                self.services[tgt].put_recovery(
-                    (pool.id, pg, name, shard), dec[:, i].reshape(-1))
+            try:
+                if dev:
+                    b = np.ascontiguousarray(
+                        dec_host[:, i]).tobytes() if eager else None
+                    self.services[tgt].put_device_recovery(
+                        (pool.id, pg, name, shard),
+                        ShardRef(dec, i, axis=1), b)
+                else:
+                    self.services[tgt].put_recovery(
+                        (pool.id, pg, name, shard),
+                        dec[:, i].reshape(-1))
+            except IOError:
+                ok = False                # dropped push: gap stays
+                continue
             stats["shards_rebuilt"] += 1
         return ok
 

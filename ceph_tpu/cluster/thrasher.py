@@ -1,0 +1,379 @@
+"""Thrasher — seeded kill/revive soak with self-healing invariants.
+
+The role of teuthology's ``thrashosds`` task (qa/tasks/thrashosds.py +
+ceph_manager.py kill_osd/revive_osd/out_osd/in_osd): under client
+load, randomly kill and revive OSDs, mark them out and in, and arm
+fault-injection points — then prove the failure pipeline actually
+self-heals:
+
+  I1  every client op completes (OpTracker shows zero stuck in-flight)
+  I2  zero data loss (readback of every object matches the oracle)
+  I3  deep scrub reports 0 inconsistencies after repair
+  I4  health converges to HEALTH_OK within a bounded number of ticks
+  I5  every armed faultpoint fired at least once (perf-counter proof —
+      a soak whose injections never happened proves nothing)
+
+Everything is driven off ONE seeded ``random.Random``: the kill/revive
+schedule, write payloads, and the faultpoint schedules (seeded from
+the run seed) — the same seed reproduces the identical schedule and
+identical fire counts, which is what turns "it survived chaos once"
+into a regression test (the determinism the online-EC studies need to
+measure degraded-mode behavior under *correlated* failures).
+
+Runs against the in-process tier (ClusterSim + Monitor +
+HeartbeatMonitor + Objecter): kills are undetected process deaths
+(``fail_osd``) that the heartbeat → failure-report → mark-down →
+peering → log-delta-recovery pipeline must notice and repair, exactly
+the pipeline the reference exercises.  Time is simulation ticks —
+heartbeat ticks and the objecter's TickClock — so a full soak takes no
+wall-clock sleeps.
+"""
+from __future__ import annotations
+
+import json
+import random
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from ..common import faults
+from ..common.op_tracker import tracker as _op_tracker
+from .heartbeat import HeartbeatConfig, HeartbeatMonitor
+from .monitor import Monitor
+from .objecter import Objecter, TooManyRetries
+
+# (name, mode, n) triples armed by default: the wire axis (in-process
+# messenger frame drops) and the device-EIO axis — the acceptance
+# pair.  Seeds derive from the run seed so schedules reproduce.
+DEFAULT_FAULTPOINTS: Tuple[Tuple[str, str, int], ...] = (
+    ("msg.drop_op", "one_in", 6),
+    ("device.eio", "one_in", 8),
+)
+
+
+@dataclass
+class ThrashConfig:
+    seed: int = 0
+    cycles: int = 5                   # kill/revive rounds
+    objects: int = 6                  # oracle objects per pool
+    object_size: int = 6144
+    writes_per_cycle: int = 3         # client load between fault events
+    reads_per_cycle: int = 3          # oracle reads between fault
+    # events (continuous I2 verification AND the read-path injection
+    # surface — a writes-only soak never evaluates device.eio)
+    max_down: int = 2                 # concurrent undetected deaths;
+    # must stay <= EC m and < replicated size or kills alone lose data
+    revive_prob: float = 0.5          # chance a cycle revives someone
+    mark_out_prob: float = 0.3        # chance a down OSD is marked out
+    settle_ticks: int = 25            # health-convergence bound (I4)
+    grace_ticks: int = 1              # heartbeat grace before report
+    faultpoints: Sequence[Tuple[str, str, int]] = DEFAULT_FAULTPOINTS
+
+
+class Thrasher:
+    """One seeded soak over a ClusterSim + Monitor stack."""
+
+    def __init__(self, sim, mon: Monitor, pool_ids: Sequence[int],
+                 cfg: Optional[ThrashConfig] = None):
+        self.sim = sim
+        self.mon = mon
+        self.pool_ids = list(pool_ids)
+        self.cfg = cfg or ThrashConfig()
+        self.rng = random.Random(self.cfg.seed)
+        self.hb = HeartbeatMonitor(
+            sim, mon, HeartbeatConfig(grace_ticks=self.cfg.grace_ticks))
+        self.client = Objecter(sim, mon, max_retries=16,
+                               seed=self.cfg.seed)
+        self.schedule: List[Tuple] = []   # the reproducibility record
+        self.oracle: Dict[Tuple[int, str], bytes] = {}
+        self.down: List[int] = []         # currently-killed OSDs
+        self.out: List[int] = []          # currently-marked-out OSDs
+        self.failures: List[str] = []     # broken invariants, as found
+
+    # ------------------------------------------------------------ pieces --
+    def _log(self, *event: Any) -> None:
+        self.schedule.append(tuple(event))
+
+    def _blob(self, n: int) -> bytes:
+        return bytes(self.rng.getrandbits(8) for _ in range(n))
+
+    def _write(self, pool_id: int, name: str) -> None:
+        """One tracked client write; retried across map catch-up (the
+        resend contract) — a TooManyRetries here after detection ticks
+        is a genuine invariant failure and surfaces in the report."""
+        data = self._blob(self.cfg.object_size)
+        try:
+            self.client.put(pool_id, name, data)
+        except TooManyRetries as e:
+            self.failures.append(f"write {pool_id}/{name} did not "
+                                 f"complete: {e}")
+            return
+        self.oracle[(pool_id, name)] = data
+        self._log("write", pool_id, name)
+
+    def _read(self, pool_id: int, name: str) -> None:
+        """One tracked client read, checked against the oracle AS the
+        cluster degrades — reads mid-thrash are both continuous
+        data-loss verification and the read-path injection surface
+        (device.eio / replica failover / degraded decode)."""
+        want = self.oracle.get((pool_id, name))
+        if want is None:
+            return
+        try:
+            got = self.client.get(pool_id, name)
+        except (TooManyRetries, IOError) as e:
+            self.failures.append(f"read {pool_id}/{name} did not "
+                                 f"complete: {e}")
+            return
+        if got != want:
+            self.failures.append(f"read {pool_id}/{name}: payload "
+                                 f"mismatch mid-thrash")
+        self._log("read", pool_id, name)
+
+    def _pick(self) -> Tuple[int, str]:
+        pool_id = self.pool_ids[self.rng.randrange(
+            len(self.pool_ids))]
+        return pool_id, f"thrash-{self.rng.randrange(self.cfg.objects)}"
+
+    def _load(self) -> None:
+        for _ in range(self.cfg.writes_per_cycle):
+            self._write(*self._pick())
+        for _ in range(self.cfg.reads_per_cycle):
+            self._read(*self._pick())
+
+    def _kill_one(self) -> None:
+        alive = [o.id for o in self.sim.osds
+                 if o.alive and o.id not in self.down]
+        if not alive or len(self.down) >= self.cfg.max_down:
+            return
+        victim = alive[self.rng.randrange(len(alive))]
+        self.sim.fail_osd(victim)          # undetected death: the
+        self.down.append(victim)           # heartbeat pipeline's job
+        self._log("kill", victim)
+        if self.rng.random() < self.cfg.mark_out_prob:
+            inc = self.mon.next_incremental()
+            inc.new_weight[victim] = 0
+            if self.mon.commit_incremental(inc):
+                self.out.append(victim)
+                self._log("out", victim)
+
+    def _revive_one(self) -> None:
+        if not self.down:
+            return
+        osd = self.down.pop(self.rng.randrange(len(self.down)))
+        self.sim.restart_osd(osd)
+        self.mon.osd_boot(osd)             # epoch reaches subscribers
+        if osd in self.out:
+            self.out.remove(osd)
+            self._log("in", osd)
+        self._log("revive", osd)
+
+    def _tick_detection(self) -> None:
+        """Heartbeat rounds until every current death is map-visible
+        (bounded): client resends need the epoch to move."""
+        for _ in range(self.cfg.grace_ticks + 2):
+            newly = self.hb.tick()
+            if newly:
+                self._log("marked_down", tuple(sorted(newly)))
+
+    def _recover(self) -> None:
+        for pool_id in self.pool_ids:
+            st = self.sim.recover_delta(pool_id)
+            self._log("recover", pool_id, st.get("delta_objects", 0),
+                      st.get("backfill_pgs", 0))
+
+    # --------------------------------------------------------------- run --
+    def run(self) -> Dict[str, Any]:
+        cfg = self.cfg
+        # fire counts are reported as THIS run's delta: the registry's
+        # cumulative tally survives disarm (by design — proof outlives
+        # the schedule), so back-to-back runs must not double-count
+        fires0 = faults.fire_counts()
+        for i, (name, mode, n) in enumerate(cfg.faultpoints):
+            faults.arm(name, mode=mode, n=n, seed=cfg.seed * 1000 + i)
+            self._log("arm", name, mode, n)
+        failures = self.failures
+        try:
+            # steady-state oracle before the first fault
+            for pool_id in self.pool_ids:
+                for j in range(cfg.objects):
+                    self._write(pool_id, f"thrash-{j}")
+            for cycle in range(cfg.cycles):
+                self._log("cycle", cycle)
+                self._kill_one()
+                self._tick_detection()
+                self._load()
+                self._recover()
+                if self.rng.random() < cfg.revive_prob:
+                    self._revive_one()
+                    self._tick_detection()
+                    self._recover()
+            # settle: stop injecting, bring everyone back, repair
+            # until health converges (the reference's thrasher also
+            # stops thrashing before its final wait_for_clean)
+            fire_counts = {
+                name: faults.fire_counts().get(name, 0) -
+                fires0.get(name, 0)
+                for name, _, _ in cfg.faultpoints}
+            for name, _, _ in cfg.faultpoints:
+                faults.disarm(name)
+            self._log("settle")
+            # _revive_one un-marks out AND restores in-weight
+            # (osd_boot commits weight 0x10000), so draining `down`
+            # also drains `out` — out is only ever a subset of down
+            while self.down:
+                self._revive_one()
+            self._tick_detection()
+            health = ""
+            health_ticks = cfg.settle_ticks
+            for tick in range(cfg.settle_ticks):
+                self._recover()
+                self.hb.tick()
+                health = self.mon.health_status(self.sim)
+                if health == "HEALTH_OK":
+                    health_ticks = tick + 1
+                    break
+            if health != "HEALTH_OK":                        # I4
+                checks = [f"{c.code}: {c.summary}"
+                          for c in self.mon.health(self.sim)]
+                failures.append(
+                    f"health did not converge within "
+                    f"{cfg.settle_ticks} ticks: {health} ({checks})")
+            # I1: nothing stuck in flight
+            inflight = _op_tracker().dump_ops_in_flight()["num_ops"]
+            if inflight:
+                failures.append(f"{inflight} ops stuck in flight")
+            # I2: readback against the oracle — zero data loss
+            lost: List[str] = []
+            for (pool_id, name), want in sorted(self.oracle.items()):
+                try:
+                    got = self.client.get(pool_id, name)
+                except (IOError, KeyError) as e:
+                    lost.append(f"{pool_id}/{name}: unreadable ({e})")
+                    continue
+                if got != want:
+                    lost.append(f"{pool_id}/{name}: payload mismatch")
+            failures.extend(lost)
+            # I3: deep scrub (EC parity re-encode) clean after repair
+            scrub_bad = 0
+            for pool_id in self.pool_ids:
+                bad = self.sim.scrub(pool_id)
+                if bad:
+                    self._recover()              # repair, then re-check
+                    bad = self.sim.scrub(pool_id)
+                scrub_bad += len(bad)
+            if scrub_bad:
+                failures.append(
+                    f"deep scrub: {scrub_bad} inconsistencies "
+                    f"after repair")
+            # I5: the injections really happened
+            for name, _, _ in cfg.faultpoints:
+                if fire_counts.get(name, 0) < 1:
+                    failures.append(
+                        f"faultpoint {name} armed but never fired — "
+                        f"the soak exercised nothing")
+            return {
+                "seed": cfg.seed,
+                "cycles": cfg.cycles,
+                "schedule": [list(e) for e in self.schedule],
+                "fire_counts": fire_counts,
+                "invariants": {
+                    "ops_in_flight": inflight,
+                    "objects_checked": len(self.oracle),
+                    "data_loss": lost,
+                    "scrub_inconsistencies": scrub_bad,
+                    "health": health,
+                    "health_ticks": health_ticks,
+                    "backoff_ticks": self.client.clock.sleeps,
+                },
+                "failures": failures,
+                "ok": not failures,
+            }
+        finally:
+            for name, _, _ in cfg.faultpoints:
+                faults.disarm(name)
+
+
+# ------------------------------------------------------------ standalone --
+
+def build_default_stack(n_hosts: int = 8, osds_per_host: int = 3,
+                        k: int = 4, m: int = 2):
+    """A self-contained sim cluster for `ceph thrash` and the
+    robustness smoke: replicated + EC pools over a flat host tree
+    (same geometry as the test suite's standard sim, so persistent
+    XLA cache entries are shared)."""
+    from ..placement.builder import build_flat_cluster
+    from ..placement.crush_map import (RULE_CHOOSELEAF_FIRSTN,
+                                       RULE_CHOOSELEAF_INDEP,
+                                       RULE_EMIT, RULE_TAKE, Rule)
+    from .osdmap import OSDMap, PGPool, POOL_ERASURE, POOL_REPLICATED
+    from .simulator import ClusterSim
+    cmap, root = build_flat_cluster(n_hosts=n_hosts,
+                                    osds_per_host=osds_per_host,
+                                    seed=0)
+    host_type = 1
+    cmap.add_rule(Rule(steps=[(RULE_TAKE, root, 0),
+                              (RULE_CHOOSELEAF_FIRSTN, 0, host_type),
+                              (RULE_EMIT, 0, 0)]))
+    cmap.add_rule(Rule(steps=[(RULE_TAKE, root, 0),
+                              (RULE_CHOOSELEAF_INDEP, 0, host_type),
+                              (RULE_EMIT, 0, 0)]))
+    om = OSDMap(cmap)
+    om.mark_all_in_up()
+    om.add_pool(PGPool(id=1, name="rep", type=POOL_REPLICATED, size=3,
+                       pg_num=32, crush_rule=0))
+    om.add_pool(PGPool(id=2, name="ec", type=POOL_ERASURE, size=k + m,
+                       pg_num=32, crush_rule=1,
+                       erasure_code_profile="default"))
+    sim = ClusterSim(om)
+    sim.create_ec_profile("default", {"plugin": "jax", "k": str(k),
+                                      "m": str(m)})
+    mon = Monitor(sim.osdmap, failure_reports_needed=2)
+    return sim, mon
+
+
+def main(argv: Optional[Sequence[str]] = None, out=None) -> int:
+    """`ceph thrash --seed N --cycles K --json`: a self-contained
+    seeded soak emitting the invariant report (exit 1 on any broken
+    invariant).  Needs no cluster dir — like `ceph lint`, it builds
+    its own stack."""
+    import argparse
+    import sys
+    out = out or sys.stdout
+    ap = argparse.ArgumentParser(
+        prog="ceph thrash",
+        description="seeded kill/revive soak with self-healing "
+                    "invariants (the thrashosds role)")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--cycles", type=int, default=5)
+    ap.add_argument("--objects", type=int, default=6)
+    ap.add_argument("--json", action="store_true")
+    ns = ap.parse_args(argv)
+    sim, mon = build_default_stack()
+    try:
+        t = Thrasher(sim, mon, [1, 2],
+                     ThrashConfig(seed=ns.seed, cycles=ns.cycles,
+                                  objects=ns.objects))
+        report = t.run()
+    finally:
+        sim.shutdown()
+    if ns.json:
+        out.write(json.dumps(report, indent=2, sort_keys=True,
+                             default=str) + "\n")
+    else:
+        inv = report["invariants"]
+        out.write(
+            f"thrash seed={report['seed']} cycles={report['cycles']}: "
+            f"{len(report['schedule'])} events, "
+            f"fires={report['fire_counts']}, "
+            f"objects={inv['objects_checked']}, "
+            f"health={inv['health']} "
+            f"(in {inv['health_ticks']} ticks)\n")
+        for f in report["failures"]:
+            out.write(f"FAIL: {f}\n")
+        if report["ok"]:
+            out.write("all invariants held\n")
+    return 0 if report["ok"] else 1
+
+
+if __name__ == "__main__":      # pragma: no cover
+    raise SystemExit(main())

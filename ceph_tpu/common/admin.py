@@ -48,6 +48,10 @@ class AdminServer:
                       lambda a: tracker().dump_historic_ops())
         self.register("dump_historic_slow_ops",
                       lambda a: tracker().dump_historic_slow_ops())
+        # runtime fault-injection control (the thrasher's per-daemon
+        # arming surface; fire counts prove injections happened)
+        from .faults import admin_handler as _fault_admin
+        self.register("fault_injection", _fault_admin)
         self.register("help", lambda a: sorted(self._handlers))
 
     @staticmethod

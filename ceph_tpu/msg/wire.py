@@ -26,7 +26,24 @@ import struct
 import zlib
 from typing import Optional
 
+from ..common import faults
 from .queue import Envelope
+
+# messenger-frame faultpoints (the qa msgr-failures suite axes): armed
+# by the thrasher / fault_injection admin command, never in production
+faults.declare("wire.drop_frame",
+               "drop an outbound frame before any byte hits the "
+               "socket (connection torn down, peer sees a clean "
+               "close) — the ms_inject_socket_failures send half")
+faults.declare("wire.truncate_frame",
+               "send only the first half of a frame, then tear the "
+               "connection down — the peer's length-prefixed read "
+               "unblocks with WireClosed when the socket dies")
+faults.declare("wire.flip_bit",
+               "flip one bit in the last byte of the assembled frame "
+               "(payload crc in plaintext mode, MAC trailer in secure "
+               "mode) — the receiver must REJECT the frame, never "
+               "deliver corrupt bytes")
 
 MAGIC = 0x43455054        # "CEPT"
 BANNER = b"ceph-tpu v1\n"
@@ -67,7 +84,17 @@ def send_frame(sock: socket.socket, env: Envelope,
     mac = b""
     if session_key is not None:
         mac = hmac.new(session_key, hdr + payload, "sha256").digest()
-    sock.sendall(hdr + payload + mac)
+    blob = hdr + payload + mac
+    if faults.fire("wire.drop_frame", type=env.type) is not None:
+        raise WireClosed("fault injected: frame dropped before send")
+    if faults.fire("wire.truncate_frame", type=env.type) is not None:
+        sock.sendall(blob[:max(1, len(blob) // 2)])
+        raise WireClosed("fault injected: frame truncated mid-send")
+    if faults.fire("wire.flip_bit", type=env.type) is not None:
+        # last byte = MAC trailer (secure) or the crc-covered payload
+        # tail / header crc field (plaintext): rejection either way
+        blob = blob[:-1] + bytes([blob[-1] ^ 0x01])
+    sock.sendall(blob)
 
 
 def recv_frame(sock: socket.socket,

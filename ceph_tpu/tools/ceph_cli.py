@@ -244,7 +244,8 @@ def cmd_scrub(rc, pool_id: int, out) -> int:
 DAEMON_COMMANDS = ("dump_ops_in_flight", "dump_historic_ops",
                    "dump_historic_slow_ops", "perf dump", "perf reset",
                    "config show", "config get", "config set",
-                   "trace dump", "trace reset", "help")
+                   "trace dump", "trace reset", "fault_injection",
+                   "help")
 
 
 def cmd_daemon(cluster_dir: str, name: str, words: List[str],
@@ -252,7 +253,15 @@ def cmd_daemon(cluster_dir: str, name: str, words: List[str],
     """`ceph daemon <osd.N|mon.N|objecter> <command...>` over the
     daemon's admin socket (admin_socket JSON protocol, common/admin.py).
     Multi-word admin prefixes ("perf dump") are joined; a trailing
-    KEY[=VALUE] pair becomes the request's key/value args."""
+    KEY[=VALUE] pair becomes the request's key/value args.
+
+    `fault_injection` takes its own grammar (runtime fault control):
+
+        ... daemon osd.0 fault_injection                 # status
+        ... daemon osd.0 fault_injection arm wire.drop_frame \\
+                mode=one_in n=5 seed=3 [count=2]
+        ... daemon osd.0 fault_injection disarm [NAME]
+    """
     import os
 
     from ..common.admin import admin_request
@@ -262,8 +271,29 @@ def cmd_daemon(cluster_dir: str, name: str, words: List[str],
                   f"(expected {path})\n")
         return 1
     req = {"prefix": " ".join(words)}
+    if words[0] == "fault_injection":
+        req = {"prefix": "fault_injection"}
+        rest = words[1:]
+        if rest:
+            req["action"] = rest[0]
+            pos = [w for w in rest[1:] if "=" not in w]
+            if pos:
+                req["name"] = pos[0]
+            for w in rest[1:]:
+                if "=" in w:
+                    k, v = w.split("=", 1)
+                    if k in ("mode", "n", "seed", "count"):
+                        req[k] = v
+                    elif k == "match":
+                        # phase filter: a JSON object on the command
+                        # line (match={"cmd":"put_shard"})
+                        req["match"] = json.loads(v)
+                    else:
+                        # anything else (e.g. seconds=0.2) rides as a
+                        # faultpoint param the fire site reads back
+                        req.setdefault("params", {})[k] = v
     # `config get KEY` / `config set KEY VALUE` style trailing args
-    if len(words) >= 3 and " ".join(words[:2]) in DAEMON_COMMANDS:
+    elif len(words) >= 3 and " ".join(words[:2]) in DAEMON_COMMANDS:
         req["prefix"] = " ".join(words[:2])
         req["key"] = words[2]
         if len(words) >= 4:
@@ -292,7 +322,9 @@ def main(argv: Optional[List[str]] = None,
                          "pg dump POOL | df | scrub POOL | "
                          "daemon NAME dump_ops_in_flight|"
                          "dump_historic_ops|dump_historic_slow_ops|"
-                         "perf dump | lint [--check|--json|...]")
+                         "perf dump|fault_injection [...] | "
+                         "lint [--check|--json|...] | "
+                         "thrash [--seed N --cycles K --json]")
     ns, extra = ap.parse_known_args(argv)
     if ns.words[0] == "lint":
         # static-analysis surface (ceph_tpu/analysis): needs no
@@ -300,6 +332,12 @@ def main(argv: Optional[List[str]] = None,
         # (`ceph lint --check`, `ceph lint --json`, ...)
         from ..analysis.runner import main as lint_main
         return lint_main(ns.words[1:] + extra, out=out)
+    if ns.words[0] == "thrash":
+        # robustness surface (`ceph thrash --seed N --cycles K
+        # --json`): a seeded kill/revive soak with self-healing
+        # invariants — builds its own in-process stack, no --dir
+        from ..cluster.thrasher import main as thrash_main
+        return thrash_main(ns.words[1:] + extra, out=out)
     if extra:
         ap.error(f"unrecognized arguments: {' '.join(extra)}")
     if ns.dir is None:

@@ -1,0 +1,96 @@
+#!/usr/bin/env python
+"""Robustness smoke check — a seeded thrash run, invariants asserted.
+
+Drives the whole ISSUE-3 failure pipeline in one pass: a small seeded
+kill/revive soak (cluster/thrasher.py) with the wire-drop and
+device-EIO faultpoints armed under client writes, then asserts the
+self-healing invariants —
+
+  * every client op completed (OpTracker: zero stuck in flight),
+  * zero data loss (readback matches the oracle for every object),
+  * deep scrub reports 0 inconsistencies after repair,
+  * health converged to HEALTH_OK within the tick bound,
+  * every armed faultpoint FIRED at least once (perf-counter proof),
+  * the identical seed reproduces the identical schedule and fire
+    counts (the regression-test property).
+
+Runs on CPU (no accelerator needed):
+
+    JAX_PLATFORMS=cpu python scripts/check_robustness.py
+
+Also wired as a fast pytest test (tests/test_thrasher.py, `smoke`
+marker) so CI covers it without a separate job — the
+check_observability.py pattern.
+"""
+from __future__ import annotations
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# runnable as `python scripts/check_robustness.py` from anywhere
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def _fail(msg: str) -> int:
+    print(f"FAIL: {msg}", file=sys.stderr)
+    return 1
+
+
+def run_once(seed: int, cycles: int = 3):
+    from ceph_tpu.cluster.thrasher import (Thrasher, ThrashConfig,
+                                           build_default_stack)
+    from ceph_tpu.common import faults
+    sim, mon = build_default_stack()
+    try:
+        t = Thrasher(sim, mon, [1, 2],
+                     ThrashConfig(seed=seed, cycles=cycles,
+                                  objects=4, writes_per_cycle=2))
+        return t.run()
+    finally:
+        sim.shutdown()
+        faults.reset()
+
+
+def main() -> int:
+    seed = 5
+    r1 = run_once(seed)
+    if not r1["ok"]:
+        return _fail("invariants broken: " + "; ".join(r1["failures"]))
+    inv = r1["invariants"]
+    if inv["ops_in_flight"] != 0:
+        return _fail(f"{inv['ops_in_flight']} ops stuck in flight")
+    if inv["data_loss"]:
+        return _fail(f"data loss: {inv['data_loss']}")
+    if inv["scrub_inconsistencies"] != 0:
+        return _fail(f"scrub found {inv['scrub_inconsistencies']} "
+                     f"inconsistencies after repair")
+    if inv["health"] != "HEALTH_OK":
+        return _fail(f"health ended {inv['health']}")
+    for name, n in r1["fire_counts"].items():
+        if n < 1:
+            return _fail(f"faultpoint {name} never fired")
+    if not r1["fire_counts"]:
+        return _fail("no faultpoint fired — the soak injected nothing")
+
+    # determinism: the identical seed reproduces the identical
+    # schedule and fire counts (what makes a chaos pass a regression
+    # test rather than an anecdote)
+    r2 = run_once(seed)
+    if r1["schedule"] != r2["schedule"]:
+        return _fail("same seed produced a different thrash schedule")
+    if r1["fire_counts"] != r2["fire_counts"]:
+        return _fail(f"same seed produced different fire counts: "
+                     f"{r1['fire_counts']} vs {r2['fire_counts']}")
+
+    print(f"OK: {len(r1['schedule'])} scheduled events over "
+          f"{r1['cycles']} cycles, fires={r1['fire_counts']}, "
+          f"{inv['objects_checked']} objects verified, "
+          f"health {inv['health']} in {inv['health_ticks']} ticks, "
+          f"schedule reproducible")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

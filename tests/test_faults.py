@@ -1,0 +1,348 @@
+"""FaultRegistry + faultpoint fire sites + deterministic backoff.
+
+The ISSUE-3 injection substrate: declaration/arming contracts, seeded
+schedule determinism, the dict-miss fast path, the per-daemon
+``fault_injection`` admin command, the wire frame faultpoints
+(drop/truncate/bit-flip over a socketpair), the device-store EIO and
+corruption points, the in-process messenger drop, and mon map churn.
+"""
+import socket
+
+import numpy as np
+import pytest
+
+from ceph_tpu.common import faults
+from ceph_tpu.common.admin import AdminServer
+from ceph_tpu.common.backoff import ExpBackoff, TickClock
+from ceph_tpu.common.faults import FaultError
+from ceph_tpu.common.perf_counters import perf
+from ceph_tpu.msg import wire
+from ceph_tpu.msg.queue import Envelope
+
+# scratch faultpoints for the registry unit tests (module-scope
+# declares, like production fire sites)
+faults.declare("test.scratch", "registry unit-test point")
+faults.declare("test.sched", "schedule determinism point")
+faults.declare("test.params", "params pass-through point")
+
+
+@pytest.fixture(autouse=True)
+def _clean_registry():
+    """Armed points are process-global state: never leak one into the
+    next test."""
+    yield
+    faults.reset()
+
+
+# ------------------------------------------------------------ registry ---
+
+def test_declare_is_idempotent_but_collision_raises():
+    faults.declare("test.scratch", "registry unit-test point")  # same
+    with pytest.raises(FaultError, match="different docstring"):
+        faults.declare("test.scratch", "some other doc")
+
+
+def test_arm_requires_declaration_and_valid_mode():
+    with pytest.raises(FaultError, match="unknown faultpoint"):
+        faults.arm("test.never_declared")
+    with pytest.raises(FaultError, match="unknown fault mode"):
+        faults.arm("test.scratch", mode="sometimes")
+    with pytest.raises(FaultError, match="one_in needs"):
+        faults.arm("test.scratch", mode="one_in", n=0)
+    with pytest.raises(FaultError, match="match must be a dict"):
+        # a stringly match (un-parsed CLI JSON) must be refused at arm
+        # time, not poison every later fire with an AttributeError
+        faults.arm("test.scratch", match='{"cmd": "put_shard"}')
+
+
+def test_disarmed_fire_is_none_and_counts_nothing():
+    before = faults.fire_counts().get("test.scratch", 0)
+    for _ in range(100):
+        assert faults.fire("test.scratch") is None
+    assert faults.fire_counts().get("test.scratch", 0) == before
+
+
+def test_always_nth_count_and_params():
+    faults.arm("test.scratch", mode="always", count=2)
+    assert faults.fire("test.scratch") == {}
+    assert faults.fire("test.scratch") == {}
+    assert faults.fire("test.scratch") is None     # count exhausted
+    assert faults.fire_counts()["test.scratch"] == 2
+
+    faults.arm("test.params", mode="nth", n=3, seconds=0.25)
+    assert faults.fire("test.params") is None
+    assert faults.fire("test.params") is None
+    assert faults.fire("test.params") == {"seconds": 0.25}
+    assert faults.fire("test.params") is None      # nth fires once
+
+
+def test_one_in_schedule_is_seed_deterministic():
+    def pattern(seed):
+        faults.arm("test.sched", mode="one_in", n=3, seed=seed)
+        out = [faults.fire("test.sched") is not None
+               for _ in range(30)]
+        faults.disarm("test.sched")
+        return out
+    a, b, c = pattern(42), pattern(42), pattern(43)
+    assert a == b                         # same seed: same schedule
+    assert a != c                         # decorrelated seeds
+    assert any(a) and not all(a)          # it is a schedule, not a knob
+
+
+def test_predicate_and_match_gate_on_context():
+    fired = []
+    faults.arm("test.scratch", mode="predicate",
+               predicate=lambda ctx: ctx.get("cmd") == "put_shard")
+    assert faults.fire("test.scratch", cmd="get_shard") is None
+    assert faults.fire("test.scratch", cmd="put_shard") is not None
+    faults.arm("test.scratch", mode="always",
+               match={"cmd": "put_shard"})
+    assert faults.fire("test.scratch", cmd="get_shard") is None
+    assert faults.fire("test.scratch", cmd="put_shard") is not None
+    del fired
+
+
+def test_fire_counts_survive_disarm_and_export_to_perf():
+    pc_before = perf("faults").get("test.scratch") or 0
+    faults.arm("test.scratch", mode="always")
+    faults.fire("test.scratch")
+    faults.disarm("test.scratch")
+    assert faults.fire_counts()["test.scratch"] >= 1
+    assert (perf("faults").get("test.scratch") or 0) == pc_before + 1
+
+
+def test_disarmed_fast_path_is_cheap():
+    """The acceptance bound: a disarmed faultpoint must be a single
+    dict-miss check.  100k disarmed fires in well under a second is a
+    very generous ceiling for that shape (it measures the guard, not
+    the machine)."""
+    import time
+    t0 = time.perf_counter()
+    for _ in range(100_000):
+        faults.fire("test.scratch")
+    assert time.perf_counter() - t0 < 1.0
+
+
+# ------------------------------------------------------ admin command ---
+
+def test_fault_injection_admin_command_round_trip():
+    srv = AdminServer()
+    st = srv.handle({"prefix": "fault_injection"})["result"]
+    assert "test.scratch" in st["declared"]
+    r = srv.handle({"prefix": "fault_injection", "action": "arm",
+                    "name": "test.scratch", "mode": "one_in",
+                    "n": 1, "seed": 7})["result"]
+    assert r["armed"] == "test.scratch"
+    assert faults.fire("test.scratch") is not None   # n=1: every call
+    st = srv.handle({"prefix": "fault_injection"})["result"]
+    assert st["armed"]["test.scratch"]["fires"] >= 1
+    assert st["fire_counts"]["test.scratch"] >= 1
+    r = srv.handle({"prefix": "fault_injection",
+                    "action": "disarm"})["result"]
+    assert r["disarmed"] == "all"
+    assert faults.fire("test.scratch") is None
+    # bad requests come back as errors, not tracebacks
+    assert "error" in srv.handle({"prefix": "fault_injection",
+                                  "action": "arm",
+                                  "name": "test.never_declared"})
+    assert "error" in srv.handle({"prefix": "fault_injection",
+                                  "action": "bogus"})
+
+
+# ------------------------------------------------------ wire faults ---
+
+def _frame_pair():
+    a, b = socket.socketpair()
+    a.settimeout(5.0)
+    b.settimeout(5.0)
+    return a, b
+
+
+def test_wire_drop_frame_raises_before_any_byte():
+    a, b = _frame_pair()
+    try:
+        faults.arm("wire.drop_frame", mode="nth", n=1)
+        with pytest.raises(wire.WireClosed, match="dropped"):
+            wire.send_frame(a, Envelope(0x10, 1, -1, b"payload"))
+        # nothing hit the socket; the next frame flows normally
+        wire.send_frame(a, Envelope(0x10, 2, -1, b"second"))
+        env = wire.recv_frame(b)
+        assert env.id == 2 and env.payload == b"second"
+    finally:
+        a.close()
+        b.close()
+
+
+def test_wire_truncate_frame_peer_sees_closed():
+    a, b = _frame_pair()
+    try:
+        faults.arm("wire.truncate_frame", mode="nth", n=1)
+        with pytest.raises(wire.WireClosed, match="truncated"):
+            wire.send_frame(a, Envelope(0x10, 1, -1, b"x" * 64))
+        a.close()            # connection torn down after the half-send
+        with pytest.raises(wire.WireClosed):
+            wire.recv_frame(b)
+    finally:
+        b.close()
+
+
+def test_wire_flip_bit_is_rejected_never_delivered():
+    a, b = _frame_pair()
+    try:
+        faults.arm("wire.flip_bit", mode="nth", n=1)
+        wire.send_frame(a, Envelope(0x10, 1, -1, b"y" * 64))
+        with pytest.raises(wire.WireError):
+            wire.recv_frame(b)             # crc mismatch: rejected
+        assert faults.fire_counts()["wire.flip_bit"] == 1
+    finally:
+        a.close()
+        b.close()
+
+
+def test_wire_flip_bit_rejected_in_secure_mode_too():
+    a, b = _frame_pair()
+    key = bytes(range(32))
+    try:
+        faults.arm("wire.flip_bit", mode="nth", n=1)
+        wire.send_frame(a, Envelope(0x10, 1, -1, b"z" * 64),
+                        session_key=key)
+        with pytest.raises(wire.WireError):
+            wire.recv_frame(b, session_key=key)   # MAC rejected
+    finally:
+        a.close()
+        b.close()
+
+
+# --------------------------------------------------- device faults ---
+
+def test_device_eio_and_read_corruption_on_simosd():
+    from ceph_tpu.cluster.simulator import SimOSD
+    osd = SimOSD(0)
+    key = (1, 0, "obj", 0)
+    payload = np.frombuffer(b"intact-bytes", dtype=np.uint8)
+    osd.put(key, payload)
+
+    faults.arm("device.eio", mode="nth", n=1)
+    assert osd.get(key) is None                  # injected EIO
+    assert bytes(osd.get(key)) == b"intact-bytes"   # next read fine
+
+    faults.arm("device.read_corruption", mode="nth", n=1)
+    got = bytes(osd.get(key))
+    assert got != b"intact-bytes" and len(got) == len(b"intact-bytes")
+    # the durable bytes were never touched: only the served copy lied
+    assert bytes(osd.get(key)) == b"intact-bytes"
+
+
+def test_device_staging_drop_evicts_clean_entry_only():
+    from ceph_tpu.cluster.device_store import DeviceShardCache, as_ref
+    cache = DeviceShardCache()
+    key = (1, 0, "o", 0)
+    ref = as_ref(np.arange(8, dtype=np.int32))
+    cache.put(key, ref, csum=123)                # clean
+    faults.arm("device.staging_drop", mode="nth", n=1)
+    assert cache.get(key, 123) is None           # injected eviction
+    assert not cache.has(key)
+    # dirty entries are the only copy: the injection must not touch them
+    cache.put(key, ref, csum=None)               # dirty
+    faults.arm("device.staging_drop", mode="always")
+    assert cache.dirty_get(key) is not None
+    assert cache.get(key, None) is not None
+
+
+# ------------------------------------------------- messenger faults ---
+
+def test_msg_drop_op_raises_and_failover_reads_survive():
+    from ceph_tpu.cluster.osd_service import OSDService
+    from ceph_tpu.cluster.simulator import SimOSD
+    svc = OSDService(SimOSD(3))
+    try:
+        key = (1, 0, "m", 0)
+        svc.put(key, np.frombuffer(b"abc", dtype=np.uint8))
+        faults.arm("msg.drop_op", mode="nth", n=1)
+        with pytest.raises(IOError, match="dropped"):
+            svc.get(key)
+        assert bytes(svc.get(key)) == b"abc"     # next op flows
+        assert faults.fire_counts()["msg.drop_op"] == 1
+    finally:
+        svc.stop()
+
+
+# ------------------------------------------------------- mon churn ---
+
+def test_mon_map_churn_bumps_an_extra_epoch():
+    from ceph_tpu.cluster.monitor import Monitor
+    from ceph_tpu.cluster.osdmap import OSDMap
+    from ceph_tpu.placement.builder import build_flat_cluster
+    cmap, _root = build_flat_cluster(n_hosts=2, osds_per_host=1,
+                                     seed=0)
+    om = OSDMap(cmap)
+    om.mark_all_in_up()
+    mon = Monitor(om)
+    e0 = mon.osdmap.epoch
+    inc = mon.next_incremental()
+    inc.new_weight[0] = 0
+    assert mon.commit_incremental(inc)
+    assert mon.osdmap.epoch == e0 + 1            # disarmed: one epoch
+
+    faults.arm("mon.map_churn", mode="nth", n=1)
+    inc = mon.next_incremental()
+    inc.new_weight[0] = 0x10000
+    assert mon.commit_incremental(inc)
+    # the committed mutation PLUS the injected empty churn epoch, and
+    # both ride the incremental stream subscribers consume
+    assert mon.osdmap.epoch == e0 + 3
+    assert len(mon.get_incrementals(e0)) == 3
+
+
+# ----------------------------------------------------------- backoff ---
+
+def test_exp_backoff_is_seed_deterministic_and_capped():
+    a = ExpBackoff(base=0.05, factor=2.0, cap=0.4, jitter=0.5, seed=9,
+                   sleep=lambda s: None)
+    b = ExpBackoff(base=0.05, factor=2.0, cap=0.4, jitter=0.5, seed=9,
+                   sleep=lambda s: None)
+    da = [a.delay(i) for i in range(8)]
+    db = [b.delay(i) for i in range(8)]
+    assert da == db
+    assert all(0 < d <= 0.4 for d in da)
+    # the envelope grows until the cap bites
+    assert max(da) > min(da)
+    c = ExpBackoff(seed=10, sleep=lambda s: None)
+    assert [c.delay(i) for i in range(8)] != da
+
+
+def test_tick_clock_never_wall_sleeps():
+    import time
+    clk = TickClock()
+    bo = ExpBackoff(base=0.5, cap=8.0, jitter=0.0, seed=0,
+                    sleep=clk.sleep)
+    t0 = time.perf_counter()
+    for i in range(6):
+        bo.sleep(i)
+    assert time.perf_counter() - t0 < 0.1        # no wall time passed
+    assert clk.sleeps == 6
+    assert clk.now == sum(min(8.0, 0.5 * 2 ** i) for i in range(6))
+
+
+def test_objecter_backoff_rides_the_tick_clock():
+    from ceph_tpu.cluster.monitor import Monitor
+    from ceph_tpu.cluster.objecter import Objecter, TooManyRetries
+    from tests.test_simulator import make_sim
+    sim = make_sim()
+    try:
+        mon = Monitor(sim.osdmap)
+        client = Objecter(sim, mon, max_retries=4)
+        client.put(2, "bk", b"payload")
+        pool = sim.osdmap.pools[2]
+        pg = sim.object_pg(pool, "bk")
+        sim.fail_osd(sim.pg_up(pool, pg)[0])     # mon never learns
+        import time
+        t0 = time.perf_counter()
+        with pytest.raises(TooManyRetries):
+            client.put(2, "bk", b"payload2")
+        # the retry loop backed off on SIM TICKS, not the wall
+        assert time.perf_counter() - t0 < 2.0
+        assert client.clock.sleeps >= 1
+        assert client.clock.now > 0.0
+    finally:
+        sim.shutdown()

@@ -308,6 +308,69 @@ def test_ctl502_register_without_dispatch_tests_count(tmp_path):
     assert "lonely" in res.findings[0].msg
 
 
+# ------------------------------------ CTL6xx: faultpoint closure ---
+
+def test_ctl601_fire_without_declare(tmp_path):
+    write(tmp_path, "pkg/site.py", """\
+        from ceph_tpu.common import faults
+
+        faults.declare("wire.drop", "declared and fired: clean")
+
+        def send():
+            if faults.fire("wire.drop") is not None:
+                return None
+            if faults.fire("wire.dorp") is not None:   # typo
+                return None
+            return 1
+        """)
+    res = lint(tmp_path, select=["CTL601"])
+    assert rules_of(res) == ["CTL601"]
+    assert "wire.dorp" in res.findings[0].msg
+    assert res.findings[0].line == 8
+
+
+def test_ctl601_declare_anywhere_in_tree_counts(tmp_path):
+    write(tmp_path, "pkg/decl.py", """\
+        from ceph_tpu.common import faults
+        faults.declare("dev.eio", "declared here")
+        """)
+    write(tmp_path, "pkg/site.py", """\
+        from ceph_tpu.common import faults
+
+        def read():
+            return faults.fire("dev.eio")
+        """)
+    assert not lint(tmp_path, select=["CTL601"]).findings
+
+
+def test_ctl602_fire_in_jit_reachable_code(tmp_path):
+    write(tmp_path, "pkg/kern.py", """\
+        import jax
+        from ceph_tpu.common import faults
+
+        faults.declare("kern.bad", "inside a traced path")
+        faults.declare("kern.ok", "at the dispatch boundary")
+
+        def helper(x):
+            if faults.fire("kern.bad") is not None:   # hot via f
+                return x
+            return x + 1
+
+        @jax.jit
+        def f(x):
+            return helper(x)
+
+        def dispatch(x):
+            if faults.fire("kern.ok") is not None:    # host side: fine
+                return None
+            return f(x)
+        """)
+    res = lint(tmp_path, select=["CTL602"])
+    assert rules_of(res) == ["CTL602"]
+    assert res.findings[0].line == 8
+    assert "jit-reachable" in res.findings[0].msg
+
+
 # ------------------------------------------- framework behavior ---
 
 def test_noqa_inline_suppression(tmp_path):
@@ -388,8 +451,8 @@ def test_write_baseline_select_preserves_other_families(tmp_path):
 def test_registry_mirrors_plugin_contract():
     reg = RuleRegistry.instance()
     ids = reg.names()
-    # one rule family minimum per the five invariant classes
-    for family in ("CTL1", "CTL2", "CTL3", "CTL4", "CTL5"):
+    # one rule family minimum per the six invariant classes
+    for family in ("CTL1", "CTL2", "CTL3", "CTL4", "CTL5", "CTL6"):
         assert any(r.startswith(family) for r in ids), family
     with pytest.raises(LintError, match="already registered"):
         reg.add("CTL301", type(reg.factory("CTL301")))

@@ -5,11 +5,17 @@ qa/suites/rados/** + src/common/options.cc): connections drop mid-op
 at random and every client path must reconnect and retry.  Here the
 wire server drops one in N requests without replying; the test runs a
 replicated workload through the RemoteCluster and requires zero
-client-visible failures AND proof that injections actually fired.
+client-visible failures AND proof that injections actually fired —
+both via the legacy ``injected_failures`` status field and via the
+faultpoint registry's fire counters on each daemon's admin socket
+(the option is a registry client since ISSUE 3).
 """
+import os
+
 import numpy as np
 import pytest
 
+from ceph_tpu.common.admin import admin_request
 from ceph_tpu.tools.vstart import Vstart, build_cluster_dir
 
 N_OSDS = 4
@@ -51,6 +57,64 @@ def test_workload_survives_socket_failures(tmp_path):
                 except (OSError, IOError):
                     rc.drop_osd_client(osd)
         assert injected > 0, "no socket failures were injected"
+        # and the registry agrees: each daemon's asok exposes the
+        # wire.inject_socket_failures fire count (the option is a
+        # faultpoint-registry client now).  Heartbeat/peer traffic
+        # keeps dropping between samples, so the check is monotone:
+        # sample the status field FIRST, then the fire count — fires
+        # can only have grown past it, never lag it
+        fired = 0
+        for osd in range(N_OSDS):
+            daemon_injected = 0
+            for _ in range(4):
+                try:
+                    daemon_injected = int(rc.osd_client(osd).call(
+                        {"cmd": "status"})["injected_failures"])
+                    break
+                except (OSError, IOError):
+                    rc.drop_osd_client(osd)
+            st = admin_request(
+                os.path.join(d, f"osd.{osd}.asok"),
+                {"prefix": "fault_injection"})["result"]
+            n = int(st["fire_counts"].get(
+                "wire.inject_socket_failures", 0))
+            fired += n
+            assert n >= daemon_injected, \
+                f"osd.{osd}: fire count {n} lags status field " \
+                f"{daemon_injected}"
+        assert fired > 0, "registry fire counters recorded nothing"
+        # perf dump exports the same counter (the fires-are-counters
+        # acceptance: tests can prove injections via `perf dump`)
+        pd = admin_request(os.path.join(d, "osd.0.asok"),
+                           {"prefix": "perf dump"})["result"]
+        asok_fires = pd.get("faults", {}).get(
+            "wire.inject_socket_failures", 0)
+        st0 = admin_request(os.path.join(d, "osd.0.asok"),
+                            {"prefix": "fault_injection"})["result"]
+        # same monotone sampling: perf export first, registry second
+        assert asok_fires > 0
+        assert st0["fire_counts"].get(
+            "wire.inject_socket_failures", 0) >= asok_fires
+        # runtime arming over the asok: stall ONE op on osd.0 at the
+        # get_shard phase (daemon.hang_op with a match filter + params
+        # riding the registry), then prove it fired and the op still
+        # completed — the chosen-phase crash/hang axis end to end
+        r = admin_request(os.path.join(d, "osd.0.asok"), {
+            "prefix": "fault_injection", "action": "arm",
+            "name": "daemon.hang_op", "mode": "nth", "n": 1,
+            "match": {"cmd": "get_shard"},
+            "params": {"seconds": 0.2}})
+        assert r["result"]["armed"] == "daemon.hang_op"
+        for _ in range(6):                        # drops still armed
+            try:
+                rc.osd_client(0).call({"cmd": "get_shard",
+                                       "coll": [1, 0], "oid": "0:x"})
+                break
+            except (OSError, IOError):
+                rc.drop_osd_client(0)
+        st0 = admin_request(os.path.join(d, "osd.0.asok"),
+                            {"prefix": "fault_injection"})["result"]
+        assert st0["fire_counts"].get("daemon.hang_op", 0) >= 1
         rc.close()
     finally:
         v.stop()

@@ -1,0 +1,107 @@
+"""Thrasher: seeded kill/revive soak with self-healing invariants.
+
+The thrashosds tier (ISSUE 3): a quick tier-1 smoke, the seeded
+determinism contract (same seed → identical schedule AND identical
+fire counts), the standalone robustness smoke script, and a long soak
+(slow tier) with map churn added to the default fault mix.
+"""
+import pytest
+
+from ceph_tpu.cluster.thrasher import (Thrasher, ThrashConfig,
+                                       build_default_stack)
+from ceph_tpu.common import faults
+
+
+@pytest.fixture(autouse=True)
+def _clean_registry():
+    yield
+    faults.reset()
+
+
+def _run(seed, cycles, **kw):
+    sim, mon = build_default_stack()
+    try:
+        cfg = ThrashConfig(seed=seed, cycles=cycles, **kw)
+        return Thrasher(sim, mon, [1, 2], cfg).run()
+    finally:
+        sim.shutdown()
+
+
+def test_thrash_smoke_invariants_hold():
+    """Quick tier: a small soak with the wire-drop + device-EIO axes
+    armed must end healed — all ops complete, zero data loss, scrub
+    clean, health OK — and must PROVE the injections happened."""
+    r = _run(seed=3, cycles=3, objects=4, writes_per_cycle=2)
+    assert r["ok"], r["failures"]
+    inv = r["invariants"]
+    assert inv["ops_in_flight"] == 0
+    assert inv["data_loss"] == []
+    assert inv["scrub_inconsistencies"] == 0
+    assert inv["health"] == "HEALTH_OK"
+    assert inv["objects_checked"] >= 8          # both pools covered
+    for name in ("msg.drop_op", "device.eio"):
+        assert r["fire_counts"].get(name, 0) >= 1, \
+            f"{name} never fired — the soak injected nothing"
+    # the schedule holds real fault events, not just writes
+    kinds = {e[0] for e in r["schedule"]}
+    assert "kill" in kinds and "arm" in kinds
+
+
+def test_thrash_same_seed_identical_schedule_and_fires():
+    """The regression-test property: a seeded run is a reproducible
+    artifact — identical schedule, identical fire counts."""
+    a = _run(seed=21, cycles=3, objects=3, writes_per_cycle=2)
+    b = _run(seed=21, cycles=3, objects=3, writes_per_cycle=2)
+    assert a["schedule"] == b["schedule"]
+    assert a["fire_counts"] == b["fire_counts"]
+    c = _run(seed=22, cycles=3, objects=3, writes_per_cycle=2)
+    assert c["schedule"] != a["schedule"]
+
+
+def test_thrash_cli_json_report():
+    """`ceph thrash --seed N --cycles K --json` emits the invariant
+    report and exits by invariant outcome."""
+    import io
+    import json
+    from ceph_tpu.tools import ceph_cli
+    out = io.StringIO()
+    rc = ceph_cli.main(["thrash", "--seed", "2", "--cycles", "2",
+                        "--objects", "3", "--json"], out=out)
+    assert rc == 0
+    report = json.loads(out.getvalue())
+    assert report["ok"] is True
+    assert report["invariants"]["health"] == "HEALTH_OK"
+    assert report["fire_counts"]
+
+
+@pytest.mark.smoke
+def test_check_robustness_script():
+    """The CI robustness smoke script, run in-process (the
+    check_observability.py pattern: fast marker, no extra job)."""
+    import importlib.util
+    import pathlib
+    path = pathlib.Path(__file__).resolve().parents[1] / "scripts" \
+        / "check_robustness.py"
+    spec = importlib.util.spec_from_file_location(
+        "check_robustness", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    assert mod.main() == 0
+
+
+@pytest.mark.slow
+def test_thrash_long_soak_with_map_churn():
+    """Slow tier: a longer soak with the mon map-churn axis added to
+    the default wire + EIO mix — every extra epoch forces subscriber
+    catch-up mid-thrash, the correlated-failure shape the online-EC
+    studies measure."""
+    r = _run(seed=8, cycles=10, objects=8, writes_per_cycle=4,
+             settle_ticks=40,
+             faultpoints=(("msg.drop_op", "one_in", 6),
+                          ("device.eio", "one_in", 8),
+                          ("mon.map_churn", "one_in", 4)))
+    assert r["ok"], r["failures"]
+    for name in ("msg.drop_op", "device.eio", "mon.map_churn"):
+        assert r["fire_counts"].get(name, 0) >= 1, name
+    assert r["invariants"]["health"] == "HEALTH_OK"
+    assert r["invariants"]["data_loss"] == []
