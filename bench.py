@@ -642,6 +642,88 @@ def _cluster_system_phases(sim, k, m, obj_bytes, batch_n, rounds):
     return out
 
 
+def bench_cluster_sharded(k=4, m=2, obj_bytes=4 << 20, batch_n=16,
+                          n_osds=16, pg_num=32):
+    """The FULL cluster step sharded across the ambient device mesh
+    (parallel_data_plane on): batched put -> degraded get -> recovery
+    round -> map_pgs_batch sweep, with per-chip accounting from the
+    ``dataplane`` perf group.  This replaces the kernel-only shards as
+    the MULTICHIP evidence: the mesh carries the SYSTEM hot loops, not
+    three toy kernels.  Single-device hosts report skipped (nothing to
+    shard); results stay bit-identical to the single-device path by
+    construction (asserted in dryrun_multichip / tests)."""
+    import jax
+    n_dev = len(jax.devices())
+    if n_dev < 2:
+        return {"skipped": f"{n_dev} device(s): nothing to shard"}
+    from ceph_tpu.common.options import config
+    from ceph_tpu.common.perf_counters import perf
+    from ceph_tpu.cluster.osdmap import OSDMap, PGPool, POOL_ERASURE
+    from ceph_tpu.cluster.simulator import ClusterSim
+    from ceph_tpu.placement.builder import TYPE_HOST, build_flat_cluster
+    from ceph_tpu.placement.crush_map import (
+        RULE_CHOOSELEAF_INDEP, RULE_EMIT, RULE_TAKE, Rule)
+    cmap, root = build_flat_cluster(n_hosts=n_osds // 2,
+                                    osds_per_host=2)
+    cmap.add_rule(Rule(steps=[(RULE_TAKE, root, 0),
+                              (RULE_CHOOSELEAF_INDEP, 0, TYPE_HOST),
+                              (RULE_EMIT, 0, 0)]))
+    om = OSDMap(cmap)
+    om.mark_all_in_up()
+    om.add_pool(PGPool(id=1, name="ec", type=POOL_ERASURE, size=k + m,
+                       pg_num=pg_num, crush_rule=0,
+                       erasure_code_profile="p", stripe_unit=1 << 18))
+    sim = ClusterSim(om)
+    try:
+        config().set("parallel_data_plane", True)
+        sim.create_ec_profile("p", {"plugin": "jax", "k": str(k),
+                                    "m": str(m)})
+        perf("dataplane").reset()
+        names = [f"s{i}" for i in range(batch_n)]
+        rng = np.random.default_rng(0)
+        datas = [rng.integers(0, 256, obj_bytes,
+                              dtype=np.uint8).tobytes()
+                 for _ in range(batch_n)]
+        t0 = time.perf_counter()
+        sim.put_many(1, names, datas)
+        t_put = time.perf_counter() - t0
+        pool = sim.osdmap.pools[1]
+        up = sim.pg_up(pool, sim.object_pg(pool, names[0]))
+        victims = [o for o in up if o >= 0][:2]
+        for v in victims:
+            sim.kill_osd(v)
+        t0 = time.perf_counter()
+        for nm, d in zip(names, datas):
+            assert sim.get(1, nm) == d
+        t_get = time.perf_counter() - t0
+        for v in victims:
+            sim.out_osd(v)
+        t0 = time.perf_counter()
+        rec = sim.recover_all(1)
+        t_rec = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        sim.osdmap.map_pgs_batch(1)
+        t_map = time.perf_counter() - t0
+        total = batch_n * obj_bytes
+        dump = perf("dataplane").dump()
+        per_chip = {str(i): dump.get(f"shard{i}.put_stripes", 0)
+                    for i in range(n_dev)}
+        return {
+            "n_devices": n_dev,
+            "put_gbps": round(total / max(t_put, 1e-9) / 1e9, 3),
+            "degraded_get_gbps":
+                round(total / max(t_get, 1e-9) / 1e9, 3),
+            "recover_s": round(t_rec, 3),
+            "map_sweep_s": round(t_map, 3),
+            "recover": rec,
+            "psum_rows": dump.get("psum_rows", 0),
+            "put_stripes_per_chip": per_chip,
+        }
+    finally:
+        config().clear("parallel_data_plane")
+        sim.shutdown()
+
+
 def bench_process_cluster(k=8, m=3, obj_bytes=256 << 20, batch_n=16,
                           rounds=4, n_osds=12, pg_num=32,
                           flush_mib=64, recovery_objects=16,
@@ -922,6 +1004,12 @@ def main():
         extras["recovery"] = bench_recovery()
     except Exception as e:
         print(f"# recovery bench failed: {e}", file=sys.stderr)
+    try:
+        import gc
+        gc.collect()
+        extras["cluster_sharded"] = bench_cluster_sharded()
+    except Exception as e:
+        print(f"# cluster sharded bench failed: {e}", file=sys.stderr)
     out["extras"] = extras
     print(json.dumps(out))
 

@@ -21,7 +21,7 @@ from __future__ import annotations
 
 import json
 import os
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -52,6 +52,11 @@ class RemoteCluster:
         self.secret = ring.secret(entity)
         self.mon: Optional[WireClient] = None
         self._connect_mon()
+        # socket timeout of the SHARED per-OSD clients: anything that
+        # blocks a daemon handler longer (notify_wait) must ride a
+        # dedicated connection with a DERIVED timeout, or the timed-out
+        # read kills the shared connection under every other caller
+        self._osd_timeout = 10.0
         self._osd_clients: Dict[int, WireClient] = {}
         self.ec_profiles = ec_profiles or {}
         self._codecs: Dict[int, object] = {}
@@ -186,22 +191,26 @@ class RemoteCluster:
             key = cx.open_key_box(self.secret, grant["key_box"])
             c = WireClient(self.addrs[osd], self.entity,
                            ticket=grant["ticket"], session_key=key,
-                           timeout=10.0)
+                           timeout=self._osd_timeout)
             self._osd_clients[osd] = c
             return c
 
-    def new_osd_client(self, osd: int) -> WireClient:
+    def new_osd_client(self, osd: int,
+                       timeout: Optional[float] = None) -> WireClient:
         """A DEDICATED (unshared) authenticated connection to one OSD.
         Long-blocking calls (notify_wait) hold a connection's lock for
         their whole wait, so background pollers must not ride the
         shared per-OSD clients — the ack they need to deliver would
-        serialize behind the very wait it unblocks."""
+        serialize behind the very wait it unblocks.  ``timeout`` lets
+        a caller that KNOWS its server-side wait derive a socket
+        timeout that outlives it."""
         grant = self.mon_call({"cmd": "get_ticket",
                                "service": f"osd.{osd}"})
         key = cx.open_key_box(self.secret, grant["key_box"])
         return WireClient(self.addrs[osd], self.entity,
                           ticket=grant["ticket"], session_key=key,
-                          timeout=10.0)
+                          timeout=timeout if timeout is not None
+                          else self._osd_timeout)
 
     def _evict_staging(self, pool_id: int, pg: int, name: str) -> None:
         """Invalidate this client's staged shards + attrs for one
@@ -475,15 +484,12 @@ class RemoteCluster:
         """Refused until the cache pool is drained (flush + evict) —
         unwiring with data in the cache strands acknowledged writes
         out of the read path (the reference's 'osd tier remove'
-        refuses the same way)."""
-        if not force:
-            cached = self.list_objects(cache_id)
-            if cached:
-                raise IOError(
-                    f"tier remove: cache pool still holds "
-                    f"{len(cached)} objects — drain first")
+        refuses the same way).  The drain check runs SERVER-side at
+        the mon — the commit point — so a write racing this call
+        cannot slip through a client-only check (the old TOCTOU);
+        ``force`` is forwarded for operators who accept stranding."""
         self.mon_call({"cmd": "pool_tier_remove", "base": base_id,
-                       "cache": cache_id})
+                       "cache": cache_id, "force": force})
         self.refresh_map()
 
     def copy_from(self, dst_pool: int, dst_name: str,
@@ -1226,14 +1232,16 @@ class RemoteCluster:
                             self.drop_osd_client(o)
                             continue
                         if d is not None:
-                            return d
-                    return None
+                            return d, o
+                    return None, None
 
                 shards: Dict[int, bytes] = {}
+                shard_src: Dict[int, int] = {}
                 for shard in sorted(fetch):
-                    d = _get(shard)
+                    d, src = _get(shard)
                     if d is not None:
                         shards[shard] = d
+                        shard_src[shard] = src
                 missing = [s for s in lost if s not in shards]
                 if missing and len(shards) < k:
                     # fewer than k survivors: the object is UNFOUND —
@@ -1250,29 +1258,53 @@ class RemoteCluster:
                 # the re-homed copies — a recovered shard without its
                 # size/S/U would strand geometry after the original
                 # holders die.
+                # attrs come from the SAME holder each shard's bytes
+                # came from: a holder serving stale bytes with fresh
+                # attrs (or vice versa) must not mix geometries —
+                # prefer the holders that actually answered the byte
+                # fetches, asking each about the shard IT served
                 S_obj, obj_attrs = 1, {}
-                for o, objs in holdings.items():
-                    probe = next((s for s in shards
-                                  if f"{s}:{name}" in objs), None)
-                    if probe is None:
-                        continue
-                    got_any = False
+                for shard, o in sorted(shard_src.items()):
+                    cand: Dict[str, bytes] = {}
                     try:
                         for akey in ("size", "S", "U"):
                             raw = self.osd_client(o).call({
                                 "cmd": "getattr_shard", "coll": coll,
-                                "oid": f"{probe}:{name}",
+                                "oid": f"{shard}:{name}",
                                 "key": akey})
                             if raw is not None:
-                                obj_attrs[akey] = bytes(raw)
-                                got_any = True
+                                cand[akey] = bytes(raw)
                     except (OSError, IOError):
+                        # a holder that died MID-fetch contributes
+                        # nothing: merging its partial attrs with the
+                        # next holder's would mix geometries from two
+                        # sources — the invariant is one holder, all
+                        # attrs
                         self.drop_osd_client(o)
                         continue
-                    if got_any:
+                    if cand:
+                        obj_attrs = cand
                         break       # this holder answered with attrs
                 if "S" in obj_attrs:
                     S_obj = int(obj_attrs["S"])
+                # geometry gate: every fetched shard must be ONE
+                # consistent length L with L == S_obj * U (attrs) —
+                # a mismatched holder (truncated shard, stale attrs)
+                # counts the object unrecoverable/skipped instead of
+                # an uncaught reshape ValueError killing the whole
+                # pool sweep
+                lengths = {len(d) for d in shards.values()}
+                L = lengths.pop() if len(lengths) == 1 else None
+                bad = shards and (
+                    L is None or (S_obj > 1 and L % S_obj != 0))
+                if not bad and shards and "U" in obj_attrs:
+                    bad = L != S_obj * int(obj_attrs["U"])
+                if bad:
+                    stats["unrecoverable"] = \
+                        stats.get("unrecoverable", 0) + 1
+                    stats["geometry_skipped"] = \
+                        stats.get("geometry_skipped", 0) + 1
+                    continue
                 records.append({"pg": pg, "coll": coll, "name": name,
                                 "up": up, "holdings": holdings,
                                 "shards": shards, "missing": missing,
@@ -1571,7 +1603,16 @@ class RemoteCluster:
         """Notify the object's watchers via its primary daemon and
         gather their acks (Watch/Notify over the wire,
         src/osd/Watch.cc): watchers that do not ack within the
-        timeout report as None."""
+        timeout report as None.
+
+        The server-side wait must never outlive the transporting
+        socket's timeout: a notify_wait riding the SHARED per-OSD
+        client with ``timeout >= socket timeout`` used to time the
+        socket out mid-wait — dropping the shared connection under
+        every other caller and surfacing an IOError instead of the
+        pending-watcher result.  Waits that fit comfortably inside
+        the shared timeout use it; longer waits ride a DEDICATED
+        connection whose socket timeout is derived from the wait."""
         prim, pg = self._watch_primary(pool_id, name)
         r = self.osd_call(prim, {"cmd": "notify",
                                  "coll": [pool_id, pg],
@@ -1579,9 +1620,16 @@ class RemoteCluster:
                                  "payload": payload})
         if not r["watchers"]:
             return {"notify_id": r["notify_id"], "acks": {}}
-        w = self.osd_call(prim, {"cmd": "notify_wait",
-                                 "notify_id": r["notify_id"],
-                                 "timeout": timeout})
+        req = {"cmd": "notify_wait", "notify_id": r["notify_id"],
+               "timeout": timeout}
+        if timeout < self._osd_timeout - 2.0:
+            w = self.osd_call(prim, req)
+        else:
+            dc = self.new_osd_client(prim, timeout=timeout + 5.0)
+            try:
+                w = dc.call(req)
+            finally:
+                dc.close()
         acks = {int(c): a for c, a in w["acks"].items()}
         for c in w.get("pending", []):
             acks[int(c)] = None
@@ -1619,6 +1667,14 @@ class WireShardIO:
     def __init__(self, rc: "RemoteCluster", pool_id: int):
         self.rc = rc
         self.pool_id = pool_id
+        # (pg, shard, name) -> target of this client's last committed
+        # sub-write: the stray-supersession sweep only needs to run
+        # when the shard's home CHANGED (or on first contact, where a
+        # stray from before this client's lifetime could exist) — a
+        # repeat commit to the same home overwrote the only copy our
+        # previous sweep left, so the O(daemons) purge is skipped on
+        # the steady-state write path
+        self._committed_to: Dict[Tuple[int, int, str], int] = {}
 
     def _pool(self) -> PGPool:
         return self.rc.osdmap.pools[self.pool_id]
@@ -1655,32 +1711,125 @@ class WireShardIO:
                 # drop it, or later reads would mix shard versions
                 rc.dev.evict(key)
                 rc._staged_attrs.pop(key, None)
+                # ...and the same hazard exists SERVER-side: any
+                # daemon still holding a previous version of this
+                # shard would serve it to the any-holder read
+                # fallback, mixing versions into a decode.  Purge,
+                # mirroring SimShardIO's "no older shard version is
+                # ever servable" invariant (failure path only, so
+                # the sweep cost lands on the rare case).
+                self.purge_shard(w.pg, w.shard, w.name, None)
+                self._committed_to.pop((w.pg, w.shard, w.name), None)
                 return None
             rc.dev.put(key, w.ref, zlib.crc32(data))
+            # success supersedes strays: a RE-HOMED shard's previous
+            # copy on its old home must not outlive this commit (the
+            # peering-time supersession SimShardIO.fanout applies) —
+            # without this, killing the new home resurrects the old
+            # version through the any-holder fallback and the reader
+            # decodes MIXED shard versions to garbage.  The sweep is
+            # DEFERRED and batched below: one bulk delete_shards call
+            # per daemon per fanout, and only for shards whose memoed
+            # home moved (or first contact) — a repeat commit to the
+            # memoized home overwrote the only copy the previous
+            # sweep left (steady-state writes skip it entirely).
+            if self._committed_to.get(
+                    (w.pg, w.shard, w.name)) != w.target:
+                sweep.append(w)         # GIL-atomic append
             rc._staged_attrs[key] = w.attrs
             return w
 
+        sweep: List = []
         if len(writes) <= 1:
             results = [one(w) for w in writes]
         else:
             with cf.ThreadPoolExecutor(
                     max_workers=min(8, len(writes))) as ex:
                 results = list(ex.map(one, writes))
+        if sweep:
+            self._bulk_supersede(sweep)
         return [w for w in results if w is not None]
+
+    def _bulk_supersede(self, sweep) -> None:
+        """Batched stray purge for committed sub-writes: ONE
+        delete_shards wire call per up daemon, covering every swept
+        shard that daemon could hold — so a put_many batch of N new
+        objects pays D daemon RTTs total (in parallel), not N*(k+m)*D.
+        First-contact writes DO sweep: the client cannot distinguish
+        a genuinely-new object from one re-homed before it connected,
+        and put_shard's "existed on target" would be exactly the
+        wrong signal (a re-homed shard's new target also reports
+        not-existed while the stray sits on the old home) — a
+        per-shard version attr is the eventual cheap evidence.
+        Only a COMPLETE sweep is memoized per shard — a daemon down
+        (or erroring) may still hold a stale copy, so that shard's
+        next commit sweeps again.  (The memo is per-client
+        best-effort — cross-client races remain the domain of
+        recovery/scrub, as before.)"""
+        import concurrent.futures as cf
+        rc = self.rc
+        daemons = list(rc.addrs)
+
+        def purge_on(o):
+            items = [[[self.pool_id, w.pg], f"{w.shard}:{w.name}"]
+                     for w in sweep if w.target != o]
+            if not items:
+                return True
+            if not rc.osdmap.osd_up[o]:
+                return False            # unreachable possible holder
+            try:
+                rc.osd_call(o, {"cmd": "delete_shards",
+                                "items": items})
+                return True
+            except (OSError, IOError):
+                return False
+        if len(daemons) <= 1:
+            reached = {o: purge_on(o) for o in daemons}
+        else:
+            with cf.ThreadPoolExecutor(
+                    max_workers=min(8, len(daemons))) as ex:
+                reached = dict(zip(daemons,
+                                   ex.map(purge_on, daemons)))
+        for w in sweep:
+            memo_key = (w.pg, w.shard, w.name)
+            if all(ok for o, ok in reached.items()
+                   if o != w.target):
+                self._committed_to[memo_key] = w.target
+            else:
+                self._committed_to.pop(memo_key, None)
+        # unbounded-growth backstop: the memo is an optimization, so
+        # wholesale reset just costs extra sweeps, never correctness
+        if len(self._committed_to) > (1 << 20):
+            self._committed_to.clear()
 
     def purge_shard(self, pg: int, shard: int, name: str,
                     keep_target) -> None:
+        self.rc.dev.evict((self.pool_id, pg, name, shard))
+        self._purge_daemons(pg, shard, name, keep_target)
+
+    def _purge_daemons(self, pg: int, shard: int, name: str,
+                       keep_target) -> bool:
+        """Delete the shard from every daemon except ``keep_target``
+        (client staging untouched).  Returns True only when every
+        other daemon was REACHED — a daemon that is down or errored
+        may still hold a stale copy, and callers memoizing "this
+        shard is stray-free" must not record an incomplete sweep
+        (the revived daemon would serve its old version forever)."""
         rc = self.rc
-        rc.dev.evict((self.pool_id, pg, name, shard))
+        complete = True
         for o in list(rc.addrs):
-            if o == keep_target or not rc.osdmap.osd_up[o]:
+            if o == keep_target:
+                continue
+            if not rc.osdmap.osd_up[o]:
+                complete = False      # unreachable possible holder
                 continue
             try:
                 rc.osd_call(o, {"cmd": "delete_shard",
                                 "coll": [self.pool_id, pg],
                                 "oid": f"{shard}:{name}"})
             except (OSError, IOError):
-                pass
+                complete = False
+        return complete
 
     # ----------------------------------------------------------- reads --
     def _digest(self, pg: int, shard: int, name: str) -> Optional[int]:

@@ -530,6 +530,44 @@ class MonDaemon:
                 for p in pools},
         }
 
+    def _osd_probe(self, osd: int, req: Dict[str, Any]) -> Any:
+        """One short-lived authenticated mon -> OSD call (the mon
+        holds every service secret, so it mints its own ticket)."""
+        ticket, key_box = self.tickets.grant(self.entity,
+                                             f"osd.{osd}")
+        key = cx.open_key_box(self.keyring.secret(self.entity),
+                              key_box)
+        c = WireClient(os.path.join(self.dir, f"osd.{osd}.sock"),
+                       self.entity, ticket=ticket, session_key=key,
+                       timeout=2.0)
+        try:
+            return c.call(req)
+        finally:
+            c.close()
+
+    def _count_pool_objects(self, pool_id: int) -> int:
+        """Best-effort object count for one pool across the OSDs
+        (replica-counted — callers gate on nonzero, not the value).
+        An OSD that cannot be checked — marked down, or up but
+        unreachable — counts as holding data: a safety gate must not
+        read 'cannot check' as 'empty' (a down OSD may hold the only
+        copies of acknowledged cache writes; ``force`` is the
+        operator override)."""
+        m = self.mon.osdmap
+        total = 0
+        for osd in range(m.max_osd):
+            if not m.osd_exists[osd]:
+                continue
+            if not m.osd_up[osd]:
+                total += 1      # down holder is unverifiable: blocks
+                continue
+            try:
+                total += int(self._osd_probe(
+                    osd, {"cmd": "count_pool", "pool": pool_id}))
+            except (OSError, IOError, cx.AuthError):
+                total += 1      # unverifiable holder blocks the gate
+        return total
+
     def _forward_to_leader(self, entity: str,
                            req: Dict[str, Any]) -> Any:
         lead = self.quorum.leader
@@ -571,6 +609,29 @@ class MonDaemon:
             # forward so they meet on the same mon no matter which
             # socket each caller happened to connect to
             return self._forward_to_leader(entity, req)
+        drain_count = None
+        if cmd == "pool_tier_remove" and \
+                not bool(req.get("force", False)):
+            # the OSD drain probes run OUTSIDE the mon lock: one
+            # 2s-timeout wire call per OSD would otherwise stall
+            # every other handler (heartbeats, boots, map fetches)
+            # behind a single admin command.  The unlocked osdmap
+            # reads are benign (worst case a stale up view — probes
+            # fail conservative); existence/relationship are checked
+            # FIRST so an invalid request fails instantly instead of
+            # paying the probe sweep, and re-validated under the
+            # lock before committing.
+            m0 = self.mon.osdmap
+            b0 = m0.pools.get(int(req["base"]))
+            c0 = m0.pools.get(int(req["cache"]))
+            if b0 is None or c0 is None:
+                raise ValueError("tier remove: no such pool")
+            if b0.read_tier != int(req["cache"]) or \
+                    c0.tier_of != int(req["base"]):
+                raise ValueError(
+                    f"tier remove: pool {req['cache']} is not a "
+                    f"tier of pool {req['base']}")
+            drain_count = self._count_pool_objects(int(req["cache"]))
         with self._lock:
             if cmd == "report_slow_ops":
                 # daemonized OSDs roll their OpTracker slow-op
@@ -732,8 +793,34 @@ class MonDaemon:
                     raise IOError("tier add: no quorum")
                 return {"epoch": self.mon.osdmap.epoch}
             if cmd == "pool_tier_remove":
+                # server-side gate (OSDMonitor 'osd tier remove'
+                # role): the mon — the commit point — verifies the
+                # tier RELATIONSHIP and that the cache pool is
+                # drained, closing the TOCTOU where only the client
+                # checked and a racing write could strand
+                # acknowledged data out of the read path
                 m = self.mon.osdmap
                 base, cache = int(req["base"]), int(req["cache"])
+                bp, cp = m.pools.get(base), m.pools.get(cache)
+                if bp is None or cp is None:
+                    raise ValueError("tier remove: no such pool")
+                if bp.read_tier != cache or cp.tier_of != base:
+                    raise ValueError(
+                        f"tier remove: pool {cache} is not a tier "
+                        f"of pool {base}")
+                if drain_count is not None:
+                    held = drain_count
+                    if held:
+                        # IOError: surfaces as IOError at the client
+                        # (retryable operator condition, like the
+                        # no-quorum refusal), unlike the ValueError
+                        # config mistakes above
+                        raise IOError(
+                            f"tier remove: cache pool still holds "
+                            f"~{held} objects (down/unreachable "
+                            f"daemons count as holding) — drain "
+                            f"first (tier_agent_work + evict), or "
+                            f"force")
                 inc = self.mon.next_incremental()
                 inc.new_pool_tier[cache] = {"tier_of": -1,
                                             "cache_mode": ""}
@@ -1232,6 +1319,30 @@ class OSDDaemon:
         if cmd == "list_pg":
             coll = tuple(req["coll"])
             return self.store.list_objects(coll)
+        if cmd == "delete_shards":
+            # bulk stray purge (the client fanout's supersession
+            # sweep): many (coll, oid) removals in one RTT instead of
+            # one delete_shard call per shard
+            from .objectstore import Transaction
+            removed = 0
+            for c, oid in req["items"]:
+                c = tuple(c)
+                if self.store.exists(c, oid):
+                    self.store.apply_transaction(
+                        Transaction().remove(c, oid))
+                    removed += 1
+            return removed
+        if cmd == "count_pool":
+            # non-meta objects this OSD holds for one pool, across
+            # all its PG collections (the mon's tier-remove drain
+            # gate: one RTT per OSD instead of pg_num listings)
+            pid = int(req["pool"])
+            n = 0
+            for c in self.store.list_collections():
+                if c[0] == pid:
+                    n += sum(1 for o in self.store.list_objects(c)
+                             if not o.startswith("meta:"))
+            return n
         if cmd == "pg_info":
             # GetInfo: this replica's log bounds + applied version
             return self._pglog(tuple(req["coll"])).info()

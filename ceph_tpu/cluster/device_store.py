@@ -292,9 +292,16 @@ def assemble_many(refs_per_object, S: int, U: int):
 
 
 class DeviceShardCache:
-    """Per-OSD HBM staging of shard plane words."""
+    """Per-OSD HBM staging of shard plane words.
 
-    def __init__(self):
+    ``owner`` is the hosting OSD's id (None for the client-side
+    cache): with the sharded data plane active, every staged entry is
+    attributed to its OSD-shard -> chip affinity partition
+    (``dataplane.shard<i>.staged_*`` counters) — the per-chip staging
+    view of the mesh-sharded put path."""
+
+    def __init__(self, owner: Optional[int] = None):
+        self.owner = owner
         self._entries: Dict[ShardKey, _Entry] = {}
         self.hits = 0
         self.misses = 0
@@ -306,6 +313,15 @@ class DeviceShardCache:
         """Stage a shard ref; ``csum=None`` marks it dirty (staged
         flush mode — the device copy is authoritative until flush)."""
         self._entries[key] = _Entry(ref, csum, int(ref.size))
+        from ..parallel import data_plane
+        if data_plane.enabled():
+            dp = data_plane.plane()
+            if dp is not None:
+                # affinity: the hosting OSD when known, else the EC
+                # shard index (client-side staging)
+                dp.account_staged(
+                    self.owner if self.owner is not None else key[3],
+                    int(ref.size))
 
     def evict(self, key: ShardKey) -> None:
         if self._entries.pop(key, None) is not None:

@@ -171,6 +171,7 @@ class ECBackend:
         flushed).  ``d_host`` lets a caller that already holds the
         payload host-side skip the data readback."""
         from .device_store import ShardRef
+        from ..parallel.data_plane import plane as _data_plane
         S, U, W = geom.S, geom.U, geom.W
         N = len(names)
         d = self.to_words(payload, N * S, U)
@@ -180,6 +181,7 @@ class ECBackend:
             if d_host is None:
                 d_host = np.asarray(d)
             p_host = np.asarray(par)
+        dp = _data_plane()
         writes: List[SubWrite] = []
         for i, name in enumerate(names):
             attrs = geom.attrs()
@@ -190,6 +192,9 @@ class ECBackend:
             s0, s1 = i * S, (i + 1) * S
             for shard in range(self.n):
                 tgt = up[shard] if shard < len(up) else ITEM_NONE
+                if dp is not None and tgt != ITEM_NONE:
+                    # fan-out accounting by OSD-shard -> chip affinity
+                    dp.account_subwrite(tgt)
                 ref = (ShardRef(d, shard, axis=1, s0=s0, s1=s1)
                        if shard < self.k else
                        ShardRef(par, shard - self.k, axis=1,

@@ -81,13 +81,18 @@ class Objecter:
         return primary is not None and self.sim.osds[primary].alive
 
     # -------------------------------------------------------------- ops --
-    def _submit(self, op, pool_id: int, name: str, optype: str = "op"):
+    def _submit(self, op, pool_id: int, name: str, optype: str = "op",
+                names: Optional[List[str]] = None):
         """op_submit: compute target, send; on stale target refresh the
         map and resend (bounded).  Traced (the jspan threaded through
         ops, src/osd/PrimaryLogPG.cc:11060 role) and TRACKED: the op
         gets a lifecycle record, active for the duration of the data-
-        path call so the OSD service / device layers tag it."""
+        path call so the OSD service / device layers tag it.
+        ``names`` widens the target-currency check to a whole batch
+        (put_many): ANY stale member resends the batch — the rewrite
+        is idempotent (stale copies are superseded)."""
         self._pc.inc("op_submit")
+        check = names if names else [name]
         tr = _op_tracker()
         top = tr.create(optype, service="objecter", pool=pool_id,
                         obj=name)
@@ -97,7 +102,8 @@ class Objecter:
                                       obj=name) as span:
                 for attempt in range(self.max_retries):
                     transient = False
-                    if self._target_current(pool_id, name):
+                    if all(self._target_current(pool_id, nm)
+                           for nm in check):
                         try:
                             with tr.track(top):
                                 result = op()
@@ -167,6 +173,26 @@ class Objecter:
             lambda: self._durable(pool_id,
                                   self.sim.put(pool_id, name, data)),
             pool_id, name, optype="put")
+
+    def put_many(self, pool_id: int, names: List[str],
+                 datas: List[bytes]) -> Dict[str, List[int]]:
+        """Batched put: ONE tracked op, one encode dispatch per
+        stripe class (ClusterSim.put_many) — sharded across the mesh
+        when the parallel data plane is on, so the op's lifecycle
+        record carries the ``dispatched_mesh`` event.  Each member
+        object individually honors the EC >= k durability contract;
+        any short landing resends the whole (idempotent) batch."""
+        if not names:
+            return {}
+
+        def op():
+            placed = self.sim.put_many(pool_id, names, datas)
+            for nm in names:
+                self._durable(pool_id, placed.get(nm, []))
+            return placed
+
+        return self._submit(op, pool_id, names[0], optype="put_many",
+                            names=list(names))
 
     def get(self, pool_id: int, name: str) -> bytes:
         return self._submit(

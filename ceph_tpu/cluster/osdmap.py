@@ -427,9 +427,18 @@ class OSDMap:
         pss = np.asarray(pss, dtype=np.int64)
         pps = pool.raw_pg_to_pps_batch(pss)
         mapper = self._batched_mapper()
+        # sharded data plane: the PG lane axis splits across the mesh
+        # (the multi-chip ParallelPGMapper, src/osd/OSDMapMapping.h:18)
+        # — million-PG remap sweeps run one shard per chip; identical
+        # results, the mapper pads lanes to the mesh size internally
+        from ..parallel.data_plane import plane as _data_plane
+        dp = _data_plane()
         raw = mapper.map_batch(
             self._crush_rule_for(pool), pps, pool.size,
-            self.osd_weight[:self.crush.max_devices]).astype(np.int64)
+            self.osd_weight[:self.crush.max_devices],
+            mesh=dp.mesh if dp is not None else None).astype(np.int64)
+        if dp is not None:
+            dp.account("map", len(pss), 4 * pool.size)
         return self._post_crush_batch(pool, pss, pps, raw)
 
     def _post_crush_batch(self, pool: PGPool, pss, pps, raw

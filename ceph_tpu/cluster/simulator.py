@@ -109,7 +109,9 @@ class SimOSD:
         self.objectstore = MemStore()
         self.store = _StoreView(self)
         from .device_store import DeviceShardCache
-        self.dev = DeviceShardCache()
+        # owner id keys the OSD-shard -> chip staging-affinity
+        # accounting when the sharded data plane is active
+        self.dev = DeviceShardCache(owner=osd_id)
         self.alive = True
         # last applied PG version per (pool, pg) — the replica-side
         # state delta recovery compares against the authoritative log
@@ -1253,6 +1255,76 @@ class ClusterSim:
         self._log_write(pool_id, pg, name, set(placed))
         return placed
 
+    def put_many(self, pool_id: int, names: List[str],
+                 datas: List[bytes]) -> Dict[str, List[int]]:
+        """Batched HOST-bytes EC put — the simulator half of the
+        objecter's batched put path: same-stripe-class objects share
+        ONE encode dispatch (sharded across the mesh when the
+        parallel data plane is on), with per-object placement,
+        logging and true sizes.  Grouping by stripe class keeps a
+        mixed batch from write-amplifying small objects to the
+        largest member's geometry (same stance as the wire client's
+        put_many).  Non-EC pools and non-device codecs fall back to
+        per-object put()."""
+        pool = self.osdmap.pools[pool_id]
+        codec = self.codec_for(pool) \
+            if pool.type == POOL_ERASURE else None
+        if codec is None or not self._device_staging(codec) or \
+                pool.write_tier >= 0:
+            # non-EC, non-device codec, or a tiered pool: per-object
+            # put() owns the writeback-cache routing — the batched
+            # path writing the base directly would leave stale cache
+            # copies serving reads (tier_add refuses EC bases today,
+            # so this is defense in depth)
+            return {n: self.put(pool_id, n, d)
+                    for n, d in zip(names, datas)}
+        from .ec_backend import ObjectGeom
+        si = self._sinfo(pool)
+        k, U = codec.get_data_chunk_count(), si.chunk_size
+        stripe = si.stripe_width
+        be = self.ec_backend(pool_id)
+        if len(set(names)) != len(names):
+            # duplicate names: the LAST occurrence wins, matching the
+            # sequential per-object fallback — class-grouped encode
+            # order must not decide which payload survives
+            winner = {nm: i for i, nm in enumerate(names)}
+            keep = sorted(winner.values())
+            names = [names[i] for i in keep]
+            datas = [datas[i] for i in keep]
+        by_class: Dict[int, List[int]] = {}
+        for i, d in enumerate(datas):
+            by_class.setdefault(
+                max(1, si.stripe_count(len(d))), []).append(i)
+        results: Dict[str, List[int]] = {}
+        eager = self.staging_flush == "eager"
+        for S, idxs in sorted(by_class.items()):
+            gnames = [names[i] for i in idxs]
+            gdatas = [datas[i] for i in idxs]
+            buf = np.zeros(len(gnames) * S * stripe, dtype=np.uint8)
+            for j, d in enumerate(gdatas):
+                buf[j * S * stripe:j * S * stripe + len(d)] = \
+                    np.frombuffer(d, dtype=np.uint8)
+            pg_of: Dict[str, int] = {}
+            for nm in gnames:
+                if "@" not in nm:
+                    self._maybe_clone(pool, nm)
+                pg_of[nm] = self.object_pg(pool, nm)
+            writes = be.encode_to_writes(     # ONE dispatch per class
+                pg_of, gnames, buf, ObjectGeom(S * stripe, S, U),
+                durable=eager,
+                sizes={nm: len(d) for nm, d in zip(gnames, gdatas)},
+                d_host=buf.reshape(len(gnames) * S, k, U))
+            acked = be.submit_loose(writes)
+            for nm, d in zip(gnames, gdatas):
+                placed = [t for _, t in
+                          sorted(acked.get(nm, {}).items())]
+                self.extent_cache.invalidate_object((pool_id, nm))
+                self.objects[(pool_id, nm)] = self._new_info(
+                    pool, nm, len(d), U, S)
+                self._log_write(pool_id, pg_of[nm], nm, set(placed))
+                results[nm] = placed
+        return results
+
     def put_many_from_device(self, pool_id: int, names: List[str],
                              batch) -> Dict[str, List[int]]:
         """Batched EC ingest: N same-size objects as ONE device array
@@ -1818,8 +1890,19 @@ class ClusterSim:
         if Tp != T:        # pow2 bucket: bounded executable count
             planes = jnp.concatenate([planes, planes[:Tp - T]])
             masks_d = jnp.concatenate([masks_d, masks_d[:Tp - T]])
-        rebuilt = xor_kernel.xor_matmul_w32(
-            masks_d, planes)[:T].reshape(T, mm, W)
+        from ..parallel.data_plane import plane as _data_plane
+        dp = _data_plane()
+        if dp is not None:
+            # sharded recovery: the (stripe, signature) batch splits
+            # across the mesh — each stripe carries its own full-width
+            # signature mask, so the shard axis needs no cross-chip
+            # traffic and the rebuilt-stripe accounting psums back
+            # over the ICI ring (bit-identical to the plain kernel)
+            rebuilt = dp.xor_matmul_w32(
+                masks_d, planes, kind="recover")[:T].reshape(T, mm, W)
+        else:
+            rebuilt = xor_kernel.xor_matmul_w32(
+                masks_d, planes)[:T].reshape(T, mm, W)
         rebuilt_host = np.asarray(rebuilt) if eager else None
         for j, mem in enumerate(mems):
             name, up, files, n_str_m, pg, missing = mem[:6]

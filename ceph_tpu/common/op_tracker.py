@@ -48,6 +48,10 @@ EVENT_INITIATED = "initiated"
 EVENT_QUEUED = "queued"
 EVENT_REACHED_OSD = "reached_osd"
 EVENT_DISPATCHED_DEVICE = "dispatched_device"
+# the op's work fanned out across the device mesh (sharded data
+# plane, parallel/data_plane.py) — dump_historic_ops shows which
+# client ops dispatched multi-chip and over how many shards
+EVENT_DISPATCHED_MESH = "dispatched_mesh"
 EVENT_DONE = "done"
 
 # per-stage histogram keys: (from_event, to_event) -> perf key
@@ -56,6 +60,7 @@ _STAGE_HISTS = (
     (EVENT_QUEUED, EVENT_REACHED_OSD, "stage_queue_to_osd_s"),
     (EVENT_REACHED_OSD, EVENT_DISPATCHED_DEVICE, "stage_osd_to_device_s"),
     (EVENT_DISPATCHED_DEVICE, EVENT_DONE, "stage_device_to_done_s"),
+    (EVENT_DISPATCHED_MESH, EVENT_DONE, "stage_mesh_to_done_s"),
 )
 
 _ids = itertools.count(1)

@@ -162,8 +162,23 @@ class Options:
         return v
 
     def clear(self, name: str, level: int = LEVEL_RUNTIME) -> None:
+        self.schema(name)
         with self._lock:
-            self._values.get(name, {}).pop(level, None)
+            removed = self._values.get(name, {}).pop(level, None)
+            obs = list(self._observers.get(name, ()))
+        if removed is None:
+            return
+        # observers track the EFFECTIVE value: clearing an override
+        # changes it just like set() does, and a cached-flag observer
+        # (perf enablement, the data plane's enabled()) left unnotified
+        # would keep honoring the removed override forever
+        try:
+            eff = self.get(name)
+        except OptionError:
+            eff = None
+        if eff is not None:
+            for cb in obs:
+                cb(name, eff)
 
     def load_file(self, path: str) -> None:
         """JSON config file: {"option": value, ...} at LEVEL_FILE."""
@@ -278,6 +293,17 @@ _TABLE: Tuple[Option, ...] = (
     Option("bluestore_compression_algorithm", TYPE_STR, "",
            "compressor plugin for BlueStore blobs ('' = off; "
            "reference: bluestore_compression_algorithm)"),
+    Option("parallel_data_plane", TYPE_BOOL, False,
+           "execute the cluster hot loops (batched put encode, "
+           "degraded-get/recovery decode, map_pgs_batch sweeps) "
+           "sharded across the device mesh (parallel/data_plane.py — "
+           "the multi-chip ParallelPGMapper + messenger fan-out role, "
+           "src/osd/OSDMapMapping.h:18); off = single-device paths "
+           "unchanged; ignored on hosts with fewer than 2 devices"),
+    Option("parallel_data_plane_devices", TYPE_INT, 0,
+           "mesh size for the sharded data plane (0 = every visible "
+           "device); values above the visible device count disable "
+           "the plane rather than fail mid-dispatch", min=0),
     Option("perf_counters_enabled", TYPE_BOOL, True,
            "collect dispatch/cache/bytes counters"),
     Option("op_tracker_enabled", TYPE_BOOL, True,

@@ -49,6 +49,14 @@ def _pallas_ok() -> bool:
     return gf_pallas.available()
 
 
+def _data_plane():
+    """The sharded cluster data plane, or None (parallel_data_plane
+    off / single-device host).  Resolved per dispatch so a runtime
+    config flip takes effect immediately."""
+    from ..parallel.data_plane import plane
+    return plane()
+
+
 class ErasureCodeJax(MatrixCodec):
     """RS/Cauchy codec whose stripe math executes on the accelerator."""
 
@@ -163,7 +171,13 @@ class ErasureCodeJax(MatrixCodec):
         pc = self._pc
         pc.inc("encode_dispatches")
         pc.inc("encode_bytes", 4 * int(np.prod(words.shape)))
-        out = xor_kernel.xor_matmul_w32(masks, planes)
+        dp = _data_plane()
+        if dp is not None:
+            # sharded data plane: stripes split across the mesh, the
+            # same masked-XOR contraction per chip (bit-identical)
+            out = dp.xor_matmul_w32(masks, planes, kind="put")
+        else:
+            out = xor_kernel.xor_matmul_w32(masks, planes)
         return out.reshape(words.shape[:-2] + (self.m, W))
 
     def decode_words_device(self, available_ids, words, erased_ids):
@@ -191,7 +205,13 @@ class ErasureCodeJax(MatrixCodec):
         masks = xor_kernel.masks_to_device(gf.gf8_bitmatrix(R))
         planes = dev.reshape(dev.shape[:-2] +
                              (8 * dev.shape[-2], W // 8))
-        out = xor_kernel.xor_matmul_w32(masks, planes)
+        dp = _data_plane()
+        if dp is not None:
+            # one sharded dispatch per signature group: the lost
+            # stripes split across the mesh, accounting psums back
+            out = dp.xor_matmul_w32(masks, planes, kind="decode")
+        else:
+            out = xor_kernel.xor_matmul_w32(masks, planes)
         return out.reshape(dev.shape[:-2] + (len(erased), W))
 
     def _select_rows(self, available_ids, erased, chunks):

@@ -3,6 +3,14 @@
 The reference scales out with one messenger connection per OSD peer
 (SURVEY.md §2.4); the TPU framework scales the batch axes (stripes, PGs)
 across a jax.sharding.Mesh, with XLA inserting ICI/DCN collectives.
+
+``mesh.py`` holds the mesh/sharding plumbing and raw kernel steps;
+``data_plane.py`` is the cluster-level subsystem (ShardedDataPlane)
+that executes the put / degraded-get / recovery / remap hot loops
+sharded, behind the ``parallel_data_plane`` option.
+
+No eager submodule imports here: ``mesh`` imports jax AND enables
+x64 at import time, and ``data_plane`` is imported by hot paths
+(plugin encode, map_pgs_batch) that must stay jax-free while the
+plane is disabled — import the submodule you need directly.
 """
-from .mesh import (batch_sharding, distributed_encode_step,  # noqa: F401
-                   make_mesh, replicated_sharding)

@@ -1,0 +1,313 @@
+"""ShardedDataPlane — the multi-chip execution tier for the CLUSTER
+hot loops.
+
+`parallel/mesh.py` shards the raw kernels; this module shards the
+*system*: the batched put encode, the degraded-get / recovery decode
+(signature-grouped masked-XOR), and the million-PG remap sweep all
+dispatch over a 1-D device mesh on the stripe/PG batch axis, with
+XLA-inserted ICI collectives carrying the cluster-wide accounting
+(the psum the byte counters ride).  This is the reference's scale-out
+— messenger fan-out across OSD processes plus the ParallelPGMapper
+thread pool (src/osd/OSDMapMapping.h:18, SURVEY §2.4) — collapsed
+into shardings, in the spirit of DrJAX's sharded-map primitives
+(arxiv 2403.07128) and batched-XOR EC pipelines (arxiv 2108.02692).
+
+Wiring (all behind the ``parallel_data_plane`` option, default off —
+the single-device path is untouched when disabled):
+
+  * ``ec/plugin_jax.py`` routes ``encode_words_device`` /
+    ``decode_words_device`` through :meth:`ShardedDataPlane.xor_matmul_w32`,
+    so every caller of the shared ECBackend engine — the simulator's
+    put/get, the wire client's batched put, signature-grouped degraded
+    reads — runs sharded without knowing it;
+  * ``cluster/simulator.py`` dispatches the recovery sweep's
+    full-width-mask rebuild through the same entry (per-stripe decode
+    signatures ride the sharded batch axis);
+  * ``cluster/osdmap.py`` passes the plane's mesh to
+    ``XlaMapper.map_batch`` so ``map_pgs_batch`` splits PG lanes
+    across chips (the multi-chip ParallelPGMapper);
+  * ``cluster/ec_backend.py`` and ``cluster/device_store.py`` account
+    sub-writes and HBM staging per chip by OSD-shard -> chip affinity.
+
+Bit-exactness: the contraction is pure AND/XOR over int32 words — a
+sharded leading axis changes the layout, never a value — and padding
+rows are zeros that are sliced off before anyone reads them, so the
+sharded path is bit-identical to the single-device path (asserted by
+tests/test_data_plane.py and the ``dryrun_multichip`` cluster step).
+
+Observability: per-chip counters land in the ``dataplane`` perf group
+(``dataplane.shard<i>.put_stripes`` / ``..._bytes``, ``decode_*``,
+``recover_*``, ``map_lanes``, ``staged_*``, ``subwrites``) and every
+sharded dispatch tags the calling thread's tracked op with a
+``dispatched_mesh`` event, so ``dump_historic_ops`` shows exactly
+which client ops fanned out across the mesh.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from ..common.op_tracker import mark_active as _mark_active
+from ..common.options import OptionError, config
+from ..common.perf_counters import perf as _perf
+
+# hot-path enablement cache (same pattern as perf_counters._counters
+# _enabled): the staging/accounting probes run per shard put, so the
+# layered-registry walk must not happen per call
+_enabled: Optional[bool] = None
+_enabled_lock = threading.Lock()
+
+
+def enabled() -> bool:
+    """Cheap cached read of the ``parallel_data_plane`` option."""
+    global _enabled
+    if _enabled is None:
+        with _enabled_lock:
+            if _enabled is None:
+                cfg = config()
+                try:
+                    val = bool(cfg.get("parallel_data_plane"))
+                except OptionError:
+                    val = False
+
+                def _refresh(_name, value):
+                    global _enabled
+                    # serialized with init: a set() firing between
+                    # our observe() and the publish below must not be
+                    # clobbered by the stale initial read
+                    with _enabled_lock:
+                        _enabled = bool(value)
+
+                try:
+                    cfg.observe("parallel_data_plane", _refresh)
+                except OptionError:
+                    pass
+                if _enabled is None:
+                    _enabled = val
+    return _enabled
+
+
+class ShardedDataPlane:
+    """Owns a mesh and executes the cluster hot loops sharded over it."""
+
+    def __init__(self, mesh):
+        self.mesh = mesh
+        self.n_shards = int(mesh.size)
+        self._pc = _perf("dataplane")
+        # (per_batch, mesh) -> jitted sharded step
+        self._steps: Dict[Tuple, object] = {}
+        # the latest dispatch's cross-shard psum scalar, UNREAD: the
+        # collective runs in the graph but the hot path must not pay
+        # a device->host sync per dispatch; psum_probe() reads it
+        self.last_psum = None
+
+    # ------------------------------------------------------------ affinity --
+    def chip_of(self, osd_id: int) -> int:
+        """OSD-shard -> chip affinity: which mesh position accounts for
+        an OSD's staged shards and sub-writes.  A stable modulo keyed
+        on the OSD id, so the partition survives map churn."""
+        return int(osd_id) % self.n_shards
+
+    # ------------------------------------------------------------- dispatch --
+    def _step(self, per_batch: bool):
+        """Jitted sharded masked-XOR step, cached per (mask mode,
+        mesh): words batch-sharded on the stripe axis, masks sharded
+        alongside when they carry per-stripe signatures (the recovery
+        sweep) and replicated otherwise (encode / grouped decode),
+        plus the cluster-wide row-count reduction — an explicit psum
+        on the ICI ring (the collective the accounting rides).
+
+        shard_map, not bare jit-with-shardings: the per-shard body
+        calls the REAL kernel entry (ops.xor_kernel.xor_matmul_w32),
+        so each chip runs the tiled Pallas kernel on TPU — a sharded
+        jit around the XLA fallback graph would silently swap the
+        flagship kernel for the slow path on exactly the hardware
+        the mesh targets.  (CPU runs the XLA fallback either way,
+        keeping the bit-identity tests meaningful.)"""
+        from .mesh import SHARD_AXIS, mesh_cache_key
+        key = (per_batch,) + mesh_cache_key(self.mesh)
+        step = self._steps.get(key)
+        if step is None:
+            import jax
+            import jax.numpy as jnp
+            from jax.experimental.shard_map import shard_map
+            from jax.sharding import PartitionSpec as P
+            from ..ops import xor_kernel
+
+            def local(masks, words):
+                out = xor_kernel.xor_matmul_w32(masks, words)
+                rows = jax.lax.psum(
+                    jnp.sum(jnp.ones((words.shape[0],), jnp.int32)
+                            .astype(jnp.int64)), SHARD_AXIS)
+                return out, rows
+
+            mspec = P(SHARD_AXIS) if per_batch else P()
+            step = self._steps[key] = jax.jit(shard_map(
+                local, mesh=self.mesh,
+                in_specs=(mspec, P(SHARD_AXIS)),
+                out_specs=(P(SHARD_AXIS), P())))
+        return step
+
+    def xor_matmul_w32(self, masks, words, kind: str = "encode"):
+        """Drop-in for ``ops.xor_kernel.xor_matmul_w32``, sharded on
+        the leading (stripe) axis.  masks [R, C] (replicated) or
+        [..., R, C] matching ``words``'s leading axes (per-stripe
+        signatures, sharded); words [..., C, W] int32 -> [..., R, W].
+
+        The batch pads with zero rows to a mesh multiple (zero inputs
+        AND zero masks produce zero outputs, sliced off before
+        return), so arbitrary batch sizes reuse the same executable
+        family and the result is bit-identical to the single-device
+        kernel.
+        """
+        import jax.numpy as jnp
+        words = jnp.asarray(words, jnp.int32)
+        masks = jnp.asarray(masks, jnp.int32)
+        lead = words.shape[:-2]
+        C, W = words.shape[-2:]
+        per_batch = masks.ndim > 2
+        if per_batch and masks.shape[:-2] != lead:
+            raise ValueError(
+                f"mask batch {masks.shape[:-2]} != data batch {lead}")
+        if masks.shape[-1] != C:
+            raise ValueError(
+                f"masks contract {masks.shape[-1]} columns, data has "
+                f"{C} planes")
+        R = masks.shape[-2]
+        B = int(np.prod(lead)) if lead else 1
+        w3 = words.reshape(B, C, W)
+        m3 = masks.reshape(B, R, masks.shape[-1]) if per_batch \
+            else masks
+        pad = (-B) % self.n_shards
+        if pad:
+            w3 = jnp.pad(w3, ((0, pad), (0, 0), (0, 0)))
+            if per_batch:
+                m3 = jnp.pad(m3, ((0, pad), (0, 0), (0, 0)))
+        # explicit reshard: operands arrive committed to whatever
+        # placement the producing dispatch left them with (a staged
+        # buffer, a gather output) and pjit refuses a silent layout
+        # change — device_put scatters the batch across the mesh
+        import jax
+        from .mesh import batch_sharding, replicated_sharding
+        w3 = jax.device_put(w3, batch_sharding(self.mesh))
+        m3 = jax.device_put(m3, batch_sharding(self.mesh) if per_batch
+                            else replicated_sharding(self.mesh))
+        out, rows = self._step(per_batch)(m3, w3)
+        # keep the psum ON DEVICE: reading it here would host-sync
+        # every dispatch (its value is deterministically B+pad, which
+        # the counter records; psum_probe() verifies the collective)
+        self.last_psum = rows
+        self.account(kind, B, 4 * C * W, padded_rows=B + pad)
+        out = out[:B] if pad else out
+        return out.reshape(lead + (R, W)) if lead else \
+            out.reshape(R, W)
+
+    def psum_probe(self) -> Optional[int]:
+        """Read back the latest dispatch's cross-shard psum (ONE
+        host sync, on demand — tests/smokes verify the collective;
+        the dispatch path never reads it)."""
+        return None if self.last_psum is None else int(self.last_psum)
+
+    # ----------------------------------------------------------- accounting --
+    def account(self, kind: str, rows: int, row_bytes: int,
+                padded_rows: Optional[int] = None) -> None:
+        """Per-chip accounting for one sharded dispatch: the leading
+        axis splits contiguously across the mesh, so chip i's REAL
+        row count is derivable host-side; ``psum_rows`` records the
+        padded total the in-graph collective reduces to (value known
+        host-side — reading the device scalar per dispatch would
+        host-sync the hot loop; see psum_probe)."""
+        pc = self._pc
+        pc.inc("dispatches")
+        pc.inc(f"{kind}_dispatches")
+        if padded_rows is not None:
+            pc.inc("psum_rows", padded_rows)
+        total = padded_rows if padded_rows is not None else rows
+        per = -(-total // self.n_shards)
+        unit = "lanes" if kind == "map" else "stripes"
+        for i in range(self.n_shards):
+            real = max(0, min(per, rows - i * per))
+            if real:
+                pc.inc(f"shard{i}.{kind}_{unit}", real)
+                pc.inc(f"shard{i}.{kind}_bytes", real * row_bytes)
+        _mark_active("dispatched_mesh", kind=kind,
+                     shards=self.n_shards, rows=rows)
+
+    def account_subwrite(self, target_osd: int) -> None:
+        """One EC sub-write headed to ``target_osd``: counted on its
+        affine chip (the fan-out half of the per-chip staging view)."""
+        self._pc.inc(f"shard{self.chip_of(target_osd)}.subwrites")
+
+    def account_staged(self, osd_or_shard: int, nbytes: int) -> None:
+        """One shard staged into an HBM partition, attributed by
+        OSD-shard -> chip affinity."""
+        chip = self.chip_of(osd_or_shard)
+        self._pc.inc(f"shard{chip}.staged_entries")
+        self._pc.inc(f"shard{chip}.staged_bytes", int(nbytes))
+
+    def stats(self) -> Dict:
+        return self._pc.dump()
+
+
+_planes: Dict[int, ShardedDataPlane] = {}
+_planes_lock = threading.Lock()
+# resolved-plane cache: plane() runs on per-shard hot paths (staging
+# accounting), so the mesh-size option walk + jax.devices() must not
+# repeat per call — the resolution is cached and invalidated by a
+# config observer, like enabled()'s flag
+_resolved: Optional[ShardedDataPlane] = None
+_resolved_valid = False
+_resolve_gen = 0
+_observing_devices = False
+
+
+def _invalidate_resolution(_name=None, _value=None) -> None:
+    global _resolved_valid, _resolve_gen
+    _resolve_gen += 1
+    _resolved_valid = False
+
+
+def plane() -> Optional[ShardedDataPlane]:
+    """The process-wide data plane, or None when the option is off or
+    fewer than two devices exist (single-device hosts fall through to
+    the plain path — there is nothing to shard)."""
+    global _resolved, _resolved_valid, _observing_devices
+    if not enabled():
+        return None
+    if _resolved_valid:
+        return _resolved
+    if not _observing_devices:
+        try:
+            config().observe("parallel_data_plane_devices",
+                             _invalidate_resolution)
+            _observing_devices = True
+        except OptionError:
+            pass
+    gen = _resolve_gen
+    try:
+        import jax
+        n_avail = len(jax.devices())
+    except Exception:
+        return None
+    want = 0
+    try:
+        want = int(config().get("parallel_data_plane_devices"))
+    except OptionError:
+        pass
+    n = want or n_avail
+    if n < 2 or n_avail < n:
+        p = None
+    else:
+        with _planes_lock:
+            p = _planes.get(n)
+            if p is None:
+                from .mesh import make_mesh
+                p = _planes[n] = ShardedDataPlane(make_mesh(n))
+    if gen == _resolve_gen:
+        # publish only if no invalidation raced the resolution (a
+        # mid-compute option change would otherwise be masked by a
+        # stale cache entry until the next change)
+        _resolved, _resolved_valid = p, True
+    return p

@@ -1,0 +1,206 @@
+"""Mesh-through-system tests (VERDICT Weak #2): the SHARDED cluster
+data plane — put / degraded-get / recovery / remap driven through
+ClusterSim over the conftest-forced 8-device host mesh, asserting
+bit-identical results vs the single-device path plus nonzero per-chip
+perf counters and the ``dispatched_mesh`` tracked-op event.
+"""
+import numpy as np
+import pytest
+
+from ceph_tpu.common.options import config
+from ceph_tpu.common.perf_counters import perf
+from tests.test_simulator import make_sim
+
+
+@pytest.fixture
+def plane_on():
+    config().set("parallel_data_plane", True)
+    yield
+    config().clear("parallel_data_plane")
+
+
+def test_plane_off_by_default():
+    from ceph_tpu.parallel.data_plane import plane
+    assert config().get("parallel_data_plane") is False
+    assert plane() is None
+
+
+def test_plane_respects_device_budget(plane_on):
+    from ceph_tpu.parallel.data_plane import plane
+    config().set("parallel_data_plane_devices", 4)
+    try:
+        assert plane().n_shards == 4
+        # more devices than exist -> plane disabled, not a crash
+        config().set("parallel_data_plane_devices", 4096)
+        assert plane() is None
+    finally:
+        config().clear("parallel_data_plane_devices")
+    assert plane().n_shards >= 2
+
+
+def test_sharded_xor_bit_identical_to_kernel(plane_on):
+    """Direct contract: the sharded dispatch equals the single-device
+    kernel bit-for-bit, for replicated masks, per-batch masks, ragged
+    batch sizes, and a lead-less 2-D operand."""
+    from ceph_tpu.ops import xor_kernel
+    from ceph_tpu.parallel.data_plane import plane
+    dp = plane()
+    assert dp is not None and dp.n_shards >= 2
+    rng = np.random.default_rng(0)
+    for B in (1, 7, 8, 13):
+        masks = (rng.integers(0, 2, (24, 32), dtype=np.int64)
+                 .astype(np.int32) * -1)
+        words = rng.integers(-2**31, 2**31 - 1, (B, 32, 16),
+                             dtype=np.int64).astype(np.int32)
+        np.testing.assert_array_equal(
+            np.asarray(dp.xor_matmul_w32(masks, words)),
+            np.asarray(xor_kernel.xor_matmul_w32(masks, words)))
+        mb = (rng.integers(0, 2, (B, 24, 32), dtype=np.int64)
+              .astype(np.int32) * -1)
+        np.testing.assert_array_equal(
+            np.asarray(dp.xor_matmul_w32(mb, words, kind="recover")),
+            np.asarray(xor_kernel.xor_matmul_w32(mb, words)))
+    np.testing.assert_array_equal(
+        np.asarray(dp.xor_matmul_w32(masks, words[0], kind="decode")),
+        np.asarray(xor_kernel.xor_matmul_w32(masks, words[0])))
+    # the in-graph collective reduced the padded batch across all
+    # shards: probe (one deliberate sync) equals rows padded to the
+    # mesh multiple — here B=1 padded to n_shards
+    assert dp.psum_probe() == dp.n_shards
+
+
+def _drive_cluster(shard: bool, seed=7, n_objs=12):
+    """put_many -> kill 2 up-set members -> degraded gets -> out ->
+    recover_all -> remap sweep -> gets again; returns everything
+    comparable."""
+    config().set("parallel_data_plane", shard)
+    try:
+        sim = make_sim()
+        rng = np.random.default_rng(seed)
+        names = [f"o{i}" for i in range(n_objs)]
+        datas = [rng.integers(0, 256, int(sz), dtype=np.uint8)
+                 .tobytes()
+                 for sz in rng.integers(500, 60000, n_objs)]
+        placed = sim.put_many(2, names, datas)
+        pool = sim.osdmap.pools[2]
+        up = sim.pg_up(pool, sim.object_pg(pool, names[0]))
+        victims = [o for o in up if o >= 0][:2]
+        up0, _ = sim.osdmap.map_pgs_batch(2)
+        for v in victims:
+            sim.kill_osd(v)
+        gets = [sim.get(2, n) for n in names]
+        for v in victims:
+            sim.out_osd(v)
+        rec = sim.recover_all(2)
+        up1, _ = sim.osdmap.map_pgs_batch(2)
+        gets2 = [sim.get(2, n) for n in names]
+        sim.shutdown()
+        return {"placed": placed, "datas": datas, "gets": gets,
+                "gets2": gets2, "rec": rec, "up0": up0.tolist(),
+                "up1": up1.tolist()}
+    finally:
+        config().clear("parallel_data_plane")
+
+
+def test_cluster_step_bit_identical_and_per_chip_counters():
+    """The acceptance contract: the full cluster step (batched put,
+    degraded get, recovery rebuild, remap sweep) sharded across the
+    8-device mesh is bit-identical to the single-device path, and
+    every chip shows nonzero put-stripe accounting."""
+    single = _drive_cluster(False)
+    perf("dataplane").reset()
+    sharded = _drive_cluster(True)
+    assert sharded["gets"] == single["gets"] == single["datas"]
+    assert sharded["gets2"] == single["gets2"] == single["datas"]
+    assert sharded["rec"] == single["rec"]
+    assert sharded["rec"]["shards_rebuilt"] > 0   # recovery really ran
+    assert sharded["up0"] == single["up0"]
+    assert sharded["up1"] == single["up1"]
+    assert sharded["placed"] == single["placed"]
+    d = perf("dataplane").dump()
+    n_dev = 8        # conftest forces an 8-device host platform
+    for i in range(n_dev):
+        assert d.get(f"shard{i}.put_stripes", 0) > 0, (i, d)
+    assert d.get("put_dispatches", 0) > 0
+    assert d.get("decode_dispatches", 0) > 0      # degraded gets
+    assert d.get("recover_dispatches", 0) > 0     # rebuild sweep
+    assert d.get("map_dispatches", 0) > 0         # remap sweeps
+    assert d.get("psum_rows", 0) > 0              # the ICI collective
+    # staging-affinity partitions saw entries on at least one chip
+    assert any(d.get(f"shard{i}.staged_entries", 0) > 0
+               for i in range(n_dev))
+    assert any(d.get(f"shard{i}.subwrites", 0) > 0
+               for i in range(n_dev))
+
+
+def test_plane_off_leaves_no_dataplane_counters():
+    perf("dataplane").reset()
+    _drive_cluster(False, seed=3, n_objs=4)
+    d = perf("dataplane").dump()
+    assert not any(v for v in d.values() if not isinstance(v, dict)), d
+
+
+def test_objecter_put_many_marks_dispatched_mesh(plane_on):
+    """The objecter's batched put rides ONE tracked op whose lifecycle
+    shows the mesh fan-out: dump_historic_ops carries the
+    ``dispatched_mesh`` event with the shard count."""
+    from ceph_tpu.cluster.monitor import Monitor
+    from ceph_tpu.cluster.objecter import Objecter
+    from ceph_tpu.common.op_tracker import tracker
+    sim = make_sim()
+    mon = Monitor(sim.osdmap)
+    client = Objecter(sim, mon)
+    tracker().reset()
+    rng = np.random.default_rng(1)
+    names = [f"b{i}" for i in range(6)]
+    datas = [rng.integers(0, 256, 4000, dtype=np.uint8).tobytes()
+             for _ in names]
+    placed = client.put_many(2, names, datas)
+    assert all(len(p) == 6 for p in placed.values())
+    for n, d in zip(names, datas):
+        assert sim.get(2, n) == d
+    hist = tracker().dump_historic_ops()
+    pm = [o for o in hist["ops"] if o["type"] == "put_many"]
+    assert pm, hist
+    events = [e for e in pm[-1]["events"]
+              if e["event"] == "dispatched_mesh"]
+    assert events and events[0]["shards"] >= 2, pm[-1]
+    sim.shutdown()
+
+
+def test_objecter_put_many_durability_contract(plane_on):
+    """A batch member that lands fewer than k shards fails the WHOLE
+    batched op (gather-all-commits at batch scope)."""
+    from ceph_tpu.cluster.monitor import Monitor
+    from ceph_tpu.cluster.objecter import Objecter, TooManyRetries
+    sim = make_sim()
+    mon = Monitor(sim.osdmap)
+    client = Objecter(sim, mon, max_retries=3)
+    # undetected-dead: kill most of the cluster without telling the map
+    for o in range(1, sim.osdmap.max_osd):
+        sim.fail_osd(o)
+    rng = np.random.default_rng(2)
+    with pytest.raises((IOError, TooManyRetries)):
+        client.put_many(2, ["x0", "x1"],
+                        [rng.integers(0, 256, 2000,
+                                      dtype=np.uint8).tobytes()] * 2)
+    sim.shutdown()
+
+
+def test_map_pgs_batch_identical_under_mesh(plane_on):
+    sim = make_sim()
+    up_on, prim_on = sim.osdmap.map_pgs_batch(2)
+    config().set("parallel_data_plane", False)
+    up_off, prim_off = sim.osdmap.map_pgs_batch(2)
+    np.testing.assert_array_equal(up_on, up_off)
+    np.testing.assert_array_equal(prim_on, prim_off)
+    sim.shutdown()
+
+
+@pytest.mark.smoke
+def test_check_multichip_smoke():
+    """scripts/check_multichip.py passes against this tree (the CI
+    gate for the sharded path's counters + the MULTICHIP
+    cluster_sharded section shape)."""
+    import scripts.check_multichip as chk
+    assert chk.main() == 0

@@ -176,3 +176,59 @@ def test_wire_tier_promote_and_flush(tmp_path):
         rc2.close()
     finally:
         v.stop()
+
+
+def test_wire_tier_remove_server_side_gate(tmp_path):
+    """The mon — the commit point — now enforces the tier-remove
+    safety gate itself: relationship validated, drain verified by
+    querying the OSDs (count_pool), ``force`` as the operator
+    escape hatch.  A client talking straight to the mon (bypassing
+    the client-side convenience check, i.e. the old TOCTOU window)
+    can no longer strand cache-held data."""
+    import time
+    from ceph_tpu.client.remote import RemoteCluster
+    from ceph_tpu.tools.vstart import Vstart, build_cluster_dir
+    d = str(tmp_path / "tiergate")
+    build_cluster_dir(
+        d, n_osds=4, osds_per_host=2, fsync=False,
+        pools=[{"id": 1, "name": "base", "type": 1, "size": 3,
+                "pg_num": 8, "crush_rule": 0},
+               {"id": 2, "name": "cache", "type": 1, "size": 2,
+                "pg_num": 8, "crush_rule": 0},
+               {"id": 3, "name": "plain", "type": 1, "size": 2,
+                "pg_num": 8, "crush_rule": 0}])
+    v = Vstart(d)
+    v.start(4, hb_interval=0.25)
+    try:
+        rc = RemoteCluster(d)
+        # not-a-tier: refused with the relationship error
+        with pytest.raises(Exception, match="not a tier"):
+            rc.mon_call({"cmd": "pool_tier_remove",
+                         "base": 1, "cache": 3})
+        rc.tier_add(1, 2)
+        rc.put(1, "hot", b"cached!" * 100)     # lands in the cache
+        # DIRECT mon call — no client-side check to save us: the
+        # mon itself must refuse while the cache holds objects
+        with pytest.raises(IOError, match="still holds"):
+            rc.mon_call({"cmd": "pool_tier_remove",
+                         "base": 1, "cache": 2})
+        # the tier survives and serves
+        rc.refresh_map()
+        assert rc.osdmap.pools[1].read_tier == 2
+        assert rc.get(1, "hot") == b"cached!" * 100
+        # drained -> allowed
+        rc.tier_flush(1, "hot")
+        rc.tier_evict(1, "hot")
+        rc.tier_remove(1, 2)
+        rc.refresh_map()
+        assert rc.osdmap.pools[1].read_tier == -1
+        assert rc.osdmap.pools[2].tier_of == -1
+        # force path: re-tier, dirty it, force through
+        rc.tier_add(1, 2)
+        rc.put(1, "hot2", b"x" * 64)
+        rc.tier_remove(1, 2, force=True)
+        rc.refresh_map()
+        assert rc.osdmap.pools[1].read_tier == -1
+        rc.close()
+    finally:
+        v.stop()

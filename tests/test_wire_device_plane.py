@@ -34,6 +34,19 @@ def ec_cluster(tmp_path):
                 "stripe_unit": 4096}])
     v = Vstart(d)
     v.start(8, hb_interval=0.25)
+    # settle: a slow-booting OSD can be transiently failure-reported
+    # and marked down; a client map fetched in that window has up-set
+    # holes and the strict all-6-commits assertions below race it.
+    # Wait for the mon map to show every OSD up before handing the
+    # cluster to a test (the down-but-alive re-announce heals it).
+    rc = _client(d)
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        if all(rc.osdmap.osd_up[o] for o in range(8)):
+            break
+        time.sleep(0.25)
+        rc.refresh_map()
+    rc.close()
     yield d, v
     v.stop()
 
@@ -161,3 +174,133 @@ def test_wire_recovery_rebuilds_stripewise_in_grouped_dispatch(
         assert rc2.get(2, n) == dt
     rc.close()
     rc2.close()
+
+
+def test_rehomed_shard_never_decodes_mixed_versions(ec_cluster):
+    """WireShardIO.fanout stale-shard regression: after a shard
+    RE-HOMES (old home marked out) and the object is rewritten, the
+    old home's previous-version copy must not survive — with the new
+    home dead, the any-holder read fallback would otherwise serve the
+    v1 shard next to v2 siblings and the reader would silently decode
+    MIXED versions to garbage.  Mirrors SimShardIO.fanout's "no older
+    shard version is ever servable" invariant."""
+    d, v = ec_cluster
+    rc = _client(d)
+    rng = np.random.default_rng(8)
+    name = "vic"
+    v1 = rng.integers(0, 256, 30000, dtype=np.uint8).tobytes()
+    rc.put_many(2, [name], [v1])
+    pool = rc.osdmap.pools[2]
+    pg = rc._pg_for(pool, name)
+    from ceph_tpu.placement.crush_map import ITEM_NONE
+    s, h_old = next((i, o) for i, o in enumerate(rc._up(pool, pg))
+                    if o != ITEM_NONE)      # a mapped shard's home
+    rc.mon_call({"cmd": "mark_out", "osd": h_old})
+    rc.refresh_map()
+    h_new = rc._up(pool, pg)[s]
+    assert h_new not in (h_old, ITEM_NONE), "shard did not re-home"
+    # rewrite: the shard now lands on its NEW home; the fix purges
+    # the stale v1 copy from h_old on commit
+    v2 = rng.integers(0, 256, 30000, dtype=np.uint8).tobytes()
+    rc.put_many(2, [name], [v2])
+    assert rc.osd_call(h_old, {
+        "cmd": "digest_shard", "coll": [2, pg],
+        "oid": f"{s}:{name}"}) is None, \
+        "stale v1 shard survived on the old home"
+    # kill the new home: a FRESH reader must decode v2 from the
+    # surviving k+ shards — never mix in a stale copy
+    v.kill9(f"osd.{h_new}")
+    rc2 = _client(d)
+    assert rc2.get(2, name) == v2
+    rc.close()
+    rc2.close()
+
+
+def test_failed_subwrite_purges_stale_copies(ec_cluster):
+    """The fanout ERROR path: a sub-write that cannot reach its
+    (dead) target purges the shard's stale copies everywhere else, so
+    no older version is servable while the slot heals degraded."""
+    d, v = ec_cluster
+    rc = _client(d)
+    rng = np.random.default_rng(9)
+    name = "errvic"
+    v1 = rng.integers(0, 256, 30000, dtype=np.uint8).tobytes()
+    rc.put_many(2, [name], [v1])
+    pool = rc.osdmap.pools[2]
+    pg = rc._pg_for(pool, name)
+    from ceph_tpu.placement.crush_map import ITEM_NONE
+    s1, tgt = [(i, o) for i, o in enumerate(rc._up(pool, pg))
+               if o != ITEM_NONE][1]
+    v1_shard1 = bytes(rc.osd_call(tgt, {
+        "cmd": "get_shard", "coll": [2, pg], "oid": f"{s1}:{name}"}))
+    # SIGKILL shard 1's home WITHOUT telling the map: the rewrite's
+    # sub-write to it fails at a current target
+    v.kill9(f"osd.{tgt}")
+    time.sleep(0.2)
+    v2 = rng.integers(0, 256, 30000, dtype=np.uint8).tobytes()
+    try:
+        rc.put_many(2, [name], [v2])
+    except IOError:
+        pass      # the strict commit contract may fail the batch; the
+        #             invariant under test is version purity below
+    # v1's shard-1 bytes must be servable NOWHERE (purged on the
+    # error path), so no later decode can mix them with v2 siblings
+    for o in range(8):
+        if o == tgt:
+            continue
+        try:
+            got = rc.osd_call(o, {"cmd": "get_shard",
+                                  "coll": [2, pg],
+                                  "oid": f"{s1}:{name}"})
+        except (OSError, IOError):
+            continue
+        assert got is None or bytes(got) != v1_shard1, \
+            f"osd.{o} still serves the stale v1 shard"
+    # every surviving shard is v2-era, so the decode is pure v2
+    rc2 = _client(d)
+    assert rc2.get(2, name) == v2
+    rc.close()
+    rc2.close()
+
+
+def test_recover_ec_pool_geometry_gate(ec_cluster):
+    """recover_ec_pool hardening: a holder serving bytes whose length
+    contradicts the object's S/U attrs counts that object
+    unrecoverable/skipped — an uncaught reshape ValueError must not
+    kill the whole pool sweep (the healthy object still recovers)."""
+    d, v = ec_cluster
+    rc = _client(d)
+    rng = np.random.default_rng(10)
+    names = ["geom-bad", "geom-good"]
+    datas = [rng.integers(0, 256, 30000, dtype=np.uint8).tobytes()
+             for _ in names]
+    rc.put_many(2, names, datas)
+    pool = rc.osdmap.pools[2]
+    from ceph_tpu.placement.crush_map import ITEM_NONE
+
+    def mapped(up):
+        # (shard, holder) pairs whose slot is actually mapped
+        return [(s, o) for s, o in enumerate(up) if o != ITEM_NONE]
+
+    # corrupt geom-bad: one shard truncated ON ITS HOLDER (attrs keep
+    # claiming S*U bytes), another deleted so repair NEEDS a decode
+    pg_bad = rc._pg_for(pool, "geom-bad")
+    (s_a, h_a), (s_b, h_b) = mapped(rc._up(pool, pg_bad))[:2]
+    rc.osd_call(h_a, {"cmd": "put_shard", "coll": [2, pg_bad],
+                      "oid": f"{s_a}:geom-bad", "data": b"z" * 100})
+    rc.osd_call(h_b, {"cmd": "delete_shard", "coll": [2, pg_bad],
+                      "oid": f"{s_b}:geom-bad"})
+    # break geom-good the recoverable way: one shard deleted
+    pg_good = rc._pg_for(pool, "geom-good")
+    s_g, h_g = mapped(rc._up(pool, pg_good))[2]
+    rc.osd_call(h_g, {"cmd": "delete_shard", "coll": [2, pg_good],
+                      "oid": f"{s_g}:geom-good"})
+    stats = rc.recover_ec_pool(2)      # must NOT raise
+    assert stats.get("geometry_skipped", 0) >= 1, stats
+    assert stats.get("unrecoverable", 0) >= 1, stats
+    assert stats["shards_rebuilt"] >= 1, stats   # good obj healed
+    # the healthy object's deleted shard is back on its home
+    assert rc.osd_call(h_g, {
+        "cmd": "digest_shard", "coll": [2, pg_good],
+        "oid": f"{s_g}:geom-good"}) is not None
+    rc.close()

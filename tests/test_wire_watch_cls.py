@@ -128,3 +128,31 @@ def test_refcount_over_wire(cluster):
     assert a.exec("counted", "refcount", "get", b"tagB") == b"2"
     assert a.exec("counted", "refcount", "put", b"tagA") == b"1"
     assert a.exec("counted", "refcount", "put", b"tagB") == b"0"
+
+
+def test_notify_wait_longer_than_socket_timeout(cluster):
+    """A notify whose wait exceeds the shared WireClient socket
+    timeout must ride a DEDICATED connection with a derived timeout:
+    the caller gets the pending-watcher result instead of a socket
+    timeout that kills the shared per-OSD connection under every
+    other caller."""
+    from ceph_tpu.client.remote import RemoteCluster
+    d, v = cluster
+    rc = RemoteCluster(d)
+    # shrink the shared socket timeout BEFORE any OSD client exists,
+    # so the clamp boundary is cheap to cross in a test
+    rc._osd_timeout = 1.5
+    rc.put(1, "slowobj", b"watched" * 10)
+    prim, pg, cookie = rc.watch_register(1, "slowobj")
+    shared = rc.osd_client(prim)          # the connection at risk
+    t0 = time.monotonic()
+    # 2.5s server-side wait > 1.5s shared socket timeout; the watcher
+    # never acks (nobody polls), so the full wait elapses
+    r = rc.notify(1, "slowobj", b"ping", timeout=2.5)
+    elapsed = time.monotonic() - t0
+    assert elapsed >= 2.0, f"wait returned early ({elapsed:.2f}s)"
+    assert r["acks"] == {cookie: None}    # pending, not an IOError
+    # the shared connection survived (was never used for the wait)
+    assert rc._osd_clients.get(prim) is shared
+    assert rc.osd_call(prim, {"cmd": "ping"})["alive"]
+    rc.close()
