@@ -14,6 +14,11 @@ either burns the compiled path or bakes one outcome in at trace time.
           ``faults.declare("name", ...)`` site declares
   CTL602  ``faults.fire`` reachable under jit (reuses the CTL1xx
           jit-reachability graph, analysis/astutil.py)
+  CTL603  catch-and-discard of IOError/OSError into a constant
+          default in client//rgw//msg/ — the ``Bucket._read_index``
+          lost-object bug class: a transient wire/device error
+          swallowed into ``{}`` reads as "object absent" and the
+          next metadata WRITE rebuilds from the fabricated default
 """
 from __future__ import annotations
 
@@ -131,6 +136,83 @@ class FireInJitRule(Rule):
         return out
 
 
+# directories whose modules face the wire/device error domain: a
+# swallowed transient error there is user-visible data loss, not a
+# local inconvenience (the scope the ISSUE-6 satellite names)
+_IO_DIRS = ("client", "rgw", "msg")
+
+# exception names that cover IOError/OSError when caught
+_IO_EXC_NAMES = ("IOError", "OSError", "EnvironmentError",
+                 "Exception", "BaseException", "WireError",
+                 "WireClosed", "ConnectionError", "TimeoutError")
+
+
+def _catches_io(handler: ast.ExceptHandler) -> bool:
+    """Does this handler swallow IOError/OSError (directly, via a
+    tuple, via a broad Exception/BaseException, or bare except)?"""
+    t = handler.type
+    if t is None:
+        return True                           # bare except
+    types = t.elts if isinstance(t, ast.Tuple) else [t]
+    for e in types:
+        name = e.id if isinstance(e, ast.Name) else (
+            e.attr if isinstance(e, ast.Attribute) else None)
+        if name in _IO_EXC_NAMES:
+            return True
+    return False
+
+
+def _const_expr(e: Optional[ast.AST]) -> bool:
+    """A literal/constant default: the fabricated value the swallow
+    substitutes for real state ({} / [] / None / 0 / "" / ...)."""
+    if e is None or isinstance(e, ast.Constant):
+        return True
+    if isinstance(e, (ast.List, ast.Tuple, ast.Set)):
+        return all(_const_expr(x) for x in e.elts)
+    if isinstance(e, ast.Dict):
+        return all(_const_expr(k) for k in e.keys if k is not None) \
+            and all(_const_expr(v) for v in e.values)
+    if isinstance(e, ast.UnaryOp):
+        return _const_expr(e.operand)
+    return False
+
+
+class SwallowedIOErrorRule(Rule):
+    rule_id = "CTL603"
+    name = "ioerror-swallowed-to-default"
+    description = ("except IOError/OSError handler returns a constant "
+                   "default in client//rgw//msg/ — a transient error "
+                   "fabricates 'absent' state (the _read_index "
+                   "lost-object bug class); retry with backoff, "
+                   "re-raise, or suppress with # noqa: CTL603")
+
+    def check_module(self, mod: ParsedModule) -> Iterable[Finding]:
+        if mod.evidence:
+            return ()
+        parts = mod.relpath.replace("\\", "/").split("/")[:-1]
+        if not any(p in _IO_DIRS for p in parts):
+            return ()
+        out: List[Finding] = []
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if not _catches_io(node):
+                continue
+            body = node.body
+            if len(body) == 1 and isinstance(body[0], ast.Return) \
+                    and _const_expr(body[0].value):
+                out.append(self.finding(
+                    mod, node.lineno,
+                    "IOError/OSError swallowed into a constant "
+                    "default return — a transient wire/device error "
+                    "now reads as 'absent' state (the _read_index "
+                    "lost-object class); retry with "
+                    "common/backoff.ExpBackoff, raise, or justify "
+                    "with # noqa: CTL603"))
+        return out
+
+
 def register(reg) -> None:
     reg.add(UndeclaredFireRule.rule_id, UndeclaredFireRule)
     reg.add(FireInJitRule.rule_id, FireInJitRule)
+    reg.add(SwallowedIOErrorRule.rule_id, SwallowedIOErrorRule)

@@ -72,7 +72,12 @@ class MirrorReplayer:
     def committed_position(self) -> int:
         try:
             return int(self.src.read(self._pos_oid()).decode())
-        except Exception:
+        except (KeyError, ValueError):
+            # genuinely absent (fresh peer) or corrupt marker: replay
+            # from the start.  A TRANSIENT read error now propagates —
+            # treating it as "no position" forced a full re-sync and
+            # re-applied every logged delete (the _read_index bug
+            # class, CTL603)
             return -1
 
     def _commit(self, seq: int) -> None:

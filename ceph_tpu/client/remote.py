@@ -51,7 +51,24 @@ class RemoteCluster:
                                             "keyring.client"))
         self.secret = ring.secret(entity)
         self.mon: Optional[WireClient] = None
+        # mon failover ROTATES: a reconnect after a failure must not
+        # land on the same (possibly minority-partitioned, lease-
+        # stalled) mon forever — start each connect sweep at the rank
+        # after the one that just failed
+        self._mon_rot = 0
         self._connect_mon()
+        # per-OSD messenger sessions: a session id survives RECONNECTS
+        # (that is its whole point), and each mutating op draws one seq
+        # from it — resends reuse the (sid, seq), the daemon dedups
+        self._sessions: Dict[int, Dict] = {}
+        self.session_resets = 0          # stale-session resets seen
+        # hooks: each called with the osd id when a session RESET is
+        # detected on reconnect (daemon lost our state — session-
+        # scoped registrations like watches must be re-established).
+        # A LIST with explicit unregistration: many ioctxs share one
+        # cluster handle, and a closed ioctx must not stay reachable
+        # through a permanently-chained closure
+        self._session_reset_cbs: List = []
         # socket timeout of the SHARED per-OSD clients: anything that
         # blocks a daemon handler longer (notify_wait) must ride a
         # dedicated connection with a DERIVED timeout, or the timed-out
@@ -124,18 +141,29 @@ class RemoteCluster:
 
     def _connect_mon(self) -> None:
         """Any quorum member serves reads and forwards mutations to
-        the leader; fail over across the configured mons."""
+        the leader; fail over across the configured mons, starting at
+        the rotation point (the mon AFTER the last failure) so a
+        stalled minority mon cannot capture every reconnect."""
         last: Optional[Exception] = None
-        for sock in self._mon_socks():
+        socks = self._mon_socks()
+        for i in range(len(socks)):
+            sock = socks[(self._mon_rot + i) % len(socks)]
+            mon_ent = os.path.basename(sock)[:-len(".sock")]
             try:
                 self.mon = WireClient(sock, self.entity,
-                                      secret=self.secret)
+                                      secret=self.secret,
+                                      peer=mon_ent)
+                self._mon_rot = (self._mon_rot + i) % len(socks)
                 return
             except (OSError, IOError, cx.AuthError) as e:
                 last = e
         raise IOError(f"no mon reachable: {last}")
 
     def mon_call(self, req: Dict) -> Dict:
+        """Bounded mon sweep: a failing/stalled mon (connection error
+        OR a retryable IOError reply such as a minority-side lease
+        stall) rotates the client to the next quorum member — the
+        'bounded stall or redirect, never a stale map' contract."""
         last: Optional[Exception] = None
         for attempt in range(3):
             if self.mon is None:
@@ -154,6 +182,7 @@ class RemoteCluster:
                 except OSError:
                     pass
                 self.mon = None
+                self._mon_rot += 1       # next reconnect: next mon
                 if attempt < 2:
                     self._backoff.sleep(attempt)
         raise IOError(f"mon unreachable ({last})")
@@ -170,11 +199,23 @@ class RemoteCluster:
             m.osd_weight[i] = w
         for p in blob["pools"]:
             m.add_pool(PGPool(**p))
+        m.flags = set(blob.get("flags", []))
         self.osdmap = m
         self._up_cache: Dict = {}
         self.addrs = {int(k): v for k, v in blob["addrs"].items()}
         self.pool_snaps = {int(k): v for k, v in
                            blob.get("pool_snaps", {}).items()}
+
+    def _session(self, osd: int) -> Dict:
+        """This client's messenger session with one OSD — created
+        once, kept across reconnects (caller holds _client_lock or is
+        single-threaded through osd_call's seq draw)."""
+        st = self._sessions.get(osd)
+        if st is None:
+            import secrets as _secrets
+            st = self._sessions[osd] = {"sid": _secrets.token_hex(8),
+                                        "seq": 0}
+        return st
 
     def osd_client(self, osd: int) -> WireClient:
         c = self._osd_clients.get(osd)
@@ -191,9 +232,47 @@ class RemoteCluster:
             key = cx.open_key_box(self.secret, grant["key_box"])
             c = WireClient(self.addrs[osd], self.entity,
                            ticket=grant["ticket"], session_key=key,
-                           timeout=self._osd_timeout)
+                           timeout=self._osd_timeout,
+                           peer=f"osd.{osd}")
+            st = self._session(osd)
             self._osd_clients[osd] = c
-            return c
+        # session resume OUTSIDE the client lock (it is a wire call):
+        # announce (sid, highest seq used); the daemon answers whether
+        # it still holds our session — a resume against an unknown sid
+        # is a detected STALE SESSION (daemon restarted/evicted): both
+        # sides reset, and session-scoped state (watches) must be
+        # re-established by the owner
+        try:
+            hello = c.call({"cmd": "session_hello",
+                            "session": st["sid"], "seq": st["seq"]})
+            if not hello.get("known") and st["seq"] > 0:
+                self.session_resets += 1
+                for cb in list(self._session_reset_cbs):
+                    try:
+                        cb(osd)
+                    except Exception:
+                        pass
+        except (OSError, IOError):
+            pass          # hello is advisory; ops re-hello via retry
+        return c
+
+    def _next_stamp(self, osd: int) -> Dict:
+        """Draw one (session, seq) replay stamp for a logical
+        mutating op against ``osd`` — the single place the stamping
+        contract (lock discipline, sid scope) lives."""
+        with self._client_lock:
+            st = self._session(osd)
+            st["seq"] += 1
+            return {"session": st["sid"], "seq": st["seq"]}
+
+    def add_session_reset_cb(self, cb) -> None:
+        self._session_reset_cbs.append(cb)
+
+    def remove_session_reset_cb(self, cb) -> None:
+        try:
+            self._session_reset_cbs.remove(cb)
+        except ValueError:
+            pass
 
     def new_osd_client(self, osd: int,
                        timeout: Optional[float] = None) -> WireClient:
@@ -210,7 +289,8 @@ class RemoteCluster:
         return WireClient(self.addrs[osd], self.entity,
                           ticket=grant["ticket"], session_key=key,
                           timeout=timeout if timeout is not None
-                          else self._osd_timeout)
+                          else self._osd_timeout,
+                          peer=f"osd.{osd}")
 
     def _evict_staging(self, pool_id: int, pg: int, name: str) -> None:
         """Invalidate this client's staged shards + attrs for one
@@ -228,11 +308,26 @@ class RemoteCluster:
         if c:
             c.close()
 
+    # mutations that ride the (session, seq) replay contract: the
+    # daemon applies each at most once, so the reconnect-retry below
+    # (and any caller resending the SAME dict) is a safe replay
+    _REPLAY_CMDS = frozenset((
+        "put_shard", "put_object", "delete_shard", "delete_object",
+        "setattr_shard", "copy_from", "exec_cls"))
+
     def osd_call(self, osd: int, req: Dict):
         """One OSD request with a single same-target retry on a FRESH
         connection: a cached connection may have been killed since its
         last use (daemon restart, injected socket failure), and that
-        staleness must cost one reconnect, not the whole target."""
+        staleness must cost one reconnect, not the whole target.
+        Mutating requests are stamped with this client's (session,
+        seq) ONCE — the reconnect retry carries the same stamp, so a
+        request whose first send applied but whose reply was lost is
+        REPLAYED, not re-applied (the daemon returns the recorded
+        completion)."""
+        if req.get("cmd") in self._REPLAY_CMDS and \
+                "session" not in req:
+            req = dict(req, **self._next_stamp(osd))
         for attempt in range(2):
             try:
                 return self.osd_client(osd).call(req)
@@ -626,6 +721,7 @@ class RemoteCluster:
             # failure) is transient, and the full-object write +
             # fresh version make the resend idempotent
             last: Optional[Exception] = None
+            stamp: Optional[Dict] = None
             for attempt in range(5):
                 replicas = [o for o in up if o != ITEM_NONE]
                 if not replicas:
@@ -640,14 +736,19 @@ class RemoteCluster:
                     up = self._up(pool, pg)
                     continue
                 primary = replicas[0]
+                if stamp is None:
+                    # ONE (session, seq) for this logical write: every
+                    # resend below replays it, and the primary's dup
+                    # detection applies it at most once (a lost REPLY
+                    # must not become a second apply)
+                    stamp = self._next_stamp(primary)
                 try:
-                    r = self.osd_client(primary).call({
+                    r = self.osd_call(primary, {
                         "cmd": "put_object", "coll": coll,
                         "oid": f"0:{name}", "data": data,
                         "attrs": extra_attrs,
-                        "replicas": replicas})
+                        "replicas": replicas, **stamp})
                 except (OSError, IOError) as e:
-                    self.drop_osd_client(primary)
                     last = e
                     if attempt < 4:      # no backoff on the last throw
                         self._backoff.sleep(attempt)
@@ -1021,12 +1122,14 @@ class RemoteCluster:
             if tgt == ITEM_NONE:
                 continue
             try:
-                self.osd_client(tgt).call({
+                # osd_call: session-stamped (replay-safe) + one
+                # reconnect retry per target
+                self.osd_call(tgt, {
                     "cmd": "delete_shard", "coll": coll,
                     "oid": f"{shard}:{name}"})
                 acks += 1
             except (OSError, IOError):
-                self.drop_osd_client(tgt)
+                pass
         return acks
 
     def list_objects(self, pool_id: int) -> List[str]:
@@ -1523,8 +1626,10 @@ class RemoteCluster:
                                     "coll": [pool_id, pg],
                                     "oid": f"{shard}:{name}",
                                     "data": data, "attrs": attrs})
-            except (OSError, IOError):
-                return 0          # stays dirty; retried next flush
+            except (OSError, IOError):   # noqa: CTL603 — not a
+                # fabricated default: the entry STAYS DIRTY in the
+                # staging tier and the next flush pass retries it
+                return 0
             self.dev.mark_clean(key, zlib.crc32(data))
             return 1
 
@@ -1781,7 +1886,10 @@ class WireShardIO:
                 rc.osd_call(o, {"cmd": "delete_shards",
                                 "items": items})
                 return True
-            except (OSError, IOError):
+            except (OSError, IOError):   # noqa: CTL603 — False =
+                # "daemon unreached": the sweep is NOT memoized and
+                # re-runs on the next commit (deferred retry, not a
+                # fabricated result)
                 return False
         if len(daemons) <= 1:
             reached = {o: purge_on(o) for o in daemons}

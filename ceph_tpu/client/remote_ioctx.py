@@ -64,6 +64,24 @@ class RemoteIoCtx:
         self._watch_thread: Optional[threading.Thread] = None
         self._watch_stop = threading.Event()
         self._poll_clients: Dict[int, object] = {}
+        # watches marked for proactive re-registration: a messenger
+        # session RESET (daemon restarted / evicted our session) means
+        # session-scoped daemon state is gone — re-register instead of
+        # waiting for a poll to come back "gone" after a notify was
+        # already missed.  Registered on the shared rc's callback
+        # LIST (several ioctxs share one cluster handle) and removed
+        # again in close().
+        self._rewatch: set = set()
+
+        def _on_reset(osd: int) -> None:
+            with self._watch_lock:
+                for (oid, cookie), (prim, pg, _cb) in \
+                        self._watches.items():
+                    if prim == osd:
+                        self._rewatch.add((oid, cookie))
+
+        self._on_reset_cb = _on_reset
+        rc.add_session_reset_cb(_on_reset)
 
     # ------------------------------------------------------------- data --
     def write_full(self, oid: str, data: bytes) -> None:
@@ -254,19 +272,23 @@ class RemoteIoCtx:
 
     def close(self) -> None:
         """Stop the watch poller and release its connections (the
-        ioctx destructor role)."""
+        ioctx destructor role).  The wire unregisters run OUTSIDE
+        _watch_lock: osd_call can reconnect and run the session-reset
+        hooks, and this ioctx's own hook takes _watch_lock — holding
+        it across the call would self-deadlock."""
         with self._watch_lock:
-            for (oid, cookie), (prim, pg, _) in \
-                    list(self._watches.items()):
-                try:
-                    self._rc.osd_call(prim, {
-                        "cmd": "watch_unregister",
-                        "coll": [self.pool_id, pg],
-                        "oid": f"0:{oid}", "cookie": cookie})
-                except (OSError, IOError):
-                    pass
+            watches = dict(self._watches)
             self._watches.clear()
             self._watch_stop.set()
+        self._rc.remove_session_reset_cb(self._on_reset_cb)
+        for (oid, cookie), (prim, pg, _) in watches.items():
+            try:
+                self._rc.osd_call(prim, {
+                    "cmd": "watch_unregister",
+                    "coll": [self.pool_id, pg],
+                    "oid": f"0:{oid}", "cookie": cookie})
+            except (OSError, IOError):
+                pass
 
     def _poll_call(self, prim: int, req: dict):
         """Poller-owned wire call on a DEDICATED connection: the main
@@ -286,6 +308,45 @@ class RemoteIoCtx:
                 pass
             raise
 
+    def _reregister(self, oid: str, cookie: int, cb) -> None:
+        """Re-establish one watch under a fresh cookie, refreshing the
+        map first if placement moved (after a restart/heal the object
+        may have a NEW primary — re-registering on the cached one
+        would silently watch nothing)."""
+        for attempt in range(2):
+            try:
+                np_, npg = self._rc._watch_primary(self.pool_id, oid)
+                nc = int(self._poll_call(np_, {
+                    "cmd": "watch_register",
+                    "coll": [self.pool_id, npg],
+                    "oid": f"0:{oid}"})["cookie"])
+            except (OSError, IOError):
+                if attempt:
+                    return            # next poll tick retries
+                try:
+                    self._rc.refresh_map()
+                except (OSError, IOError):  # noqa: CTL603 — the
+                    # poller tick IS the retry loop: giving up here
+                    # re-enters on the next poll interval
+                    return
+                continue
+            with self._watch_lock:
+                if (oid, cookie) in self._watches:
+                    del self._watches[(oid, cookie)]
+                    self._watches[(oid, nc)] = (np_, npg, cb)
+                    return
+            # the watch was unwatched/closed while we re-registered:
+            # release the fresh cookie, or the daemon holds a watcher
+            # nobody polls and every notify blocks to its timeout
+            try:
+                self._poll_call(np_, {
+                    "cmd": "watch_unregister",
+                    "coll": [self.pool_id, npg],
+                    "oid": f"0:{oid}", "cookie": nc})
+            except (OSError, IOError):
+                pass
+            return
+
     def _watch_poller(self, interval: float = 0.05) -> None:
         while not self._watch_stop.is_set():
             with self._watch_lock:
@@ -294,6 +355,13 @@ class RemoteIoCtx:
                 time.sleep(interval)
                 continue
             for (oid, cookie), (prim, pg, cb) in watches.items():
+                if (oid, cookie) in self._rewatch:
+                    # session reset detected on reconnect: daemon-side
+                    # watch state is session-scoped and gone — do not
+                    # wait for a missed notify to find out
+                    self._rewatch.discard((oid, cookie))
+                    self._reregister(oid, cookie, cb)
+                    continue
                 try:
                     r = self._poll_call(prim, {
                         "cmd": "watch_poll",
@@ -305,19 +373,7 @@ class RemoteIoCtx:
                     # daemon restarted and lost the registry:
                     # re-register under a fresh cookie (on the
                     # poller's own connection)
-                    try:
-                        np_, npg = self._rc._watch_primary(
-                            self.pool_id, oid)
-                        nc = int(self._poll_call(np_, {
-                            "cmd": "watch_register",
-                            "coll": [self.pool_id, npg],
-                            "oid": f"0:{oid}"})["cookie"])
-                    except (OSError, IOError):
-                        continue
-                    with self._watch_lock:
-                        if (oid, cookie) in self._watches:
-                            del self._watches[(oid, cookie)]
-                            self._watches[(oid, nc)] = (np_, npg, cb)
+                    self._reregister(oid, cookie, cb)
                     continue
                 for nid, payload in r.get("events", []):
                     try:

@@ -43,6 +43,7 @@ from ..common.admin import AdminServer
 from ..common.backoff import ExpBackoff
 from ..common.lockdep import LockdepLock
 from ..common.op_tracker import mark_active, tracker as _op_tracker
+from ..common.perf_counters import perf as _perf
 from ..msg import encoding
 from ..msg.queue import Envelope
 from ..msg import wire
@@ -105,7 +106,8 @@ class WireServer:
     def __init__(self, sock_path: str, service: str, keyring: cx.Keyring,
                  handler: Callable[[str, Dict[str, Any]], Any],
                  secret_mode_keyring: Optional[cx.Keyring] = None,
-                 inject_socket_failures: int = 0):
+                 inject_socket_failures: int = 0,
+                 net_entity: Optional[str] = None):
         """``handler(entity, request) -> reply_obj`` (may raise).
         ``secret_mode_keyring``: when set (the mon), clients may
         authenticate by entity secret; otherwise only tickets sealed
@@ -124,6 +126,10 @@ class WireServer:
         daemon whose spec option was 0."""
         self.sock_path = sock_path
         self.service = service
+        # this daemon's name in net.partition groups (the service
+        # string for OSDs; mons pass their RANKED entity, since
+        # "mon." cannot distinguish quorum members in a split)
+        self.net_entity = net_entity or service
         self.keyring = keyring
         self.secret_mode_keyring = secret_mode_keyring
         self.handler = handler
@@ -214,6 +220,13 @@ class WireServer:
                     return
                 if env.type != MSG_REQ:
                     continue
+                if faults.fire("net.partition", src=entity,
+                               dst=self.net_entity) is not None:
+                    # inbound half of a cut: the request frame never
+                    # arrived — drop the connection, no reply (covers
+                    # peers whose OWN registry is not armed: one
+                    # process's arm severs both directions with it)
+                    return
                 if faults.fire("wire.inject_socket_failures",
                                service=self.service) is not None:
                     # drop the connection mid-op, no reply — the
@@ -234,7 +247,11 @@ class WireServer:
                     out = Envelope(MSG_ERR, env.id, -1,
                                    _dumps((type(e).__name__, str(e))))
                 try:
-                    wire.send_frame(conn, out, session_key=key)
+                    # reply direction carries its own src/dst: a
+                    # oneway cut can apply the op yet lose the ack —
+                    # the case session replay dedup exists for
+                    wire.send_frame(conn, out, session_key=key,
+                                    src=self.net_entity, dst=entity)
                 except OSError:
                     return
         finally:
@@ -258,7 +275,19 @@ class WireClient:
                  secret: Optional[bytes] = None,
                  ticket: Optional[bytes] = None,
                  session_key: Optional[bytes] = None,
-                 timeout: float = 10.0):
+                 timeout: float = 10.0,
+                 peer: Optional[str] = None):
+        self.entity = entity
+        # the peer's entity name, when the caller knows it: the
+        # net.partition faultpoint severs (entity -> peer) traffic at
+        # connect AND per request frame (asymmetric cuts can still
+        # deliver the reverse direction)
+        self.peer = peer
+        if peer is not None and faults.fire(
+                "net.partition", src=entity, dst=peer) is not None:
+            raise wire.WireClosed(
+                f"fault injected: {entity} -> {peer} partitioned "
+                f"(connect refused)")
         self.sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
         self.sock.settimeout(timeout)
         self.sock.connect(sock_path)
@@ -303,7 +332,8 @@ class WireClient:
             rid = self._id
             wire.send_frame(self.sock, Envelope(MSG_REQ, rid, -1,
                                                 _dumps(req)),
-                            session_key=self.key)
+                            session_key=self.key,
+                            src=self.entity, dst=self.peer)
             env = wire.recv_frame(self.sock, session_key=self.key)
         if env.type == MSG_ERR:
             name, msg = encoding.loads(env.payload)
@@ -345,6 +375,7 @@ class MonDaemon:
                  "pool_create", "pool_rm",
                  "pool_tier_add", "pool_tier_remove",
                  "pool_snap_create", "pool_snap_remove",
+                 "osd_set_flag", "osd_unset_flag",
                  "config_set")
 
     def __init__(self, cluster_dir: str, rank: int = 0):
@@ -367,6 +398,13 @@ class MonDaemon:
         self.mon = Monitor.open(
             base, self.db,
             failure_reports_needed=spec.get("failure_reports_needed", 2))
+        # markdown hysteresis (the osd_markdown_log role): wall-clock
+        # windows on the process tier; 0 markdowns-to-hold = disabled
+        self.mon.configure_flap_dampening(
+            count=int(spec.get("osd_flap_markdown_count", 0)),
+            window=float(spec.get("osd_flap_window", 60.0)),
+            hold=float(spec.get("osd_flap_hold", 5.0)),
+            hold_cap=float(spec.get("osd_flap_hold_cap", 30.0)))
         # RLock: the leader's propose path re-enters through the
         # quorum's local apply (handle -> commit_incremental ->
         # propose -> _commit_entry -> _apply_decree)
@@ -376,9 +414,10 @@ class MonDaemon:
         self._peer_mons: Dict[int, WireClient] = {}
         if self.n_mons > 1:
             from .mon_quorum import QuorumNode
-            self.quorum = QuorumNode(rank, self.n_mons, self.db,
-                                     self._apply_decree,
-                                     self._send_peer_mon)
+            self.quorum = QuorumNode(
+                rank, self.n_mons, self.db, self._apply_decree,
+                self._send_peer_mon,
+                lease_duration=float(spec.get("mon_lease", 2.0)))
             self.mon.set_proposer(self._propose_value)
             self.quorum.replay(0)      # idempotent re-apply after crash
         sock = os.path.join(cluster_dir, "mon.sock") \
@@ -388,7 +427,13 @@ class MonDaemon:
             sock, "mon.", self.keyring, self._handle,
             secret_mode_keyring=self.keyring,
             inject_socket_failures=int(
-                spec.get("ms_inject_socket_failures", 0)))
+                spec.get("ms_inject_socket_failures", 0)),
+            # net.partition group name: must match what CLIENTS derive
+            # from the socket basename ("mon.sock" -> "mon",
+            # "mon.N.sock" -> "mon.N") — the keyring entity "mon."
+            # would make single-mon cuts silently one-sided
+            net_entity="mon" if self.n_mons == 1
+            else f"mon.{rank}")
         # per-daemon admin socket (`ceph daemon mon.N ...` — the
         # AdminSocket surface: perf dump, config, tracked-op dumps)
         self.admin = AdminServer()
@@ -416,7 +461,8 @@ class MonDaemon:
             c = WireClient(
                 os.path.join(self.dir, f"mon.{rank}.sock"),
                 self.entity,
-                secret=self.keyring.secret(self.entity), timeout=3.0)
+                secret=self.keyring.secret(self.entity), timeout=3.0,
+                peer=f"mon.{rank}")
             self._peer_mons[rank] = c
         try:
             return c.call(req)
@@ -470,6 +516,13 @@ class MonDaemon:
             try:
                 if lead is None:
                     q.start_election()
+                elif lead == self.rank:
+                    # leader: extend the read lease on a majority each
+                    # round (Paxos::extend_lease).  A leader that can
+                    # no longer reach a majority (netsplit minority)
+                    # fails here, its own lease expires, and its map
+                    # reads stall instead of serving stale state.
+                    q.extend_lease()
                 elif lead != self.rank:
                     try:
                         self._send_peer_mon(lead, {"q": "ping"})
@@ -514,6 +567,7 @@ class MonDaemon:
             "epoch": m.epoch,
             "crush_text": decompile_crushmap(m.crush),
             "pools": pools,
+            "flags": sorted(m.flags),
             "pool_id_max": m.pool_id_max,
             "osd_up": [bool(v) for v in m.osd_up[:m.max_osd]],
             "osd_weight": [int(v) for v in m.osd_weight[:m.max_osd]],
@@ -539,7 +593,7 @@ class MonDaemon:
                               key_box)
         c = WireClient(os.path.join(self.dir, f"osd.{osd}.sock"),
                        self.entity, ticket=ticket, session_key=key,
-                       timeout=2.0)
+                       timeout=2.0, peer=f"osd.{osd}")
         try:
             return c.call(req)
         finally:
@@ -592,6 +646,7 @@ class MonDaemon:
                     "election_epoch":
                         0 if q is None else q.election_epoch,
                     "committed": 0 if q is None else q.committed,
+                    "readable": True if q is None else q.readable(),
                     "epoch": self.mon.osdmap.epoch}
         if cmd == "_forwarded":
             # leader-side unwrap of a peon-forwarded mutation: the
@@ -665,14 +720,41 @@ class MonDaemon:
                 ticket, key_box = self.tickets.grant(entity, service)
                 return {"ticket": ticket, "key_box": key_box}
             if cmd == "get_map":
+                if self.quorum is not None and \
+                        not self.quorum.readable():
+                    # minority-side mon: the read lease expired and a
+                    # majority may be committing epochs this rank
+                    # cannot see — STALL (IOError = retryable) rather
+                    # than serve a stale map as fresh; the client's
+                    # mon failover rotates to a majority mon
+                    raise IOError(
+                        f"{self.entity}: no quorum read lease "
+                        f"(possible minority partition) — map reads "
+                        f"stalled, retry another mon")
                 return self.map_blob()
             if cmd == "osd_boot":
                 osd = int(req["osd"])
                 if entity != f"osd.{osd}":
                     raise cx.AuthError(
                         f"{entity} cannot boot osd.{osd}")
-                self.mon.osd_boot(osd)
+                if not self.mon.osd_boot(osd):
+                    # flap dampening: a markdown-storm OSD is HELD
+                    # down for its backoff; the daemon's heartbeat
+                    # keeps re-announcing and eventually lands
+                    return {"epoch": self.mon.osdmap.epoch,
+                            "held": True,
+                            "hold": self.mon.flap_status(osd)}
                 return {"epoch": self.mon.osdmap.epoch}
+            if cmd == "osd_set_flag":
+                if not self.mon.set_flag(str(req["flag"]), True):
+                    raise IOError("set flag: no quorum")
+                return {"epoch": self.mon.osdmap.epoch,
+                        "flags": sorted(self.mon.osdmap.flags)}
+            if cmd == "osd_unset_flag":
+                if not self.mon.set_flag(str(req["flag"]), False):
+                    raise IOError("unset flag: no quorum")
+                return {"epoch": self.mon.osdmap.epoch,
+                        "flags": sorted(self.mon.osdmap.flags)}
             if cmd == "report_failure":
                 if not entity.startswith("osd."):
                     raise cx.AuthError("only OSDs report failures")
@@ -683,12 +765,18 @@ class MonDaemon:
             if cmd == "mark_out":
                 inc = self.mon.next_incremental()
                 inc.new_weight[int(req["osd"])] = 0
-                self.mon.commit_incremental(inc)
+                if not self.mon.commit_incremental(inc):
+                    # IOError = retryable at the client (mon_call
+                    # backs off and retries/rotates): a quorum round
+                    # that transiently failed must NOT ack with an
+                    # unchanged epoch as if it committed
+                    raise IOError("mark_out: no quorum")
                 return {"epoch": self.mon.osdmap.epoch}
             if cmd == "mark_in":
                 inc = self.mon.next_incremental()
                 inc.new_weight[int(req["osd"])] = 0x10000
-                self.mon.commit_incremental(inc)
+                if not self.mon.commit_incremental(inc):
+                    raise IOError("mark_in: no quorum")
                 return {"epoch": self.mon.osdmap.epoch}
             if cmd == "pool_create":
                 # `ceph osd pool create` (OSDMonitor::prepare_new_pool):
@@ -975,6 +1063,18 @@ class OSDDaemon:
                                       f"osd.{osd_id}.asok"))
         self._hb_misses: Dict[int, int] = {}
         self._slow_reported = 0       # last slow-op count sent to mon
+        # messenger sessions (the reference's Session + pg-log reqid
+        # dup detection, collapsed to one table): a client carries a
+        # session id + per-session op seq across RECONNECTS, so a
+        # write whose reply was lost to a cut/drop is replayed and
+        # applied AT MOST ONCE — the replay returns the cached reply.
+        # (entity, sid) -> {"last": applied seq high-water,
+        #                   "replies": {seq: reply}, "touched": ts}
+        self._session_lock = LockdepLock("osd.sessions",
+                                         recursive=False)
+        self._sessions: Dict[Tuple[str, str], Dict[str, Any]] = {}
+        self.session_resets = 0       # unknown-sid resumes observed
+        self._pc_session = _perf("osd.session")
 
     # ----------------------------------------------------------- mon I/O --
     def _mon_socks(self) -> List[str]:
@@ -986,10 +1086,12 @@ class OSDDaemon:
         if self._mon is None:
             last: Optional[Exception] = None
             for sock in self._mon_socks():
+                mon_ent = os.path.basename(sock)[:-len(".sock")]
                 try:
                     self._mon = WireClient(
                         sock, self.entity,
-                        secret=self.keyring.secret(self.entity))
+                        secret=self.keyring.secret(self.entity),
+                        peer=mon_ent)
                     break
                 except (OSError, IOError, cx.AuthError) as e:
                     last = e
@@ -1009,7 +1111,8 @@ class OSDDaemon:
                               grant["key_box"])
         c = WireClient(os.path.join(self.dir, f"osd.{osd}.sock"),
                        self.entity, ticket=grant["ticket"],
-                       session_key=key, timeout=5.0)
+                       session_key=key, timeout=5.0,
+                       peer=f"osd.{osd}")
         with self._peer_lock:
             self._peers[osd] = c
         return c
@@ -1097,6 +1200,131 @@ class OSDDaemon:
         "getattr_shard", "stat_shard", "digest_shard", "copy_from",
         "put_object", "delete_object", "exec_cls"))
 
+    # mutations covered by (session, seq) dup detection: a replay of
+    # an already-applied op must not apply a second time
+    _REPLAY_CMDS = frozenset((
+        "put_shard", "put_object", "delete_shard", "delete_object",
+        "setattr_shard", "copy_from", "exec_cls"))
+
+    _SESSION_REPLY_WINDOW = 64        # cached replies per session
+    _MAX_SESSIONS = 256               # LRU cap across clients
+
+    # ------------------------------------------------------- sessions --
+    def _session_state(self, entity: str, sid: str) -> Dict[str, Any]:
+        """Find-or-create under _session_lock (caller holds it)."""
+        key = (entity, sid)
+        st = self._sessions.get(key)
+        if st is None:
+            if len(self._sessions) >= self._MAX_SESSIONS:
+                oldest = min(self._sessions,
+                             key=lambda k:
+                             self._sessions[k]["touched"])
+                del self._sessions[oldest]
+            st = self._sessions[key] = {"last": 0, "replies": {},
+                                        "touched": time.monotonic()}
+        st["touched"] = time.monotonic()
+        return st
+
+    def _session_hello(self, entity: str,
+                       req: Dict[str, Any]) -> Dict[str, Any]:
+        """Session establishment/resume on (re)connect: the client
+        announces its session id and the highest seq it has USED; the
+        server answers whether it still holds the session.  A resume
+        (seq > 0) against an unknown sid is a detected STALE SESSION
+        — this daemon restarted or evicted it — and both sides reset:
+        the server starts fresh state here, the client learns its
+        dedup history is gone (its durable-idempotent full-rewrite
+        contract covers re-applies) and re-establishes session-scoped
+        state such as watches."""
+        sid = str(req["session"])
+        with self._session_lock:
+            known = (entity, sid) in self._sessions
+            st = self._session_state(entity, sid)
+            if not known and int(req.get("seq", 0)) > 0:
+                self.session_resets += 1
+                self._pc_session.inc("resets")
+            return {"known": known, "last_applied": st["last"]}
+
+    _MISS = object()
+
+    class _InFlight:
+        """Marker parked in the reply window while the FIRST arrival
+        of a seq is still applying: a replay that races it (client
+        socket timeout + retry while the apply is merely slow) must
+        WAIT for that apply rather than start a second one — two
+        concurrent applies of one seq could interleave with a newer
+        write and clobber it."""
+
+        __slots__ = ("event",)
+
+        def __init__(self) -> None:
+            self.event = threading.Event()
+
+    def _session_check(self, entity: str, sid: str, seq: int) -> Any:
+        """_MISS when the op must apply (an in-flight marker is
+        parked first); otherwise the recorded reply.  Dedup is
+        strictly against the RETAINED reply window: a seq below the
+        window's floor is applied again (ops on one session run
+        CONCURRENTLY over per-object paths, so ``seq <= last`` cannot
+        distinguish 'applied long ago' from 'arrived out of order' —
+        and the client's full-rewrite semantics make a beyond-window
+        re-apply idempotent, exactly the reference's bounded pg-log
+        dup window contract)."""
+        with self._session_lock:
+            st = self._session_state(entity, sid)
+            ent = st["replies"].get(seq)
+            if ent is None:
+                st["replies"][seq] = self._InFlight()
+                return self._MISS
+            if not isinstance(ent, self._InFlight):
+                self._pc_session.inc("replay_dups")
+                return ent
+            ev = ent.event
+        # the first arrival is still applying: wait it out (outside
+        # the lock — the apply needs it), then return ITS outcome
+        ev.wait(30.0)
+        with self._session_lock:
+            st = self._sessions.get((entity, sid))
+            ent = None if st is None else st["replies"].get(seq)
+            if ent is None or isinstance(ent, self._InFlight):
+                # first apply failed (aborted) or is still stuck:
+                # surface a retryable error — the caller's resend
+                # machinery comes back through a fresh check
+                raise IOError(f"session {sid}: seq {seq} first "
+                              f"apply did not complete")
+            self._pc_session.inc("replay_dups")
+            return ent
+
+    def _session_record(self, entity: str, sid: str, seq: int,
+                        reply: Any) -> None:
+        with self._session_lock:
+            st = self._session_state(entity, sid)
+            prev = st["replies"].get(seq)
+            st["replies"][seq] = reply
+            st["last"] = max(st["last"], seq)
+            self._pc_session.inc("applied")
+            live = [s for s, e in st["replies"].items()
+                    if not isinstance(e, self._InFlight)]
+            while len(live) > self._SESSION_REPLY_WINDOW:
+                # evict completed replies only: an in-flight marker
+                # must survive until its apply resolves
+                oldest = min(live)
+                del st["replies"][oldest]
+                live.remove(oldest)
+        if isinstance(prev, self._InFlight):
+            prev.event.set()          # wake replay waiters
+
+    def _session_abort(self, entity: str, sid: str, seq: int) -> None:
+        """First apply raised: clear the marker so a resend can apply
+        afresh, and wake any replay waiting on it."""
+        with self._session_lock:
+            st = self._sessions.get((entity, sid))
+            ent = None if st is None else st["replies"].get(seq)
+            if isinstance(ent, self._InFlight):
+                del st["replies"][seq]
+        if isinstance(ent, self._InFlight):
+            ent.event.set()
+
     def _handle(self, entity: str, req: Dict[str, Any]) -> Any:
         cmd = req["cmd"]
         inj = faults.fire("daemon.hang_op", cmd=cmd)
@@ -1109,6 +1337,25 @@ class OSDDaemon:
             # process death mid-op: no reply, no cleanup — exactly the
             # thrasher's kill -9; durable state must carry the cluster
             os._exit(17)
+        if cmd == "session_hello":
+            return self._session_hello(entity, req)
+        sid, seq = req.get("session"), req.get("seq")
+        if sid is not None and seq is not None and \
+                cmd in self._REPLAY_CMDS:
+            cached = self._session_check(entity, str(sid), int(seq))
+            if cached is not self._MISS:
+                return cached          # replayed op: applied once
+            try:
+                reply = self._handle_tracked(entity, req)
+            except BaseException:
+                self._session_abort(entity, str(sid), int(seq))
+                raise
+            self._session_record(entity, str(sid), int(seq), reply)
+            return reply
+        return self._handle_tracked(entity, req)
+
+    def _handle_tracked(self, entity: str, req: Dict[str, Any]) -> Any:
+        cmd = req["cmd"]
         if cmd not in self._TRACKED_CMDS:
             return self._handle_inner(entity, req)
         tr = _op_tracker()
@@ -1493,11 +1740,15 @@ class OSDDaemon:
         if cmd == "ping":
             return {"osd": self.id, "alive": True}
         if cmd == "status":
+            with self._session_lock:
+                n_sessions = len(self._sessions)
             return {"osd": self.id,
                     "objects": sum(
                         len(self.store.list_objects(c))
                         for c in self.store.list_collections()),
-                    "injected_failures": self.server.injected}
+                    "injected_failures": self.server.injected,
+                    "sessions": n_sessions,
+                    "session_resets": self.session_resets}
         if cmd == "fsck":
             return [list(map(str, b)) for b in self.store.fsck()]
         raise ValueError(f"unknown osd command {cmd!r}")

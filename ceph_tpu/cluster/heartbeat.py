@@ -6,6 +6,16 @@ src/osd/OSD.cc:5327; peer selection maybe_update_heartbeat_peers
 `grace` consecutive ticks get reported to the mon, which marks them
 down after enough distinct reporters (Monitor.report_failure).
 
+Partition tolerance (ISSUE 6): pings consult the ``net.partition``
+faultpoint — a peer that is ALIVE but unreachable (netsplit) misses
+heartbeats exactly like a dead one, and a reporter cut off from the
+mon cannot deliver its report (the minority side of a split detects
+the majority as down but can never act on it).  The tick counter is
+installed as the Monitor's flap clock so markdown hysteresis runs on
+deterministic sim time, and the optional ``down_out_ticks`` grace
+drives the automatic down→out transition (mon_osd_down_out_interval
+role) that the ``noout`` cluster flag vetoes.
+
 Simulation-time driven (tick()), deterministic peer rings — the piece
 under test is the detection/report/mark-down pipeline, not wall-clock
 timers.
@@ -15,6 +25,7 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Set
 
+from ..common import faults
 from .monitor import Monitor
 
 
@@ -22,6 +33,7 @@ from .monitor import Monitor
 class HeartbeatConfig:
     n_peers: int = 3          # ring neighbors each OSD monitors
     grace_ticks: int = 3      # missed ticks before reporting
+    down_out_ticks: int = 0   # down->out grace (0 = no auto-out)
 
 
 class HeartbeatMonitor:
@@ -39,14 +51,28 @@ class HeartbeatMonitor:
         self.cfg = cfg if cfg is not None else HeartbeatConfig()
         self.missed: Dict[int, Dict[int, int]] = {}   # target -> {peer: n}
         self.marked_down: List[int] = []
+        self.ticks = 0
+        self._down_ticks: Dict[int, int] = {}   # map-down tick counts
+        self.auto_outs: List[int] = []
+        # deterministic time for the mon's flap-dampening windows: the
+        # heartbeat tick IS the sim's clock (never clobber a clock a
+        # test installed explicitly)
+        if mon.flap_clock is None:
+            mon.flap_clock = lambda: float(self.ticks)
 
     def peers_of(self, osd: int) -> List[int]:
         """Deterministic ring peers (the front/back messenger peer set)."""
         n = len(self.sim.osds)
         return [(osd + d) % n for d in range(1, self.cfg.n_peers + 1)]
 
+    def _reaches(self, src: int, dst_entity: str) -> bool:
+        """Can osd.src deliver a frame to dst right now?  A severed
+        link counts a net.partition fire (the proof the cut carried)."""
+        return not faults.partitioned(f"osd.{src}", dst_entity)
+
     def tick(self) -> List[int]:
         """One heartbeat round; returns OSDs newly marked down."""
+        self.ticks += 1
         newly_down: List[int] = []
         om = self.sim.osdmap
         for osd in range(len(self.sim.osds)):
@@ -55,15 +81,45 @@ class HeartbeatMonitor:
             for peer in self.peers_of(osd):
                 if not om.is_up(peer):
                     continue                  # already marked down
-                if self.sim.osds[peer].alive:
+                if self.sim.osds[peer].alive and \
+                        self._reaches(osd, f"osd.{peer}") and \
+                        self._reaches(peer, f"osd.{osd}"):
+                    # a ping is a ROUND TRIP: the request must reach
+                    # the peer AND the reply must come back, so a
+                    # one-way cut in EITHER direction reads as a miss
+                    # (the mute-minority half-open link included)
                     self.missed.get(peer, {}).pop(osd, None)
                     continue
+                # dead OR alive-but-partitioned: a netsplit looks
+                # exactly like death to the ping path
                 cnt = self.missed.setdefault(peer, {})
                 cnt[osd] = cnt.get(osd, 0) + 1
                 if cnt[osd] >= self.cfg.grace_ticks:
+                    if not self._reaches(osd, "mon"):
+                        continue   # cut off from the mon: the report
+                        # never lands (minority-side reporters)
                     if self.mon.report_failure(peer, reporter=osd):
                         newly_down.append(peer)
                         self.missed.pop(peer, None)
                         break
         self.marked_down.extend(newly_down)
+        if self.cfg.down_out_ticks:
+            self._tick_down_out()
         return newly_down
+
+    def _tick_down_out(self) -> None:
+        """Automatic down->out after the grace (the reference mon's
+        mon_osd_down_out_interval); ``noout`` vetoes inside the mon."""
+        om = self.sim.osdmap
+        for osd in range(len(self.sim.osds)):
+            if om.is_up(osd):
+                self._down_ticks.pop(osd, None)
+                continue
+            if om.osd_weight[osd] == 0:
+                continue                      # already out
+            n = self._down_ticks.get(osd, 0) + 1
+            self._down_ticks[osd] = n
+            if n >= self.cfg.down_out_ticks:
+                if self.mon.auto_out_down(osd):
+                    self.auto_outs.append(osd)
+                    self._down_ticks.pop(osd, None)

@@ -31,6 +31,15 @@ threaded stress in test_mon_quorum_stress.py):
   * a restarted or lagging node catches up from the leader's log
     (fetch), applying entries in order.
 
+Partition tolerance (ISSUE 6): the leader extends a READ LEASE on a
+majority each round (Paxos::extend_lease / lease_expire roles); a rank
+whose lease lapsed — a minority-side mon after a netsplit — answers
+``readable() == False`` and the daemon stalls map reads instead of
+serving a stale map as fresh, while the majority side elects, keeps
+committing, and re-grants leases.  The healed minority catches up
+through the normal fetch path, so every rank's committed log stays a
+prefix of the quorum's (no split-brain double-commit).
+
 Simplifications vs the reference, on purpose: one in-flight slot (no
 pipelining, Paxos.h pipelines too but one-at-a-time is its documented
 base case), and election preference by rank emerges from staggered
@@ -39,6 +48,7 @@ timeouts rather than a deferral subprotocol.
 from __future__ import annotations
 
 import json
+import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from ..common.lockdep import LockdepLock
@@ -58,12 +68,28 @@ class QuorumNode:
     """One mon rank's consensus state machine."""
 
     def __init__(self, rank: int, n_ranks: int, db, apply_fn: ApplyFn,
-                 send_fn: SendFn):
+                 send_fn: SendFn, lease_duration: float = 2.0,
+                 now_fn: Callable[[], float] = time.monotonic):
         self.rank = rank
         self.n_ranks = n_ranks
         self.db = db
         self.apply_fn = apply_fn
         self.send_fn = send_fn
+        # read lease (Paxos::extend_lease / lease_expire): the leader
+        # extends it on a MAJORITY each round; a rank whose lease
+        # lapsed must treat its committed state as possibly stale —
+        # map reads stall instead of serving a minority-side view.
+        # ``now_fn`` is injectable so unit tests drive a fake clock.
+        self.lease_duration = float(lease_duration)
+        self._now = now_fn
+        # lease state: 0.0 = never granted (bootstrap: nothing newer
+        # exists to be stale against), -1.0 = EXPIRED.  Whether a
+        # lease was ever granted is PERSISTED — a restarted rank that
+        # held leases before must come back NOT readable, or crashing
+        # a minority-side mon would silently defeat the stale-read
+        # stall for the rest of the partition
+        self._lease_ever = db.get("quorum", "leased") is not None
+        self._lease_until = -1.0 if self._lease_ever else 0.0
         self._lock = LockdepLock("mon.quorum")
         # ordered-apply machinery: commits may be delivered on
         # concurrent wire-handler threads; the log itself grows in
@@ -119,6 +145,88 @@ class QuorumNode:
 
     def quorum(self) -> int:
         return self.n_ranks // 2 + 1
+
+    # ------------------------------------------------------------- lease --
+    def readable(self) -> bool:
+        """May this rank serve committed state as CURRENT?  True until
+        the first lease is granted (bootstrap: there is nothing newer
+        to be stale against), then only while the lease holds — across
+        restarts (the granted-once fact is persisted).  A minority-
+        side rank's lease lapses within ``lease_duration`` of the cut
+        and its reads stall until the quorum heals."""
+        if self._lease_until == 0.0:
+            return True
+        return self._now() < self._lease_until
+
+    def lease_remaining(self) -> float:
+        return max(0.0, self._lease_until - self._now())
+
+    def _grant_lease(self, until: float) -> None:
+        if not self._lease_ever:
+            self._lease_ever = True
+            self._put("leased", b"1")
+        self._lease_until = until
+
+    def extend_lease(self) -> bool:
+        """Leader-only: grant the read lease to a majority (the
+        Paxos::extend_lease round).  The leader's OWN lease extends
+        iff a majority acked — a deposed/minority leader fails here
+        and stalls its reads too.  Returns success."""
+        with self._lock:
+            if self.leader != self.rank:
+                return False
+            e = self.election_epoch
+        until = self._now() + self.lease_duration
+        acks = 1
+        for r in range(self.n_ranks):
+            if r == self.rank:
+                continue
+            try:
+                rep = self.send_fn(r, {"q": "lease", "epoch": e,
+                                       "leader": self.rank,
+                                       "duration":
+                                           self.lease_duration,
+                                       "committed": self.committed})
+            except Exception:
+                continue
+            if rep.get("ok"):
+                acks += 1
+        if acks < self.quorum():
+            return False
+        self._grant_lease(until)
+        return True
+
+    def _on_lease(self, msg: Dict[str, Any]) -> Dict[str, Any]:
+        e = int(msg["epoch"])
+        with self._lock:
+            if e < self.election_epoch:
+                # a stale (deposed, minority-side) leader's lease must
+                # not let this rank serve reads on its behalf
+                return {"ok": False, "epoch": self.election_epoch}
+            if e > self.election_epoch:
+                self.election_epoch = e
+                self._put("election_epoch", str(e).encode())
+            self.leader = int(msg["leader"])
+            leader = self.leader
+            behind = int(msg.get("committed", 0)) > self.committed
+        if behind:
+            # a lease that ADOPTS the leader also suppresses this
+            # rank's election trigger — so it must carry the catch-up
+            # duty victory messages have, or a revived laggard would
+            # idle forever behind the quorum (outside the lock, like
+            # _on_victory: the fetch takes peer round-trips)
+            try:
+                self._catch_up_from(leader, int(msg["committed"]))
+            except Exception:
+                # STILL behind: refuse the lease — accepting it would
+                # stamp this rank's stale state as fresh, the exact
+                # read the lease machinery exists to stall.  The
+                # leader's next round retries the grant (and this
+                # rank's fetch).
+                return {"ok": False, "epoch": self.election_epoch,
+                        "behind": True}
+        self._grant_lease(self._now() + float(msg["duration"]))
+        return {"ok": True}
 
     # ---------------------------------------------------------- election --
     def start_election(self) -> bool:
@@ -390,6 +498,8 @@ class QuorumNode:
         if q == "commit":
             self._on_commit(msg)
             return {"ok": True}
+        if q == "lease":
+            return self._on_lease(msg)
         if q == "fetch":
             after = int(msg["after"])
             entries = []

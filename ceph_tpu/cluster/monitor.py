@@ -143,6 +143,23 @@ class Monitor:
         # so SLOW_OPS covers the whole cluster, not just this
         # process's tracker): daemon entity -> last nonzero summary
         self._daemon_slow: Dict[str, Dict[str, Any]] = {}
+        # ------ flap dampening (the osd_markdown_log role) ------
+        # an OSD marked down >= _flap_count times inside _flap_window
+        # gets its next boot HELD for a doubling backoff (capped), so
+        # a flapping link cannot churn the map/peering every tick.
+        # Disabled by default (_flap_count = 0): the process tier opts
+        # in via the cluster spec, sims via configure_flap_dampening.
+        # Time source: wall clock unless a tick clock is installed
+        # (HeartbeatMonitor installs its tick counter — seeded soaks
+        # must not depend on wall time).
+        self._flap_count = 0
+        self._flap_window = 60.0
+        self._flap_hold = 5.0
+        self._flap_hold_cap = 30.0
+        self.flap_clock: Optional[Callable[[], float]] = None
+        self._markdown_log: Dict[int, List[float]] = {}
+        self._boot_hold_until: Dict[int, float] = {}
+        self.boots_held = 0           # hysteresis-refused boots
 
     def set_proposer(self,
                      fn: Optional[Callable[[Tuple], bool]]) -> None:
@@ -173,6 +190,7 @@ class Monitor:
             "old_pools": list(inc.old_pools),
             "new_pool_tier": {str(k): v for k, v in
                               inc.new_pool_tier.items()},
+            "new_flags": dict(inc.new_flags),
         }).encode()
 
     @staticmethod
@@ -201,6 +219,8 @@ class Monitor:
             old_pools=[int(p) for p in d.get("old_pools", [])],
             new_pool_tier={int(k): v for k, v in
                            d.get("new_pool_tier", {}).items()},
+            new_flags={str(k): bool(v) for k, v in
+                       d.get("new_flags", {}).items()},
         )
 
     @classmethod
@@ -322,28 +342,111 @@ class Monitor:
     def config_get(self, key: str) -> Any:
         return self.config_db.get(key)
 
+    # ------------------------------------------------------------- flags --
+    def set_flag(self, flag: str, on: bool = True) -> bool:
+        """Set/clear a cluster-wide osdmap flag (noout/nodown) through
+        a committed incremental — `ceph osd set noout` (OSDMonitor
+        prepare_command CEPH_OSDMAP_* role)."""
+        from .osdmap import CLUSTER_FLAGS
+        if flag not in CLUSTER_FLAGS:
+            raise ValueError(f"unknown osdmap flag {flag!r} "
+                             f"(known: {CLUSTER_FLAGS})")
+        if (flag in self.osdmap.flags) == on:
+            return True              # idempotent: already there
+        inc = self.next_incremental()
+        inc.new_flags[flag] = on
+        return self.commit_incremental(inc)
+
+    # ----------------------------------------------------- flap damping --
+    def configure_flap_dampening(self, count: int, window: float,
+                                 hold: float,
+                                 hold_cap: float) -> None:
+        """Arm markdown hysteresis: ``count`` markdowns inside
+        ``window`` hold the next boot for ``hold`` (doubling per extra
+        markdown, capped at ``hold_cap``).  count=0 disables."""
+        self._flap_count = int(count)
+        self._flap_window = float(window)
+        self._flap_hold = float(hold)
+        self._flap_hold_cap = float(hold_cap)
+
+    def _flap_now(self) -> float:
+        import time as _time
+        return self.flap_clock() if self.flap_clock is not None \
+            else _time.monotonic()
+
+    def _record_markdown(self, osd: int) -> None:
+        if not self._flap_count:
+            return
+        now = self._flap_now()
+        log = [t for t in self._markdown_log.get(osd, [])
+               if now - t <= self._flap_window]
+        log.append(now)
+        self._markdown_log[osd] = log
+        extra = len(log) - self._flap_count
+        if extra >= 0:
+            hold = min(self._flap_hold_cap,
+                       self._flap_hold * (2.0 ** extra))
+            self._boot_hold_until[osd] = now + hold
+
+    def flap_status(self, osd: int) -> Dict[str, Any]:
+        now = self._flap_now()
+        return {
+            "markdowns_in_window": len(
+                [t for t in self._markdown_log.get(osd, [])
+                 if now - t <= self._flap_window]),
+            "held_for": max(0.0, self._boot_hold_until.get(osd, 0.0)
+                            - now),
+        }
+
     # ---------------------------------------------------- failure reports --
     def report_failure(self, target: int, reporter: int) -> bool:
         """OSD peers report a dead peer; at the threshold the mon
         commits an epoch marking it down (OSDMonitor::prepare_failure).
-        Returns True when the target was marked down."""
+        Returns True when the target was marked down.  The ``nodown``
+        cluster flag vetoes the markdown (reports still accumulate, so
+        clearing the flag acts on the evidence immediately) — the
+        operator's ride-out-a-known-partition knob."""
         if not self.osdmap.is_up(target):
             return False
         reps = self._failure_reports.setdefault(target, set())
         reps.add(reporter)
         if len(reps) < self.failure_reports_needed:
             return False
+        if "nodown" in self.osdmap.flags:
+            return False
         inc = self.next_incremental()
         inc.new_up[target] = False
         if self.commit_incremental(inc):
             del self._failure_reports[target]
+            self._record_markdown(target)
             return True
         return False
+
+    def auto_out_down(self, osd: int) -> bool:
+        """Down->out transition after the grace (the
+        mon_osd_down_out_interval role, driven by the heartbeat
+        monitor's tick): vetoed by the ``noout`` flag."""
+        if "noout" in self.osdmap.flags:
+            return False
+        if self.osdmap.is_up(osd) or self.osdmap.osd_weight[osd] == 0:
+            return False
+        inc = self.next_incremental()
+        inc.new_weight[osd] = 0
+        return self.commit_incremental(inc)
 
     def osd_boot(self, osd: int, weight: int = 0x10000) -> bool:
         """An OSD announces itself up (the MOSDBoot path,
         OSDMonitor::prepare_boot): commits a map epoch marking it up
-        and restoring its in-weight, so subscribed clients catch up."""
+        and restoring its in-weight, so subscribed clients catch up.
+        A flapping OSD (markdown hysteresis engaged) is HELD down for
+        its backoff: the boot returns False and the announcer retries
+        — the reference's osd_markdown_log suicide/backoff shape."""
+        hold = self._boot_hold_until.get(osd)
+        if hold is not None:
+            if self._flap_now() < hold:
+                self.boots_held += 1
+                return False
+            del self._boot_hold_until[osd]
         inc = self.next_incremental()
         inc.new_up[osd] = True
         inc.new_weight[osd] = weight

@@ -14,9 +14,11 @@ daemon and rejected, triggering resend).
 from __future__ import annotations
 
 import copy
+import itertools
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Tuple
 
+from ..common import faults
 from ..common.backoff import ExpBackoff, TickClock
 from ..common.op_tracker import tracker as _op_tracker
 from ..common.perf_counters import perf as _perf
@@ -29,6 +31,16 @@ from .simulator import ClusterSim
 
 class TooManyRetries(IOError):
     pass
+
+
+faults.declare(
+    "msg.drop_ack",
+    "drop the COMPLETION of a client op after the cluster durably "
+    "applied it (the lost-reply half of a cut: op committed, ack "
+    "never arrived) — the client must resend and the (session, seq) "
+    "dup detection must apply it at most once")
+
+_SESSION_IDS = itertools.count(1)
 
 
 class Objecter:
@@ -49,10 +61,24 @@ class Objecter:
         self._backoff = ExpBackoff(base=0.05, cap=2.0, seed=seed,
                                    sleep=self.clock.sleep)
         self._pc = _perf("objecter")
+        # messenger session: one id per objecter lifetime, a fresh seq
+        # per MUTATING logical op.  Retries/replays of one op reuse
+        # its (session, seq), so the sim's dup detection applies it at
+        # most once even when the first apply's ack was lost
+        self.session = f"objecter.{next(_SESSION_IDS)}.{seed}"
+        self._op_seq = 0
+        self.replay_dups = 0      # resends suppressed by dup-detect
+        self.acks_dropped = 0     # injected completion losses
 
     # ------------------------------------------------------------- maps --
     def maybe_update_map(self) -> int:
-        """Consume the mon's incremental stream (subscription model)."""
+        """Consume the mon's incremental stream (subscription model).
+        A client partitioned from the mon sees NO new epochs — its
+        map simply stops advancing, the stale-target resend loop keeps
+        spinning against old state until the cut heals (the
+        subscription half of a netsplit)."""
+        if faults.partitioned("client", "mon"):
+            return 0
         incs = self.mon.get_incrementals(self.osdmap.epoch)
         for inc in incs:
             self.osdmap.apply_incremental(inc)
@@ -80,9 +106,16 @@ class Objecter:
         primary = next((o for o in client_up if o != ITEM_NONE), None)
         return primary is not None and self.sim.osds[primary].alive
 
+    def _next_reqid(self) -> Tuple[str, int]:
+        """One (session, seq) per mutating LOGICAL op — resends reuse
+        it (the osd_op_reqid_t the reference dedups on in the pg log)."""
+        self._op_seq += 1
+        return (self.session, self._op_seq)
+
     # -------------------------------------------------------------- ops --
     def _submit(self, op, pool_id: int, name: str, optype: str = "op",
-                names: Optional[List[str]] = None):
+                names: Optional[List[str]] = None,
+                reqid: Optional[Tuple[str, int]] = None):
         """op_submit: compute target, send; on stale target refresh the
         map and resend (bounded).  Traced (the jspan threaded through
         ops, src/osd/PrimaryLogPG.cc:11060 role) and TRACKED: the op
@@ -90,7 +123,12 @@ class Objecter:
         path call so the OSD service / device layers tag it.
         ``names`` widens the target-currency check to a whole batch
         (put_many): ANY stale member resends the batch — the rewrite
-        is idempotent (stale copies are superseded)."""
+        is idempotent (stale copies are superseded).
+        ``reqid`` (mutating ops) is the replay contract: every resend
+        of this logical op carries the same id; an op the cluster
+        already durably committed is NOT re-applied — the recorded
+        completion is returned instead (at-most-once apply, even when
+        the first apply's ack was dropped on a cut)."""
         self._pc.inc("op_submit")
         check = names if names else [name]
         tr = _op_tracker()
@@ -102,13 +140,42 @@ class Objecter:
                                       obj=name) as span:
                 for attempt in range(self.max_retries):
                     transient = False
+                    if reqid is not None:
+                        hit = self.sim.reqid_cached(reqid)
+                        if hit is not None:
+                            # this resend is a REPLAY of a committed
+                            # op: dup-suppressed, completion recalled
+                            self.replay_dups += 1
+                            self._pc.inc("replay_dups")
+                            top.mark_event("replay_dup",
+                                           attempt=attempt)
+                            span.set_tag("replayed", True)
+                            return hit[0]
                     if all(self._target_current(pool_id, nm)
                            for nm in check):
                         try:
                             with tr.track(top):
                                 result = op()
-                            span.set_tag("attempts", attempt + 1)
-                            return result
+                            if reqid is not None:
+                                self.sim.reqid_commit(reqid, result)
+                                if faults.fire("msg.drop_ack",
+                                               optype=optype
+                                               ) is not None:
+                                    # committed, ack lost: the caller
+                                    # never hears — resend and let the
+                                    # dup detection prove idempotency
+                                    self.acks_dropped += 1
+                                    self._pc.inc("acks_dropped")
+                                    top.mark_event("ack_dropped",
+                                                   attempt=attempt)
+                                    transient = True
+                                else:
+                                    span.set_tag("attempts",
+                                                 attempt + 1)
+                                    return result
+                            else:
+                                span.set_tag("attempts", attempt + 1)
+                                return result
                         except IOError:
                             # transient failure at a CURRENT target
                             # (EIO, injected drop): worth retrying on
@@ -172,7 +239,7 @@ class Objecter:
         return self._submit(
             lambda: self._durable(pool_id,
                                   self.sim.put(pool_id, name, data)),
-            pool_id, name, optype="put")
+            pool_id, name, optype="put", reqid=self._next_reqid())
 
     def put_many(self, pool_id: int, names: List[str],
                  datas: List[bytes]) -> Dict[str, List[int]]:
@@ -192,7 +259,8 @@ class Objecter:
             return placed
 
         return self._submit(op, pool_id, names[0], optype="put_many",
-                            names=list(names))
+                            names=list(names),
+                            reqid=self._next_reqid())
 
     def get(self, pool_id: int, name: str) -> bytes:
         return self._submit(
@@ -205,4 +273,4 @@ class Objecter:
             lambda: self._durable(pool_id,
                                   self.sim.write(pool_id, name,
                                                  offset, data)),
-            pool_id, name, optype="write")
+            pool_id, name, optype="write", reqid=self._next_reqid())

@@ -150,6 +150,16 @@ class OSDService:
             # seeded thrash runs), before any state is registered
             raise IOError(f"osd.{self.osd.id}: op dropped "
                           f"(fault injected)")
+        src = op.get("src", "client")
+        if faults.partitioned(src, f"osd.{self.osd.id}"):
+            # in-process netsplit: the op never reaches this OSD's
+            # queue.  Sim-tier traffic all originates at the client/
+            # primary entity "client" (recovery pushes included — the
+            # sim's orchestrator IS the primary), so a partition that
+            # cuts "client" from a group of OSDs severs their whole
+            # data path while the daemons stay alive
+            raise IOError(f"osd.{self.osd.id}: unreachable from "
+                          f"{src} (netsplit)")
         op_id = next(self._ids)
         ev = threading.Event()
         with self._lock:

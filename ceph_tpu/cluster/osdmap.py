@@ -37,6 +37,13 @@ POOL_ERASURE = 3
 FLAG_HASHPSPOOL = 1 << 0
 FLAG_EC_OVERWRITES = 1 << 17   # reference: src/osd/osd_types.h:1244
 
+# cluster-wide osdmap flags an operator sets to ride out known events
+# (reference: CEPH_OSDMAP_NOOUT / CEPH_OSDMAP_NODOWN,
+# src/osd/OSDMap.h get_flags; `ceph osd set noout`): "noout" stops the
+# automatic down->out transition, "nodown" stops failure reports from
+# marking OSDs down — both honored by the heartbeat/markdown path
+CLUSTER_FLAGS = ("noout", "nodown")
+
 MAX_PRIMARY_AFFINITY = 0x10000
 WEIGHT_IN = 0x10000
 
@@ -162,6 +169,9 @@ class Incremental:
     # cache-tier wiring: pool id -> {tier_of|read_tier|write_tier|
     # cache_mode} field updates (OSDMonitor 'osd tier add' role)
     new_pool_tier: Dict[int, dict] = field(default_factory=dict)
+    # cluster flag changes: name -> set (True) / clear (False)
+    # (OSDMap::Incremental new_flags role)
+    new_flags: Dict[str, bool] = field(default_factory=dict)
 
 
 class OSDMap:
@@ -178,6 +188,8 @@ class OSDMap:
         self.osd_primary_affinity = np.full(n, MAX_PRIMARY_AFFINITY,
                                             dtype=np.int64)
         self.pools: Dict[int, PGPool] = {}
+        # cluster-wide flags (noout/nodown — CLUSTER_FLAGS)
+        self.flags: set = set()
         # monotonic pool-id high-water mark (the reference's
         # new_pool_max): a deleted pool's id is NEVER reused, or the
         # next pool would inherit its surviving objects/snap state
@@ -232,6 +244,11 @@ class OSDMap:
                     setattr(pool, fk, int(fields[fk]))
             if "cache_mode" in fields:
                 pool.cache_mode = str(fields["cache_mode"])
+        for flag, on in inc.new_flags.items():
+            if on:
+                self.flags.add(flag)
+            else:
+                self.flags.discard(flag)
         for pid in inc.old_pools:
             self.pools.pop(pid, None)
             # stale placement overrides keyed by the dead pool go too

@@ -368,6 +368,13 @@ class ClusterSim:
         # "staged" defers durability to flush_all() (deferred-write/WAL
         # shape — a crash before flush loses the staged writes)
         self.staging_flush = "eager"
+        # (session, seq) -> [commit_count, recorded completion]: the
+        # cluster-side half of the objecter's replay contract (the
+        # pg-log reqid dup table role).  commit_count is the replay-
+        # idempotency ORACLE: under a correct dedup it can never pass
+        # 1 — the netsplit thrasher asserts exactly that.
+        self._reqids: Dict[Tuple[str, int], List] = {}
+        self.reqid_double_commits = 0
 
     @staticmethod
     def _stop_services(services) -> None:
@@ -388,6 +395,30 @@ class ClusterSim:
     def shutdown(self) -> None:
         """Stop dispatcher threads and close queues (idempotent)."""
         self._finalizer()
+
+    # ------------------------------------------------- replay dedup --
+    def reqid_cached(self, reqid: Tuple[str, int]):
+        """[completion] when this op already committed durably (the
+        replay must NOT re-apply), else None.  Returned boxed so a
+        None completion stays distinguishable from a miss."""
+        ent = self._reqids.get(tuple(reqid))
+        return None if ent is None else [ent[1]]
+
+    def reqid_commit(self, reqid: Tuple[str, int], result) -> None:
+        """Record a durable commit of one logical op.  A second commit
+        for the same reqid is the exact bug the session-replay
+        machinery exists to prevent — counted, and asserted zero by
+        the netsplit invariant set."""
+        ent = self._reqids.get(tuple(reqid))
+        if ent is None:
+            self._reqids[tuple(reqid)] = [1, result]
+            return
+        ent[0] += 1
+        self.reqid_double_commits += 1
+
+    def reqid_stats(self) -> Dict[str, int]:
+        return {"tracked": len(self._reqids),
+                "double_commits": self.reqid_double_commits}
 
     def _log(self, pool_id: int, pg: int) -> PGLog:
         log = self.pg_logs.get((pool_id, pg))

@@ -27,6 +27,19 @@ peering → log-delta-recovery pipeline must notice and repair, exactly
 the pipeline the reference exercises.  Time is simulation ticks —
 heartbeat ticks and the objecter's TickClock — so a full soak takes no
 wall-clock sleeps.
+
+Netsplit mode (ISSUE 6, ``ceph thrash --netsplit``): instead of
+killing processes, seeded cut/heal cycles sever a minority of OSDs
+from the rest of the cluster via the ``net.partition`` faultpoint —
+sometimes one-way (half-open links), sometimes ridden out under the
+operator's ``noout``/``nodown`` flags — while ``msg.drop_ack`` loses
+committed ops' completions so the session-replay dedup is exercised.
+Two invariants join the set: **no op applies twice** (the replay
+idempotency oracle, ``ClusterSim.reqid_stats``) and **mon epoch
+history is linear** (gapless, forkless — no split brain).  Flap
+dampening (markdown hysteresis) runs on the heartbeat tick clock, so
+repeated cut/heal flapping holds the flapper down and the settle loop
+must out-wait the hold, exactly as a real cluster would.
 """
 from __future__ import annotations
 
@@ -49,6 +62,16 @@ DEFAULT_FAULTPOINTS: Tuple[Tuple[str, str, int], ...] = (
     ("device.eio", "one_in", 8),
 )
 
+# the netsplit scenario's default mix: ack loss rides along so the
+# session-replay dedup is exercised (committed op, dropped completion,
+# resend suppressed) — net.partition itself is armed per cut with its
+# seeded GROUPS, not from this table
+NETSPLIT_FAULTPOINTS: Tuple[Tuple[str, str, int], ...] = (
+    ("msg.drop_op", "one_in", 10),
+    ("device.eio", "one_in", 10),
+    ("msg.drop_ack", "one_in", 4),
+)
+
 
 @dataclass
 class ThrashConfig:
@@ -67,6 +90,19 @@ class ThrashConfig:
     settle_ticks: int = 25            # health-convergence bound (I4)
     grace_ticks: int = 1              # heartbeat grace before report
     faultpoints: Sequence[Tuple[str, str, int]] = DEFAULT_FAULTPOINTS
+    # ---- netsplit scenario (`ceph thrash --netsplit`) ----
+    netsplit: bool = False            # cut/heal instead of kill/revive
+    partition_prob: float = 0.7       # chance a cycle cuts (when whole)
+    heal_prob: float = 0.6            # chance a cycle heals (when cut)
+    oneway_prob: float = 0.25         # asymmetric (half-open) cuts
+    flags_prob: float = 0.2           # ride a cut out under noout+nodown
+    max_minority: int = 2             # minority size; <= EC m and
+    # < replicated size so the majority side always stays writable
+    # markdown hysteresis (Monitor flap dampening), in heartbeat ticks:
+    flap_count: int = 3               # markdowns in window -> hold
+    flap_window: float = 200.0
+    flap_hold: float = 2.0
+    flap_hold_cap: float = 12.0
 
 
 class Thrasher:
@@ -81,12 +117,23 @@ class Thrasher:
         self.rng = random.Random(self.cfg.seed)
         self.hb = HeartbeatMonitor(
             sim, mon, HeartbeatConfig(grace_ticks=self.cfg.grace_ticks))
+        if self.cfg.netsplit:
+            # markdown hysteresis on the heartbeat TICK clock (the
+            # HeartbeatMonitor installed itself as mon.flap_clock):
+            # repeated cut/heal flapping holds the flapper down
+            mon.configure_flap_dampening(
+                count=self.cfg.flap_count,
+                window=self.cfg.flap_window,
+                hold=self.cfg.flap_hold,
+                hold_cap=self.cfg.flap_hold_cap)
         self.client = Objecter(sim, mon, max_retries=16,
                                seed=self.cfg.seed)
         self.schedule: List[Tuple] = []   # the reproducibility record
         self.oracle: Dict[Tuple[int, str], bytes] = {}
         self.down: List[int] = []         # currently-killed OSDs
         self.out: List[int] = []          # currently-marked-out OSDs
+        self.partition: Optional[Dict[str, Any]] = None  # active cut
+        self.flags_set: List[str] = []    # cluster flags we set
         self.failures: List[str] = []     # broken invariants, as found
 
     # ------------------------------------------------------------ pieces --
@@ -175,6 +222,75 @@ class Thrasher:
             if newly:
                 self._log("marked_down", tuple(sorted(newly)))
 
+    # ------------------------------------------------------- netsplit --
+    def _cut(self) -> None:
+        """Sever a seeded minority of OSDs from the rest of the
+        cluster (client and mon ride the majority side — the sim has
+        ONE mon; quorum-side splits are the wire/mon_quorum tier's
+        scenario).  Sometimes asymmetric, sometimes ridden out under
+        the operator flags."""
+        cfg = self.cfg
+        candidates = [o.id for o in self.sim.osds if o.alive]
+        size = 1 + self.rng.randrange(cfg.max_minority)
+        if len(candidates) <= size:
+            return
+        minority = sorted(self.rng.sample(candidates, size))
+        min_ent = [f"osd.{o}" for o in minority]
+        maj_ent = ["client", "mon"] + [
+            f"osd.{o.id}" for o in self.sim.osds
+            if o.id not in minority]
+        oneway = self.rng.random() < cfg.oneway_prob
+        # oneway cuts groups[0] -> others; orientation decides which
+        # half-open shape we get (majority can't reach the minority,
+        # or the minority is mute toward the majority)
+        min_first = self.rng.random() < 0.5
+        groups = [min_ent, maj_ent] if min_first else [maj_ent,
+                                                       min_ent]
+        if self.rng.random() < cfg.flags_prob:
+            # operator rides the known partition out: no markdowns,
+            # no auto-outs while the flags hold
+            for flag in ("noout", "nodown"):
+                if self.mon.set_flag(flag, True):
+                    self.flags_set.append(flag)
+            self._log("flags_set", tuple(self.flags_set))
+        faults.arm("net.partition", groups=groups, oneway=oneway)
+        self.partition = {"minority": minority, "oneway": oneway,
+                          "min_first": min_first}
+        self._log("cut", tuple(minority), oneway, min_first)
+
+    def _heal(self) -> None:
+        """Disarm the cut, clear ride-out flags, and re-announce every
+        partition victim the map marked down (flap dampening may HOLD
+        a flapper — the settle loop keeps re-announcing, exactly like
+        the daemon's heartbeat re-boot)."""
+        if self.partition is None:
+            return
+        faults.disarm("net.partition")
+        for flag in self.flags_set:
+            self.mon.set_flag(flag, False)
+        if self.flags_set:
+            self._log("flags_cleared", tuple(self.flags_set))
+        self.flags_set = []
+        self._log("heal", tuple(self.partition["minority"]))
+        self.partition = None
+        self._boot_survivors()
+
+    def _boot_survivors(self) -> int:
+        """Re-announce alive-but-marked-down OSDs (the OSD's own
+        MOSDBoot re-send when it sees itself down in a newer map).
+        Returns how many announcements the mon REFUSED (held by flap
+        dampening or quorum-less)."""
+        held = 0
+        om = self.sim.osdmap
+        for o in self.sim.osds:
+            if not o.alive or om.is_up(o.id) or o.id in self.down:
+                continue
+            if self.mon.osd_boot(o.id):
+                self._log("boot", o.id)
+            else:
+                held += 1
+        return held
+
     def _recover(self) -> None:
         for pool_id in self.pool_ids:
             st = self.sim.recover_delta(pool_id)
@@ -188,9 +304,13 @@ class Thrasher:
         # cumulative tally survives disarm (by design — proof outlives
         # the schedule), so back-to-back runs must not double-count
         fires0 = faults.fire_counts()
+        reqid0 = self.sim.reqid_stats()
         for i, (name, mode, n) in enumerate(cfg.faultpoints):
             faults.arm(name, mode=mode, n=n, seed=cfg.seed * 1000 + i)
             self._log("arm", name, mode, n)
+        proven = [name for name, _, _ in cfg.faultpoints]
+        if cfg.netsplit:
+            proven.append("net.partition")
         failures = self.failures
         try:
             # steady-state oracle before the first fault
@@ -199,24 +319,39 @@ class Thrasher:
                     self._write(pool_id, f"thrash-{j}")
             for cycle in range(cfg.cycles):
                 self._log("cycle", cycle)
-                self._kill_one()
-                self._tick_detection()
-                self._load()
-                self._recover()
-                if self.rng.random() < cfg.revive_prob:
-                    self._revive_one()
+                if cfg.netsplit:
+                    if self.partition is None and \
+                            self.rng.random() < cfg.partition_prob:
+                        self._cut()
                     self._tick_detection()
+                    self._load()
                     self._recover()
+                    if self.partition is not None and \
+                            self.rng.random() < cfg.heal_prob:
+                        self._heal()
+                        self._tick_detection()
+                        self._recover()
+                else:
+                    self._kill_one()
+                    self._tick_detection()
+                    self._load()
+                    self._recover()
+                    if self.rng.random() < cfg.revive_prob:
+                        self._revive_one()
+                        self._tick_detection()
+                        self._recover()
             # settle: stop injecting, bring everyone back, repair
             # until health converges (the reference's thrasher also
             # stops thrashing before its final wait_for_clean)
             fire_counts = {
                 name: faults.fire_counts().get(name, 0) -
                 fires0.get(name, 0)
-                for name, _, _ in cfg.faultpoints}
+                for name in proven}
             for name, _, _ in cfg.faultpoints:
                 faults.disarm(name)
             self._log("settle")
+            if cfg.netsplit:
+                self._heal()       # also disarms net.partition
             # _revive_one un-marks out AND restores in-weight
             # (osd_boot commits weight 0x10000), so draining `down`
             # also drains `out` — out is only ever a subset of down
@@ -226,6 +361,11 @@ class Thrasher:
             health = ""
             health_ticks = cfg.settle_ticks
             for tick in range(cfg.settle_ticks):
+                if cfg.netsplit:
+                    # flap-held victims keep re-announcing each tick
+                    # (the daemon heartbeat's MOSDBoot re-send); the
+                    # hold expires on this same tick clock
+                    self._boot_survivors()
                 self._recover()
                 self.hb.tick()
                 health = self.mon.health_status(self.sim)
@@ -266,14 +406,45 @@ class Thrasher:
                     f"deep scrub: {scrub_bad} inconsistencies "
                     f"after repair")
             # I5: the injections really happened
-            for name, _, _ in cfg.faultpoints:
+            for name in proven:
                 if fire_counts.get(name, 0) < 1:
                     failures.append(
                         f"faultpoint {name} armed but never fired — "
                         f"the soak exercised nothing")
+            # I6 (netsplit): replay idempotency — no logical op was
+            # durably applied twice, however many times the cut/ack
+            # loss forced the client to resend it
+            reqid = self.sim.reqid_stats()
+            double_commits = reqid["double_commits"] - \
+                reqid0["double_commits"]
+            replay_dups = self.client.replay_dups
+            if double_commits:
+                failures.append(
+                    f"replay idempotency broken: {double_commits} "
+                    f"ops applied more than once")
+            if cfg.netsplit and \
+                    fire_counts.get("msg.drop_ack", 0) >= 1 and \
+                    replay_dups < 1:
+                failures.append(
+                    "acks were dropped but no resend was ever "
+                    "dup-suppressed — the replay path never ran")
+            # I7 (netsplit): mon epoch history is LINEAR — committed
+            # incrementals form one gapless, forkless chain ending at
+            # the live map (a split brain would fork or repeat epochs)
+            epochs = [i.epoch for i in self.mon.incrementals]
+            linear = epochs == sorted(set(epochs)) and \
+                (not epochs or
+                 (epochs == list(range(epochs[0], epochs[-1] + 1)) and
+                  epochs[-1] == self.sim.osdmap.epoch))
+            if cfg.netsplit and not linear:
+                failures.append(
+                    f"mon epoch history not linear: "
+                    f"{epochs[:5]}..{epochs[-5:]} vs map epoch "
+                    f"{self.sim.osdmap.epoch}")
             return {
                 "seed": cfg.seed,
                 "cycles": cfg.cycles,
+                "netsplit": cfg.netsplit,
                 "schedule": [list(e) for e in self.schedule],
                 "fire_counts": fire_counts,
                 "invariants": {
@@ -284,6 +455,10 @@ class Thrasher:
                     "health": health,
                     "health_ticks": health_ticks,
                     "backoff_ticks": self.client.clock.sleeps,
+                    "replay_double_commits": double_commits,
+                    "replay_dups_suppressed": replay_dups,
+                    "mon_epochs_linear": linear,
+                    "boots_held": self.mon.boots_held,
                 },
                 "failures": failures,
                 "ok": not failures,
@@ -291,6 +466,7 @@ class Thrasher:
         finally:
             for name, _, _ in cfg.faultpoints:
                 faults.disarm(name)
+            faults.disarm("net.partition")
 
 
 # ------------------------------------------------------------ standalone --
@@ -346,13 +522,23 @@ def main(argv: Optional[Sequence[str]] = None, out=None) -> int:
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--cycles", type=int, default=5)
     ap.add_argument("--objects", type=int, default=6)
+    ap.add_argument("--netsplit", action="store_true",
+                    help="seeded partition/heal soak instead of "
+                         "kill/revive: cuts a minority of OSDs off "
+                         "(sometimes one-way, sometimes ridden out "
+                         "under noout/nodown), with session-replay "
+                         "and mon-epoch-linearity invariants")
     ap.add_argument("--json", action="store_true")
     ns = ap.parse_args(argv)
     sim, mon = build_default_stack()
     try:
-        t = Thrasher(sim, mon, [1, 2],
-                     ThrashConfig(seed=ns.seed, cycles=ns.cycles,
-                                  objects=ns.objects))
+        cfg = ThrashConfig(seed=ns.seed, cycles=ns.cycles,
+                           objects=ns.objects)
+        if ns.netsplit:
+            cfg.netsplit = True
+            cfg.faultpoints = NETSPLIT_FAULTPOINTS
+            cfg.settle_ticks = max(cfg.settle_ticks, 40)
+        t = Thrasher(sim, mon, [1, 2], cfg)
         report = t.run()
     finally:
         sim.shutdown()

@@ -37,6 +37,25 @@ admin command expose them per daemon (each process owns its registry).
 Static closure: cephtpu-lint CTL601 requires every ``faults.fire``
 literal to name a declared point; CTL602 bans ``faults.fire`` inside
 jit-reachable code (a host-side branch would burn the compiled path).
+
+Partition faults: ``net.partition`` is the cross-layer netsplit
+faultpoint (the iptables-drop teuthology uses between daemon hosts).
+It is armed with ``groups`` — a list of entity-name lists — and
+severs traffic whose ``src`` and ``dst`` context entities fall in
+DIFFERENT groups (entities in no group are unaffected).  The
+``oneway`` param makes the cut asymmetric: only frames FROM
+``groups[0]`` TOWARD the other groups are dropped, the reverse
+direction still delivers (half-open links, the nastier real-world
+shape).  Arming goes through the normal grammar — the registry builds
+the membership predicate itself, so the asok path works:
+
+    fault_injection arm net.partition
+        params={"groups": [["osd.0","osd.1"], ["mon","client",...]],
+                "oneway": false}
+
+Fire sites ask ``faults.partitioned(src, dst)`` (or fire() with
+src/dst ctx); a fire is counted only when the cut actually severed
+that (src, dst) pair, so fire counts prove the partition carried.
 """
 from __future__ import annotations
 
@@ -52,6 +71,31 @@ MODES = ("always", "one_in", "nth", "predicate")
 
 class FaultError(ValueError):
     """Bad declaration/arming (unknown point, bad mode, dup doc)."""
+
+
+def _partition_predicate(params: Dict[str, Any]) -> Callable:
+    """Membership predicate for ``net.partition``: severed iff src and
+    dst sit in different groups (oneway: only groups[0] -> others)."""
+    try:
+        groups = [frozenset(g) for g in params["groups"]]
+    except (TypeError, KeyError):
+        raise FaultError("net.partition needs groups=[[entity,...],"
+                         "...] (lists of entity names)")
+    if len(groups) < 2 or any(not g for g in groups):
+        raise FaultError("net.partition needs >= 2 non-empty groups")
+    oneway = bool(params.get("oneway", False))
+
+    def severed(ctx: Dict[str, Any]) -> bool:
+        src, dst = ctx.get("src"), ctx.get("dst")
+        gi = next((i for i, g in enumerate(groups) if src in g), None)
+        gj = next((i for i, g in enumerate(groups) if dst in g), None)
+        if gi is None or gj is None or gi == gj:
+            return False          # unlisted or same-side: delivered
+        if oneway:
+            return gi == 0        # only groups[0] -> others is cut
+        return True
+
+    return severed
 
 
 @dataclass
@@ -116,6 +160,12 @@ class FaultRegistry:
             raise FaultError(f"{name}: match must be a dict of "
                              f"context key -> expected value, got "
                              f"{type(match).__name__}")
+        if name == "net.partition" and predicate is None:
+            # partition arming carries groups, not a schedule: the
+            # registry builds the membership predicate itself so the
+            # asok grammar (which cannot ship callables) arms it
+            predicate = _partition_predicate(params)
+            mode = "predicate"
         with self._lock:
             if name not in self._declared:
                 raise FaultError(
@@ -204,6 +254,17 @@ def registry() -> FaultRegistry:
     return _REGISTRY
 
 
+# declared HERE (not at a single fire site): the partition cut is a
+# cross-layer point — wire frames, in-process queue admission, peer
+# heartbeats and quorum traffic all consult the same armed groups
+registry().declare(
+    "net.partition",
+    "sever traffic between named daemon groups (both directions; "
+    "params oneway=True cuts only groups[0] -> others) — the "
+    "netsplit axis; arm with params={'groups': [[entity,...],...]}; "
+    "fires count only actually-severed (src, dst) frames")
+
+
 def declare(name: str, doc: str) -> None:
     _REGISTRY.declare(name, doc)
 
@@ -235,6 +296,16 @@ def fire(name: str, **ctx: Any) -> Optional[Dict[str, Any]]:
     if name not in _REGISTRY._armed:
         return None
     return _REGISTRY._evaluate(name, ctx)
+
+
+def partitioned(src: str, dst: str) -> bool:
+    """True when an armed ``net.partition`` severs src -> dst traffic
+    (counts a fire).  The disarmed case is one dict-miss check, so
+    heartbeat/dispatch hot paths may call this unconditionally."""
+    if "net.partition" not in _REGISTRY._armed:
+        return False
+    return _REGISTRY._evaluate("net.partition",
+                               {"src": src, "dst": dst}) is not None
 
 
 def admin_handler(args: Dict[str, Any]) -> Dict[str, Any]:

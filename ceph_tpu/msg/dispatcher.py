@@ -18,6 +18,7 @@ from __future__ import annotations
 import threading
 from typing import Callable, Dict, List, Optional, Sequence
 
+from ..common import faults
 from ..common.lockdep import LockdepLock
 from ..common.perf_counters import perf as _perf
 from .queue import Envelope, MessageQueue
@@ -79,9 +80,17 @@ class ShardFanout:
     completes when every shard acked (or fails on nack)."""
 
     def __init__(self, shard_queues: Sequence[MessageQueue],
-                 ack_q: MessageQueue):
+                 ack_q: MessageQueue, entity: str = "client",
+                 shard_entities: Optional[Sequence[str]] = None):
+        """``entity``/``shard_entities`` name this primary and its
+        shard servers for the ``net.partition`` faultpoint: a severed
+        sub-op is never enqueued (the peer's frame vanished), so the
+        gather sees a missing ack — exactly a netsplit's face."""
         self.shard_queues = list(shard_queues)
         self.ack_q = ack_q
+        self.entity = entity
+        self.shard_entities = list(shard_entities) if shard_entities \
+            else [f"shard.{i}" for i in range(len(self.shard_queues))]
         self._lock = LockdepLock("msg.fanout", recursive=False)
         self._pending: Dict[int, Dict] = {}
         self._pc = _perf("msg.fanout")
@@ -97,6 +106,13 @@ class ShardFanout:
         self._pc.inc("ops_submitted")
         for shard, (q, payload) in enumerate(
                 zip(self.shard_queues, shard_payloads)):
+            if faults.partitioned(self.entity,
+                                  self.shard_entities[shard]):
+                # the frame is lost on the cut link: no push, no ack —
+                # the waiter's timeout is the failure signal, as on a
+                # real netsplit (a nack would be a delivered frame)
+                self._pc.inc("subops_partitioned")
+                continue
             q.push(Envelope(msg_type, op_id, shard, payload))
 
     def ack(self, op_id: int, shard: int, ok: bool = True) -> None:

@@ -74,7 +74,18 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
 
 
 def send_frame(sock: socket.socket, env: Envelope,
-               session_key: Optional[bytes] = None) -> None:
+               session_key: Optional[bytes] = None,
+               src: Optional[str] = None,
+               dst: Optional[str] = None) -> None:
+    """``src``/``dst`` are the sending/receiving entity names, passed
+    by callers that know them (WireClient requests, WireServer
+    replies): an armed ``net.partition`` that severs src -> dst drops
+    the frame before any byte hits the socket — per-direction, so a
+    oneway cut can deliver the request yet drop the reply (the
+    half-open-link shape the session-replay machinery must absorb)."""
+    if src is not None and dst is not None and \
+            faults.partitioned(src, dst):
+        raise WireClosed(f"fault injected: {src} -> {dst} partitioned")
     payload = env.payload or b""
     if session_key is not None:
         from ..common.auth import seal

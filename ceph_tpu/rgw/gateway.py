@@ -26,11 +26,48 @@ import json
 import time
 from typing import Dict, List, Optional, Tuple
 
+from ..common.backoff import ExpBackoff
+
 _BUCKETS_OID = "rgw.buckets"
 
 
 class RGWError(IOError):
     pass
+
+
+def _read_json(ioctx, oid: str, default, what: str):
+    """Read+decode one JSON metadata object (bucket index, bucket
+    directory, GC log) with the failure taxonomy these objects NEED:
+
+      * object absent -> ``default`` (a fresh bucket/log);
+      * TRANSIENT IOError (degraded EC read mid-recovery, injected
+        EIO, connection cut) -> bounded retry with ExpBackoff, then
+        RAISE.  The old ``except Exception: return {}`` here was a
+        lost-object bug under load, not a flake: one transient read
+        error made a full bucket index read as EMPTY — spurious
+        NoSuchKey on a GET, and the next index WRITE would rebuild
+        from {} and silently orphan every existing object;
+      * corrupt JSON -> raise (serving {} for a damaged index is the
+        same data loss with less evidence).
+    """
+    import zlib
+    # stable digest, NOT hash(): str hashing is salted per process
+    # and would make retry jitter irreproducible across runs
+    backoff = ExpBackoff(base=0.02, cap=0.25,
+                         seed=zlib.crc32(oid.encode()) & 0xffff)
+    last: Optional[Exception] = None
+    for attempt in range(4):
+        try:
+            return json.loads(ioctx.read(oid).decode())
+        except KeyError:
+            # ObjectNotFound subclasses KeyError in both client tiers:
+            # genuinely absent metadata means a fresh bucket/log
+            return default
+        except (IOError, OSError) as e:
+            last = e
+            if attempt < 3:
+                backoff.sleep(attempt)
+    raise RGWError(f"{what} {oid!r} unreadable after retries: {last}")
 
 
 class Bucket:
@@ -61,11 +98,8 @@ class Bucket:
         return f"rgw.index.{self.name}"
 
     def _read_index(self) -> Dict[str, dict]:
-        try:
-            return json.loads(self.gw.ioctx.read(self._index_oid())
-                              .decode())
-        except Exception:
-            return {}
+        return _read_json(self.gw.ioctx, self._index_oid(), {},
+                          "bucket index")
 
     def _write_index(self, idx: Dict[str, dict]) -> None:
         self.gw.ioctx.write_full(self._index_oid(),
@@ -173,11 +207,11 @@ class Bucket:
         return f"rgw_mp.{self.name}/{uid}.{n}"
 
     def _read_mp(self, uid: str) -> dict:
-        try:
-            return json.loads(
-                self.gw.ioctx.read(self._mp_meta_oid(uid)).decode())
-        except Exception:
+        meta = _read_json(self.gw.ioctx, self._mp_meta_oid(uid),
+                          None, "multipart meta")
+        if meta is None:
             raise RGWError(f"NoSuchUpload: {uid}")
+        return meta
 
     def initiate_multipart(self, key: str) -> str:
         import secrets as _secrets
@@ -317,10 +351,10 @@ class RGWGateway:
     # cleanup is centralized.
 
     def _read_gc(self) -> List[dict]:
-        try:
-            return json.loads(self.ioctx.read(_GC_OID).decode())
-        except Exception:
-            return []
+        # same taxonomy as the bucket index: a transient read error
+        # treated as "empty log" would let the next gc_enqueue
+        # OVERWRITE pending entries — leaked data objects
+        return _read_json(self.ioctx, _GC_OID, [], "gc log")
 
     def gc_enqueue(self, oids: List[str],
                    delay: float = 0.0) -> None:
@@ -355,10 +389,8 @@ class RGWGateway:
         return removed
 
     def _read_buckets(self) -> Dict[str, dict]:
-        try:
-            return json.loads(self.ioctx.read(_BUCKETS_OID).decode())
-        except Exception:
-            return {}
+        return _read_json(self.ioctx, _BUCKETS_OID, {},
+                          "bucket directory")
 
     def _write_buckets(self, d: Dict[str, dict]) -> None:
         self.ioctx.write_full(_BUCKETS_OID, json.dumps(d).encode())

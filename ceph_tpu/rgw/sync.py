@@ -43,11 +43,12 @@ class BucketSyncAgent:
                 self._zones_oid(), json.dumps(sorted(zones)).encode())
 
     def _zones(self):
-        try:
-            return json.loads(
-                self.src_gw.ioctx.read(self._zones_oid()).decode())
-        except Exception:
-            return []
+        # retry-through transient errors, default only on absence:
+        # an "empty zone set" fabricated from a transient read error
+        # would drop every peer zone from the next sync fan-out
+        from .gateway import _read_json
+        return _read_json(self.src_gw.ioctx, self._zones_oid(), [],
+                          "zone set")
 
     def _dst_bucket(self) -> Bucket:
         try:
@@ -62,7 +63,10 @@ class BucketSyncAgent:
     def committed_position(self) -> int:
         try:
             return int(self.src_gw.ioctx.read(self._pos_oid()).decode())
-        except Exception:
+        except (KeyError, ValueError):
+            # absent (first sync) or corrupt marker -> replay from 0;
+            # a TRANSIENT error propagates instead of silently forcing
+            # a full re-replay (CTL603 bug class)
             return -1
 
     def _commit(self, seq: int) -> None:

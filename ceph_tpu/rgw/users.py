@@ -108,7 +108,10 @@ class UserStore:
     def lookup_access_key(self, access_key: str) -> Optional[dict]:
         try:
             uid = bytes(self.ioctx.read(self._koid(access_key))).decode()
-        except Exception:
+        except KeyError:
+            # unknown access key -> auth failure.  A TRANSIENT read
+            # error propagates: spuriously denying a VALID key on a
+            # degraded read is the CTL603 fabricated-absence class
             return None
         try:
             rec = self._load(uid)

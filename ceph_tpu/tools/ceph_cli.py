@@ -316,7 +316,8 @@ def main(argv: Optional[List[str]] = None,
                     help="replica count for `osd pool create`")
     ap.add_argument("words", nargs="+",
                     help="command, e.g.: status | health | mon stat | "
-                         "osd tree | osd out N | osd pool ls | "
+                         "osd tree | osd out N | osd in N | "
+                         "osd set|unset noout|nodown | osd pool ls | "
                          "osd tier add|remove BASE CACHE | "
                          "osd tier agent BASE [TARGET] | "
                          "pg dump POOL | df | scrub POOL | "
@@ -324,7 +325,8 @@ def main(argv: Optional[List[str]] = None,
                          "dump_historic_ops|dump_historic_slow_ops|"
                          "perf dump|fault_injection [...] | "
                          "lint [--check|--json|...] | "
-                         "thrash [--seed N --cycles K --json]")
+                         "thrash [--seed N --cycles K --netsplit "
+                         "--json]")
     ns, extra = ap.parse_known_args(argv)
     if ns.words[0] == "lint":
         # static-analysis surface (ceph_tpu/analysis): needs no
@@ -382,6 +384,19 @@ def _dispatch(ap, ns, rc, out) -> int:
         return cmd_osd_out(rc, int(arg(2)), out)
     if w[:2] == ["osd", "in"]:
         return cmd_osd_in(rc, int(arg(2)), out)
+    if w[:2] == ["osd", "set"]:
+        # `ceph osd set noout|nodown` — ride out a known partition:
+        # noout stops the down->out transition, nodown stops failure
+        # reports from marking OSDs down (OSDMonitor flag commands)
+        r = rc.mon_call({"cmd": "osd_set_flag", "flag": arg(2)})
+        out.write(f"{arg(2)} is set (flags: "
+                  f"{','.join(r['flags']) or '-'})\n")
+        return 0
+    if w[:2] == ["osd", "unset"]:
+        r = rc.mon_call({"cmd": "osd_unset_flag", "flag": arg(2)})
+        out.write(f"{arg(2)} is unset (flags: "
+                  f"{','.join(r['flags']) or '-'})\n")
+        return 0
     if w[:3] == ["osd", "pool", "ls"]:
         return cmd_pool_ls(rc, ns.detail, out)
     if w[:3] == ["osd", "pool", "create"]:

@@ -12,7 +12,12 @@ self-healing invariants —
   * health converged to HEALTH_OK within the tick bound,
   * every armed faultpoint FIRED at least once (perf-counter proof),
   * the identical seed reproduces the identical schedule and fire
-    counts (the regression-test property).
+    counts (the regression-test property),
+
+then a quick NETSPLIT soak (ISSUE 6: seeded partition/heal cycles via
+``net.partition`` with ``msg.drop_ack`` losing committed completions)
+asserting the same set PLUS replay idempotency (no op applies twice
+under session replay) and linear mon epoch history (no split brain).
 
 Runs on CPU (no accelerator needed):
 
@@ -38,15 +43,20 @@ def _fail(msg: str) -> int:
     return 1
 
 
-def run_once(seed: int, cycles: int = 3):
-    from ceph_tpu.cluster.thrasher import (Thrasher, ThrashConfig,
+def run_once(seed: int, cycles: int = 3, netsplit: bool = False):
+    from ceph_tpu.cluster.thrasher import (NETSPLIT_FAULTPOINTS,
+                                           Thrasher, ThrashConfig,
                                            build_default_stack)
     from ceph_tpu.common import faults
     sim, mon = build_default_stack()
     try:
-        t = Thrasher(sim, mon, [1, 2],
-                     ThrashConfig(seed=seed, cycles=cycles,
-                                  objects=4, writes_per_cycle=2))
+        cfg = ThrashConfig(seed=seed, cycles=cycles,
+                           objects=4, writes_per_cycle=2)
+        if netsplit:
+            cfg.netsplit = True
+            cfg.faultpoints = NETSPLIT_FAULTPOINTS
+            cfg.settle_ticks = 40
+        t = Thrasher(sim, mon, [1, 2], cfg)
         return t.run()
     finally:
         sim.shutdown()
@@ -84,11 +94,30 @@ def main() -> int:
         return _fail(f"same seed produced different fire counts: "
                      f"{r1['fire_counts']} vs {r2['fire_counts']}")
 
+    # netsplit scenario (ISSUE 6): seeded partition/heal cycles with
+    # the full invariant set PLUS replay idempotency (no op applies
+    # twice under session replay) and linear mon epoch history
+    rn = run_once(seed=7, netsplit=True)
+    if not rn["ok"]:
+        return _fail("netsplit invariants broken: " +
+                     "; ".join(rn["failures"]))
+    ninv = rn["invariants"]
+    if ninv["replay_double_commits"] != 0:
+        return _fail(f"replay applied "
+                     f"{ninv['replay_double_commits']} ops twice")
+    if not ninv["mon_epochs_linear"]:
+        return _fail("mon epoch history forked or gapped")
+    if rn["fire_counts"].get("net.partition", 0) < 1:
+        return _fail("netsplit soak never severed a frame")
+
     print(f"OK: {len(r1['schedule'])} scheduled events over "
           f"{r1['cycles']} cycles, fires={r1['fire_counts']}, "
           f"{inv['objects_checked']} objects verified, "
           f"health {inv['health']} in {inv['health_ticks']} ticks, "
-          f"schedule reproducible")
+          f"schedule reproducible; netsplit: "
+          f"{rn['fire_counts']['net.partition']} severed frames, "
+          f"{ninv['replay_dups_suppressed']} replays suppressed, "
+          f"epochs linear, health {ninv['health']}")
     return 0
 
 

@@ -371,6 +371,69 @@ def test_ctl602_fire_in_jit_reachable_code(tmp_path):
     assert "jit-reachable" in res.findings[0].msg
 
 
+def test_ctl603_swallowed_ioerror_to_default(tmp_path):
+    """The _read_index bug class: except IOError -> return {} in an
+    IO-facing dir fabricates 'absent' state from a transient error."""
+    write(tmp_path, "rgw/gw.py", """\
+        def read_index(ioctx, oid):
+            try:
+                return ioctx.read(oid)
+            except IOError:
+                return {}
+
+        def read_meta(ioctx, oid):
+            try:
+                return ioctx.read(oid)
+            except (OSError, ValueError):
+                return None
+
+        def read_ok(ioctx, oid):
+            try:
+                return ioctx.read(oid)
+            except KeyError:          # genuine absence: not flagged
+                return {}
+
+        def read_loud(ioctx, oid):
+            try:
+                return ioctx.read(oid)
+            except IOError:
+                raise RuntimeError("index unreadable")
+        """)
+    res = lint(tmp_path, select=["CTL603"])
+    assert rules_of(res) == ["CTL603", "CTL603"]
+    assert [f.line for f in res.findings] == [4, 10]
+    assert "lost-object" in res.findings[0].msg
+
+
+def test_ctl603_scoped_to_io_facing_dirs(tmp_path):
+    """cluster/ (and everything outside client//rgw//msg/) keeps its
+    local error conventions — the rule is about the wire/device
+    boundary dirs the ISSUE names."""
+    code = """\
+        def read(store, oid):
+            try:
+                return store.read(oid)
+            except IOError:
+                return {}
+        """
+    write(tmp_path, "cluster/store.py", code)
+    assert not lint(tmp_path, select=["CTL603"]).findings
+    write(tmp_path, "client/remote.py", code)
+    res = lint(tmp_path, select=["CTL603"])
+    assert rules_of(res) == ["CTL603"]
+
+
+def test_ctl603_noqa_suppresses(tmp_path):
+    write(tmp_path, "msg/wire.py", """\
+        def probe(sock):
+            try:
+                return sock.recv(1)
+            except OSError:  # noqa: CTL603 -- poller retries next tick
+                return None
+        """)
+    assert not lint(tmp_path, select=["CTL603"]).findings
+
+
 # ------------------------------------------- framework behavior ---
 
 def test_noqa_inline_suppression(tmp_path):

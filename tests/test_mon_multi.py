@@ -170,3 +170,87 @@ def test_follower_forwards_mutations(cluster3):
     assert r["epoch"] > before     # committed via leader forwarding
     c.close()
     rc.close()
+
+
+def test_minority_mon_stalls_reads_client_redirects(cluster3):
+    """ISSUE 6: netsplit a peon away from the quorum.  The minority
+    mon's read lease expires and it STALLS get_map (bounded IOError)
+    instead of serving a stale map as fresh; a client pinned to it
+    fails over to the majority and sees the NEW epoch; after heal the
+    minority syncs forward (identical committed history)."""
+    import json
+    from ceph_tpu.common.admin import admin_request
+    d, v = cluster3
+    rc = _client(d)
+    _wait_leader(rc)
+    _wait_up(rc, N_OSDS)
+    asok2 = os.path.join(d, "mon.2.asok")
+    # pin a client to mon.2 (the soon-to-be minority side)
+    pinned = _client(d)
+    pinned._mon_rot = 2
+    pinned.mon.close()
+    pinned.mon = None
+    pinned.mon_call({"cmd": "mon_status"})      # connected to rank 2
+    # cut mon.2 from the quorum (armed INSIDE mon.2's process: both
+    # directions sever — its peer calls and its peers' calls to it)
+    admin_request(asok2, {
+        "prefix": "fault_injection", "action": "arm",
+        "name": "net.partition",
+        "params": {"groups": [["mon.2"], ["mon.0", "mon.1"]]}})
+    try:
+        # majority keeps committing epochs the minority cannot see
+        e0 = rc.mon_call({"cmd": "get_map"})["epoch"]
+        rc.mon_call({"cmd": "mark_out", "osd": 3})
+        rc.mon_call({"cmd": "mark_in", "osd": 3})
+        e1 = rc.mon_call({"cmd": "get_map"})["epoch"]
+        assert e1 > e0
+        # the pinned client's mon: lease expires within mon_lease
+        # (2s) — its DIRECT get_map must turn into a bounded stall,
+        # never a stale-as-fresh map
+        deadline = time.monotonic() + 15.0
+        stalled = False
+        while time.monotonic() < deadline:
+            try:
+                m = pinned.mon.call({"cmd": "get_map"})
+                assert m["epoch"] <= e1     # never a FUTURE lie
+                time.sleep(0.3)
+            except (OSError, IOError):
+                stalled = True
+                break
+        assert stalled, "minority mon kept serving reads as fresh"
+        # ...and the client SDK redirects: the same logical call via
+        # mon_call rotates to a majority mon and gets the new epoch
+        m = pinned.mon_call({"cmd": "get_map"})
+        assert m["epoch"] >= e1
+        # fire proof: the cut actually severed quorum traffic
+        st = admin_request(asok2, {"prefix":
+                                   "fault_injection"})["result"]
+        assert st["fire_counts"].get("net.partition", 0) >= 1
+    finally:
+        admin_request(asok2, {"prefix": "fault_injection",
+                              "action": "disarm",
+                              "name": "net.partition"})
+    # healed: the minority syncs forward to the identical committed
+    # history (linear epochs, no fork) and serves reads again
+    def synced():
+        try:
+            s0 = rc.mon_call({"cmd": "mon_status"})
+            pinned._mon_rot = 2
+            if pinned.mon is not None:
+                pinned.mon.close()
+                pinned.mon = None
+            s2 = pinned.mon_call({"cmd": "mon_status"})
+            return (s2["rank"] == 2 and s2["readable"] and
+                    s2["committed"] >= s0["committed"] and
+                    s2["epoch"] == s0["epoch"])
+        except (OSError, IOError):
+            return False
+    deadline = time.monotonic() + 25.0
+    while time.monotonic() < deadline:
+        if synced():
+            break
+        time.sleep(0.5)
+    else:
+        raise AssertionError("minority mon never synced after heal")
+    rc.close()
+    pinned.close()

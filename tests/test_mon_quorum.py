@@ -187,3 +187,119 @@ def test_commit_gap_pulls_backlog():
     assert net.nodes[0].propose(encode_decree("x", n=2))
     assert net.nodes[2].committed == 3
     assert [d["n"] for _, d in applied[2]] == [0, 1, 2]
+
+
+# ---------------------------------------------------------------------
+# Netsplit + read leases (ISSUE 6): a minority-side mon must stall map
+# reads (lease expiry) rather than serve stale state; the majority
+# elects, keeps committing, re-grants leases; the healed minority
+# catches up to an IDENTICAL log (no split-brain double-commit).
+
+class SplitNet:
+    """Directional in-process wire with a severable link set."""
+
+    def __init__(self):
+        self.nodes: Dict[int, QuorumNode] = {}
+        self.cut = set()            # directed (src, dst) pairs
+
+    def send_from(self, src):
+        def send(dst, msg):
+            if (src, dst) in self.cut or dst not in self.nodes:
+                raise IOError(f"mon.{src} -> mon.{dst} severed")
+            return self.nodes[dst].handle(msg)
+        return send
+
+    def split(self, minority):
+        for a in range(len(self.nodes)):
+            for b in range(len(self.nodes)):
+                if (a in minority) != (b in minority):
+                    self.cut.add((a, b))
+
+    def heal(self):
+        self.cut.clear()
+
+
+def make_leased_cluster(n=3, lease=1.0):
+    net = SplitNet()
+    clock = {"t": 0.0}
+    applied = {r: [] for r in range(n)}
+    for r in range(n):
+        def mk_apply(rr):
+            return lambda v, blob: applied[rr].append(
+                (v, decode_decree(blob)))
+        net.nodes[r] = QuorumNode(
+            r, n, MemDB(), mk_apply(r), net.send_from(r),
+            lease_duration=lease, now_fn=lambda: clock["t"])
+    return net, applied, clock
+
+
+def _log_of(node):
+    return [(v, node.db.get("quorum", node._log_key(v)))
+            for v in range(1, node.committed + 1)]
+
+
+def test_lease_grant_and_expiry():
+    net, _, clock = make_leased_cluster()
+    assert net.nodes[0].start_election()
+    # bootstrap: no lease granted yet, reads serve the base state
+    assert all(net.nodes[r].readable() for r in range(3))
+    assert net.nodes[0].extend_lease()
+    clock["t"] += 0.5
+    assert all(net.nodes[r].readable() for r in range(3))
+    clock["t"] += 1.0                       # past the 1.0s lease
+    assert not any(net.nodes[r].readable() for r in range(3))
+    assert net.nodes[0].extend_lease()      # leader re-grants
+    assert all(net.nodes[r].readable() for r in range(3))
+
+
+def test_minority_leader_stalls_majority_elects_and_commits():
+    net, _, clock = make_leased_cluster()
+    assert net.nodes[0].start_election()
+    assert net.nodes[0].extend_lease()
+    assert net.nodes[0].propose(encode_decree("e", n=1))
+    # netsplit: old leader 0 alone on the minority side
+    net.split({0})
+    assert not net.nodes[0].extend_lease()  # no majority: no lease
+    clock["t"] += 1.5
+    assert not net.nodes[0].readable()      # minority READS STALL
+    # minority cannot commit either (the no-split-brain half)
+    assert not net.nodes[0].propose(encode_decree("evil", n=99))
+    assert net.nodes[0].committed == 1
+    # majority side: elect, re-grant, keep committing epochs
+    assert net.nodes[1].start_election()
+    assert net.nodes[1].extend_lease()
+    assert net.nodes[1].readable() and net.nodes[2].readable()
+    for i in (2, 3):
+        assert net.nodes[1].propose(encode_decree("e", n=i))
+    assert net.nodes[1].committed == 3
+    assert not net.nodes[0].readable()      # still cut, still stalled
+
+
+def test_healed_minority_syncs_forward_no_split_brain():
+    net, _, clock = make_leased_cluster()
+    assert net.nodes[0].start_election()
+    assert net.nodes[0].extend_lease()      # leave bootstrap mode
+    assert net.nodes[0].propose(encode_decree("e", n=1))
+    net.split({0})
+    # the deposed minority leader parks an UNCOMMITTED tail at v2 —
+    # the dangerous residue a heal must never double-commit
+    assert not net.nodes[0].propose(encode_decree("minority", n=2))
+    assert net.nodes[1].start_election()
+    for i in (2, 3):
+        assert net.nodes[1].propose(encode_decree("major", n=i))
+    net.heal()
+    # one more majority commit reaches rank 0, which pulls its backlog
+    assert net.nodes[1].propose(encode_decree("major", n=4))
+    assert net.nodes[0].committed == 4
+    # EPOCH HISTORY IS LINEAR: every rank holds the identical log —
+    # the minority's parked value was superseded, never committed
+    logs = [_log_of(net.nodes[r]) for r in range(3)]
+    assert logs[0] == logs[1] == logs[2]
+    assert all(b is not None for _, b in logs[0])
+    committed_vals = [decode_decree(b)["n"] for _, b in logs[0]]
+    assert committed_vals == [1, 2, 3, 4]   # no n=99 / minority fork
+    # and the healed rank becomes readable again once leased
+    clock["t"] += 5.0
+    assert not net.nodes[0].readable()
+    assert net.nodes[1].extend_lease()
+    assert net.nodes[0].readable()

@@ -118,3 +118,96 @@ def test_workload_survives_socket_failures(tmp_path):
         rc.close()
     finally:
         v.stop()
+
+
+def test_session_replay_applies_lost_reply_op_once(tmp_path):
+    """Messenger session replay (ISSUE 6): a write whose REPLY frame
+    is lost applies exactly once — the client reconnect-retry carries
+    the same (session, seq), the daemon returns the recorded
+    completion instead of re-applying.  Oracle: the PG log grows by
+    exactly one entry per logical write.  Heartbeats are quieted
+    (hb_interval=60) so the armed reply-drop deterministically hits
+    OUR op's reply, not a peer ping's."""
+    d = str(tmp_path / "cluster")
+    build_cluster_dir(d, n_osds=3, osds_per_host=1, fsync=False)
+    v = Vstart(d)
+    v.start(3, hb_interval=60.0)
+    try:
+        from ceph_tpu.client.remote import RemoteCluster
+        rc = RemoteCluster(d)
+        rc.put(1, "sess-obj", b"v1" * 400)
+        pool = rc.osdmap.pools[1]
+        pg = rc._pg_for(pool, "sess-obj")
+        prim = [o for o in rc._up(pool, pg) if o >= 0][0]
+        asok = os.path.join(d, f"osd.{prim}.asok")
+
+        def log_len():
+            r = rc.osd_call(prim, {"cmd": "pg_log", "coll": [1, pg],
+                                   "after": [0, 0]})
+            return len(r["entries"])
+
+        n0 = log_len()
+        # drop the NEXT reply frame this daemon sends (0x11 =
+        # MSG_REPLY): the put applies, the completion vanishes
+        admin_request(asok, {"prefix": "fault_injection",
+                             "action": "arm",
+                             "name": "wire.drop_frame",
+                             "match": {"type": 0x11}, "count": 1})
+        assert rc.put(1, "sess-obj", b"v2" * 400) >= 1
+        assert rc.get(1, "sess-obj") == b"v2" * 400
+        # the drop really happened AND the resend was dup-suppressed
+        st = admin_request(asok, {"prefix":
+                                  "fault_injection"})["result"]
+        assert st["fire_counts"].get("wire.drop_frame", 0) >= 1
+        pd = admin_request(asok, {"prefix": "perf dump"})["result"]
+        assert pd.get("osd.session", {}).get("replay_dups", 0) >= 1
+        # at-most-once: ONE new log entry for the lost-reply write
+        assert log_len() == n0 + 1
+        rc.close()
+    finally:
+        v.stop()
+
+
+def test_session_stale_replay_cannot_clobber_newer_write(tmp_path):
+    """The replay-ordering hazard, driven manually: W1(seq1) applies,
+    W2(seq2) supersedes it, then W1's replay (same session, seq 1)
+    arrives — the daemon must return W1's RECORDED completion and
+    leave W2's bytes in place (and append no third log entry)."""
+    d = str(tmp_path / "cluster")
+    build_cluster_dir(d, n_osds=3, osds_per_host=1, fsync=False)
+    v = Vstart(d)
+    v.start(3, hb_interval=60.0)
+    try:
+        from ceph_tpu.client.remote import RemoteCluster
+        rc = RemoteCluster(d)
+        pool = rc.osdmap.pools[1]
+        name = "manual-obj"
+        pg = rc._pg_for(pool, name)
+        members = [o for o in rc._up(pool, pg) if o >= 0]
+        prim = members[0]
+        w1 = {"cmd": "put_object", "coll": [1, pg],
+              "oid": f"0:{name}", "data": b"ver-one" * 100,
+              "replicas": members, "session": "manual-sid", "seq": 1}
+        r1 = rc.osd_call(prim, dict(w1))
+        r2 = rc.osd_call(prim, {**w1, "data": b"ver-two" * 100,
+                                "seq": 2})
+        assert r2["version"] != r1["version"]
+
+        def log_len():
+            r = rc.osd_call(prim, {"cmd": "pg_log", "coll": [1, pg],
+                                   "after": [0, 0]})
+            return len(r["entries"])
+
+        n2 = log_len()
+        replayed = rc.osd_call(prim, dict(w1))   # W1's replay
+        assert replayed == r1                    # recorded completion
+        assert log_len() == n2                   # nothing re-applied
+        got = rc.osd_call(prim, {"cmd": "get_shard", "coll": [1, pg],
+                                 "oid": f"0:{name}"})
+        assert bytes(got) == b"ver-two" * 100
+        # the daemon accounted the session machinery
+        st = rc.osd_client(prim).call({"cmd": "status"})
+        assert st["sessions"] >= 1
+        rc.close()
+    finally:
+        v.stop()

@@ -1,0 +1,271 @@
+"""Partition tolerance (ISSUE 6): net.partition semantics, flap
+dampening, noout/nodown flags, and session replay — sim-tier units.
+
+The netsplit SOAK (seeded cut/heal cycles with the full invariant
+set) lives in tests/test_thrasher.py; these are the focused contracts
+each layer must hold on its own.
+"""
+import pytest
+
+from ceph_tpu.cluster.heartbeat import HeartbeatConfig, HeartbeatMonitor
+from ceph_tpu.cluster.monitor import Monitor
+from ceph_tpu.cluster.objecter import Objecter
+from ceph_tpu.common import faults
+from ceph_tpu.common.faults import FaultError
+from tests.test_snaps import make_sim
+
+
+@pytest.fixture(autouse=True)
+def _clean_registry():
+    yield
+    faults.reset()
+
+
+# ------------------------------------------------------ registry level --
+
+def test_partition_groups_sever_cross_group_only():
+    faults.arm("net.partition",
+               groups=[["osd.0", "osd.1"], ["osd.2", "mon"]])
+    assert faults.partitioned("osd.0", "osd.2")
+    assert faults.partitioned("osd.2", "osd.0")     # both directions
+    assert faults.partitioned("osd.1", "mon")
+    assert not faults.partitioned("osd.0", "osd.1")  # same side
+    assert not faults.partitioned("osd.2", "mon")
+    # unlisted entities are unaffected
+    assert not faults.partitioned("client", "osd.0")
+    assert not faults.partitioned("osd.0", "client")
+    # every severed check above counted as a fire
+    assert faults.fire_counts()["net.partition"] == 3
+    faults.disarm("net.partition")
+    assert not faults.partitioned("osd.0", "osd.2")
+
+
+def test_partition_oneway_is_asymmetric():
+    faults.arm("net.partition", groups=[["osd.0"], ["osd.1"]],
+               oneway=True)
+    assert faults.partitioned("osd.0", "osd.1")   # groups[0] -> cut
+    assert not faults.partitioned("osd.1", "osd.0")  # reverse open
+
+
+def test_partition_arm_validates_groups():
+    with pytest.raises(FaultError):
+        faults.arm("net.partition")               # no groups
+    with pytest.raises(FaultError):
+        faults.arm("net.partition", groups=[["osd.0"]])  # one group
+    with pytest.raises(FaultError):
+        faults.arm("net.partition", groups=[["osd.0"], []])
+
+
+def test_partition_armable_over_admin_grammar():
+    """The asok path: params carry the groups; the registry builds
+    the membership predicate itself (predicate mode is otherwise not
+    armable over the wire)."""
+    faults.admin_handler({
+        "prefix": "fault_injection", "action": "arm",
+        "name": "net.partition",
+        "params": {"groups": [["osd.0"], ["osd.1", "mon"]],
+                   "oneway": False}})
+    assert faults.partitioned("osd.0", "mon")
+    st = faults.status()["armed"]["net.partition"]
+    assert st["mode"] == "predicate"
+    faults.admin_handler({"prefix": "fault_injection",
+                          "action": "disarm",
+                          "name": "net.partition"})
+    assert not faults.partitioned("osd.0", "mon")
+
+
+# ----------------------------------------------------- dispatcher tier --
+
+def test_shard_fanout_partition_drops_subop():
+    from ceph_tpu.msg.dispatcher import ShardFanout
+    from ceph_tpu.msg.queue import MessageQueue
+    qs = [MessageQueue() for _ in range(3)]
+    ack = MessageQueue()
+    f = ShardFanout(qs, ack)
+    faults.arm("net.partition",
+               groups=[["client"], ["shard.1"]])
+    f.submit(7, 0x20, [b"a", b"b", b"c"])
+    # the severed sub-op was never enqueued: its frame is lost on the
+    # cut link, so the gather can only time out (a netsplit's face)
+    assert qs[0].stats()["depth"] == 1
+    assert qs[1].stats()["depth"] == 0
+    assert qs[2].stats()["depth"] == 1
+    assert f.wait(7, timeout=0.2) is False
+    assert faults.fire_counts()["net.partition"] >= 1
+
+
+# ------------------------------------------------- sim heartbeat tier --
+
+def _stack(**hb_kw):
+    sim = make_sim()
+    mon = Monitor(sim.osdmap, failure_reports_needed=2)
+    hb = HeartbeatMonitor(sim, mon, HeartbeatConfig(grace_ticks=1,
+                                                    **hb_kw))
+    return sim, mon, hb
+
+
+def test_alive_but_partitioned_osd_is_marked_down_and_heals():
+    sim, mon, hb = _stack()
+    try:
+        sim.put(1, "obj", b"payload" * 100)
+        minority = [f"osd.{0}"]
+        rest = ["client", "mon"] + [f"osd.{o.id}" for o in sim.osds
+                                    if o.id != 0]
+        faults.arm("net.partition", groups=[rest, minority])
+        assert sim.osds[0].alive            # the process never died
+        downs = []
+        for _ in range(4):
+            downs += hb.tick()
+        assert downs == [0], "partitioned OSD must be marked down"
+        # heal: disarm + re-announce; map converges back
+        faults.disarm("net.partition")
+        assert mon.osd_boot(0)
+        assert sim.osdmap.is_up(0)
+        assert mon.health_status(sim) in ("HEALTH_OK", "HEALTH_WARN")
+    finally:
+        sim.shutdown()
+
+
+def test_minority_reporters_cannot_reach_mon():
+    """The minority side detects the majority as unreachable but its
+    failure reports are severed too: nobody on the majority side gets
+    marked down by a minority-side reporter."""
+    sim, mon, hb = _stack()
+    try:
+        n = len(sim.osds)
+        minority = [f"osd.{n - 1}"]
+        rest = ["client", "mon"] + [f"osd.{o.id}" for o in sim.osds
+                                    if o.id != n - 1]
+        # one-way-ISH full cut: minority first so both directions die
+        faults.arm("net.partition", groups=[rest, minority])
+        for _ in range(6):
+            hb.tick()
+        # only the minority OSD went down; every majority OSD the
+        # minority "reported" stayed up (reports never landed)
+        up = [o for o in range(n) if sim.osdmap.is_up(o)]
+        assert up == [o for o in range(n - 1)]
+    finally:
+        sim.shutdown()
+
+
+def test_nodown_flag_vetoes_markdown_and_clears():
+    sim, mon, hb = _stack()
+    try:
+        assert mon.set_flag("nodown", True)
+        assert "nodown" in sim.osdmap.flags
+        sim.fail_osd(2)
+        for _ in range(4):
+            assert hb.tick() == []          # flag rides it out
+        assert sim.osdmap.is_up(2)
+        assert mon.set_flag("nodown", False)
+        downs = []
+        for _ in range(4):
+            downs += hb.tick()
+        assert downs == [2]                 # evidence acts immediately
+    finally:
+        sim.shutdown()
+
+
+def test_noout_flag_vetoes_auto_out():
+    sim, mon, hb = _stack(down_out_ticks=2)
+    try:
+        assert mon.set_flag("noout", True)
+        sim.fail_osd(1)
+        for _ in range(6):
+            hb.tick()
+        assert not sim.osdmap.is_up(1)      # marked down normally
+        assert sim.osdmap.osd_weight[1] != 0  # but never auto-outed
+        assert mon.set_flag("noout", False)
+        for _ in range(4):
+            hb.tick()
+        assert sim.osdmap.osd_weight[1] == 0  # grace elapsed -> out
+        assert hb.auto_outs == [1]
+    finally:
+        sim.shutdown()
+
+
+def test_flap_dampening_holds_a_flapping_osd_down():
+    """osd_markdown_log hysteresis: N markdowns inside the window and
+    the next boot is HELD for a (doubling, capped) backoff on the
+    heartbeat tick clock."""
+    sim, mon, hb = _stack()
+    try:
+        mon.configure_flap_dampening(count=2, window=100.0,
+                                     hold=4.0, hold_cap=16.0)
+        for flap in range(2):
+            sim.fail_osd(3)
+            for _ in range(3):
+                hb.tick()
+            assert not sim.osdmap.is_up(3)
+            sim.restart_osd(3)
+            if flap == 0:
+                assert mon.osd_boot(3)      # first flap boots fine
+        # second markdown inside the window: the boot is HELD
+        assert not mon.osd_boot(3)
+        assert mon.boots_held >= 1
+        assert mon.flap_status(3)["held_for"] > 0
+        for _ in range(5):                  # hold=4 ticks expires
+            hb.tick()
+        assert mon.osd_boot(3)
+        assert sim.osdmap.is_up(3)
+    finally:
+        sim.shutdown()
+
+
+# ---------------------------------------------------- session replay --
+
+def test_replay_after_dropped_ack_applies_once():
+    sim = make_sim()
+    try:
+        mon = Monitor(sim.osdmap, failure_reports_needed=2)
+        client = Objecter(sim, mon, max_retries=8, seed=1)
+        faults.arm("msg.drop_ack", mode="nth", n=1)
+        placed = client.put(1, "obj", b"version-one" * 50)
+        assert placed                       # the RESEND completed it
+        assert client.acks_dropped == 1
+        assert client.replay_dups == 1      # second apply suppressed
+        assert sim.reqid_stats()["double_commits"] == 0
+        assert sim.get(1, "obj") == b"version-one" * 50
+    finally:
+        sim.shutdown()
+
+
+def test_stale_replay_cannot_clobber_newer_write():
+    """The classic replay hazard: W1's ack is lost, W2 (same object)
+    commits, then W1's replay arrives — it must return W1's recorded
+    completion and leave W2's data in place."""
+    sim = make_sim()
+    try:
+        mon = Monitor(sim.osdmap, failure_reports_needed=2)
+        client = Objecter(sim, mon, max_retries=8, seed=2)
+        placed1 = client.put(1, "obj", b"v1" * 100)   # reqid seq 1
+        client.put(1, "obj", b"v2" * 100)             # reqid seq 2
+        # W1's replay: same reqid, same payload op — must be
+        # dup-suppressed, NOT re-applied over v2
+        replay = client._submit(
+            lambda: client._durable(1, sim.put(1, "obj", b"v1" * 100)),
+            1, "obj", optype="put", reqid=(client.session, 1))
+        assert replay == placed1            # recorded completion
+        assert client.replay_dups == 1
+        assert sim.get(1, "obj") == b"v2" * 100
+        assert sim.reqid_stats()["double_commits"] == 0
+    finally:
+        sim.shutdown()
+
+
+def test_client_partitioned_from_mon_sees_no_new_epochs():
+    sim = make_sim()
+    try:
+        mon = Monitor(sim.osdmap, failure_reports_needed=2)
+        client = Objecter(sim, mon, max_retries=4, seed=3)
+        inc = mon.next_incremental()
+        inc.new_weight[0] = 0
+        assert mon.commit_incremental(inc)
+        faults.arm("net.partition", groups=[["client"], ["mon"]])
+        assert client.maybe_update_map() == 0
+        assert client.osdmap.epoch < sim.osdmap.epoch
+        faults.disarm("net.partition")
+        assert client.maybe_update_map() >= 1
+        assert client.osdmap.epoch == sim.osdmap.epoch
+    finally:
+        sim.shutdown()

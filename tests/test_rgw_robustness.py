@@ -1,0 +1,78 @@
+"""RGW metadata reads under transient device errors (ISSUE 6 satellite).
+
+The `Bucket._read_index` bug class: a TRANSIENT read failure (injected
+EIO, degraded EC read mid-recovery, a cut connection) swallowed into
+``{}`` turns a full bucket index into "empty" — a spurious NoSuchKey
+on GET, and the next index write would rebuild from {} and orphan
+every object in the bucket.  The fix retries with ExpBackoff and
+raises after exhaustion; only genuine absence returns the default.
+"""
+import pytest
+
+from ceph_tpu.client.rados import Rados
+from ceph_tpu.cluster.monitor import Monitor
+from ceph_tpu.common import faults
+from ceph_tpu.rgw import RGWError, RGWGateway
+from ceph_tpu.rgw.gateway import _read_json
+from tests.test_snaps import make_sim
+
+
+@pytest.fixture(autouse=True)
+def _clean_registry():
+    yield
+    faults.reset()
+
+
+def test_index_read_survives_injected_eio():
+    """device.eio on every shard of the bucket-index object fails the
+    first read attempt outright (k=2, m=1: one EC read attempt costs 3
+    shard reads, all injected); the retry must land and the object
+    stay VISIBLE — the old code returned NoSuchKey here."""
+    sim = make_sim(k=2, m=1)
+    try:
+        rados = Rados(sim, Monitor(sim.osdmap)).connect()
+        # one attempt per logical read: the objecter's own retry loop
+        # must not mask the IOError this regression test is about
+        rados._objecter.max_retries = 1
+        io = rados.open_ioctx("ec")
+        gw = RGWGateway(io)
+        b = gw.create_bucket("fragile")
+        b.put_object("precious.bin", b"do not lose me" * 100)
+        fires0 = faults.fire_counts().get("device.eio", 0)
+        # 3 fires = every shard of the index object EIOs once: the
+        # whole first decode attempt fails with IOError
+        faults.arm("device.eio", mode="always", count=3)
+        data, ent = b.get_object("precious.bin")
+        assert data == b"do not lose me" * 100
+        assert faults.fire_counts()["device.eio"] - fires0 >= 3, \
+            "EIO was never injected — the test exercised nothing"
+    finally:
+        sim.shutdown()
+
+
+def test_read_json_taxonomy():
+    """Absent object -> default; persistent IOError -> raises (never
+    the default); transient IOError -> retried through."""
+
+    class FlakyIoctx:
+        def __init__(self, fail, payload=b'{"k": 1}'):
+            self.fail = fail
+            self.reads = 0
+            self.payload = payload
+
+        def read(self, oid):
+            self.reads += 1
+            if self.reads <= self.fail:
+                raise IOError("transient")
+            return self.payload
+
+    class AbsentIoctx:
+        def read(self, oid):
+            raise KeyError(oid)
+
+    assert _read_json(AbsentIoctx(), "x", {"d": 1}, "t") == {"d": 1}
+    flaky = FlakyIoctx(fail=2)
+    assert _read_json(flaky, "x", {}, "t") == {"k": 1}
+    assert flaky.reads == 3                  # two retries, then through
+    with pytest.raises(RGWError):
+        _read_json(FlakyIoctx(fail=99), "x", {}, "t")

@@ -105,3 +105,69 @@ def test_thrash_long_soak_with_map_churn():
         assert r["fire_counts"].get(name, 0) >= 1, name
     assert r["invariants"]["health"] == "HEALTH_OK"
     assert r["invariants"]["data_loss"] == []
+
+
+# ------------------------------------------------------- netsplit ---
+# ISSUE 6: the partition-tolerance soak — seeded cut/heal cycles
+# (sometimes one-way, sometimes ridden out under noout/nodown) under
+# interleaved writes+reads, with the PR-3 invariant set plus replay
+# idempotency (no op applies twice) and linear mon epoch history.
+
+def _run_netsplit(seed, cycles, **kw):
+    from ceph_tpu.cluster.thrasher import NETSPLIT_FAULTPOINTS
+    kw.setdefault("settle_ticks", 40)
+    return _run(seed, cycles, netsplit=True,
+                faultpoints=NETSPLIT_FAULTPOINTS, **kw)
+
+
+def test_netsplit_smoke_invariants_hold():
+    r = _run_netsplit(seed=3, cycles=3, objects=4, writes_per_cycle=2)
+    assert r["ok"], r["failures"]
+    inv = r["invariants"]
+    assert inv["data_loss"] == []
+    assert inv["scrub_inconsistencies"] == 0
+    assert inv["health"] == "HEALTH_OK"
+    # the partition actually severed traffic, and replay idempotency
+    # held under dropped acks
+    assert r["fire_counts"].get("net.partition", 0) >= 1
+    assert inv["replay_double_commits"] == 0
+    assert inv["mon_epochs_linear"] is True
+    if r["fire_counts"].get("msg.drop_ack", 0):
+        assert inv["replay_dups_suppressed"] >= 1
+    kinds = {e[0] for e in r["schedule"]}
+    assert "cut" in kinds and "heal" in kinds
+
+
+def test_netsplit_same_seed_identical_schedule_and_fires():
+    """Same-seed netsplit thrash twice => identical schedules and
+    fire counts (the ISSUE 6 acceptance determinism clause)."""
+    a = _run_netsplit(seed=21, cycles=3, objects=3,
+                      writes_per_cycle=2)
+    b = _run_netsplit(seed=21, cycles=3, objects=3,
+                      writes_per_cycle=2)
+    assert a["schedule"] == b["schedule"]
+    assert a["fire_counts"] == b["fire_counts"]
+    c = _run_netsplit(seed=22, cycles=3, objects=3,
+                      writes_per_cycle=2)
+    assert c["schedule"] != a["schedule"]
+
+
+def test_netsplit_cli_json_report():
+    """`ceph thrash --netsplit --json` emits the extended invariant
+    report (replay + epoch-linearity fields) and exits by outcome."""
+    import io
+    import json
+    from ceph_tpu.tools import ceph_cli
+    out = io.StringIO()
+    rc = ceph_cli.main(["thrash", "--seed", "2", "--cycles", "2",
+                        "--objects", "3", "--netsplit", "--json"],
+                       out=out)
+    assert rc == 0
+    report = json.loads(out.getvalue())
+    assert report["ok"] is True
+    assert report["netsplit"] is True
+    inv = report["invariants"]
+    assert inv["health"] == "HEALTH_OK"
+    assert inv["replay_double_commits"] == 0
+    assert inv["mon_epochs_linear"] is True
+    assert report["fire_counts"].get("net.partition", 0) >= 1
