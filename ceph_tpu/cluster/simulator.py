@@ -428,11 +428,29 @@ class ClusterSim:
 
     def _log_write(self, pool_id: int, pg: int, name: str,
                    stored_osds) -> None:
-        """Append a MODIFY entry and advance last_complete on every
-        OSD that durably applied this write."""
-        e = self._log(pool_id, pg).append(self.osdmap.epoch, name)
-        for o in stored_osds:
-            self.osds[o].last_complete[(pool_id, pg)] = e.version
+        """Append a MODIFY entry and advance last_complete on the
+        OSDs that durably applied this write and were current through
+        the previous head (see _advance_lc)."""
+        log = self._log(pool_id, pg)
+        prev_head = log.head
+        e = log.append(self.osdmap.epoch, name)
+        self._advance_lc(pool_id, pg, stored_osds, prev_head,
+                         e.version)
+
+    def _advance_lc(self, pool_id: int, pg: int, osds, prev_head,
+                    version) -> None:
+        """Advance last_complete on OSDs that durably applied the log
+        entry `version` — but only those already complete through the
+        PREVIOUS head (the reference's last_complete contract):
+        bumping an OSD with an unrecovered hole past the hole would
+        hide every entry it missed from delta recovery, leaving the
+        dropped shards unrepaired forever (latent data loss once
+        enough other copies fail).  A lagging OSD catches up through
+        recover_delta instead."""
+        for o in osds:
+            if self.osds[o].last_complete.get((pool_id, pg),
+                                              ZERO) >= prev_head:
+                self.osds[o].last_complete[(pool_id, pg)] = version
 
     # ------------------------------------------------------------- pools --
     def create_ec_profile(self, name: str, profile: Dict[str, str]) -> None:
@@ -1588,14 +1606,10 @@ class ClusterSim:
         log = self._log(pool_id, pg)
         prev_head = log.head
         e = log.append(self.osdmap.epoch, name, op=OP_DELETE)
-        for o in up:
-            if o == ITEM_NONE or not self.osds[o].alive:
-                continue
-            # only replicas that were CURRENT advance: bumping a lagging
-            # replica to head would hide every entry it never applied
-            if self.osds[o].last_complete.get((pool_id, pg),
-                                              ZERO) >= prev_head:
-                self.osds[o].last_complete[(pool_id, pg)] = e.version
+        self._advance_lc(pool_id, pg,
+                         (o for o in up
+                          if o != ITEM_NONE and self.osds[o].alive),
+                         prev_head, e.version)
 
     # ----------------------------------------------------------- failure --
     def kill_osd(self, osd: int) -> None:

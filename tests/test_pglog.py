@@ -166,6 +166,53 @@ def test_delete_applied_on_delta_recovery():
     assert not any(k[2] == "doomed" for k in sim.osds[victim].store)
 
 
+def test_later_write_does_not_hide_recovery_hole():
+    """An OSD that missed a write must not have its last_complete
+    bumped past the hole by a LATER write that does land on it —
+    delta recovery would then believe the OSD is current and never
+    rebuild the missing shards (latent data loss once enough other
+    copies fail).  The netsplit soak hit exactly this: a sub-op
+    dropped by msg.drop_op left an object at k shards, steady-state
+    writes hid the gap, and the next single-OSD cut pushed the object
+    below decodability."""
+    sim = make_sim()
+    pool = sim.osdmap.pools[2]
+    # three objects in the SAME PG: shared up set, shared log
+    names: list = []
+    pg0 = None
+    i = 0
+    while len(names) < 3:
+        nm = f"hole-{i}"
+        i += 1
+        pg = sim.object_pg(pool, nm)
+        if pg0 is None:
+            pg0 = pg
+        if pg == pg0:
+            names.append(nm)
+    pre, hole, later = names
+    rng = np.random.default_rng(23)
+    data = {nm: rng.integers(0, 256, size=9000).astype(np.uint8)
+            .tobytes() for nm in names}
+    up = sim.pg_up(pool, pg0)
+    victim = up[0]                      # home of shard 0 for all three
+    sim.put(2, pre, data[pre])          # victim current through here
+    sim.fail_osd(victim)                # undetected: map never moves
+    sim.put(2, hole, data[hole])        # victim misses its shard
+    sim.restart_osd(victim)             # back up, same map epoch
+    sim.put(2, later, data[later])      # lands on victim again
+    key = (2, pg0, hole, 0)
+    assert not sim.osds[victim].has(key)
+    stats = sim.recover_delta(2)
+    # the log-driven pass must still see the victim's gap and repair it
+    assert stats["delta_objects"] >= 1
+    assert sim.osds[victim].has(key)
+    # the endgame the hole would have caused: lose m OTHER holders and
+    # the object must still decode from what recovery rebuilt
+    for o in up[1:3]:
+        sim.fail_osd(o)
+    assert sim.get(2, hole) == data[hole]
+
+
 def test_replicated_put_total_failure_preserves_old_version():
     sim = make_sim()
     import pytest as _pytest
