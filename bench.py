@@ -931,6 +931,136 @@ def bench_process_cluster(k=8, m=3, obj_bytes=256 << 20, batch_n=16,
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def bench_wire_async(n_osds=4, frame_kib=1024, blocking_mib=48,
+                     async_mib=192, secure_mib=48, streams=8,
+                     window=16):
+    """The async multi-stream wire data path (ISSUE 7), decomposed:
+    raw ``put_shard`` wire put throughput into live OSD daemon
+    processes, same frame size and target spread per phase, varying
+    ONE axis at a time:
+
+      * single_stream: the seed's blocking path — ONE WireClient per
+        target, one sealed frame per round trip (this is BENCH r05's
+        ~150 MiB/s wire number).
+      * async_1stream: the async core pinned to 1 stream, window 1,
+        crc data mode — isolates the per-byte crypto win (plaintext
+        payload, crc32 bound into a constant-cost HMAC'd header, vs
+        the stdlib PRF-CTR seal) from any concurrency.
+      * multi_stream: N streams, window 1 — concurrent crypto lanes
+        and sockets, still one frame in flight per stream.
+      * pipelined: N streams, window W — the full data path: frame
+        i+1 encodes while frame i is on the wire, submissions gather
+        at the end (the acceptance ratio is pipelined vs
+        single_stream).
+      * pipelined_secure: same, sealed payloads — what the multi-
+        stream path costs when confidentiality is required.
+    """
+    import gc
+    import shutil
+    import tempfile
+    from ceph_tpu.client.remote import RemoteCluster
+    from ceph_tpu.common.options import config
+    from ceph_tpu.tools.vstart import Vstart, build_cluster_dir
+
+    frame = os.urandom(frame_kib << 10)
+    tmp = tempfile.mkdtemp(prefix="bench-wire-")
+    d = os.path.join(tmp, "cluster")
+    # distinct 1-MiB objects per phase: size the stores so the whole
+    # sweep (~0.7 GiB across the daemons) never trips the allocator
+    build_cluster_dir(d, n_osds=n_osds, osds_per_host=1, fsync=False,
+                      bluestore_device_bytes=2 << 30)
+    v = Vstart(d)
+    v.start(n_osds, hb_interval=60.0)
+    out = {"frame_kib": frame_kib, "n_osds": n_osds,
+           "streams": streams, "window": window}
+    seq = [0]
+    try:
+        rc = RemoteCluster(d)
+        pool = rc.osdmap.pools[1]
+
+        def reqs(mib):
+            n = max(1, (mib << 20) // len(frame))
+            work = []
+            for i in range(n):
+                name = f"wb{seq[0]}"
+                seq[0] += 1
+                pg = rc._pg_for(pool, name)
+                tgt = [o for o in rc._up(pool, pg) if o >= 0][0]
+                work.append((tgt, {"cmd": "put_shard",
+                                   "coll": [1, pg],
+                                   "oid": f"0:{name}",
+                                   "data": frame, "attrs": {}}))
+            return work
+
+        # shared-host noise swings any one measurement by 2x: every
+        # phase is the MEDIAN of `reps` independent runs
+        reps = 3
+
+        def blocking_phase(mib):
+            # the seed's wire path: secure frames, one RTT at a time
+            # on one (warmed) connection per target
+            for tgt, req in reqs(1):
+                rc.osd_client(tgt).call(req)
+            work = reqs(mib)
+            t0 = time.perf_counter()
+            for tgt, req in work:
+                rc.osd_client(tgt).call(req)
+            return len(work) * len(frame) / (
+                time.perf_counter() - t0) / 1e9
+
+        out["single_stream_gbps"] = round(statistics.median(
+            blocking_phase(blocking_mib) for _ in range(reps)), 3)
+
+        def async_phase(mib, n_streams, win, mode):
+            from ceph_tpu.cluster.async_objecter import AsyncObjecter
+            config().set("objecter_wire_streams", n_streams)
+            config().set("objecter_wire_window", win)
+            config().set("objecter_wire_mode", mode)
+            try:
+                aio = AsyncObjecter(rc)
+                try:
+                    # warm the stream pools (connect + handshake RTTs
+                    # are setup, not throughput)
+                    for tgt, req in reqs(1):
+                        aio.call(tgt, req)
+                    vals = []
+                    for _ in range(reps):
+                        work = reqs(mib)
+                        t0 = time.perf_counter()
+                        comps = [aio.call_async(tgt, req)
+                                 for tgt, req in work]
+                        for r, err in aio.gather(comps):
+                            if err is not None:
+                                raise err
+                        t = time.perf_counter() - t0
+                        vals.append(len(work) * len(frame) / t / 1e9)
+                    return statistics.median(vals)
+                finally:
+                    aio.close()
+            finally:
+                config().clear("objecter_wire_streams")
+                config().clear("objecter_wire_window")
+                config().clear("objecter_wire_mode")
+
+        out["async_1stream_gbps"] = round(
+            async_phase(blocking_mib, 1, 1, "crc"), 3)
+        out["multi_stream_gbps"] = round(
+            async_phase(async_mib, streams, 1, "crc"), 3)
+        out["pipelined_gbps"] = round(
+            async_phase(async_mib, streams, window, "crc"), 3)
+        out["pipelined_secure_gbps"] = round(
+            async_phase(secure_mib, streams, window, "secure"), 3)
+        out["speedup_pipelined_vs_single"] = round(
+            out["pipelined_gbps"] / max(out["single_stream_gbps"],
+                                        1e-9), 1)
+        rc.close()
+        return out
+    finally:
+        v.stop()
+        gc.collect()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def main():
     out = {"metric": "ec_encode_rs8_3_gbps", "unit": "GB/s"}
     extras = {}
@@ -974,6 +1104,12 @@ def main():
                 obj_bytes=32 << 20, rounds=2)
     except Exception as e:
         print(f"# process cluster bench failed: {e}", file=sys.stderr)
+    try:
+        import gc
+        gc.collect()
+        extras["wire_async"] = bench_wire_async()
+    except Exception as e:
+        print(f"# wire async bench failed: {e}", file=sys.stderr)
     try:
         cpu_gbps, cpu_details = bench_ec_cpu_baseline()
         extras["cpu_simd_baseline_gbps"] = round(cpu_gbps, 3)

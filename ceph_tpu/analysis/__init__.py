@@ -8,8 +8,10 @@ daemon plane depend on are checked at lint time, across every module,
 before any test runs.  Five rule families (ids are stable and
 suppressable via ``# noqa: CTL###`` or the checked-in baseline):
 
-  CTL1xx  JAX hot-path hygiene (host syncs / tracer branches /
-          per-call jit inside jit-reachable code)
+  CTL1xx  hot-path hygiene: JAX (host syncs / tracer branches /
+          per-call jit inside jit-reachable code) and the messenger
+          (110: blocking calls reachable from completion-callback
+          context)
   CTL2xx  GF(2^8)/CRUSH dtype invariants (implicit dtypes that drift
           under jax_enable_x64; unpinned array ingestion in ops/)
   CTL3xx  concurrency (static lock-order inversions against the same

@@ -13,6 +13,14 @@ invocation.  These rules walk the jit-reachable call graph
           function (statically-marked args are exempt)
   CTL103  jax.jit(...) built and invoked in one expression — a fresh
           executable (and a retrace) per call
+  CTL110  blocking socket / wait call reachable from messenger
+          CALLBACK context — completion callbacks (``cb=`` /
+          ``set_complete_callback`` / ``add_done_callback``) run on
+          a stream's reader thread (cluster/async_objecter.py), so a
+          callback that blocks on a connect RTT or a future stalls
+          every completion pipelined behind it.  Work handed to an
+          engine via ``.submit(...)`` is deferred off the callback
+          thread and exempt (that is the sanctioned escape hatch).
 """
 from __future__ import annotations
 
@@ -131,7 +139,133 @@ class JitPerCallRule(Rule):
         return out
 
 
+# socket / future verbs that park the calling thread; in callback
+# context (a stream's reader thread) each one stalls every completion
+# pipelined behind it
+_BLOCKING_ATTRS = {
+    "connect", "accept", "recv", "recv_into", "recvfrom", "sendall",
+    "sendmsg", "makefile", "create_connection", "result",
+    "wait_for_complete",
+}
+# deferral verbs: a callable handed to X.submit(...) runs on the
+# engine's workers, NOT in callback context
+_DEFER_ATTRS = {"submit"}
+# registration sites whose callable argument becomes callback-context
+_CB_REG_ATTRS = {"set_complete_callback", "add_done_callback"}
+
+
+class CallbackBlockingRule(Rule):
+    rule_id = "CTL110"
+    name = "msgr-callback-blocking"
+    description = ("blocking socket/wait call reachable from "
+                   "messenger callback context (cb= / done-callback "
+                   "functions run on stream reader threads)")
+
+    @staticmethod
+    def _own_calls(fn: ast.AST) -> List[ast.Call]:
+        """Call nodes executed IN ``fn``'s own frame: nested
+        def/lambda bodies are excluded (they only run if called or
+        registered themselves), and argument subtrees of deferral
+        calls (``X.submit(...)``) are excluded — they execute on the
+        engine, not in callback context."""
+        out: List[ast.Call] = []
+
+        def visit(n: ast.AST) -> None:
+            for ch in ast.iter_child_nodes(n):
+                if isinstance(ch, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef, ast.Lambda)):
+                    continue
+                if isinstance(ch, ast.Call):
+                    out.append(ch)
+                    if isinstance(ch.func, ast.Attribute) and \
+                            ch.func.attr in _DEFER_ATTRS:
+                        visit(ch.func)      # receiver still runs here
+                        continue            # args are deferred
+                visit(ch)
+
+        visit(fn)
+        return out
+
+    def check_module(self, mod: ParsedModule) -> Iterable[Finding]:
+        if mod.evidence:
+            return ()
+        tree = mod.tree
+        aliases = astutil.import_aliases(tree)
+        funcs = {}
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef,
+                                 ast.AsyncFunctionDef)):
+                funcs.setdefault(node.name, []).append(node)
+
+        # roots: callables registered as completion callbacks
+        roots: Set[ast.AST] = set()
+        root_names: dict = {}
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            cands = [kw.value for kw in node.keywords
+                     if kw.arg == "cb"]
+            if isinstance(node.func, ast.Attribute) and \
+                    node.func.attr in _CB_REG_ATTRS and node.args:
+                cands.append(node.args[0])
+            for v in cands:
+                if isinstance(v, ast.Lambda):
+                    roots.add(v)
+                    root_names[v] = "<lambda callback>"
+                else:
+                    base = astutil.dotted(v)
+                    if base:
+                        for fn in funcs.get(base.rsplit(".", 1)[-1],
+                                            ()):
+                            roots.add(fn)
+                            root_names[fn] = fn.name
+        if not roots:
+            return ()
+
+        # propagate through the in-module call graph (name-based,
+        # the hot_functions idiom) to everything callback-reachable
+        reach = set(roots)
+        origin = dict((fn, root_names[fn]) for fn in roots)
+        changed = True
+        while changed:
+            changed = False
+            for fn in list(reach):
+                for call in self._own_calls(fn):
+                    base = astutil.dotted(call.func)
+                    if base is None:
+                        continue
+                    for tgt in funcs.get(base.rsplit(".", 1)[-1], ()):
+                        if tgt not in reach:
+                            reach.add(tgt)
+                            origin[tgt] = origin[fn]
+                            changed = True
+
+        out: List[Finding] = []
+        seen: Set[Tuple[int, str]] = set()
+        for fn in reach:
+            for call in self._own_calls(fn):
+                msg = None
+                if isinstance(call.func, ast.Attribute) and \
+                        call.func.attr in _BLOCKING_ATTRS:
+                    msg = (f".{call.func.attr}() blocks in messenger "
+                           f"callback context (reachable from "
+                           f"callback {origin[fn]!r}) — defer it via "
+                           f"the completion engine's submit()")
+                else:
+                    cn = astutil.resolve(call.func, aliases)
+                    if cn == "time.sleep":
+                        msg = (f"time.sleep() in messenger callback "
+                               f"context (reachable from callback "
+                               f"{origin[fn]!r}) stalls every "
+                               f"completion behind it")
+                if msg and (call.lineno, msg) not in seen:
+                    seen.add((call.lineno, msg))
+                    out.append(self.finding(mod, call.lineno, msg))
+        return out
+
+
 def register(reg) -> None:
     reg.add(HostSyncRule.rule_id, HostSyncRule)
     reg.add(TracerBranchRule.rule_id, TracerBranchRule)
     reg.add(JitPerCallRule.rule_id, JitPerCallRule)
+    reg.add(CallbackBlockingRule.rule_id, CallbackBlockingRule)

@@ -3,9 +3,13 @@
 The reference rewrote librados around asio completions (src/neorados/:
 `RADOS::execute` returning awaitable operations instead of blocking
 calls).  The Python-native analog is asyncio: every I/O verb returns
-an awaitable, fan-out happens with `asyncio.gather`, and the blocking
-librados IoCtx underneath runs on the executor pool the sync AIO
-surface already uses.
+an awaitable and fan-out happens with `asyncio.gather`.  Data verbs
+ride REAL async submission — the underlying ioctx's ``aio_*``
+completions (the async objecter's engine, per-object ordered) wrapped
+via ``asyncio.wrap_future`` — so an `await io.write_full(...)` is the
+same submit→complete machinery the wire core runs, not a thread
+parked on a blocking call.  Verbs with no aio counterpart (snap DDL,
+listings) fall back to a small executor.
 
     async with AsyncRados(rados) as ar:
         io = await ar.open_ioctx("rep")
@@ -42,19 +46,31 @@ class AsyncIoCtx:
         return loop.run_in_executor(self._pool,
                                     lambda: fn(*args, **kw))
 
+    def _aio(self, verb: str, fallback, *args):
+        """Prefer the ioctx's real async submission (an AioCompletion
+        IS a concurrent.futures.Future, so wrap_future turns it into
+        an awaitable with no thread parked on it); executor fallback
+        keeps foreign IoCtx implementations working."""
+        fn = getattr(self._io, verb, None)
+        if fn is not None:
+            return asyncio.wrap_future(fn(*args))
+        return self._run(fallback, *args)
+
     # ------------------------------------------------------------- verbs --
     def write_full(self, oid: str, data: bytes):
-        return self._run(self._io.write_full, oid, data)
+        return self._aio("aio_write_full", self._io.write_full,
+                         oid, data)
 
     def write(self, oid: str, data: bytes, offset: int = 0):
         return self._run(self._io.write, oid, data, offset)
 
     def read(self, oid: str, length: Optional[int] = None,
              offset: int = 0, snap: Optional[int] = None):
-        return self._run(self._io.read, oid, length, offset, snap)
+        return self._aio("aio_read", self._io.read,
+                         oid, length, offset, snap)
 
     def remove(self, oid: str):
-        return self._run(self._io.remove, oid)
+        return self._aio("aio_remove", self._io.remove, oid)
 
     def stat(self, oid: str):
         return self._run(self._io.stat, oid)

@@ -9,7 +9,6 @@ across map changes.
 """
 from __future__ import annotations
 
-import concurrent.futures
 import threading
 from dataclasses import dataclass
 from typing import Dict, List, Optional
@@ -31,14 +30,23 @@ class ObjectStat:
 
 class Rados:
     """Cluster handle (librados `rados_t`): connect() attaches to the
-    mon + cluster, then open_ioctx() per pool."""
+    mon + cluster, then open_ioctx() per pool.
+
+    AIO rides the async objecter's completion engine
+    (cluster/async_objecter.py AioEngine), not a flat thread pool:
+    ops to the SAME object execute strictly in submission order (the
+    librados per-object write-ordering contract two overlapping
+    ``aio_write_full`` calls rely on) while distinct objects run
+    concurrently, and every verb returns an ``AioCompletion`` wearing
+    the librados waiting verbs (is_complete / wait_for_complete /
+    get_return_value / set_complete_callback)."""
 
     def __init__(self, sim: ClusterSim, mon: Monitor):
         self._sim = sim
         self._mon = mon
         self._objecter: Optional[Objecter] = None
-        self._pool = concurrent.futures.ThreadPoolExecutor(
-            max_workers=4, thread_name_prefix="rados-aio")
+        self._aio = None                  # lazy AioEngine
+        self._aio_lock = threading.Lock()
 
     def connect(self) -> "Rados":
         self._objecter = Objecter(self._sim, self._mon)
@@ -70,8 +78,21 @@ class Rados:
     def health(self) -> str:
         return self._mon.health_status(self._sim)
 
+    @property
+    def aio_engine(self):
+        """The completion engine behind the aio verbs — built lazily
+        so a handle that never submits async work starts no threads."""
+        if self._aio is None:
+            with self._aio_lock:
+                if self._aio is None:
+                    from ..cluster.async_objecter import AioEngine
+                    self._aio = AioEngine(workers=4, name="rados-aio")
+        return self._aio
+
     def shutdown(self) -> None:
-        self._pool.shutdown(wait=False)
+        if self._aio is not None:
+            self._aio.close()
+            self._aio = None
         self._objecter = None
 
 
@@ -162,9 +183,24 @@ class IoCtx:
                       if pid == self.pool_id)
 
     # -------------------------------------------------------------- aio --
-    def aio_write_full(self, oid: str, data: bytes
-                       ) -> "concurrent.futures.Future":
-        return self._rados._pool.submit(self.write_full, oid, data)
+    # Async submission through the completion engine: same-object ops
+    # serialize in submission order (overlapping aio_write_full to one
+    # object commit in order; a read submitted after a write observes
+    # it), distinct objects run concurrently across the workers.
+    def _aio_key(self, oid: str):
+        return ("obj", self.pool_id, oid)
 
-    def aio_read(self, oid: str) -> "concurrent.futures.Future":
-        return self._rados._pool.submit(self.read, oid)
+    def aio_write_full(self, oid: str, data: bytes):
+        return self._rados.aio_engine.submit(
+            lambda: self.write_full(oid, data),
+            key=self._aio_key(oid))
+
+    def aio_read(self, oid: str, length: Optional[int] = None,
+                 offset: int = 0, snap: Optional[int] = None):
+        return self._rados.aio_engine.submit(
+            lambda: self.read(oid, length, offset, snap),
+            key=self._aio_key(oid))
+
+    def aio_remove(self, oid: str):
+        return self._rados.aio_engine.submit(
+            lambda: self.remove(oid), key=self._aio_key(oid))

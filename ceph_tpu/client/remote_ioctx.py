@@ -115,6 +115,32 @@ class RemoteIoCtx:
             return data[offset:]
         return data[offset:offset + length]
 
+    # -------------------------------------------------------------- aio --
+    # Real async submission (librados aio_*): ops ride the cluster
+    # handle's async-objecter completion engine under a per-object
+    # key, so overlapping ops on one object execute in submission
+    # order while distinct objects run concurrently — same engine,
+    # same key-space as RemoteCluster.aio_put, so mixing the two
+    # surfaces on one object still serializes correctly.
+    def _aio_key(self, oid: str):
+        return ("obj", self.pool_id, oid)
+
+    def aio_write_full(self, oid: str, data: bytes):
+        buf = bytes(data)
+        return self._rc.aio.engine.submit(
+            lambda: self.write_full(oid, buf),
+            key=self._aio_key(oid))
+
+    def aio_read(self, oid: str, length: Optional[int] = None,
+                 offset: int = 0, snap: Optional[int] = None):
+        return self._rc.aio.engine.submit(
+            lambda: self.read(oid, length, offset, snap),
+            key=self._aio_key(oid))
+
+    def aio_remove(self, oid: str):
+        return self._rc.aio.engine.submit(
+            lambda: self.remove(oid), key=self._aio_key(oid))
+
     def _shard0_probe(self, oid: str, cmd: str):
         """No-payload probe against the acting set (authoritative
         after peering); non-members are swept only when the acting set

@@ -286,17 +286,31 @@ class BlueStore:
         c1 = (s1 + self.min_alloc - 1) // self.min_alloc
         blocks = self._blob_block_list(blob)
         parts = []
-        for ci in range(c0, c1):
-            blk = blocks[ci]
-            want = min(self.min_alloc,
+        # ONE device read per contiguous device run (crc verification
+        # stays per-block on the slices) — the read-side twin of
+        # _make_blob's batched writes
+        ci = c0
+        while ci < c1:
+            cj = ci + 1
+            while cj < c1 and blocks[cj] == blocks[cj - 1] + 1:
+                cj += 1
+            want = min((cj - ci) * self.min_alloc,
                        blob.stored_len - ci * self.min_alloc)
-            buf = os.pread(self._dev, want, blk * self.min_alloc)
-            if len(buf) != want or (
-                    check and zlib.crc32(buf) != blob.csums[ci]):
+            buf = os.pread(self._dev, want, blocks[ci] * self.min_alloc)
+            if len(buf) != want:
                 raise ChecksumError(
-                    f"blob block {ci} @dev {blk}: data fails "
-                    f"checksum (EIO)")
+                    f"blob blocks {ci}..{cj} @dev {blocks[ci]}: "
+                    f"short device read (EIO)")
+            mv = memoryview(buf)
+            for k in range(ci, cj):
+                lo = (k - ci) * self.min_alloc
+                chunk = mv[lo:lo + self.min_alloc]
+                if check and zlib.crc32(chunk) != blob.csums[k]:
+                    raise ChecksumError(
+                        f"blob block {k} @dev {blocks[k]}: data "
+                        f"fails checksum (EIO)")
             parts.append(buf)
+            ci = cj
         joined = b"".join(parts)
         lo = s0 - c0 * self.min_alloc
         return joined[lo:lo + (s1 - s0)]
@@ -375,13 +389,23 @@ class BlueStore:
                 for s, n in self.alloc.allocate(n_blocks)]
         csums = []
         writes: List[Tuple[int, bytes]] = []
-        blocks: List[int] = []
+        mv = memoryview(stored)
+        ci = 0
+        # ONE device write per contiguous run (not per block): the
+        # checksum granularity stays min_alloc, the syscall count
+        # drops from stored_len/min_alloc to len(runs) — this is the
+        # difference between ~256 pwrites and ~1 for a 1 MiB shard,
+        # and it is what the multi-stream wire path's daemons spend
+        # their time in otherwise
         for start, n in runs:
-            blocks.extend(range(start, start + n))
-        for ci, blk in enumerate(blocks):
-            chunk = stored[ci * self.min_alloc:(ci + 1) * self.min_alloc]
-            csums.append(zlib.crc32(chunk))
-            writes.append((blk * self.min_alloc, chunk))
+            lo = ci * self.min_alloc
+            hi = min(lo + n * self.min_alloc, len(stored))
+            for b in range(ci, ci + n):
+                csums.append(zlib.crc32(
+                    mv[b * self.min_alloc:
+                       min((b + 1) * self.min_alloc, len(stored))]))
+            writes.append((start * self.min_alloc, bytes(mv[lo:hi])))
+            ci += n
         return Blob(flags, raw_len, len(stored), runs, csums,
                     comp_name), writes
 

@@ -33,6 +33,20 @@ class TooManyRetries(IOError):
     pass
 
 
+class WriteBlocked(IOError):
+    """An EC write landed RECOVERABLE (>= k) but sub-``min_size``
+    shards, and the map offers no progress that would change that —
+    the reference refuses to ack such writes (min_size = k+1: a write
+    acked with zero parity headroom is one failure away from loss) and
+    BLOCKS them until the PG heals (src/osd/PrimaryLogPG.cc
+    check_pool_min_size / PG_STATE_DEGRADED wait).  Raised only after
+    the bounded in-objecter probe gave up; the bytes ARE durably
+    applied at >= k, the op just never acked.  Callers that can park
+    and resume (the thrasher's mid-cut ride-outs) re-drive the write
+    after heal; treating this as a plain failure loses the
+    write-is-still-pending distinction."""
+
+
 faults.declare(
     "msg.drop_ack",
     "drop the COMPLETION of a client op after the cluster durably "
@@ -138,8 +152,10 @@ class Objecter:
         try:
             with _tracer().start_span("objecter.op", pool=pool_id,
                                       obj=name) as span:
+                blocked: Optional[WriteBlocked] = None
                 for attempt in range(self.max_retries):
                     transient = False
+                    blocked = None
                     if reqid is not None:
                         hit = self.sim.reqid_cached(reqid)
                         if hit is not None:
@@ -176,6 +192,17 @@ class Objecter:
                             else:
                                 span.set_tag("attempts", attempt + 1)
                                 return result
+                        except WriteBlocked as wb:
+                            # durable at >= k but below the min_size
+                            # write floor: keep probing (map progress
+                            # / recovery may restore headroom), and
+                            # if the budget runs out surface the
+                            # BLOCKED state, not a retry failure
+                            blocked = wb
+                            self._pc.inc("op_blocked_min_size")
+                            top.mark_event("blocked_min_size",
+                                           attempt=attempt)
+                            transient = True
                         except IOError:
                             # transient failure at a CURRENT target
                             # (EIO, injected drop): worth retrying on
@@ -206,6 +233,14 @@ class Objecter:
                         # jitter, on the sim-tick clock (no wall wait)
                         self._pc.tinc("op_backoff_wait_s",
                                       self._backoff.sleep(attempt))
+                if blocked is not None:
+                    # never acked, still pending: the caller may park
+                    # this op and re-drive it after heal (the write is
+                    # durably applied at >= k; a re-drive is an
+                    # idempotent full rewrite)
+                    span.set_tag("error", "blocked_min_size")
+                    error = "blocked_min_size"
+                    raise blocked
                 span.set_tag("error", "retries_exhausted")
                 error = "retries_exhausted"
                 raise TooManyRetries(f"{name}: gave up after "
@@ -233,6 +268,16 @@ class Objecter:
                     f"EC write degraded below k "
                     f"({len(placed)} < {k} shards committed): "
                     f"un-ackable, resend")
+            # the reference's min_size = k+1 write floor: a landing at
+            # exactly k is durable but has ZERO parity headroom until
+            # the next recovery pass — it must not ack.  (min() keeps
+            # a degenerate m=0 profile writable at k.)
+            min_size = min(k + 1, pool.size)
+            if len(placed) < min_size:
+                raise WriteBlocked(
+                    f"EC write below min_size write floor "
+                    f"({len(placed)} < {min_size} shards committed, "
+                    f"k={k}): blocked until the PG heals")
         return placed
 
     def put(self, pool_id: int, name: str, data: bytes) -> List[int]:

@@ -52,7 +52,7 @@ from ..common import faults
 from ..common.op_tracker import tracker as _op_tracker
 from .heartbeat import HeartbeatConfig, HeartbeatMonitor
 from .monitor import Monitor
-from .objecter import Objecter, TooManyRetries
+from .objecter import Objecter, TooManyRetries, WriteBlocked
 
 # (name, mode, n) triples armed by default: the wire axis (in-process
 # messenger frame drops) and the device-EIO axis — the acceptance
@@ -135,6 +135,11 @@ class Thrasher:
         self.partition: Optional[Dict[str, Any]] = None  # active cut
         self.flags_set: List[str] = []    # cluster flags we set
         self.failures: List[str] = []     # broken invariants, as found
+        # writes blocked below the min_size floor mid-cut, PARKED for
+        # re-drive once the cluster can give them parity headroom
+        # (heal / markdown re-home): (pool_id, name, data)
+        self.parked: List[Tuple[int, str, bytes]] = []
+        self.writes_parked = 0            # cumulative park events
 
     # ------------------------------------------------------------ pieces --
     def _log(self, *event: Any) -> None:
@@ -150,6 +155,18 @@ class Thrasher:
         data = self._blob(self.cfg.object_size)
         try:
             self.client.put(pool_id, name, data)
+        except WriteBlocked:
+            # sub-(k+1) landing under a ride-out: the write is durably
+            # applied at >= k (reads see the new bytes) but must not
+            # ack until the PG has parity headroom again — PARK it
+            # first, re-drive after heal/markdown gives the map a way
+            # forward.  A parked write that never unblocks is an
+            # invariant failure at settle, not here.
+            self.parked.append((pool_id, name, data))
+            self.writes_parked += 1
+            self.oracle[(pool_id, name)] = data
+            self._log("write_blocked", pool_id, name)
+            return
         except TooManyRetries as e:
             self.failures.append(f"write {pool_id}/{name} did not "
                                  f"complete: {e}")
@@ -297,6 +314,29 @@ class Thrasher:
             self._log("recover", pool_id, st.get("delta_objects", 0),
                       st.get("backfill_pgs", 0))
 
+    def _unpark(self) -> None:
+        """Re-drive writes parked below the min_size floor — an
+        idempotent full rewrite under a fresh reqid.  Ones that ack
+        unblock; ones still below the floor stay parked for the next
+        pass (heal or markdown must eventually free them: a write
+        still parked at settle end is an invariant failure)."""
+        if not self.parked:
+            return
+        still: List[Tuple[int, str, bytes]] = []
+        for pool_id, name, data in self.parked:
+            try:
+                self.client.put(pool_id, name, data)
+            except WriteBlocked:
+                still.append((pool_id, name, data))
+                continue
+            except TooManyRetries as e:
+                self.failures.append(
+                    f"parked write {pool_id}/{name} failed on "
+                    f"re-drive: {e}")
+                continue
+            self._log("write_unblocked", pool_id, name)
+        self.parked = still
+
     # --------------------------------------------------------------- run --
     def run(self) -> Dict[str, Any]:
         cfg = self.cfg
@@ -331,6 +371,10 @@ class Thrasher:
                         self._heal()
                         self._tick_detection()
                         self._recover()
+                    # parked sub-min_size writes re-drive once the
+                    # cluster moved (heal above, or a non-ride-out
+                    # cut's markdowns re-homed their PGs)
+                    self._unpark()
                 else:
                     self._kill_one()
                     self._tick_detection()
@@ -340,6 +384,7 @@ class Thrasher:
                         self._revive_one()
                         self._tick_detection()
                         self._recover()
+                    self._unpark()
             # settle: stop injecting, bring everyone back, repair
             # until health converges (the reference's thrasher also
             # stops thrashing before its final wait_for_clean)
@@ -358,6 +403,13 @@ class Thrasher:
             while self.down:
                 self._revive_one()
             self._tick_detection()
+            # every parked write must unblock once the cluster is
+            # whole — the min_size floor blocks, it must not lose
+            self._unpark()
+            if self.parked:
+                failures.append(
+                    f"{len(self.parked)} write(s) still blocked "
+                    f"below min_size after full heal")
             health = ""
             health_ticks = cfg.settle_ticks
             for tick in range(cfg.settle_ticks):
@@ -459,6 +511,8 @@ class Thrasher:
                     "replay_dups_suppressed": replay_dups,
                     "mon_epochs_linear": linear,
                     "boots_held": self.mon.boots_held,
+                    "writes_parked": self.writes_parked,
+                    "writes_still_parked": len(self.parked),
                 },
                 "failures": failures,
                 "ok": not failures,

@@ -93,6 +93,32 @@ def seal(key: bytes, plaintext: bytes) -> bytes:
     return b"P" + nonce + ct + tag
 
 
+def seal_parts(key: bytes, parts) -> list:
+    """``seal`` over a scatter-gather payload WITHOUT first joining it:
+    returns the sealed blob as a list of buffers suitable for
+    ``socket.sendmsg`` (wire.py's scatter-gather frame path).  Each
+    plaintext byte is touched exactly once by the cipher XOR and once
+    by the MAC — no intermediate whole-payload assembly.  The AES-GCM
+    path has no streaming API here, so it joins (hardware AES makes
+    the copy irrelevant next to the cipher win)."""
+    if _HAVE_AESGCM:
+        return [seal(key, b"".join(bytes(p) for p in parts))]
+    nonce = secrets.token_bytes(16)
+    total = sum(len(p) for p in parts)
+    ks = _keystream(key, nonce, total)
+    out = [b"P" + nonce]
+    tag = hmac.new(key, b"seal" + nonce, sha256)
+    off = 0
+    for p in parts:
+        n = len(p)
+        ct = _xor(bytes(p), ks[off:off + n])
+        off += n
+        tag.update(ct)
+        out.append(ct)
+    out.append(tag.digest())
+    return out
+
+
 def unseal(key: bytes, blob: bytes) -> bytes:
     fmt = blob[:1]
     if fmt == b"G":

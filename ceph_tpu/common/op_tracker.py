@@ -52,6 +52,10 @@ EVENT_DISPATCHED_DEVICE = "dispatched_device"
 # plane, parallel/data_plane.py) — dump_historic_ops shows which
 # client ops dispatched multi-chip and over how many shards
 EVENT_DISPATCHED_MESH = "dispatched_mesh"
+# the op's frames left on the asynchronous wire path (stream pool,
+# cluster/async_objecter.py) — dump_ops_in_flight between this event
+# and "done" IS the in-flight wire window
+EVENT_DISPATCHED_WIRE = "dispatched_wire"
 EVENT_DONE = "done"
 
 # per-stage histogram keys: (from_event, to_event) -> perf key
@@ -61,6 +65,7 @@ _STAGE_HISTS = (
     (EVENT_REACHED_OSD, EVENT_DISPATCHED_DEVICE, "stage_osd_to_device_s"),
     (EVENT_DISPATCHED_DEVICE, EVENT_DONE, "stage_device_to_done_s"),
     (EVENT_DISPATCHED_MESH, EVENT_DONE, "stage_mesh_to_done_s"),
+    (EVENT_DISPATCHED_WIRE, EVENT_DONE, "stage_wire_to_done_s"),
 )
 
 _ids = itertools.count(1)

@@ -321,6 +321,22 @@ _TABLE: Tuple[Option, ...] = (
     Option("op_tracker_max_inflight", TYPE_INT, 1024,
            "bound on the in-flight tracking table; ops past it run "
            "untracked (counted as op_tracker.ops_untracked)", min=1),
+    Option("objecter_wire_streams", TYPE_INT, 4,
+           "parallel pipelined connections per OSD daemon in the "
+           "async objecter's stream pool (the ms_async_op_threads / "
+           "multi-connection fan-out role): one logical op's k+m "
+           "shard fan-out stripes across them", min=1),
+    Option("objecter_wire_window", TYPE_INT, 16,
+           "per-stream send window (frames in flight before submit "
+           "blocks) — the Throttle role on the async wire path",
+           min=1),
+    Option("objecter_wire_mode", TYPE_STR, "crc",
+           "data mode of async objecter streams after the cephx "
+           "handshake (reference ms_client_mode): 'crc' = "
+           "plaintext payload, crc32 bound into the HMAC'd header "
+           "(integrity only, the reference's intra-cluster default), "
+           "'secure' = sealed payloads",
+           enum_values=("crc", "secure")),
 )
 
 _config: Optional[Options] = None

@@ -105,6 +105,53 @@ def test_ctl103_jit_per_call(tmp_path):
     assert res.findings[0].line == 4
 
 
+def test_ctl110_blocking_call_in_callback_context(tmp_path):
+    """ISSUE 7: completion callbacks run on stream reader threads —
+    a callback that blocks (socket RTT, future wait, sleep) stalls
+    every completion pipelined behind it.  Deferral through an
+    engine's submit() is the sanctioned escape hatch."""
+    write(tmp_path, "cluster/ao.py", """\
+        import time
+
+        def issue(pool, sock, engine, meta):
+            def _cb(result, exc):
+                if exc is not None:
+                    sock.connect(("peer", 1))      # blocks reader
+                    helper()
+
+            def _fin(result, exc):
+                engine.submit(lambda: sock.sendall(meta))  # deferred
+
+            pool.submit(meta, cb=_cb)
+            pool.submit(meta, cb=_fin)
+
+        def helper():
+            time.sleep(0.5)                        # via _cb: flagged
+
+        def unregistered(sock):
+            sock.recv(4096)                        # never a callback
+        """)
+    res = lint(tmp_path, select=["CTL110"])
+    assert rules_of(res) == ["CTL110", "CTL110"]
+    assert sorted(f.line for f in res.findings) == [6, 16]
+    assert any("connect" in f.msg for f in res.findings)
+    assert any("time.sleep" in f.msg for f in res.findings)
+
+
+def test_ctl110_done_callbacks_and_result_wait(tmp_path):
+    write(tmp_path, "cluster/comp.py", """\
+        def hang(comp, other):
+            comp.set_complete_callback(lambda c: other.result())
+
+        def fine(comp, log):
+            comp.add_done_callback(lambda c: log.append(c))
+        """)
+    res = lint(tmp_path, select=["CTL110"])
+    assert rules_of(res) == ["CTL110"]
+    assert res.findings[0].line == 2
+    assert "result" in res.findings[0].msg
+
+
 # --------------------------------------- CTL2xx: dtype invariants ---
 
 def test_ctl201_implicit_dtype_scoped_to_ops_placement(tmp_path):

@@ -269,3 +269,94 @@ def test_client_partitioned_from_mon_sees_no_new_epochs():
         assert client.osdmap.epoch == sim.osdmap.epoch
     finally:
         sim.shutdown()
+
+
+# ------------------------------------------------- min_size write floor --
+
+def _cut_ec_upset(sim, name, n_cut):
+    """Arm a nodown-ride-out-shaped cut severing ``n_cut`` members of
+    ``name``'s EC up set from everyone else (no heartbeat ticks run,
+    so the map never moves — the operator-flags ride-out seen from
+    the data path)."""
+    pool = sim.osdmap.pools[2]
+    pg = sim.object_pg(pool, name)
+    up = sim.pg_up(pool, pg)
+    minority = [f"osd.{o}" for o in up[:n_cut]]
+    rest = ["client", "mon"] + [f"osd.{o.id}" for o in sim.osds
+                                if f"osd.{o.id}" not in minority]
+    faults.arm("net.partition", groups=[rest, minority])
+    return up
+
+
+def test_min_size_floor_blocks_write_at_exactly_k():
+    """The reference's min_size = k+1 write floor: a landing at
+    exactly k shards (all parity headroom severed) is durable but
+    must NOT ack — it surfaces as WriteBlocked (still pending), the
+    bytes are readable at >= k, and a re-drive after heal acks."""
+    from ceph_tpu.cluster.objecter import WriteBlocked
+    sim = make_sim(k=2, m=2)            # 4 shards on 4 hosts
+    try:
+        mon = Monitor(sim.osdmap, failure_reports_needed=2)
+        client = Objecter(sim, mon, max_retries=4, seed=7)
+        v1 = b"v1" * 4096
+        assert len(client.put(2, "obj", v1)) == 4
+        _cut_ec_upset(sim, "obj", 2)    # leaves exactly k landable
+        v2 = b"v2" * 4096
+        with pytest.raises(WriteBlocked):
+            client.put(2, "obj", v2)
+        from ceph_tpu.common.perf_counters import perf
+        assert perf("objecter").get("op_blocked_min_size") >= 1
+        # durably applied at k: degraded reads already see v2
+        assert client.get(2, "obj") == v2
+        # heal -> the parked op's re-drive acks with headroom
+        faults.disarm("net.partition")
+        assert len(client.put(2, "obj", v2)) == 4
+        assert client.get(2, "obj") == v2
+    finally:
+        sim.shutdown()
+
+
+def test_min_size_floor_acks_at_k_plus_1():
+    """One severed member leaves k+1 landable shards: at the floor,
+    not below it — the write must ack (blocking here would turn every
+    single-OSD hiccup into a stall)."""
+    sim = make_sim(k=2, m=2)
+    try:
+        mon = Monitor(sim.osdmap, failure_reports_needed=2)
+        client = Objecter(sim, mon, max_retries=4, seed=8)
+        _cut_ec_upset(sim, "obj", 1)
+        placed = client.put(2, "obj", b"payload" * 512)
+        assert len(placed) == 3         # k+1 exactly
+        assert client.get(2, "obj") == b"payload" * 512
+    finally:
+        sim.shutdown()
+
+
+def test_thrasher_parks_blocked_write_and_unparks_after_heal():
+    """The soak-side contract: a mid-cut sub-(k+1) write PARKS
+    (logged, oracle updated, not a failure) and the first _unpark
+    after heal re-drives it to an ack."""
+    from ceph_tpu.cluster.thrasher import (Thrasher, ThrashConfig,
+                                           build_default_stack)
+    sim, mon = build_default_stack()
+    try:
+        t = Thrasher(sim, mon, [2],
+                     ThrashConfig(seed=11, netsplit=True))
+        name = "thrash-0"
+        up = _cut_ec_upset(sim, name, 2)
+        t._write(2, name)
+        assert t.writes_parked == 1 and len(t.parked) == 1
+        assert ("write_blocked", 2, name) in t.schedule
+        assert not t.failures
+        # still parked while the cut holds
+        t._unpark()
+        assert len(t.parked) == 1
+        faults.disarm("net.partition")
+        t._unpark()
+        assert not t.parked
+        assert ("write_unblocked", 2, name) in t.schedule
+        assert not t.failures
+        # the oracle carried the blocked write's bytes throughout
+        assert t.client.get(2, name) == t.oracle[(2, name)]
+    finally:
+        sim.shutdown()
