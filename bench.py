@@ -1061,6 +1061,71 @@ def bench_wire_async(n_osds=4, frame_kib=1024, blocking_mib=48,
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def bench_crash_recovery(n_wal_batches=1500, batch_kib=8,
+                         n_deferred=512, deferred_kib=4):
+    """Cold-restart recovery cost (ISSUE 9, ROADMAP item 2's
+    cold-restart datapoint): a BlueStore with N un-compacted WAL
+    batches plus M pending deferred rows (a power cut landed between
+    their KV commit and the in-place apply) is remounted; the mount's
+    WAL replay and deferred replay are timed separately via the
+    bluestore observability counters."""
+    import shutil
+    import tempfile
+    from ceph_tpu.cluster.bluestore import BlueStore, _DEF
+    from ceph_tpu.cluster.kv import WriteBatch
+    from ceph_tpu.cluster.objectstore import Transaction
+
+    tmp = tempfile.mkdtemp(prefix="bench-crash-recovery-")
+    C = (1, 0)
+    try:
+        dev_bytes = max(1 << 28,
+                        2 * n_wal_batches * batch_kib << 10)
+        st = BlueStore(os.path.join(tmp, "s"), fsync=False,
+                       min_alloc=4096, device_bytes=dev_bytes,
+                       fsck_on_mount=False)
+        st.kv.compact_bytes = 1 << 40     # keep every batch in the WAL
+        payload = b"\xa5" * (batch_kib << 10)
+        for i in range(n_wal_batches):
+            st.apply_transaction(Transaction().write_full(
+                C, f"o{i % 256}", payload))
+        # inject pending deferred rows as a crash would leave them:
+        # committed in the KV, in-place apply never ran
+        dpay = b"\x5a" * (deferred_kib << 10)
+        batch = WriteBatch()
+        for i in range(n_deferred):
+            batch.set("deferred", f"bench.{i:06d}",
+                      _DEF.pack((i % 1024) * 4096, len(dpay)) + dpay)
+        st.kv.submit(batch)
+        wal_bytes = st.kv._wal.tell()
+        st.close()
+
+        t0 = time.perf_counter()
+        st2 = BlueStore(os.path.join(tmp, "s"), fsync=False,
+                        min_alloc=4096, device_bytes=dev_bytes,
+                        fsck_on_mount=False)
+        mount_s = time.perf_counter() - t0
+        rs = st2.kv.replay_stats
+        out = {
+            "wal_batches": n_wal_batches,
+            "wal_bytes": int(wal_bytes),
+            "wal_replay_records": int(rs["records"]),
+            "wal_replay_s": round(rs["seconds"], 4),
+            "wal_replay_gbps": round(
+                rs["bytes"] / max(rs["seconds"], 1e-9) / 1e9, 3),
+            "deferred_entries": int(st2.deferred_replayed),
+            "deferred_bytes": int(st2.deferred_replay_bytes),
+            "deferred_replay_s": round(st2.deferred_replay_s, 4),
+            "deferred_replay_gbps": round(
+                st2.deferred_replay_bytes
+                / max(st2.deferred_replay_s, 1e-9) / 1e9, 3),
+            "mount_s": round(mount_s, 4),
+        }
+        st2.close()
+        return out
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def main():
     out = {"metric": "ec_encode_rs8_3_gbps", "unit": "GB/s"}
     extras = {}
@@ -1110,6 +1175,10 @@ def main():
         extras["wire_async"] = bench_wire_async()
     except Exception as e:
         print(f"# wire async bench failed: {e}", file=sys.stderr)
+    try:
+        extras["crash_recovery"] = bench_crash_recovery()
+    except Exception as e:
+        print(f"# crash recovery bench failed: {e}", file=sys.stderr)
     try:
         cpu_gbps, cpu_details = bench_ec_cpu_baseline()
         extras["cpu_simd_baseline_gbps"] = round(cpu_gbps, 3)

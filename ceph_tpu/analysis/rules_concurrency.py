@@ -34,7 +34,8 @@ _LOCKDEP_TAIL = "LockdepLock"
 
 # storage engines: single coarse leaf lock each, per-op hot path
 _ENGINE_EXEMPT = {"bluestore.py", "filestore.py", "kv.py",
-                  "wal_kv.py", "objectstore.py"}
+                  "wal_kv.py", "objectstore.py", "blockdev.py",
+                  "crashdev.py"}
 
 
 def _lock_ctor_kind(call: ast.Call,

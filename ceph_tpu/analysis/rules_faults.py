@@ -19,6 +19,13 @@ either burns the compiled path or bakes one outcome in at trace time.
           lost-object bug class: a transient wire/device error
           swallowed into ``{}`` reads as "object absent" and the
           next metadata WRITE rebuilds from the fabricated default
+  CTL604  direct write-capable ``open()`` / ``os.write`` /
+          ``os.pwrite`` / ``os.rename`` / ... in a BlockDevice-owned
+          store module (cluster/{bluestore,wal_kv,filestore}.py) —
+          bytes that bypass the barrier API are invisible to the
+          CrashDev recorder, so the crash-state enumeration silently
+          proves nothing about them (exactly the bug class that
+          invalidates the power-loss harness)
 """
 from __future__ import annotations
 
@@ -212,7 +219,81 @@ class SwallowedIOErrorRule(Rule):
         return out
 
 
+# the BlockDevice-owned store modules: every byte they persist must
+# cross cluster/blockdev.py's barrier-recording API, or the CrashDev
+# crash-state recorder is blind to it.  blockdev.py itself is the
+# one place raw I/O is legitimate (it IS the door).
+_STORE_MODULES = frozenset(("bluestore.py", "wal_kv.py",
+                            "filestore.py"))
+
+# os-level write-capable calls a store module must not make directly
+_RAW_OS_WRITERS = frozenset((
+    "os.write", "os.pwrite", "os.writev", "os.pwritev",
+    "os.rename", "os.replace", "os.truncate", "os.ftruncate",
+    "os.unlink", "os.remove", "os.fsync", "os.fdatasync"))
+
+
+def _open_write_mode(call: ast.Call) -> Optional[str]:
+    """The literal mode string of an ``open()`` call when it enables
+    writing ('w'/'a'/'x'/'+'), else None.  A read-only or
+    mode-omitted open is fine — the recorder only needs WRITES."""
+    mode = None
+    if len(call.args) >= 2 and isinstance(call.args[1], ast.Constant):
+        mode = call.args[1].value
+    for kw in call.keywords:
+        if kw.arg == "mode" and isinstance(kw.value, ast.Constant):
+            mode = kw.value.value
+    if isinstance(mode, str) and any(c in mode for c in "wax+"):
+        return mode
+    return None
+
+
+class StoreBypassRule(Rule):
+    rule_id = "CTL604"
+    name = "store-write-bypasses-blockdev"
+    description = ("write-capable open()/os.write/os.pwrite/os.rename"
+                   "/... in a BlockDevice-owned store module — bytes "
+                   "that bypass the barrier API are invisible to the "
+                   "CrashDev crash-state recorder, so power-loss "
+                   "enumeration proves nothing about them")
+
+    def check_module(self, mod: ParsedModule) -> Iterable[Finding]:
+        if mod.evidence:
+            return ()
+        parts = mod.relpath.replace("\\", "/").split("/")
+        if "cluster" not in parts[:-1] or \
+                parts[-1] not in _STORE_MODULES:
+            return ()
+        aliases = astutil.import_aliases(mod.tree)
+        out: List[Finding] = []
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if isinstance(node.func, ast.Name) and \
+                    node.func.id == "open":
+                m = _open_write_mode(node)
+                if m is not None:
+                    out.append(self.finding(
+                        mod, node.lineno,
+                        f"open(..., {m!r}) in a BlockDevice-owned "
+                        f"store module bypasses the barrier API — "
+                        f"the crash-state recorder never sees these "
+                        f"bytes; use cluster.blockdev.BlockDevice"))
+                continue
+            r = astutil.resolve(node.func, aliases)
+            if r in _RAW_OS_WRITERS:
+                out.append(self.finding(
+                    mod, node.lineno,
+                    f"{r}() in a BlockDevice-owned store module "
+                    f"bypasses the barrier API — route it through "
+                    f"cluster.blockdev (BlockDevice / replace / "
+                    f"unlink) so CrashDev can enumerate its "
+                    f"crash states"))
+        return out
+
+
 def register(reg) -> None:
     reg.add(UndeclaredFireRule.rule_id, UndeclaredFireRule)
     reg.add(FireInJitRule.rule_id, FireInJitRule)
     reg.add(SwallowedIOErrorRule.rule_id, SwallowedIOErrorRule)
+    reg.add(StoreBypassRule.rule_id, StoreBypassRule)

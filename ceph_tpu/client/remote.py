@@ -761,10 +761,20 @@ class RemoteCluster:
             # bounded retry with a map refresh between attempts: a
             # dropped connection (daemon restart, injected socket
             # failure) is transient, and the full-object write +
-            # fresh version make the resend idempotent
+            # fresh version make the resend idempotent.  8 attempts
+            # with capped backoff out-wait a kill9'd primary's reboot
+            # window instead of racing it (the pre-ISSUE-9 5-attempt
+            # budget exhausted under CPU contention).
             last: Optional[Exception] = None
-            stamp: Optional[Dict] = None
-            for attempt in range(5):
+            # (session, seq) stamps are PER PRIMARY: a resend to the
+            # SAME primary replays one stamp (its dup table applies
+            # the write at most once), while a re-homed primary gets
+            # its own fresh stamp — sessions are per-OSD state, and
+            # replaying osd.A's stamp at osd.B would smuggle seqs
+            # into an unrelated dedup stream
+            stamps: Dict[int, Dict] = {}
+            attempts = 8
+            for attempt in range(attempts):
                 replicas = [o for o in up if o != ITEM_NONE]
                 if not replicas:
                     # booting cluster / transient all-down map: retry
@@ -778,12 +788,9 @@ class RemoteCluster:
                     up = self._up(pool, pg)
                     continue
                 primary = replicas[0]
+                stamp = stamps.get(primary)
                 if stamp is None:
-                    # ONE (session, seq) for this logical write: every
-                    # resend below replays it, and the primary's dup
-                    # detection applies it at most once (a lost REPLY
-                    # must not become a second apply)
-                    stamp = self._next_stamp(primary)
+                    stamp = stamps[primary] = self._next_stamp(primary)
                 try:
                     r = self.osd_call(primary, {
                         "cmd": "put_object", "coll": coll,
@@ -792,7 +799,8 @@ class RemoteCluster:
                         "replicas": replicas, **stamp})
                 except (OSError, IOError) as e:
                     last = e
-                    if attempt < 4:      # no backoff on the last throw
+                    if attempt < attempts - 1:   # no backoff on the
+                        # last throw
                         self._backoff.sleep(attempt)
                         try:
                             self.refresh_map()
@@ -944,16 +952,23 @@ class RemoteCluster:
 
     def _get_base_direct(self, pool_id: int, name: str,
                          size: Optional[int] = None) -> bytes:
-        """The retrying read against ONE pool, no tier routing."""
+        """The retrying read against ONE pool, no tier routing.  Six
+        attempts with capped backoff + map refresh: a degraded sweep
+        can lose one round to EVERY holder transiently (kill9'd
+        daemons whose sockets refuse, starved survivors, injected
+        drops) — the budget must out-wait a markdown/reboot window
+        rather than race it (the same ISSUE-9 contention fix as the
+        put path)."""
         last: Optional[Exception] = None
-        for attempt in range(3):
+        attempts = 6
+        for attempt in range(attempts):
             try:
                 return self._get_once(pool_id, name, size)
             except RemoteObjectMissing:
                 raise        # definitive miss (targets answered): no retry
             except (OSError, IOError) as e:
                 last = e
-                if attempt < 2:      # no backoff on the last throw
+                if attempt < attempts - 1:   # no backoff on last throw
                     self._backoff.sleep(attempt)
                     try:
                         self.refresh_map()
@@ -1280,12 +1295,20 @@ class RemoteCluster:
             members = [o for o in up if o != ITEM_NONE]
             if not members:
                 continue
+            # every non-member OSD is a potential STRAY log/data
+            # source (the past-interval role): a map flap can have
+            # landed acked writes on a substitute member that has
+            # since dropped out of the set — the primary must be
+            # able to find that log or the objects are unreachable
+            # to recovery forever
+            strays = [int(o) for o in self.addrs
+                      if int(o) not in members]
             r = None
             for attempt in range(3):  # a skipped PG stays unrepaired
                 try:
                     r = self.osd_call(members[0], {
                         "cmd": "recover_pg", "coll": [pool_id, pg],
-                        "members": members})
+                        "members": members, "strays": strays})
                     break
                 except (OSError, IOError):
                     self._backoff.sleep(attempt)

@@ -44,13 +44,16 @@ from __future__ import annotations
 import os
 import struct
 import threading
+import time
 import zlib
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
 from ..common.compressor import compressors
+from ..common.perf_counters import perf as _perf
 from ..native_bridge import AllocatorError, BitmapAllocator
-from .kv import WriteBatch
+from .blockdev import BlockDevice
+from .kv import WriteBatch, rm_object_rows
 from .objectstore import (ChecksumError, Coll, ObjectStoreError,
                           OP_OMAP_RM, OP_OMAP_SET, OP_REMOVE, OP_SETATTR,
                           OP_TOUCH, OP_TRUNCATE, OP_WRITE, OP_WRITE_FULL,
@@ -216,12 +219,22 @@ class BlueStore:
                       if compression else None)
         self._comp_name = compression
         dev_path = os.path.join(path, "block")
-        flags = os.O_RDWR | os.O_CREAT
-        self._dev = os.open(dev_path, flags, 0o644)
-        os.ftruncate(self._dev, self.device_bytes)
+        # the block device behind the barrier API: every data byte
+        # this store persists is visible to the crash-state recorder
+        # (cluster/blockdev.py), and the device.* power-loss
+        # faultpoints fire inside it
+        self._dev = BlockDevice(dev_path, size=self.device_bytes)
         self._lock = threading.RLock()
+        self._pc = _perf("bluestore")
         self.txns_applied = 0
         self.deferred_applied = 0
+        # cold-restart observability: the KV mount already replayed
+        # its WAL — surface records/bytes/duration as perf counters
+        # (the recovery-trajectory datapoint bench_crash_recovery reads)
+        rs = self.kv.replay_stats
+        self._pc.inc("wal_replay_entries", int(rs["records"]))
+        self._pc.inc("wal_replay_bytes", int(rs["bytes"]))
+        self._pc.set("wal_replay_last_s", round(rs["seconds"], 6))
         self.alloc = BitmapAllocator(self.n_blocks)
         try:
             self._rebuild_allocations()
@@ -252,18 +265,29 @@ class BlueStore:
     def _replay_deferred(self) -> None:
         """Re-apply deferred writes whose in-place pwrite may not have
         happened before a crash (idempotent), then drop the rows."""
+        t0 = time.perf_counter()
         rows = list(self.kv.iterate("deferred"))
+        self.deferred_replayed = len(rows)
+        self.deferred_replay_bytes = 0
+        self.deferred_replay_s = 0.0
         if not rows:
             return
         batch = WriteBatch()
         for key, payload in rows:
             dev_off, ln = _DEF.unpack_from(payload, 0)
             data = payload[_DEF.size:_DEF.size + ln]
-            os.pwrite(self._dev, data, dev_off)
+            self._dev.pwrite(data, dev_off)
+            self.deferred_replay_bytes += ln
             batch.rm("deferred", key)
         if self.fsync:
-            os.fsync(self._dev)
+            self._dev.fsync()
         self.kv.submit(batch)
+        self.deferred_replay_s = time.perf_counter() - t0
+        self._pc.inc("deferred_replay_entries", len(rows))
+        self._pc.inc("deferred_replay_bytes",
+                     self.deferred_replay_bytes)
+        self._pc.set("deferred_replay_last_s",
+                     round(self.deferred_replay_s, 6))
 
     # ------------------------------------------------------------ helpers --
     def _onode(self, coll: Coll, oid: str) -> Optional[Onode]:
@@ -296,7 +320,7 @@ class BlueStore:
                 cj += 1
             want = min((cj - ci) * self.min_alloc,
                        blob.stored_len - ci * self.min_alloc)
-            buf = os.pread(self._dev, want, blocks[ci] * self.min_alloc)
+            buf = self._dev.pread(want, blocks[ci] * self.min_alloc)
             if len(buf) != want:
                 raise ChecksumError(
                     f"blob blocks {ci}..{cj} @dev {blocks[ci]}: "
@@ -618,9 +642,9 @@ class BlueStore:
 
         # ---- COW data to the device FIRST (commit point is the KV) ----
         for dev_off, payload in pending:
-            os.pwrite(self._dev, payload, dev_off)
+            self._dev.pwrite(payload, dev_off)
         if pending and self.fsync:
-            os.fsync(self._dev)
+            self._dev.fsync()
 
         batch = WriteBatch()
         def_rows: List[Tuple[str, int, bytes]] = []
@@ -658,13 +682,13 @@ class BlueStore:
         if def_rows:
             clear = WriteBatch()
             for row, dev_off, payload in def_rows:
-                os.pwrite(self._dev, payload, dev_off)
+                self._dev.pwrite(payload, dev_off)
                 clear.rm("deferred", row)
             # the rows may only be durably dropped once the in-place
             # bytes are ON the device — same order as _replay_deferred
             # (clearing first would lose the write on power cut)
             if self.fsync:
-                os.fsync(self._dev)
+                self._dev.fsync()
             self.deferred_applied += len(def_rows)
             self.kv.submit(clear)
         for start, n in to_release:
@@ -776,14 +800,26 @@ class BlueStore:
                 return False
 
     # ------------------------------------------------------------- fsck --
-    def fsck(self) -> List[Tuple[Coll, str]]:
+    def fsck(self, repair: bool = False) -> List[Tuple[Coll, str]]:
         """Walk every onode: csum-verify all stored bytes, bounds-check
         extents, and rebuild the allocation bitmap to detect
-        double-allocated blocks (the BlueStore fsck roles)."""
-        with self._lock:
-            return self._fsck_locked()
+        double-allocated blocks (the BlueStore fsck roles).
 
-    def _fsck_locked(self) -> List[Tuple[Coll, str]]:
+        ``repair=True`` QUARANTINES each inconsistent object instead
+        of just listing it: its onode + xattr/omap rows are dropped in
+        one KV batch, so the object reads as missing and scrub /
+        peering recovery re-replicate it from healthy copies (the
+        fsck --repair stance: a locally-damaged replica must not keep
+        serving EIO when the cluster holds good bytes).  Device blocks
+        stay allocated until the next mount's NCB rebuild — leaking
+        space is safe, releasing blocks a double-allocated twin still
+        references is not.  Counted on perf counters
+        ``bluestore.fsck_errors`` / ``bluestore.fsck_repaired``."""
+        with self._lock:
+            return self._fsck_locked(repair)
+
+    def _fsck_locked(self, repair: bool = False
+                     ) -> List[Tuple[Coll, str]]:
         bad = []
         shadow = BitmapAllocator(self.n_blocks)
         for key, raw in self.kv.iterate("onode"):
@@ -809,15 +845,21 @@ class BlueStore:
                 ok = False
             if not ok:
                 bad.append((coll, oid))
+        if bad:
+            self._pc.inc("fsck_errors", len(bad))
+        if repair and bad:
+            batch = WriteBatch()
+            for coll, oid in bad:
+                rm_object_rows(self.kv, batch, "onode",
+                               _objkey(coll, oid))
+            self.kv.submit(batch)
+            self._pc.inc("fsck_repaired", len(bad))
         return bad
 
     def close(self) -> None:
         with self._lock:
             self.kv.close()
-            try:
-                os.close(self._dev)
-            except OSError:
-                pass
+            self._dev.close()
 
     # --------------------------------------------------------- test hook --
     def corrupt(self, coll: Coll, oid: str, offset: int = 0) -> None:
@@ -836,7 +878,7 @@ class BlueStore:
             blocks = self._blob_block_list(blob)
             dev_off = blocks[s // self.min_alloc] * self.min_alloc + \
                 (s % self.min_alloc)
-            cur = os.pread(self._dev, 1, dev_off)
-            os.pwrite(self._dev, bytes([cur[0] ^ 0xFF]), dev_off)
+            cur = self._dev.pread(1, dev_off)
+            self._dev.pwrite(bytes([cur[0] ^ 0xFF]), dev_off)
             return
         raise ObjectStoreError(f"corrupt: no extent at {offset}")

@@ -146,19 +146,36 @@ class WireServer:
             os.unlink(sock_path)
         self._sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
         self._sock.bind(sock_path)
-        self._sock.listen(64)
+        # deep backlog: injected-drop reconnect storms (every client
+        # path re-dialing at once) overflow a 64-entry queue under
+        # CPU contention and surface as ECONNREFUSED from a
+        # perfectly healthy daemon
+        self._sock.listen(512)
         self._thread = threading.Thread(target=self._accept_loop,
                                         daemon=True, name=f"srv-{service}")
         self._thread.start()
 
     def _accept_loop(self) -> None:
+        import errno
         while not self._stop.is_set():
             try:
                 self._sock.settimeout(0.2)
                 conn, _ = self._sock.accept()
             except socket.timeout:
                 continue
-            except OSError:
+            except OSError as e:
+                # TRANSIENT resource pressure must not kill the
+                # accept loop: an EMFILE spike (fd exhaustion under
+                # reconnect storms / parallel suites) used to return
+                # here, after which the still-bound socket's backlog
+                # filled and every connect was REFUSED forever — a
+                # live daemon that can never be reached again.  Only
+                # a closed listener (stop()) ends the loop.
+                if e.errno in (errno.EMFILE, errno.ENFILE,
+                               errno.ENOBUFS, errno.ENOMEM,
+                               errno.EINTR):
+                    time.sleep(0.05)
+                    continue
                 return
             threading.Thread(target=self._serve_conn, args=(conn,),
                              daemon=True).start()
@@ -716,7 +733,8 @@ class MonDaemon:
             orig = inner.pop("fwd_entity")
             return {"reply": self._handle(orig, inner)}
         if (self.quorum is not None and
-                cmd in self.MUTATIONS + ("report_slow_ops", "health")
+                cmd in self.MUTATIONS + ("report_slow_ops", "health",
+                                         "report_store_health")
                 and self.quorum.leader != self.rank):
             # slow-op rollup state is leader-local (transient health,
             # not a quorum decree): reports AND health queries both
@@ -758,6 +776,16 @@ class MonDaemon:
                         f"{entity} may not report slow ops")
                 self.mon.record_daemon_slow_ops(
                     entity, req.get("summary") or {})
+                return {"ok": True}
+            if cmd == "report_store_health":
+                # boot-fsck damage rollup (STORE_DAMAGED): transient
+                # leader-local health state like the slow-op reports
+                if not entity.startswith("osd."):
+                    raise cx.AuthError(
+                        f"{entity} may not report store health")
+                self.mon.record_store_damage(
+                    entity, int(req.get("errors", 0)),
+                    repaired=int(req.get("repaired", 0)))
                 return {"ok": True}
             if cmd == "health":
                 # PG_DEGRADED needs the batched mapper (a compile in
@@ -1082,6 +1110,23 @@ class OSDDaemon:
             self.store = FileStore(
                 store_path, fsync=bool(spec.get("fsync", True)),
                 fsck_on_mount=fsck_on_mount)
+        # power-loss boot fsck (the CrashDev pipeline): a BlockDevice
+        # power cut dropped a POWER_LOSS marker in the store tree —
+        # quarantine torn objects BEFORE serving (fsck repair=True
+        # drops their onode rows; peering recovery re-replicates) and
+        # report the count up the heartbeat so the mon raises
+        # STORE_DAMAGED.  The count clears on a later clean fsck
+        # (`ceph daemon osd.N store_fsck [repair]`).
+        from .blockdev import (clear_power_loss_markers,
+                               power_loss_markers)
+        self.store_fsck_errors = 0
+        self.store_fsck_repaired = 0
+        self._store_reported = 0
+        if power_loss_markers(store_path):
+            bad = self.store.fsck(repair=True)
+            self.store_fsck_errors = len(bad)
+            self.store_fsck_repaired = len(bad)
+            clear_power_loss_markers(store_path)
         from ..msg.scheduler import MClockScheduler
         self.sched = MClockScheduler()
         self._sched_lock = LockdepLock("osd.sched", recursive=False)
@@ -1118,6 +1163,10 @@ class OSDDaemon:
         # surfaces exist before the first tracked op arrives)
         _op_tracker()
         self.admin = AdminServer()
+        # `ceph daemon osd.N store_fsck [repair]` — the on-demand
+        # store consistency walk (and the operator's way to clear a
+        # STORE_DAMAGED report after recovery healed the quarantine)
+        self.admin.register("store_fsck", self._admin_store_fsck)
         self.admin.serve(os.path.join(cluster_dir,
                                       f"osd.{osd_id}.asok"))
         self._hb_misses: Dict[int, int] = {}
@@ -1795,7 +1844,8 @@ class OSDDaemon:
             return self._scrub_pg(tuple(req["coll"]), req["members"],
                                   bool(req.get("repair", False)))
         if cmd == "recover_pg":
-            return self._recover_pg(tuple(req["coll"]), req["members"])
+            return self._recover_pg(tuple(req["coll"]), req["members"],
+                                    req.get("strays") or [])
         if cmd == "ping":
             return {"osd": self.id, "alive": True}
         if cmd == "status":
@@ -1845,20 +1895,29 @@ class OSDDaemon:
             "data": data, "klass": "background_recovery"}) is not None
 
     def _recover_pg(self, coll: Tuple[int, int],
-                    members: List[int]) -> Dict[str, Any]:
+                    members: List[int],
+                    strays: Optional[List[int]] = None
+                    ) -> Dict[str, Any]:
         """Primary-driven PG recovery running the PeeringState shape
         over the wire (GetInfo -> GetLog -> GetMissing -> Recovering
         or Backfilling, src/osd/PeeringState.h:561):
 
         1. GetInfo: every member reports its log bounds +
-           last_complete (pg_info).
-        2. GetLog: the authority is the member with the newest head;
-           a stale primary first catches ITSELF up from it.
-        3. GetMissing: per member, if the authoritative log still
-           covers its last_complete, recover by LOG DELTA — only the
-           objects the log names after that version (deletes applied
-           as deletes); otherwise fall back to BACKFILL (full listing
-           diff, the pre-peering path).
+           last_complete (pg_info).  ``strays`` — OSDs OUTSIDE the
+           current acting set — are consulted as info/log SOURCES
+           only (the reference's past-interval/stray peering): a
+           write that landed on a substitute member during a map
+           flap must not become unreachable when the map heals and
+           that member drops out of the set — without stray infos
+           the newest log (and its objects) would be invisible to
+           every future recovery pass.
+        2. GetLog: the authority is the info-holder with the newest
+           head; a stale primary first catches ITSELF up from it.
+        3. GetMissing: per MEMBER (never a stray), if the
+           authoritative log still covers its last_complete, recover
+           by LOG DELTA — only the objects the log names after that
+           version (deletes applied as deletes); otherwise fall back
+           to BACKFILL (full listing diff, the pre-peering path).
         4. Recovered members merge the authority's log tail and
            advance last_complete (log_sync).
         Stats record which path each member took so chaos tests can
@@ -1868,13 +1927,20 @@ class OSDDaemon:
         me = self.id
         log = self._pglog(coll)
         infos: Dict[int, Dict] = {me: log.info()}
-        peers = [m for m in members if m != me]
+        stray_set = set(strays or []) - set(members)
+        peers = [m for m in members if m != me] + \
+            [s for s in sorted(stray_set) if s != me]
         for m in peers:
             inf = self._peer_req(m, {"cmd": "pg_info",
                                      "coll": list(coll)})
             if inf is not None:
                 infos[m] = inf
-        # authority = newest head
+        # a stray with an EMPTY log never held this PG — drop it so
+        # the member loop below doesn't try to "recover" it
+        for s in list(stray_set):
+            if s in infos and tuple(infos[s]["head"]) == (0, 0):
+                infos.pop(s)
+        # authority = newest head (member or stray)
         auth = max(infos, key=lambda m: tuple(infos[m]["head"]))
         auth_head = tuple(infos[auth]["head"])
         stats: Dict[str, Any] = {"authority": auth, "mode": {},
@@ -1905,19 +1971,38 @@ class OSDDaemon:
             return [(tuple(vv), o, op) for vv, o, op in r["entries"]]
 
         def listing_of(m):
+            """None on a FAILED peer listing — an unreachable peer
+            must read as 'unknown', never as 'holds nothing': a
+            failure collapsed into an empty set once let a backfill
+            pass copy nothing, then stamp the member current
+            (last_complete = auth head with neither data nor log) —
+            after which every future pass called it clean and the
+            objects were unreachable to recovery forever.  The
+            server-side twin of the CTL603 lost-object class."""
             if m == me:
                 return set(o for o in self.store.list_objects(coll)
                            if not o.startswith("meta:"))
             r = self._peer_req(m, {"cmd": "list_pg",
                                    "coll": list(coll)})
-            return set(o for o in (r or [])
-                       if not o.startswith("meta:"))
+            if r is None:
+                return None
+            return set(o for o in r if not o.startswith("meta:"))
 
         auth_listing = None
         for m in sorted(infos, key=lambda x: x != auth):
-            if m == auth:
+            if m == auth or m in stray_set:
+                # strays are log/data SOURCES, never recovery
+                # targets: the map does not want data there
                 continue
-            lc = tuple(infos[m]["last_complete"])
+            # recovery baseline: last_complete CLAMPED to the
+            # member's own log head.  lc > head is impossible in a
+            # healthy log (they advance together in one txn), so a
+            # member showing it was stamped current by a broken past
+            # pass (the swallowed-failure bug above) — trusting the
+            # lie would read it as clean forever; clamping makes the
+            # delta path re-copy from its true position and HEALS it
+            lc = min(tuple(infos[m]["last_complete"]),
+                     tuple(infos[m]["head"]))
             if lc >= auth_head:
                 stats["mode"][str(m)] = "clean"
                 continue
@@ -1955,7 +2040,19 @@ class OSDDaemon:
                 stats["mode"][str(m)] = "backfill"
                 if auth_listing is None:
                     auth_listing = listing_of(auth)
+                if auth_listing is None:
+                    # the AUTHORITY listing failed: nothing provable
+                    # for this member, and nothing cacheable either
+                    stats["mode"][str(m)] += "-incomplete"
+                    continue
                 have = listing_of(m)
+                if have is None:
+                    # an unreachable MEMBER means this pass proved
+                    # nothing about it — never advance last_complete
+                    # (the cached authority listing stays valid for
+                    # the remaining members)
+                    stats["mode"][str(m)] += "-incomplete"
+                    continue
                 for obj in sorted(auth_listing - have):
                     stats["backfill_objects"] += 1
                     data = self._pull_object(coll, obj, [auth])
@@ -1966,7 +2063,13 @@ class OSDDaemon:
                         stats["copied"] += 1
                     else:
                         complete = False
-                entries = auth_entries_after(lc) or []
+                entries = auth_entries_after(lc)
+                if entries is None:
+                    # the log fetch failed: the data may have moved
+                    # but the member's log view is unproven —
+                    # last_complete must not advance past it
+                    complete = False
+                    entries = []
             # advance last_complete ONLY when every object landed —
             # a partial pass must stay visible to the next peering
             # round, or the gap is masked forever
@@ -2076,6 +2179,40 @@ class OSDDaemon:
             with self._pglog_lock:
                 self._pglogs.pop(tuple(coll), None)
 
+    def _admin_store_fsck(self, args: Dict[str, Any]) -> Dict[str, Any]:
+        """Admin-socket store fsck: walk every object (csum + layout
+        checks); ``repair`` quarantines inconsistencies so recovery
+        re-replicates them.  Updates the health rollup state the
+        heartbeat reports to the mon."""
+        repair = str(args.get("repair", "")).lower() in (
+            "1", "true", "yes", "repair")
+        bad = self.store.fsck(repair=repair)
+        if repair:
+            self.store_fsck_repaired += len(bad)
+            self.store_fsck_errors = 0      # quarantined = consistent
+        else:
+            self.store_fsck_errors = len(bad)
+        return {"backend": type(self.store).__name__,
+                "errors": [[list(map(int, c)), o] for c, o in bad],
+                "n_errors": len(bad),
+                "repaired": len(bad) if repair else 0}
+
+    def _report_store_health(self) -> None:
+        """Roll boot-fsck damage up to the mon (STORE_DAMAGED).  Sent
+        when nonzero, plus one zero report to clear the mon entry
+        once a clean fsck resets the count — the _report_slow_ops
+        pattern."""
+        n = self.store_fsck_errors
+        if n == 0 and not self._store_reported:
+            return
+        try:
+            self.mon_client().call({
+                "cmd": "report_store_health", "osd": self.id,
+                "errors": n, "repaired": self.store_fsck_repaired})
+            self._store_reported = n
+        except (OSError, IOError):
+            self._mon = None
+
     def _report_slow_ops(self) -> None:
         """Roll this process's slow-op summary up to the mon (PR 1's
         known gap: daemon trackers were only visible on their own
@@ -2096,46 +2233,76 @@ class OSDDaemon:
             self._mon = None
 
     def _heartbeat_loop(self, interval: float, grace: int) -> None:
+        # the OUTER catch is the thread's survival contract: this
+        # loop is the daemon's only path back into the map (boot
+        # re-announce, failure reports, map fetch) — ANY exception
+        # that kills it leaves an alive daemon marked down FOREVER,
+        # so non-IO surprises (encoding errors on a mangled reply, a
+        # handler bug) must log and retry next round, the same rule
+        # the mon election loop follows.
         while not self._stop.is_set():
             time.sleep(interval)
             try:
-                self._map = self.mon_client().call({"cmd": "get_map"})
+                self._heartbeat_once(grace)
+            except Exception as e:
+                from ..common.log import dout
+                dout("osd", 5, f"osd.{self.id} heartbeat round "
+                               f"failed: {e!r}")
+                self._mon = None
+
+    def _heartbeat_once(self, grace: int) -> None:
+        try:
+            self._map = self.mon_client().call({"cmd": "get_map"})
+        except (OSError, IOError):
+            self._mon = None
+            return
+        self._report_slow_ops()
+        self._report_store_health()
+        self._purge_dead_pools()
+        up = self._map.get("osd_up", [])
+        # spuriously marked down (missed heartbeats during a stall
+        # or injected drops) but clearly alive: re-announce — the
+        # reference OSD re-sends MOSDBoot when it sees itself down
+        # in a newer map (OSD::_committed_osd_maps)
+        if self.id < len(up) and not up[self.id]:
+            try:
+                self.mon_client().call(
+                    {"cmd": "osd_boot", "osd": self.id})
             except (OSError, IOError):
                 self._mon = None
+        for peer in range(len(up)):
+            if peer == self.id or not up[peer]:
                 continue
-            self._report_slow_ops()
-            self._purge_dead_pools()
-            up = self._map.get("osd_up", [])
-            # spuriously marked down (missed heartbeats during a stall
-            # or injected drops) but clearly alive: re-announce — the
-            # reference OSD re-sends MOSDBoot when it sees itself down
-            # in a newer map (OSD::_committed_osd_maps)
-            if self.id < len(up) and not up[self.id]:
-                try:
-                    self.mon_client().call(
-                        {"cmd": "osd_boot", "osd": self.id})
-                except (OSError, IOError):
-                    self._mon = None
-            for peer in range(len(up)):
-                if peer == self.id or not up[peer]:
-                    continue
-                try:
-                    self.peer_client(peer).call({"cmd": "ping"})
-                    self._hb_misses[peer] = 0
-                except (OSError, IOError):
-                    self.drop_peer(peer)
-                    self._hb_misses[peer] = \
-                        self._hb_misses.get(peer, 0) + 1
-                    if self._hb_misses[peer] >= grace:
-                        try:
-                            self.mon_client().call(
-                                {"cmd": "report_failure", "target": peer})
-                        except (OSError, IOError):
-                            self._mon = None
+            try:
+                self.peer_client(peer).call({"cmd": "ping"})
+                self._hb_misses[peer] = 0
+            except (OSError, IOError):
+                self.drop_peer(peer)
+                self._hb_misses[peer] = \
+                    self._hb_misses.get(peer, 0) + 1
+                if self._hb_misses[peer] >= grace:
+                    try:
+                        self.mon_client().call(
+                            {"cmd": "report_failure", "target": peer})
+                    except (OSError, IOError):
+                        self._mon = None
 
     def run_forever(self, hb_interval: float = 0.5,
                     hb_grace: int = 2) -> None:
-        self.boot()
+        # boot must not be fatal: with socket-failure injection (or a
+        # mon mid-restart) every call of a boot attempt can drop, and
+        # a daemon that EXITS on that leaves a bound-but-dead socket
+        # refusing connections forever — the reference OSD retries
+        # mon contact indefinitely, so do we
+        backoff = ExpBackoff(base=0.2, cap=2.0, seed=self.id)
+        attempt = 0
+        while True:
+            try:
+                self.boot()
+                break
+            except (OSError, IOError):
+                backoff.sleep(attempt)
+                attempt += 1
         t = threading.Thread(target=self._heartbeat_loop,
                              args=(hb_interval, hb_grace), daemon=True)
         t.start()

@@ -40,7 +40,8 @@ import zlib
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
-from .kv import WriteBatch
+from . import blockdev
+from .kv import WriteBatch, rm_object_rows
 from .objectstore import (ChecksumError, Coll, ObjectStoreError,
                           OP_OMAP_RM, OP_OMAP_SET, OP_REMOVE, OP_SETATTR,
                           OP_TOUCH, OP_TRUNCATE, OP_WRITE, OP_WRITE_FULL,
@@ -105,10 +106,11 @@ class FileStore:
         legacy = os.path.join(path, "data.log")
         if self._gen == 0 and os.path.exists(legacy) and \
                 not os.path.exists(self._gen_path(0)):
-            os.replace(legacy, self._gen_path(0))
+            blockdev.replace(legacy, self._gen_path(0))
         self._data_path = self._gen_path(self._gen)
-        self._data = open(self._data_path, "ab")
-        self._rfd = os.open(self._data_path, os.O_RDONLY)
+        # append log behind the BlockDevice barrier API (reads share
+        # the same device handle — no separate read fd)
+        self._data = blockdev.BlockDevice(self._data_path)
         self._lock = threading.RLock()
         self.txns_applied = 0
         self._drop_stale_generations()
@@ -137,10 +139,7 @@ class FileStore:
             gen_part = name[len("data."):-len(".log")]
             if not gen_part.isdigit():
                 continue               # never touch non-generation files
-            try:
-                os.unlink(os.path.join(self.path, name))
-            except OSError:
-                pass
+            blockdev.unlink(os.path.join(self.path, name))
 
     # ---------------------------------------------------------- data log --
     def _append_data(self, payloads: List[bytes]) -> List[Tuple[int, int]]:
@@ -148,16 +147,14 @@ class FileStore:
         caller holds the lock; fsync happens once per transaction."""
         spans = []
         for p in payloads:
-            off = self._data.tell()
-            self._data.write(p)
+            off = self._data.append(p)
             spans.append((off, zlib.crc32(p)))
-        self._data.flush()
         if self.fsync:
-            os.fsync(self._data.fileno())
+            self._data.fsync()
         return spans
 
     def _read_extent(self, log_off: int, ln: int, crc: int) -> bytes:
-        buf = os.pread(self._rfd, ln, log_off)
+        buf = self._data.pread(ln, log_off)
         if len(buf) != ln or zlib.crc32(buf) != crc:
             raise ChecksumError(
                 f"extent @{log_off}+{ln}: data fails checksum (EIO)")
@@ -348,32 +345,25 @@ class FileStore:
             new_gen = self._gen + 1
             new_path = self._gen_path(new_gen)
             batch = WriteBatch()
-            with open(new_path, "wb") as f:
-                for k, blob in self.kv.iterate("obj"):
-                    m = _Meta.decode(blob)
-                    data = bytes(self._materialize(m))
-                    off = f.tell()
-                    f.write(data)
-                    m.extents = [(0, m.size, off, zlib.crc32(data),
-                                  m.size)] if m.size else []
-                    batch.set("obj", k, m.encode())
-                f.flush()
-                if self.fsync:
-                    os.fsync(f.fileno())
-                new_size = f.tell()
+            newdev = blockdev.BlockDevice(new_path, fresh=True)
+            for k, blob in self.kv.iterate("obj"):
+                m = _Meta.decode(blob)
+                data = bytes(self._materialize(m))
+                off = newdev.append(data)
+                m.extents = [(0, m.size, off, zlib.crc32(data),
+                              m.size)] if m.size else []
+                batch.set("obj", k, m.encode())
+            if self.fsync:
+                newdev.fsync()
+            new_size = newdev.tell()
             batch.set("meta", "data_gen", str(new_gen).encode())
             self.kv.submit(batch)               # the atomic flip
             self._data.close()
-            os.close(self._rfd)
             old_path = self._data_path
             self._gen = new_gen
             self._data_path = new_path
-            self._data = open(new_path, "ab")
-            self._rfd = os.open(new_path, os.O_RDONLY)
-            try:
-                os.unlink(old_path)
-            except OSError:
-                pass
+            self._data = newdev
+            blockdev.unlink(old_path)
             return max(0, old_size - new_size)
 
     def _materialize(self, meta: _Meta) -> bytearray:
@@ -452,12 +442,15 @@ class FileStore:
         return sorted(out)
 
     # ------------------------------------------------------------- fsck --
-    def fsck(self) -> List[Tuple[Coll, str]]:
+    def fsck(self, repair: bool = False) -> List[Tuple[Coll, str]]:
         """Verify every object's extents (bounds + CRC); also computes
-        the orphaned data-log fraction into ``last_fsck_orphan_bytes``."""
+        the orphaned data-log fraction into ``last_fsck_orphan_bytes``.
+        ``repair=True`` quarantines inconsistent objects (drops their
+        meta + xattr/omap rows in one batch) so recovery re-replicates
+        them — same contract as BlueStore.fsck(repair=True)."""
         bad = []
         live = 0
-        size = os.path.getsize(self._data_path)
+        size = self._data.tell()
         for k, blob in self.kv.iterate("obj"):
             ck, oid = k.split("/", 1)
             pool, pg = ck.split(".")
@@ -472,6 +465,12 @@ class FileStore:
             except (ObjectStoreError, ChecksumError, struct.error):
                 bad.append((coll, oid))
         self.last_fsck_orphan_bytes = max(0, size - live)
+        if repair and bad:
+            batch = WriteBatch()
+            for coll, oid in bad:
+                rm_object_rows(self.kv, batch, "obj",
+                               _objkey(coll, oid))
+            self.kv.submit(batch)
         return bad
 
     # --------------------------------------------------------- test hook --
@@ -487,18 +486,12 @@ class FileStore:
                     break
             else:
                 pos = m.extents[-1][2]
-            self._data.flush()
-            with open(self._data_path, "r+b") as f:
-                f.seek(pos)
-                b = f.read(1)
-                f.seek(pos)
-                f.write(bytes([b[0] ^ 0xFF]))
+            b = self._data.pread(1, pos)
+            self._data.pwrite(bytes([b[0] ^ 0xFF]), pos)
 
     def close(self) -> None:
         with self._lock:
-            self._data.flush()
             if self.fsync:
-                os.fsync(self._data.fileno())
+                self._data.fsync()
             self._data.close()
-            os.close(self._rfd)
             self.kv.close()

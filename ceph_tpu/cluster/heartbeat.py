@@ -52,6 +52,11 @@ class HeartbeatMonitor:
         self.missed: Dict[int, Dict[int, int]] = {}   # target -> {peer: n}
         self.marked_down: List[int] = []
         self.ticks = 0
+        # boot-fsck damage delivery (the STORE_DAMAGED pipeline): an
+        # OSD whose power-loss boot quarantined objects reports the
+        # count on its next heartbeat; one clearing zero follows on
+        # the tick after, mirroring the daemon tier's slow-op rollup
+        self._damage_reported: Set[int] = set()
         self._down_ticks: Dict[int, int] = {}   # map-down tick counts
         self.auto_outs: List[int] = []
         # deterministic time for the mon's flap-dampening windows: the
@@ -75,6 +80,21 @@ class HeartbeatMonitor:
         self.ticks += 1
         newly_down: List[int] = []
         om = self.sim.osdmap
+        # store-damage rollup: deliver boot-fsck counts to the mon
+        # (only when the reporter can actually reach it), then one
+        # clearing zero once the damage report has been delivered
+        for o in self.sim.osds:
+            if not o.alive or not self._reaches(o.id, "mon"):
+                continue
+            if o.fsck_errors:
+                self.mon.record_store_damage(
+                    f"osd.{o.id}", o.fsck_errors,
+                    repaired=o.fsck_errors)
+                self._damage_reported.add(o.id)
+                o.fsck_errors = 0
+            elif o.id in self._damage_reported:
+                self.mon.record_store_damage(f"osd.{o.id}", 0)
+                self._damage_reported.discard(o.id)
         for osd in range(len(self.sim.osds)):
             if not self.sim.osds[osd].alive or not om.is_up(osd):
                 continue                      # dead OSDs don't ping

@@ -11,7 +11,9 @@ native backend.
 from __future__ import annotations
 
 import bisect
+import struct
 import threading
+import zlib
 from typing import Dict, Iterator, List, Optional, Tuple
 
 
@@ -32,6 +34,21 @@ class WriteBatch:
     def rm_prefix(self, prefix: str) -> "WriteBatch":
         self.ops.append(("rm_prefix", prefix, "", None))
         return self
+
+
+def rm_object_rows(db: "MemDB", batch: WriteBatch, main_prefix: str,
+                   objkey: str) -> None:
+    """Queue removal of one object's main metadata row plus every
+    ``objkey + "\\x00" + key`` xattr/omap row — the quarantine/remove
+    row shape BlueStore and FileStore share (their KV layouts agree
+    on the ``<objkey>\\0<key>`` scheme, so the scan lives once)."""
+    batch.rm(main_prefix, objkey)
+    start = objkey + "\x00"
+    for prefix in ("xattr", "omap"):
+        for k, _ in db.iterate(prefix, start=start):
+            if not k.startswith(start):
+                break
+            batch.rm(prefix, k)
 
 
 class MemDB:
@@ -92,3 +109,19 @@ class MemDB:
 
     def keys(self, prefix: str) -> List[str]:
         return [k for k, _ in self.iterate(prefix)]
+
+    def state_digest(self) -> int:
+        """crc32 over the full sorted (prefix, key, value) state —
+        cheap whole-store equality for crash-consistency checks (two
+        replay orders converged iff their digests match).  Length
+        framing keeps adjacent fields from aliasing."""
+        with self._lock:
+            h = 0
+            for k in self._keys:
+                v = self._data[k]
+                p = k[0].encode()
+                key = k[1].encode()
+                h = zlib.crc32(struct.pack("<III", len(p), len(key),
+                                           len(v)), h)
+                h = zlib.crc32(p + key + v, h)
+            return h

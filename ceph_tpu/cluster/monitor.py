@@ -143,6 +143,11 @@ class Monitor:
         # so SLOW_OPS covers the whole cluster, not just this
         # process's tracker): daemon entity -> last nonzero summary
         self._daemon_slow: Dict[str, Dict[str, Any]] = {}
+        # boot-time fsck damage rollup (the CrashDev pipeline): an OSD
+        # that browned out reports objects its fsck quarantined; the
+        # STORE_DAMAGED health check surfaces them until the daemon
+        # reports clean (or the reporter ages out like slow ops)
+        self._store_damage: Dict[str, Dict[str, Any]] = {}
         # ------ flap dampening (the osd_markdown_log role) ------
         # an OSD marked down >= _flap_count times inside _flap_window
         # gets its next boot HELD for a doubling backoff (capped), so
@@ -547,6 +552,23 @@ class Monitor:
                 f"{num} slow ops, oldest one blocked for "
                 f"{oldest:.3f} sec, daemons [{names}] "
                 f"have slow ops"))
+        # STORE_DAMAGED (the CrashDev boot-fsck rollup): a power-cut
+        # OSD quarantined torn objects at boot — recovery must
+        # re-replicate them, and the operator must know it happened
+        dmg_n = 0
+        dmg_daemons = []
+        for entity, rep in sorted(self._store_damage.items()):
+            if now - float(rep.get("ts", now)) > 600.0:
+                continue              # reporter gone silent: stale
+            if int(rep.get("errors", 0)) > 0:
+                dmg_n += int(rep["errors"])
+                dmg_daemons.append(entity)
+        if dmg_n:
+            checks.append(HealthCheck(
+                "STORE_DAMAGED", "HEALTH_WARN",
+                f"{dmg_n} objects quarantined by boot-time fsck on "
+                f"[{','.join(dmg_daemons)}] (power-loss damage; "
+                f"recovery re-replicates)"))
         return checks
 
     def record_daemon_slow_ops(self, daemon: str,
@@ -561,6 +583,19 @@ class Monitor:
                                              ts=_time.time())
         else:
             self._daemon_slow.pop(daemon, None)
+
+    def record_store_damage(self, daemon: str, errors: int,
+                            repaired: int = 0) -> None:
+        """Ingest one daemon's boot-fsck report (the heartbeat
+        carries it).  A zero-error report clears the entry — the
+        daemon's store fsck'd clean again."""
+        import time as _time
+        if int(errors) > 0:
+            self._store_damage[daemon] = {
+                "errors": int(errors), "repaired": int(repaired),
+                "ts": _time.time()}
+        else:
+            self._store_damage.pop(daemon, None)
 
     def health_status(self, sim=None) -> str:
         checks = self.health(sim)

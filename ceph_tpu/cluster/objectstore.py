@@ -258,13 +258,19 @@ class MemStore:
         return sorted(self._colls)
 
     # ------------------------------------------------------------- fsck --
-    def fsck(self) -> List[Tuple[Coll, str]]:
-        """Verify every object's checksum (BlueStore fsck role)."""
+    def fsck(self, repair: bool = False) -> List[Tuple[Coll, str]]:
+        """Verify every object's checksum (BlueStore fsck role).
+        ``repair=True`` quarantines failing objects (drops them) so
+        recovery re-replicates from healthy copies — the same
+        contract the durable backends implement."""
         bad = []
         for coll, objs in self._colls.items():
             for oid, o in objs.items():
                 if not o.check():
                     bad.append((coll, oid))
+        if repair:
+            for coll, oid in bad:
+                self._colls.get(coll, {}).pop(oid, None)
         return bad
 
     # --------------------------------------------------------- test hook --

@@ -113,6 +113,12 @@ class SimOSD:
         # accounting when the sharded data plane is active
         self.dev = DeviceShardCache(owner=osd_id)
         self.alive = True
+        # power-loss bookkeeping (the device.power_loss sim-tier fire
+        # site): a browned-out OSD runs fsck(repair=True) on its next
+        # boot and reports quarantined objects up the heartbeat so
+        # the mon raises STORE_DAMAGED
+        self.power_lost = False
+        self.fsck_errors = 0
         # last applied PG version per (pool, pg) — the replica-side
         # state delta recovery compares against the authoritative log
         self.last_complete: Dict[Tuple[int, int], Version] = {}
@@ -126,6 +132,19 @@ class SimOSD:
         if not self.alive:
             raise IOError(f"osd.{self.id} is dead")
         coll, oid = self._split(key)
+        if faults.fire("device.power_loss", osd=self.id) is not None:
+            # sim-tier power cut mid-write: a TORN shard lands with a
+            # stale checksum and the OSD browns out — the durable
+            # store is left in exactly the state boot-time
+            # fsck(repair=True) exists to quarantine
+            payload = np.asarray(data, dtype=np.uint8).tobytes()
+            self.objectstore.apply_transaction(
+                Transaction().write_full(coll, oid, payload))
+            self.objectstore.corrupt(coll, oid)
+            self.crash()
+            self.alive = False
+            self.power_lost = True
+            raise IOError(f"osd.{self.id}: power loss mid-write")
         self.objectstore.apply_transaction(
             Transaction().write_full(
                 coll, oid, np.asarray(data, dtype=np.uint8).tobytes()))
@@ -161,6 +180,13 @@ class SimOSD:
         return np.frombuffer(data, dtype=np.uint8)
 
     def delete(self, key: ShardKey) -> None:
+        if self.power_lost:
+            # a browned-out daemon's durable store is FROZEN until it
+            # reboots: the supersession sweeps that normally tidy
+            # stale copies on dead OSDs cannot reach in and hide the
+            # torn state boot-time fsck exists to find — the delete
+            # simply never happens on this store
+            return
         self.dev.evict(key)
         coll, oid = self._split(key)
         if self.objectstore.exists(coll, oid):
@@ -1638,8 +1664,16 @@ class ClusterSim:
         self.osdmap.bump_epoch()
 
     def restart_osd(self, osd: int) -> None:
-        """Process back up, map untouched — pair with Monitor.osd_boot."""
-        self.osds[osd].alive = True
+        """Process back up, map untouched — pair with Monitor.osd_boot.
+        An OSD that died to ``device.power_loss`` runs boot-time
+        fsck(repair=True): torn objects are quarantined (recovery
+        re-replicates them) and the count rides the next heartbeat
+        tick to the mon's STORE_DAMAGED health check."""
+        o = self.osds[osd]
+        o.alive = True
+        if o.power_lost:
+            o.power_lost = False
+            o.fsck_errors = len(o.objectstore.fsck(repair=True))
 
     # ---------------------------------------------------------- recovery --
     def remap_diff(self, pool_id: int, old_up: np.ndarray

@@ -44,6 +44,7 @@ must out-wait the hold, exactly as a real cluster would.
 from __future__ import annotations
 
 import json
+import os
 import random
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Sequence, Tuple
@@ -523,6 +524,228 @@ class Thrasher:
             faults.disarm("net.partition")
 
 
+# ----------------------------------------------------------- powercycle --
+
+@dataclass
+class PowerCycleConfig:
+    """`ceph thrash --powercycle`: power-cycle whole OSD *daemons* —
+    SIGKILL-class death driven by the store-tier power-loss
+    faultpoints, crash-state mutation of the backing BlueStore, then
+    reboot under client load."""
+    seed: int = 0
+    cycles: int = 3
+    n_osds: int = 4
+    objects: int = 6                  # steady-state oracle objects
+    object_size: int = 3072
+    writes_per_cycle: int = 3         # steady overwrites (must ack)
+    kill_writes: int = 14             # fresh-name writes driven while
+    # the armed faultpoint waits to brown the victim out; ones that
+    # ack join the oracle, ones the cut interrupts carry no promise
+    hb_interval: float = 0.25
+    wait_ticks: int = 240             # state-poll budget (0.25s each)
+
+
+class PowerCycleThrasher:
+    """Seeded daemon power-cycle soak (the thrashosds powercycle
+    flavor: qa's thrashosds with powercycle=true).
+
+    Per cycle: seeded steady writes (retried until acked), then a
+    victim OSD gets ``device.power_loss`` or ``device.torn_write``
+    armed over its OWN asok (``exit=True``) — its next store barrier
+    or data write browns it out mid-transaction, exactly a power cut.
+    If the schedule's write budget never touches the victim's store,
+    a SIGKILL fallback keeps the run moving WITHOUT entering the
+    schedule (so schedules stay bit-identical per seed).  The dead
+    store then takes a crash-state mutation (``tear_wal_tail``: bytes
+    off the trailing *partial* WAL record — a fragment that never
+    completed its commit), and the daemon reboots: its boot sees the
+    POWER_LOSS marker, runs fsck(repair=True), and reports
+    STORE_DAMAGED up the heartbeat.
+
+    Invariants: **zero acked-write loss** against the oracle after
+    recovery, fsck errors post-cycle reported (and expected 0 — the
+    WAL/COW ordering makes power cuts lossless), and the same seed
+    reproduces the identical schedule."""
+
+    def __init__(self, cluster_dir: str,
+                 cfg: Optional[PowerCycleConfig] = None):
+        self.dir = cluster_dir
+        self.cfg = cfg or PowerCycleConfig()
+        self.rng = random.Random(self.cfg.seed)
+        self.schedule: List[Tuple] = []
+        self.oracle: Dict[Tuple[int, str], bytes] = {}
+        self.failures: List[str] = []
+        self.fsck_errors_post_cycle = 0
+        self.fsck_repaired = 0
+        self.powercycles = 0
+        self.fallback_kills = 0
+
+    def _log(self, *event: Any) -> None:
+        self.schedule.append(tuple(event))
+
+    def _blob(self, n: int) -> bytes:
+        return bytes(self.rng.getrandbits(8) for _ in range(n))
+
+    def _wait(self, fn, desc: str) -> bool:
+        """Bounded wait-for-state: the budget is POLLS, not wall
+        clock, and a connection error costs one poll (a rebooting
+        daemon must not burn the whole window)."""
+        import time as _time
+        for _ in range(self.cfg.wait_ticks):
+            try:
+                if fn():
+                    return True
+            except (OSError, IOError):
+                pass
+            _time.sleep(0.25)
+        self.failures.append(f"wait-for-state timed out: {desc}")
+        return False
+
+    def _steady_write(self, rc, name: str) -> None:
+        data = self._blob(self.cfg.object_size)
+        # the schedule event is logged BEFORE the attempt: whether
+        # the write needed one try or twenty is timing, and timing
+        # must never leak into the seeded schedule
+        self._log("write", 1, name)
+        # steady writes are the oracle seed and MUST ack — give them
+        # the same poll budget as every other wait-for-state (a
+        # daemon rebooting from the previous cycle can eat the put
+        # path's own retry budget under contention)
+        if self._wait(lambda: rc.put(1, name, data) >= 1,
+                      f"steady write {name} acked"):
+            self.oracle[(1, name)] = data
+
+    def _powercycle(self, rc, v, cycle: int) -> None:
+        from ..common.admin import admin_request
+        cfg = self.cfg
+        victim = self.rng.randrange(cfg.n_osds)
+        point = ("device.power_loss"
+                 if self.rng.random() < 0.5 else "device.torn_write")
+        n_in = 2 + self.rng.randrange(3)
+        self._log("powercycle", cycle, victim, point, n_in)
+        asok = os.path.join(self.dir, f"osd.{victim}.asok")
+        try:
+            admin_request(asok, {
+                "prefix": "fault_injection", "action": "arm",
+                "name": point, "mode": "one_in", "n": n_in,
+                "seed": cfg.seed * 1000 + cycle,
+                "params": {"exit": True}})
+        except (OSError, IOError) as e:
+            self.failures.append(f"arming {point} on osd.{victim} "
+                                 f"failed: {e}")
+        # fresh-name kill-window writes: acked ones join the oracle
+        # (an ack means every landing daemon fsynced), interrupted
+        # ones carry no promise.  The rng draws are unconditional so
+        # the schedule never depends on WHEN the victim dies.
+        for i in range(cfg.kill_writes):
+            name = f"pc-{cycle}-{i}"
+            data = self._blob(cfg.object_size)
+            self._log("kill_write", 1, name)
+            try:
+                rc.put(1, name, data)
+                self.oracle[(1, name)] = data
+            except (OSError, IOError):
+                pass                  # unacked: no promise
+            if not v.alive(f"osd.{victim}"):
+                break
+        if v.alive(f"osd.{victim}"):
+            # the write budget never hit the victim's store: SIGKILL
+            # keeps the soak moving (timing-dependent, so it stays
+            # OUT of the seeded schedule)
+            v.kill9(f"osd.{victim}")
+            self.fallback_kills += 1
+        self.powercycles += 1
+        # crash-state mutation of the dead backing store: tear the
+        # WAL's trailing partial record (never a completed commit)
+        from .crashdev import tear_wal_tail
+        store = os.path.join(self.dir, f"osd.{victim}.store")
+        # torn-byte count is timing-dependent (did a partial record
+        # exist?) so it stays OUT of the seeded schedule; the rng
+        # draw inside tear_wal_tail is unconditional, keeping rng
+        # state — and therefore the schedule — bit-identical per seed
+        tear_wal_tail(store, self.rng)
+        self._log("wal_tear", cycle, victim)
+        # reboot: boot-time fsck(repair) runs iff a POWER_LOSS marker
+        # landed; collect its verdict over the asok
+        v.start_osd(victim, hb_interval=cfg.hb_interval)
+        self._wait(lambda: rc.status()["n_up"] >= cfg.n_osds - 1,
+                   f"osd.{victim} back up after cycle {cycle}")
+        try:
+            r = admin_request(asok, {"prefix": "store_fsck"})["result"]
+            self.fsck_errors_post_cycle += int(r["n_errors"])
+        except (OSError, IOError, KeyError) as e:
+            self.failures.append(
+                f"post-cycle fsck on osd.{victim} failed: {e}")
+        try:
+            rc.refresh_map()
+        except (OSError, IOError):
+            pass
+
+    def run(self) -> Dict[str, Any]:
+        from ..client.remote import RemoteCluster
+        from ..tools.vstart import Vstart, build_cluster_dir
+        cfg = self.cfg
+        build_cluster_dir(self.dir, n_osds=cfg.n_osds,
+                          osds_per_host=1, fsync=True)
+        v = Vstart(self.dir)
+        v.start(cfg.n_osds, hb_interval=cfg.hb_interval)
+        rc = None
+        try:
+            rc = RemoteCluster(self.dir)
+            for j in range(cfg.objects):
+                self._steady_write(rc, f"pcobj-{j}")
+            for cycle in range(cfg.cycles):
+                self._log("cycle", cycle)
+                for _ in range(cfg.writes_per_cycle):
+                    self._steady_write(
+                        rc, f"pcobj-{self.rng.randrange(cfg.objects)}")
+                self._powercycle(rc, v, cycle)
+            # settle: everyone up, recover, then the oracle readback
+            self._wait(lambda: rc.status()["n_up"] == cfg.n_osds,
+                       "all OSDs up at settle")
+            rc.refresh_map()
+            try:
+                rc.recover_pool(1)
+            except (OSError, IOError) as e:
+                self.failures.append(f"settle recovery failed: {e}")
+            lost: List[str] = []
+            for (pool_id, name), want in sorted(self.oracle.items()):
+                try:
+                    got = rc.get(pool_id, name)
+                except (OSError, IOError, KeyError) as e:
+                    lost.append(f"{pool_id}/{name}: unreadable ({e})")
+                    continue
+                if got != want:
+                    lost.append(f"{pool_id}/{name}: payload mismatch")
+            if lost:
+                self.failures.extend(lost)
+            if self.fsck_errors_post_cycle:
+                self.failures.append(
+                    f"boot fsck found {self.fsck_errors_post_cycle} "
+                    f"damaged objects after power cycles (the WAL/COW "
+                    f"ordering should make cuts lossless)")
+            return {
+                "seed": cfg.seed,
+                "cycles": cfg.cycles,
+                "powercycle": True,
+                "schedule": [list(e) for e in self.schedule],
+                "invariants": {
+                    "acked_writes_lost": len(lost),
+                    "objects_checked": len(self.oracle),
+                    "fsck_errors_post_cycle":
+                        self.fsck_errors_post_cycle,
+                    "powercycles": self.powercycles,
+                    "fallback_kills": self.fallback_kills,
+                },
+                "failures": self.failures,
+                "ok": not self.failures,
+            }
+        finally:
+            if rc is not None:
+                rc.close()
+            v.stop()
+
+
 # ------------------------------------------------------------ standalone --
 
 def build_default_stack(n_hosts: int = 8, osds_per_host: int = 3,
@@ -582,8 +805,47 @@ def main(argv: Optional[Sequence[str]] = None, out=None) -> int:
                          "(sometimes one-way, sometimes ridden out "
                          "under noout/nodown), with session-replay "
                          "and mon-epoch-linearity invariants")
+    ap.add_argument("--powercycle", action="store_true",
+                    help="power-cycle whole OSD daemons instead: arm "
+                         "device.power_loss/torn_write over each "
+                         "victim's asok so its store barrier browns "
+                         "it out mid-transaction, tear the dead "
+                         "store's partial WAL tail, reboot (boot "
+                         "fsck reports STORE_DAMAGED) — invariants: "
+                         "zero acked-write loss, fsck clean, "
+                         "bit-identical schedule per seed")
     ap.add_argument("--json", action="store_true")
     ns = ap.parse_args(argv)
+    if ns.powercycle:
+        import tempfile
+        import shutil
+        d = tempfile.mkdtemp(prefix="ceph-powercycle-")
+        try:
+            t = PowerCycleThrasher(d, PowerCycleConfig(
+                seed=ns.seed, cycles=ns.cycles,
+                objects=ns.objects))
+            report = t.run()
+        finally:
+            shutil.rmtree(d, ignore_errors=True)
+        if ns.json:
+            out.write(json.dumps(report, indent=2, sort_keys=True,
+                                 default=str) + "\n")
+        else:
+            inv = report["invariants"]
+            out.write(
+                f"powercycle seed={report['seed']} "
+                f"cycles={report['cycles']}: "
+                f"{inv['powercycles']} power cycles "
+                f"({inv['fallback_kills']} SIGKILL fallbacks), "
+                f"{inv['objects_checked']} objects checked, "
+                f"acked_writes_lost={inv['acked_writes_lost']}, "
+                f"fsck_errors_post_cycle="
+                f"{inv['fsck_errors_post_cycle']}\n")
+            for f in report["failures"]:
+                out.write(f"FAIL: {f}\n")
+            if report["ok"]:
+                out.write("all invariants held\n")
+        return 0 if report["ok"] else 1
     sim, mon = build_default_stack()
     try:
         cfg = ThrashConfig(seed=ns.seed, cycles=ns.cycles,

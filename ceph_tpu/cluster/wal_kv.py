@@ -31,9 +31,11 @@ from __future__ import annotations
 import os
 import struct
 import threading
+import time
 import zlib
 from typing import Iterator, List, Optional, Tuple
 
+from . import blockdev
 from .kv import MemDB, WriteBatch
 
 _MAGIC = 0x57414C31                      # "WAL1"
@@ -82,6 +84,10 @@ class WalDB(MemDB):
         self.compact_bytes = compact_bytes
         self._wlock = threading.Lock()
         self._seq = 0
+        # cold-restart observability: what the last mount's WAL
+        # replay cost (records/bytes applied, seconds) — the
+        # bluestore.wal_replay_* perf counters read this
+        self.replay_stats = {"records": 0, "bytes": 0, "seconds": 0.0}
         os.makedirs(path, exist_ok=True)
         self._mount()
 
@@ -105,7 +111,9 @@ class WalDB(MemDB):
                 os.path.join(self.path, f"snap.{snap_id}"))
         self._replay_wal()
         # reopen the WAL for appends (preserving any replayed tail)
-        self._wal = open(self._wal_path(), "ab")
+        # through the BlockDevice barrier API — every byte this store
+        # persists must be visible to the crash-state recorder
+        self._wal = blockdev.BlockDevice(self._wal_path())
 
     def _load_snapshot(self, path: str) -> None:
         with open(path, "rb") as f:
@@ -125,10 +133,12 @@ class WalDB(MemDB):
         path = self._wal_path()
         if not os.path.exists(path):
             return
+        t0 = time.perf_counter()
         with open(path, "rb") as f:
             blob = f.read()
         off = 0
         good_end = 0
+        replayed = 0
         while off + _HDR.size <= len(blob):
             magic, seq, ln, crc = _HDR.unpack_from(blob, off)
             if magic != _MAGIC:
@@ -141,12 +151,16 @@ class WalDB(MemDB):
                 batch.ops = _decode_batch(payload)
                 MemDB.submit(self, batch)
                 self._seq = seq
+                replayed += 1
             off += _HDR.size + ln
             good_end = off
         if good_end < len(blob):
             # truncate the torn tail so future appends are clean
-            with open(path, "r+b") as f:
-                f.truncate(good_end)
+            dev = blockdev.BlockDevice(path)
+            dev.truncate(good_end)
+            dev.close()
+        self.replay_stats = {"records": replayed, "bytes": good_end,
+                             "seconds": time.perf_counter() - t0}
 
     # ------------------------------------------------------------- write --
     def submit(self, batch: WriteBatch) -> None:
@@ -155,18 +169,20 @@ class WalDB(MemDB):
             self._seq += 1
             rec = _HDR.pack(_MAGIC, self._seq, len(payload),
                             zlib.crc32(payload)) + payload
-            self._wal.write(rec)
-            self._wal.flush()
+            # the durability order IS the contract CrashDev proves:
+            # WAL record on media and fsynced BEFORE the in-memory
+            # index mutates (= before any caller can observe the
+            # batch as committed)
+            self._wal.append(rec)
             if self.fsync:
-                os.fsync(self._wal.fileno())
+                self._wal.fsync()
             MemDB.submit(self, batch)
             if self._wal.tell() >= self.compact_bytes:
                 self._compact_locked()
 
     def sync(self) -> None:
         with self._wlock:
-            self._wal.flush()
-            os.fsync(self._wal.fileno())
+            self._wal.fsync()
 
     # ----------------------------------------------------------- compact --
     def _compact_locked(self) -> None:
@@ -175,31 +191,32 @@ class WalDB(MemDB):
         ops = [("set", p, k, self._data[(p, k)]) for p, k in self._keys]
         payload = _encode_batch(ops)
         tmp = os.path.join(self.path, "snap.tmp")
-        with open(tmp, "wb") as f:
-            f.write(struct.pack("<Q", self._seq))
-            f.write(struct.pack("<II", zlib.crc32(payload), len(payload)))
-            f.write(payload)
-            f.flush()
-            os.fsync(f.fileno())
+        # write-tmp / fsync / atomic-rename: the snapshot's bytes are
+        # on media BEFORE any name points at them (the idiom that
+        # makes blockdev's ordered-rename crash model sound)
+        dev = blockdev.BlockDevice(tmp, fresh=True)
+        dev.append(struct.pack("<Q", self._seq))
+        dev.append(struct.pack("<II", zlib.crc32(payload),
+                               len(payload)))
+        dev.append(payload)
+        dev.fsync()
+        dev.close()
         final = os.path.join(self.path, f"snap.{snap_id}")
-        os.replace(tmp, final)
+        blockdev.replace(tmp, final)
         mtmp = self._manifest_path() + ".tmp"
-        with open(mtmp, "w") as f:
-            f.write(str(snap_id))
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(mtmp, self._manifest_path())
+        dev = blockdev.BlockDevice(mtmp, fresh=True)
+        dev.append(str(snap_id).encode())
+        dev.fsync()
+        dev.close()
+        blockdev.replace(mtmp, self._manifest_path())
         # WAL restart: records up to _seq are in the snapshot
         self._wal.close()
-        self._wal = open(self._wal_path(), "wb")
+        self._wal = blockdev.BlockDevice(self._wal_path(), fresh=True)
         # drop superseded snapshots
         for name in os.listdir(self.path):
             if name.startswith("snap.") and name != f"snap.{snap_id}" \
                     and name != "snap.tmp":
-                try:
-                    os.unlink(os.path.join(self.path, name))
-                except OSError:
-                    pass
+                blockdev.unlink(os.path.join(self.path, name))
 
     def compact(self) -> None:
         with self._wlock:
@@ -208,7 +225,6 @@ class WalDB(MemDB):
     def close(self) -> None:
         with self._wlock:
             if self._wal and not self._wal.closed:
-                self._wal.flush()
                 if self.fsync:
-                    os.fsync(self._wal.fileno())
+                    self._wal.fsync()
                 self._wal.close()

@@ -265,6 +265,28 @@ registry().declare(
     "fires count only actually-severed (src, dst) frames")
 
 
+# declared HERE like net.partition: the power-loss axes are
+# cross-layer points — the BlockDevice shim (cluster/blockdev.py)
+# fires them on real store files, and the sim tier (SimOSD.put)
+# mirrors the contract on its in-memory store, so one declaration
+# covers both fire sites and the asok grammar arms either
+registry().declare(
+    "device.power_loss",
+    "the process browns out AT a barrier (fsync never completes) — "
+    "params exit=False raises PowerLoss in-process instead of dying; "
+    "a POWER_LOSS marker makes the next boot run fsck(repair)")
+registry().declare(
+    "device.torn_write",
+    "a device write persists only a prefix (params keep=bytes) and "
+    "the process dies mid-write — the torn-write half of the "
+    "power-loss crash model (params exit=False raises in-process)")
+registry().declare(
+    "device.lost_write",
+    "the device acks a write that never reaches media (firmware "
+    "write loss); the process continues — per-block checksums, "
+    "fsck and scrub are the detectors")
+
+
 def declare(name: str, doc: str) -> None:
     _REGISTRY.declare(name, doc)
 

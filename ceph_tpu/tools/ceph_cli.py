@@ -245,7 +245,7 @@ DAEMON_COMMANDS = ("dump_ops_in_flight", "dump_historic_ops",
                    "dump_historic_slow_ops", "perf dump", "perf reset",
                    "config show", "config get", "config set",
                    "trace dump", "trace reset", "fault_injection",
-                   "help")
+                   "store_fsck", "help")
 
 
 def cmd_daemon(cluster_dir: str, name: str, words: List[str],
@@ -271,7 +271,12 @@ def cmd_daemon(cluster_dir: str, name: str, words: List[str],
                   f"(expected {path})\n")
         return 1
     req = {"prefix": " ".join(words)}
-    if words[0] == "fault_injection":
+    if words[0] == "store_fsck":
+        # `... daemon osd.N store_fsck [repair]` — on-demand store
+        # consistency walk; `repair` quarantines inconsistencies
+        req = {"prefix": "store_fsck",
+               "repair": "repair" in words[1:]}
+    elif words[0] == "fault_injection":
         req = {"prefix": "fault_injection"}
         rest = words[1:]
         if rest:
@@ -323,10 +328,11 @@ def main(argv: Optional[List[str]] = None,
                          "pg dump POOL | df | scrub POOL | "
                          "daemon NAME dump_ops_in_flight|"
                          "dump_historic_ops|dump_historic_slow_ops|"
-                         "perf dump|fault_injection [...] | "
+                         "perf dump|fault_injection [...]|"
+                         "store_fsck [repair] | "
                          "lint [--check|--json|...] | "
                          "thrash [--seed N --cycles K --netsplit "
-                         "--json]")
+                         "--powercycle --json]")
     ns, extra = ap.parse_known_args(argv)
     if ns.words[0] == "lint":
         # static-analysis surface (ceph_tpu/analysis): needs no

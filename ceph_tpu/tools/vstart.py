@@ -83,7 +83,8 @@ class Vstart:
         self.dir = cluster_dir
         self.procs: Dict[str, subprocess.Popen] = {}
 
-    def _spawn(self, *args: str) -> subprocess.Popen:
+    def _spawn(self, *args: str,
+               log_name: Optional[str] = None) -> subprocess.Popen:
         env = dict(os.environ)
         env["JAX_PLATFORMS"] = "cpu"      # daemons never touch the TPU
         # share the persistent XLA compilation cache: dozens of daemon
@@ -94,12 +95,23 @@ class Vstart:
                        os.path.join(repo, ".jax_cache"))
         env.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS",
                        "0.5")
-        return subprocess.Popen(
+        # daemon stderr lands in <dir>/<name>.log (the vstart.sh
+        # out/ dir role): a daemon that dies to an unhandled
+        # exception must leave its traceback somewhere a human — or
+        # a flake hunt — can find it, not in /dev/null
+        err = subprocess.DEVNULL
+        if log_name is not None:
+            err = open(os.path.join(self.dir, f"{log_name}.log"),
+                       "ab")
+        p = subprocess.Popen(
             [sys.executable, "-m", "ceph_tpu.cluster.daemon", *args],
             env=env, stdout=subprocess.DEVNULL,
-            stderr=subprocess.DEVNULL,
+            stderr=err,
             cwd=os.path.dirname(os.path.dirname(
                 os.path.dirname(os.path.abspath(__file__)))))
+        if err is not subprocess.DEVNULL:
+            err.close()                   # the child owns the fd now
+        return p
 
     @staticmethod
     def _clear_stale_sock(path: str) -> None:
@@ -120,7 +132,8 @@ class Vstart:
         sock = mon_sockets(self.dir)[rank]
         self._clear_stale_sock(sock)
         p = self._spawn("mon", "--cluster-dir", self.dir,
-                        "--id", str(rank))
+                        "--id", str(rank),
+                        log_name=f"mon.{rank}")
         self.procs[f"mon.{rank}"] = p
         if rank == 0:
             self.procs["mon"] = p          # legacy alias
@@ -132,7 +145,8 @@ class Vstart:
         self._clear_stale_sock(sock)
         self.procs[f"osd.{osd_id}"] = self._spawn(
             "osd", "--cluster-dir", self.dir, "--id", str(osd_id),
-            "--hb-interval", str(hb_interval))
+            "--hb-interval", str(hb_interval),
+            log_name=f"osd.{osd_id}")
         self._wait_sock(sock, timeout)
 
     @staticmethod

@@ -151,8 +151,8 @@ def test_deferred_replay_on_mount(tmp_path):
     blk = bs._blob_block_list(o.blobs[0])[0]
     from ceph_tpu.cluster.bluestore import _DEF
     from ceph_tpu.cluster.kv import WriteBatch
-    merged = os.pread(bs._dev, bs.min_alloc, blk * bs.min_alloc)
-    os.pwrite(bs._dev, base[:bs.min_alloc], blk * bs.min_alloc)
+    merged = bs._dev.pread(bs.min_alloc, blk * bs.min_alloc)
+    bs._dev.pwrite(base[:bs.min_alloc], blk * bs.min_alloc)
     bs.kv.submit(WriteBatch().set(
         "deferred", "replayme",
         _DEF.pack(blk * bs.min_alloc, len(merged)) + merged))

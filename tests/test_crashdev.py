@@ -1,0 +1,367 @@
+"""CrashDev — power-loss crash consistency for the storage tier.
+
+The acceptance set (ISSUE 9): every BlueStore/WalDB write crosses the
+BlockDevice recorder; crash-state enumeration (clean barrier cuts +
+seeded torn/lost/reordered tails across >= 3 seeds) reopens each image
+and proves fsck-clean + every acked transaction fully readable + no
+partially-visible transaction; deferred replay converges under
+double-crash; and a deliberately broken ordering (KV commit acked
+before its WAL fsync) is DEMONSTRATED TO FAIL the harness — the proof
+it catches the bug class rather than vacuously passing.
+"""
+import os
+import random
+
+import pytest
+
+from ceph_tpu.cluster import blockdev
+from ceph_tpu.cluster.blockdev import BlockDevice, PowerLoss
+from ceph_tpu.cluster.crashdev import (CrashHarness, crash_points,
+                                       materialize, pending_writes,
+                                       tear_wal_tail)
+from ceph_tpu.common import faults
+
+C = (1, 0)
+
+
+# ------------------------------------------------- recorder mechanics ---
+
+def test_recorder_captures_ordered_stream_with_barriers(tmp_path):
+    rec = blockdev.attach(str(tmp_path))
+    try:
+        dev = BlockDevice(str(tmp_path / "f"), size=4096)
+        dev.pwrite(b"hello", 0)
+        dev.fsync()
+        dev.append(b"tail")
+        dev.close()
+        blockdev.replace(str(tmp_path / "f"), str(tmp_path / "g"))
+    finally:
+        blockdev.detach(rec)
+    ops = [r[0] for r in rec.log]
+    assert ops == ["trunc", "write", "barrier", "write", "rename"]
+    # the un-fsynced tail is pending; the sealed write is not
+    assert pending_writes(rec.log, 4) == [3]
+    assert pending_writes(rec.log, 3) == []
+    # rename seals everything on the file
+    assert pending_writes(rec.log, 5) == []
+    assert crash_points(rec.log) == [3]
+
+
+def test_materialize_replays_drops_and_tears(tmp_path):
+    rec = blockdev.attach(str(tmp_path / "src"))
+    try:
+        os.makedirs(tmp_path / "src")
+        dev = BlockDevice(str(tmp_path / "src" / "f"))
+        dev.append(b"AAAA")
+        dev.fsync()
+        dev.append(b"BBBB")          # pending
+        dev.append(b"CCCC")          # pending
+        dev.close()
+    finally:
+        blockdev.detach(rec)
+    log = rec.snapshot()
+    pend = pending_writes(log, len(log))
+    assert len(pend) == 2
+    # full replay
+    materialize(log, len(log), str(tmp_path / "full"))
+    assert open(tmp_path / "full" / "f", "rb").read() == \
+        b"AAAABBBBCCCC"
+    # drop the middle pending write: a hole of zeros (lost sector)
+    materialize(log, len(log), str(tmp_path / "drop"),
+                drop=[pend[0]])
+    assert open(tmp_path / "drop" / "f", "rb").read() == \
+        b"AAAA\x00\x00\x00\x00CCCC"
+    # tear the last pending write
+    materialize(log, len(log), str(tmp_path / "tear"),
+                tear=(pend[1], 2))
+    assert open(tmp_path / "tear" / "f", "rb").read() == \
+        b"AAAABBBBCC"
+    # sealed writes can never be dropped
+    materialize(log, len(log), str(tmp_path / "seal"), drop=[1])
+    assert open(tmp_path / "seal" / "f", "rb").read() == \
+        b"AAAABBBBCCCC"
+
+
+# -------------------------------------------- the acceptance sweep ---
+
+def test_crash_enumeration_barrier_cuts_and_seeded_images(tmp_path):
+    """Every barrier-cut image plus >= 200 seeded torn/lost/reordered
+    images across >= 3 seeds: reopen => fsck clean, acked
+    transactions fully readable, no Frankenstein objects."""
+    h = CrashHarness(str(tmp_path / "run"), seed=0, n_txns=30)
+    log = h.run_workload()
+    assert sum(1 for r in log if r[0] == "rename") >= 2, \
+        "workload must cross WAL compactions (snapshot + MANIFEST)"
+    rep = h.enumerate_and_check(str(tmp_path / "imgs"),
+                                seeds=(0, 1, 2), images_per_seed=70,
+                                barrier_stride=1)
+    assert rep["seeded"] >= 200
+    assert rep["barrier_cuts"] >= 20
+    assert rep["violations"] == []
+
+
+def test_double_crash_during_deferred_replay_converges(tmp_path):
+    """Crash again DURING an image's recovery (WAL + deferred
+    replay), reopen: contract still holds and every replay order
+    converges to one KV state."""
+    h = CrashHarness(str(tmp_path / "run"), seed=3, n_txns=24)
+    h.run_workload()
+    rep = h.enumerate_and_check(str(tmp_path / "imgs"), seeds=(3,),
+                                images_per_seed=20, barrier_stride=4,
+                                double_crash_every=2)
+    assert rep["double_crash"] >= 3
+    assert rep["violations"] == []
+
+
+def test_broken_ordering_kv_commit_before_wal_fsync_is_caught(
+        tmp_path):
+    """Falsifiability: ack a transaction whose WAL record was never
+    fsynced (kv_fsync=False) and the dropped-tail image MUST lose
+    acked writes — the harness reports it.  A harness that passes
+    this store proves nothing."""
+    # compaction OFF (huge compact_bytes): a snapshot would seal the
+    # acked state behind its fsync+rename and mask the missing WAL
+    # barrier — the probe must keep the acked records in the tail
+    h = CrashHarness(str(tmp_path / "run"), seed=1, n_txns=20,
+                     kv_fsync=False, compact_bytes=1 << 20)
+    h.run_workload()
+    img, upto = h.lost_tail_image(str(tmp_path / "imgs"))
+    problems = h.check_image(img, upto)
+    assert problems, ("the deliberately-broken ordering was NOT "
+                      "caught — the harness is vacuous")
+    assert any("acked" in p for p in problems)
+
+
+def test_correct_ordering_survives_the_same_lost_tail(tmp_path):
+    """The control for the test above: the CORRECT store survives the
+    identical worst-case image (every pending write dropped)."""
+    h = CrashHarness(str(tmp_path / "run"), seed=1, n_txns=20,
+                     compact_bytes=1 << 20)
+    h.run_workload()
+    img, upto = h.lost_tail_image(str(tmp_path / "imgs"))
+    assert h.check_image(img, upto) == []
+
+
+# ------------------------------------------------ faultpoint wiring ---
+
+def test_torn_write_faultpoint_drops_marker_and_stays_recoverable(
+        tmp_path):
+    """device.torn_write (exit=False): the write persists a prefix, a
+    POWER_LOSS marker lands, PowerLoss raises; the torn COW write of
+    the interrupted txn is invisible after remount (fsck clean,
+    committed state intact)."""
+    from ceph_tpu.cluster.bluestore import BlueStore
+    from ceph_tpu.cluster.objectstore import Transaction
+    st = BlueStore(str(tmp_path / "s"), fsync=True, min_alloc=512,
+                   device_bytes=1 << 20, fsck_on_mount=False)
+    st.apply_transaction(
+        Transaction().write_full(C, "safe", b"S" * 2000))
+    fires0 = faults.fire_counts().get("device.torn_write", 0)
+    faults.arm("device.torn_write", mode="nth", n=1,
+               exit=False, keep=100)
+    try:
+        with pytest.raises(PowerLoss):
+            st.apply_transaction(
+                Transaction().write_full(C, "doomed", b"D" * 2000))
+    finally:
+        faults.disarm("device.torn_write")
+    assert faults.fire_counts()["device.torn_write"] == fires0 + 1
+    assert blockdev.power_loss_markers(str(tmp_path / "s"))
+    st.close()
+    st2 = BlueStore(str(tmp_path / "s"), fsync=True, min_alloc=512,
+                    device_bytes=1 << 20, fsck_on_mount=False)
+    assert st2.fsck() == []
+    assert st2.read(C, "safe") == b"S" * 2000
+    assert not st2.exists(C, "doomed")
+    st2.close()
+
+
+def test_lost_write_faultpoint_detected_by_fsck_and_repaired(
+        tmp_path):
+    """device.lost_write: the ack'd write never reaches media; the
+    per-block checksum catches it on read AND fsck(repair=True)
+    quarantines it, counting bluestore.fsck_{errors,repaired}."""
+    from ceph_tpu.cluster.bluestore import BlueStore
+    from ceph_tpu.cluster.objectstore import Transaction
+    from ceph_tpu.common.perf_counters import perf
+    st = BlueStore(str(tmp_path / "s"), fsync=True, min_alloc=512,
+                   device_bytes=1 << 20, fsck_on_mount=False)
+    faults.arm("device.lost_write", mode="nth", n=1)
+    try:
+        st.apply_transaction(
+            Transaction().write_full(C, "ghost", b"G" * 1000))
+    finally:
+        faults.disarm("device.lost_write")
+    with pytest.raises(IOError):
+        st.read(C, "ghost")
+    e0 = perf("bluestore").get("fsck_errors") or 0
+    r0 = perf("bluestore").get("fsck_repaired") or 0
+    bad = st.fsck(repair=True)
+    assert bad == [(C, "ghost")]
+    assert perf("bluestore").get("fsck_errors") == e0 + 1
+    assert perf("bluestore").get("fsck_repaired") == r0 + 1
+    assert st.fsck() == []            # quarantined: store consistent
+    assert not st.exists(C, "ghost")
+    st.close()
+
+
+def test_power_loss_asok_grammar_arms_the_point():
+    """The existing fault_injection admin grammar arms the new
+    points (the thrasher's per-daemon arming path)."""
+    r = faults.admin_handler({
+        "prefix": "fault_injection", "action": "arm",
+        "name": "device.power_loss", "mode": "one_in", "n": 4,
+        "seed": 9, "params": {"exit": False}})
+    try:
+        assert r["armed"] == "device.power_loss"
+        st = faults.status()
+        assert st["armed"]["device.power_loss"]["params"] == \
+            {"exit": False}
+    finally:
+        faults.disarm("device.power_loss")
+
+
+def test_wal_replay_perf_counters_after_remount(tmp_path):
+    """Crash-recovery observability: a remount's WAL replay surfaces
+    entries/bytes/duration on the bluestore perf group."""
+    from ceph_tpu.cluster.bluestore import BlueStore
+    from ceph_tpu.cluster.objectstore import Transaction
+    from ceph_tpu.common.perf_counters import perf
+    st = BlueStore(str(tmp_path / "s"), fsync=True, min_alloc=512,
+                   device_bytes=1 << 20, fsck_on_mount=False)
+    for i in range(5):
+        st.apply_transaction(
+            Transaction().write_full(C, f"o{i}", b"x" * 700))
+    st.close()
+    e0 = perf("bluestore").get("wal_replay_entries") or 0
+    st2 = BlueStore(str(tmp_path / "s"), fsync=True, min_alloc=512,
+                    device_bytes=1 << 20, fsck_on_mount=False)
+    assert st2.kv.replay_stats["records"] >= 6   # superblock + txns
+    assert perf("bluestore").get("wal_replay_entries") >= e0 + 6
+    assert perf("bluestore").get("wal_replay_bytes") > 0
+    assert perf("bluestore").get("wal_replay_last_s") >= 0.0
+    st2.close()
+
+
+def test_filestore_rides_the_blockdev_recorder(tmp_path):
+    """FileStore is routed (not exempted): its appends/gc cross the
+    recorder too, so the same harness machinery applies."""
+    from ceph_tpu.cluster.filestore import FileStore
+    from ceph_tpu.cluster.objectstore import Transaction
+    rec = blockdev.attach(str(tmp_path))
+    try:
+        fs = FileStore(str(tmp_path / "fs"), fsync=True)
+        fs.apply_transaction(
+            Transaction().write_full(C, "o", b"F" * 3000))
+        fs.close()
+    finally:
+        blockdev.detach(rec)
+    writes = [r for r in rec.log if r[0] == "write"
+              and r[1].endswith("data.0.log")]
+    barriers = [r for r in rec.log if r[0] == "barrier"]
+    assert writes and barriers
+
+
+# ----------------------------------------------- sim-tier pipeline ---
+
+def test_sim_power_loss_boot_fsck_raises_store_damaged(tmp_path):
+    """SimOSD power cut: the write tears, the OSD dies; restart runs
+    fsck(repair=True) automatically, the heartbeat reports the
+    quarantine count, and the mon raises STORE_DAMAGED — then the
+    clearing zero report and recovery converge back to readable."""
+    from ceph_tpu.cluster.heartbeat import (HeartbeatConfig,
+                                            HeartbeatMonitor)
+    from ceph_tpu.cluster.monitor import Monitor
+    from ceph_tpu.cluster.thrasher import build_default_stack
+    sim, mon = build_default_stack(n_hosts=4, osds_per_host=2)
+    try:
+        hb = HeartbeatMonitor(sim, mon,
+                              HeartbeatConfig(grace_ticks=1))
+        from ceph_tpu.cluster.objecter import Objecter
+        client = Objecter(sim, mon, max_retries=12, seed=0)
+        client.put(1, "before", b"B" * 4096)
+        # arm for ONE victim write: the cut may interrupt this put
+        # (no promise) — detection ticks + a re-drive follow, the
+        # thrasher's own park/re-drive shape
+        faults.arm("device.power_loss", mode="nth", n=1)
+        try:
+            try:
+                client.put(1, "cut", b"C" * 4096)
+            except IOError:
+                pass              # interrupted mid-fan-out: re-driven
+        finally:
+            faults.disarm("device.power_loss")
+        victims = [o.id for o in sim.osds if not o.alive]
+        assert len(victims) == 1
+        v = victims[0]
+        assert sim.osds[v].power_lost
+        for _ in range(3):
+            hb.tick()             # detection: the death reaches the map
+        client.put(1, "cut", b"C" * 4096)   # idempotent re-drive acks
+        # boot: automatic fsck quarantines the torn shard
+        sim.restart_osd(v)
+        mon.osd_boot(v)
+        assert sim.osds[v].fsck_errors >= 1
+        hb.tick()
+        checks = {c.code: c for c in mon.health(sim)}
+        assert "STORE_DAMAGED" in checks
+        assert f"osd.{v}" in checks["STORE_DAMAGED"].summary
+        # the clearing zero rides the next tick
+        hb.tick()
+        assert "STORE_DAMAGED" not in \
+            {c.code for c in mon.health(sim)}
+        # recovery re-replicates the quarantined shard; data intact
+        for pool_id in (1, 2):
+            sim.recover_delta(pool_id)
+        assert client.get(1, "before") == b"B" * 4096
+        assert client.get(1, "cut") == b"C" * 4096
+    finally:
+        sim.shutdown()
+        faults.reset()
+
+
+# ----------------------------------------------------- WAL surgery ---
+
+def test_tear_wal_tail_only_touches_partial_records(tmp_path):
+    """The powercycle mutation never tears a COMPLETED record (it may
+    carry an acked write); a trailing partial fragment is fair game,
+    and the rng advances identically either way (schedule
+    determinism)."""
+    from ceph_tpu.cluster.wal_kv import WalDB
+    db = WalDB(str(tmp_path / "kv"), fsync=True)
+    for i in range(4):
+        db.set("p", f"k{i}", b"v" * 64)
+    db.close()
+    wal = tmp_path / "kv" / "wal.log"
+    clean = wal.read_bytes()
+    r1, r2 = random.Random(7), random.Random(7)
+    assert tear_wal_tail(str(tmp_path), r1) == 0
+    assert wal.read_bytes() == clean          # untouched
+    # append a partial fragment (a crash mid-append)
+    with open(wal, "ab") as f:
+        f.write(b"\x31\x4c\x41\x57" + b"partial-record-fragment")
+    torn = tear_wal_tail(str(tmp_path), r2)
+    assert torn > 0
+    assert wal.read_bytes()[:len(clean)] == clean
+    assert r1.random() == r2.random()         # rng state identical
+    # the store still mounts to the full committed state
+    db2 = WalDB(str(tmp_path / "kv"), fsync=True)
+    assert db2.get("p", "k3") == b"v" * 64
+    db2.close()
+
+
+# -------------------------------------------------------- CI smoke ---
+
+@pytest.mark.smoke
+def test_crash_smoke_script_checks(tmp_path):
+    """The CI crash smoke (scripts/check_robustness.py
+    run_crash_smoke), run in-process — the check_observability
+    pattern."""
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "check_robustness", os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(
+                __file__))), "scripts", "check_robustness.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    assert mod.run_crash_smoke(str(tmp_path)) == 0

@@ -481,6 +481,66 @@ def test_ctl603_noqa_suppresses(tmp_path):
     assert not lint(tmp_path, select=["CTL603"]).findings
 
 
+def test_ctl604_store_write_bypasses_blockdev(tmp_path):
+    """ISSUE 9: a direct write in a BlockDevice-owned store module is
+    invisible to the CrashDev recorder — the exact bug class that
+    invalidates the power-loss harness."""
+    write(tmp_path, "cluster/bluestore.py", """\
+        import os
+
+        def bad_patch(fd, data, off):
+            os.pwrite(fd, data, off)          # bypasses the recorder
+
+        def bad_log(path, rec):
+            with open(path, "ab") as f:       # raw append log
+                f.write(rec)
+
+        def bad_flip(tmp, final):
+            os.replace(tmp, final)            # unrecorded rename
+
+        def fine_read(path):
+            with open(path, "rb") as f:       # reads are harmless
+                return f.read()
+
+        def fine_default(path):
+            return open(path).read()          # mode omitted: read
+        """)
+    res = lint(tmp_path, select=["CTL604"])
+    assert rules_of(res) == ["CTL604", "CTL604", "CTL604"]
+    assert [f.line for f in res.findings] == [4, 7, 11]
+    assert "barrier API" in res.findings[0].msg
+
+
+def test_ctl604_scoped_to_store_modules(tmp_path):
+    """Only the BlockDevice-owned store modules are in scope —
+    blockdev.py itself (the door) and the rest of cluster/ keep
+    their raw I/O."""
+    code = """\
+        import os
+
+        def writer(fd, data):
+            os.pwrite(fd, data, 0)
+        """
+    write(tmp_path, "cluster/blockdev.py", code)
+    write(tmp_path, "cluster/daemon.py", code)
+    write(tmp_path, "tools/exporter.py", code)
+    assert not lint(tmp_path, select=["CTL604"]).findings
+    write(tmp_path, "cluster/wal_kv.py", code)
+    res = lint(tmp_path, select=["CTL604"])
+    assert rules_of(res) == ["CTL604"]
+    assert res.findings[0].path.endswith("wal_kv.py")
+
+
+def test_ctl604_noqa_suppresses(tmp_path):
+    write(tmp_path, "cluster/filestore.py", """\
+        import os
+
+        def surgery(fd):
+            os.ftruncate(fd, 0)  # noqa: CTL604 -- mkfs-time wipe
+        """)
+    assert not lint(tmp_path, select=["CTL604"]).findings
+
+
 # ------------------------------------------- framework behavior ---
 
 def test_noqa_inline_suppression(tmp_path):
