@@ -53,6 +53,42 @@ except Exception:
     pass
 
 
+def _collect_trace_spans(cluster_dir, n_osds):
+    """Gather span dumps from this process's tracer plus every OSD
+    daemon's `dump_traces` asok surface (the ClusterTelemetry
+    collector, bench-shaped)."""
+    from ceph_tpu.common.admin import admin_request
+    from ceph_tpu.common.tracer import tracer
+    spans = list(tracer().dump_traces()["spans"])
+    for i in range(n_osds):
+        path = os.path.join(cluster_dir, f"osd.{i}.asok")
+        try:
+            r = admin_request(path, {"prefix": "dump_traces"}) \
+                .get("result") or {}
+            spans.extend(r.get("spans") or [])
+        except (OSError, IOError):
+            pass
+    return spans
+
+
+def _trace_stage_breakdown(spans, trace_ids=None):
+    """Per-stage wall-time attribution from assembled traces: WHERE
+    the tier's time goes, not just that it is slow (ROADMAP item 2's
+    missing datapoint).  ``share`` is each stage's fraction of summed
+    span time — nested stages overlap their parents, so shares rank
+    stages rather than partitioning wall-clock."""
+    from ceph_tpu.common.tracer import stage_breakdown
+    if trace_ids is not None:
+        spans = [s for s in spans
+                 if s.get("trace_id") in trace_ids]
+    bd = stage_breakdown(spans)
+    total = sum(d["total_s"] for d in bd.values()) or 1.0
+    return {name: {"count": d["count"],
+                   "total_s": round(d["total_s"], 6),
+                   "share": round(d["total_s"] / total, 3)}
+            for name, d in sorted(bd.items())}
+
+
 def _chained_xor_time(masks, words, iters_pair=(64, 576), reps=3):
     """Marginal seconds per masked-XOR dispatch: the output's first word
     is folded into the mask operand, serializing iterations with zero
@@ -906,9 +942,23 @@ def bench_process_cluster(k=8, m=3, obj_bytes=256 << 20, batch_n=16,
         rc.refresh_map()
         pc = rc.codec_for(pool)._pc
         d0 = pc.get("decode_dispatches") or 0
+        # trace-driven stage attribution: reset the client tracer,
+        # run the sweep under a ROOT span (so every send the sweep
+        # makes stamps a context daemons link under), then filter the
+        # gathered daemon spans to trace ids the client minted during
+        # the sweep — daemon tracers still hold population-phase
+        # spans that must not be attributed to recovery
+        from ceph_tpu.common.tracer import tracer as _tr
+        _tr().reset()
         t0 = time.perf_counter()
-        st = rc.recover_ec_pool(1)
+        with _tr().start_span("recovery.sweep"):
+            st = rc.recover_ec_pool(1)
         t_rec = time.perf_counter() - t0
+        sweep_traces = {s["trace_id"]
+                        for s in _tr().dump_traces()["spans"]}
+        rec_stages = _trace_stage_breakdown(
+            _collect_trace_spans(d, n_osds),
+            trace_ids=sweep_traces)
         out["recovery"] = {
             "seconds": round(t_rec, 2),
             "objects": st.get("objects", 0),
@@ -922,6 +972,10 @@ def bench_process_cluster(k=8, m=3, obj_bytes=256 << 20, batch_n=16,
                 (st.get("shards_rebuilt", 0) +
                  st.get("shards_copied", 0)) * rS * U
                 / max(t_rec, 1e-9) / 1e9, 3),
+            # per-stage wall-time attribution assembled from client +
+            # daemon spans: WHY recovery is slow (BENCH r06's new
+            # datapoint), not just that it is
+            "stage_breakdown": rec_stages,
         }
         rc.close()
         return out
@@ -1053,6 +1107,38 @@ def bench_wire_async(n_osds=4, frame_kib=1024, blocking_mib=48,
         out["speedup_pipelined_vs_single"] = round(
             out["pipelined_gbps"] / max(out["single_stream_gbps"],
                                         1e-9), 1)
+
+        # ---- trace-driven stage breakdown: a short traced batch
+        # through the async path, spans assembled from the client
+        # tracer + every daemon's dump_traces asok — per-stage
+        # wall-time attribution of where a wire put's time goes
+        # (client submit vs daemon op vs scheduler vs store)
+        from ceph_tpu.cluster.async_objecter import AsyncObjecter
+        from ceph_tpu.common.tracer import tracer as _tr
+        config().set("objecter_wire_streams", streams)
+        config().set("objecter_wire_window", window)
+        config().set("objecter_wire_mode", "crc")
+        try:
+            _tr().reset()
+            aio = AsyncObjecter(rc)
+            try:
+                work = reqs(8)
+                comps = [aio.call_async(tgt, req)
+                         for tgt, req in work]
+                for r, err in aio.gather(comps):
+                    if err is not None:
+                        raise err
+            finally:
+                aio.close()
+            spans = _collect_trace_spans(d, n_osds)
+            client_traces = {s["trace_id"] for s in spans
+                             if s["name"] == "objecter.wire_submit"}
+            out["stage_breakdown"] = _trace_stage_breakdown(
+                spans, trace_ids=client_traces)
+        finally:
+            config().clear("objecter_wire_streams")
+            config().clear("objecter_wire_window")
+            config().clear("objecter_wire_mode")
         rc.close()
         return out
     finally:

@@ -105,9 +105,11 @@ class RuleRegistry:
         # local imports to avoid cycles; each module exposes
         # register(reg), mirroring the EC plugin seam
         from . import (rules_admin, rules_concurrency, rules_dtype,
-                       rules_faults, rules_jax, rules_perfconfig)
+                       rules_faults, rules_jax, rules_perfconfig,
+                       rules_trace)
         for mod in (rules_jax, rules_dtype, rules_concurrency,
-                    rules_perfconfig, rules_admin, rules_faults):
+                    rules_perfconfig, rules_admin, rules_faults,
+                    rules_trace):
             mod.register(self)
 
 

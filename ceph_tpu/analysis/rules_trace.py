@@ -1,0 +1,114 @@
+"""CTL7xx — trace-context propagation closure (ClusterTelemetry).
+
+Cross-process tracing only works if every hop carries the trace
+context forward: the objecter/AsyncObjecter chokepoints stamp
+``(trace_id, span_id)`` into every request they send (``tctx`` in
+the typed meta of MSG_REQ / MSG_REQ_SG frames), and daemons link
+their stage spans under it.  The failure mode is SILENT: a wire send
+or dispatch fan-out site that builds its own request dict and ships
+it through a raw connection never propagates the context, the trace
+simply has a hole where that hop's spans should be, and nobody
+notices until a slow op's flame trace dead-ends mid-cluster — the
+silent-trace-gap bug class (this sweep found 11 real gaps: the
+client's snapset/digest/recovery sends and every daemon peer_req).
+
+  CTL701  a raw wire send (``<conn>.call({...})`` / ``_peer_req(n,
+          {...})``) in cluster//client/ whose dict-literal request
+          names a DATA-PATH command but neither passed through
+          ``tracer.stamp(...)`` nor carries a ``tctx`` key
+
+Sends through the stamping chokepoints (``osd_call`` /
+``call_async`` / ``aio_osd_call``) are exempt — AsyncObjecter.
+call_async stamps centrally.  Control traffic (maps, pings, boots,
+mon commands) is exempt: only the tracked data-path commands carry
+op traces.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List
+
+from .core import Finding, ParsedModule, Rule
+
+# the tracked wire data-path commands (cluster/daemon.py
+# _TRACKED_CMDS): the ops whose traces an operator hunts
+_DATA_CMDS = frozenset((
+    "put_shard", "get_shard", "delete_shard", "setattr_shard",
+    "getattr_shard", "stat_shard", "digest_shard", "copy_from",
+    "put_object", "delete_object", "exec_cls"))
+
+# raw send callables that do NOT stamp centrally; osd_call /
+# call_async route through AsyncObjecter's stamping and are exempt
+_RAW_SENDS = frozenset(("call", "_peer_req"))
+
+_SCOPE_DIRS = frozenset(("cluster", "client"))
+
+
+def _data_cmd_of(node: ast.AST):
+    """The constant data-path command name of a dict-literal request,
+    or None (non-dict, computed cmd, control command)."""
+    if not isinstance(node, ast.Dict):
+        return None
+    cmd = None
+    has_tctx = False
+    for k, v in zip(node.keys, node.values):
+        if not (isinstance(k, ast.Constant) and
+                isinstance(k.value, str)):
+            continue
+        if k.value == "cmd" and isinstance(v, ast.Constant) and \
+                isinstance(v.value, str):
+            cmd = v.value
+        elif k.value == "tctx":
+            has_tctx = True
+    if cmd in _DATA_CMDS and not has_tctx:
+        return cmd
+    return None
+
+
+class TraceGapRule(Rule):
+    rule_id = "CTL701"
+    name = "wire-send-without-trace-context"
+    description = ("raw wire send / dispatch fan-out builds a "
+                   "data-path request without propagating the active "
+                   "trace context (the silent-trace-gap bug class): "
+                   "wrap the request in tracer.stamp(...) or route "
+                   "through the stamping chokepoints")
+
+    def check_module(self, mod: ParsedModule) -> Iterable[Finding]:
+        if mod.evidence:
+            return ()
+        parts = mod.relpath.replace("\\", "/").split("/")[:-1]
+        if not any(p in _SCOPE_DIRS for p in parts):
+            return ()
+        out: List[Finding] = []
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            if isinstance(fn, ast.Attribute):
+                name = fn.attr
+            elif isinstance(fn, ast.Name):
+                name = fn.id
+            else:
+                continue
+            if name not in _RAW_SENDS:
+                continue
+            for arg in node.args:
+                cmd = _data_cmd_of(arg)
+                if cmd is None:
+                    continue
+                # a stamp(...)-wrapped dict is not a direct arg of
+                # the send, so reaching here means the context was
+                # dropped on the floor
+                out.append(self.finding(
+                    mod, arg.lineno,
+                    f"data-path request {cmd!r} sent over a raw "
+                    f"connection without trace propagation — wrap "
+                    f"it in tracer.stamp(...) (or carry 'tctx') so "
+                    f"the receiving daemon's spans link into the "
+                    f"op's trace instead of leaving a silent gap"))
+        return out
+
+
+def register(reg) -> None:
+    reg.add("CTL701", TraceGapRule)

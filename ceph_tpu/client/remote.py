@@ -26,6 +26,7 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from ..common import auth as cx
+from ..common import tracer as _trace
 from ..common.backoff import ExpBackoff
 from ..common.op_tracker import tracker as _op_tracker
 from ..cluster.daemon import WireClient
@@ -127,8 +128,19 @@ class RemoteCluster:
                         obj=name)
         error = None
         try:
-            with tr.track(top):
-                return fn()
+            # client ROOT span: every wire_submit below nests under
+            # it, and the op-id -> trace-id mapping on the tracked op
+            # is what `ceph trace <op>` resolves through (slow ops
+            # auto-pin this trace via op_tracker.finish).  The
+            # tracker's active-op registration stays — sub-op sites
+            # (call_async's dispatched_wire mark, nested tier
+            # routing) find the op through tr.current()
+            with _trace.start_span(f"client.{optype}", pool=pool_id,
+                                   obj=name) as span:
+                if span.trace_id and top.tracked:
+                    top.tags["trace_id"] = span.trace_id
+                with tr.track(top):
+                    return fn()
         except BaseException as e:
             error = type(e).__name__
             raise
@@ -469,9 +481,9 @@ class RemoteCluster:
         answered = False
         for o in [x for x in up if x != ITEM_NONE]:
             try:
-                raw = self.osd_client(o).call({
+                raw = self.osd_client(o).call(_trace.stamp({
                     "cmd": "getattr_shard", "coll": coll,
-                    "oid": f"0:{name}", "key": "snapset"})
+                    "oid": f"0:{name}", "key": "snapset"}))
             except (OSError, IOError):
                 self.drop_osd_client(o)
                 continue
@@ -504,9 +516,9 @@ class RemoteCluster:
             if tgt == ITEM_NONE:
                 continue
             try:
-                self.osd_client(tgt).call({
+                self.osd_client(tgt).call(_trace.stamp({
                     "cmd": "setattr_shard", "coll": coll,
-                    "oid": oid, "attrs": {"snapset": blob}})
+                    "oid": oid, "attrs": {"snapset": blob}}))
                 acks += 1
             except (OSError, IOError):
                 self.drop_osd_client(tgt)
@@ -532,9 +544,9 @@ class RemoteCluster:
             for o in [x for x in self._up(pool, pg)
                       if x != ITEM_NONE]:
                 try:
-                    exists = self.osd_client(o).call({
+                    exists = self.osd_client(o).call(_trace.stamp({
                         "cmd": "digest_shard", "coll": [pool.id, pg],
-                        "oid": f"0:{name}"}) is not None
+                        "oid": f"0:{name}"})) is not None
                     break
                 except (OSError, IOError):
                     self.drop_osd_client(o)
@@ -569,10 +581,10 @@ class RemoteCluster:
             for o in [x for x in self._up(pool, cpg)
                       if x != ITEM_NONE]:
                 try:
-                    exists = self.osd_client(o).call({
+                    exists = self.osd_client(o).call(_trace.stamp({
                         "cmd": "digest_shard",
                         "coll": [pool.id, cpg],
-                        "oid": f"0:{clone}"}) is not None
+                        "oid": f"0:{clone}"})) is not None
                     break
                 except (OSError, IOError):
                     self.drop_osd_client(o)
@@ -1419,9 +1431,11 @@ class RemoteCluster:
                               if oid in objs]:
                         try:
                             d = self.osd_client(o).call(
-                                {"cmd": "get_shard", "coll": coll,
-                                 "oid": oid,
-                                 "klass": "background_recovery"})
+                                _trace.stamp(
+                                    {"cmd": "get_shard",
+                                     "coll": coll, "oid": oid,
+                                     "klass":
+                                     "background_recovery"}))
                         except (OSError, IOError):
                             self.drop_osd_client(o)
                             continue
@@ -1462,10 +1476,12 @@ class RemoteCluster:
                     cand: Dict[str, bytes] = {}
                     try:
                         for akey in ("size", "S", "U"):
-                            raw = self.osd_client(o).call({
-                                "cmd": "getattr_shard", "coll": coll,
-                                "oid": f"{shard}:{name}",
-                                "key": akey})
+                            raw = self.osd_client(o).call(
+                                _trace.stamp({
+                                    "cmd": "getattr_shard",
+                                    "coll": coll,
+                                    "oid": f"{shard}:{name}",
+                                    "key": akey}))
                             if raw is not None:
                                 cand[akey] = bytes(raw)
                     except (OSError, IOError):
@@ -1554,11 +1570,11 @@ class RemoteCluster:
                     if oid in holdings.get(tgt, set()):
                         continue
                     try:
-                        self.osd_client(tgt).call({
+                        self.osd_client(tgt).call(_trace.stamp({
                             "cmd": "put_shard", "coll": rec["coll"],
                             "oid": oid, "data": data,
                             "attrs": rec["attrs"],
-                            "klass": "background_recovery"})
+                            "klass": "background_recovery"}))
                         holdings.setdefault(tgt, set()).add(oid)
                         if shard not in rec["rebuilt"]:
                             stats["shards_copied"] += 1

@@ -49,6 +49,7 @@ import threading
 from collections import deque
 from typing import Any, Dict, List, Optional, Tuple
 
+from ..common import tracer as _trace
 from ..common.lockdep import LockdepLock
 from ..common.op_tracker import (EVENT_DISPATCHED_WIRE,
                                  tracker as _op_tracker)
@@ -275,6 +276,18 @@ class AsyncObjecter:
         if req.get("cmd") in self.rc._REPLAY_CMDS and \
                 "session" not in req:
             req = dict(req, **self.rc._next_stamp(osd))
+        tr_span = None
+        if _trace.enabled():
+            # wire-submit stage span, opened MANUALLY (submit and
+            # completion run on different threads, so no context
+            # manager can bracket it) and stamped into the request
+            # meta — the trace-context wire propagation for both
+            # MSG_REQ and scatter-gather MSG_REQ_SG frames
+            tr_span = _trace.tracer().span_open(
+                "objecter.wire_submit", osd=osd, cmd=req.get("cmd"))
+            if tr_span.trace_id:
+                req = dict(req)
+                req["tctx"] = [tr_span.trace_id, tr_span.span_id]
         req, data = self._sg_payload(req)
         meta = encoding.dumps(req)
         self._pc.inc("submits")
@@ -289,9 +302,16 @@ class AsyncObjecter:
             own = tr.create(req.get("cmd", "op"), service="objecter",
                             osd=osd, oid=req.get("oid"))
             own.mark_event(EVENT_DISPATCHED_WIRE, osd=osd)
+            if tr_span is not None and own.tracked and \
+                    tr_span.trace_id:
+                own.tags["trace_id"] = tr_span.trace_id
         state = {"retried": False}
 
         def _finish(result, exc) -> None:
+            if tr_span is not None:
+                _trace.tracer().finish_span(
+                    tr_span, error=None if exc is None
+                    else type(exc).__name__)
             if own is not None:
                 tr.finish(own, error=None if exc is None
                           else type(exc).__name__)

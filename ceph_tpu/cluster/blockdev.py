@@ -47,6 +47,7 @@ import threading
 from typing import Any, Dict, List, Optional, Tuple
 
 from ..common import faults
+from ..common import tracer as _trace
 
 POWER_LOSS_MARKER = "POWER_LOSS"
 
@@ -210,7 +211,11 @@ class BlockDevice:
         p = faults.fire("device.power_loss", path=self.path)
         if p is not None:
             self._power_cut(p, "power loss at barrier")
-        os.fsync(self._fd)
+        # store-barrier trace stage: null unless the op above this
+        # barrier carries an active span (the ClusterTelemetry
+        # queue/dispatch/store-barrier/device stage set)
+        with _trace.child_span("store.barrier"):
+            os.fsync(self._fd)
         if self.rec is not None:
             self.rec.record(OP_BARRIER, self.path)
 

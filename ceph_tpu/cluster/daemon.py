@@ -39,6 +39,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from ..common import auth as cx
 from ..common import faults
+from ..common import tracer as _trace
 from ..common.admin import AdminServer
 from ..common.backoff import ExpBackoff
 from ..common.lockdep import LockdepLock
@@ -464,6 +465,9 @@ class MonDaemon:
             os.path.join(cluster_dir, "keyring.mon"))
         self.entity = f"mon.{rank}" if \
             f"mon.{rank}" in self.keyring.entries else "mon."
+        # span attribution for cross-process trace assembly
+        _trace.set_service("mon" if self.n_mons == 1
+                           else f"mon.{rank}")
         self.tickets = cx.TicketServer(self.keyring)
         from .monitor import Monitor
         from .wal_kv import WalDB
@@ -734,12 +738,14 @@ class MonDaemon:
             return {"reply": self._handle(orig, inner)}
         if (self.quorum is not None and
                 cmd in self.MUTATIONS + ("report_slow_ops", "health",
-                                         "report_store_health")
+                                         "report_store_health",
+                                         "report_perf",
+                                         "cluster_stats")
                 and self.quorum.leader != self.rank):
-            # slow-op rollup state is leader-local (transient health,
-            # not a quorum decree): reports AND health queries both
-            # forward so they meet on the same mon no matter which
-            # socket each caller happened to connect to
+            # slow-op/perf rollup state is leader-local (transient
+            # health + stats, not a quorum decree): reports AND their
+            # queries both forward so they meet on the same mon no
+            # matter which socket each caller happened to connect to
             return self._forward_to_leader(entity, req)
         drain_count = None
         if cmd == "pool_tier_remove" and \
@@ -787,6 +793,32 @@ class MonDaemon:
                     entity, int(req.get("errors", 0)),
                     repaired=int(req.get("repaired", 0)))
                 return {"ok": True}
+            if cmd == "report_perf":
+                # ClusterTelemetry stats ingestion (the mgr-module
+                # PGMap/prometheus role): each daemon's heartbeat
+                # ships its perf counters, OpTracker log2 histograms
+                # and store utilization; the leader-local aggregator
+                # merges them into cluster p50/p99/p999, io rates and
+                # per-OSD utilization (leader-local like slow ops)
+                if not (entity.startswith("osd.") or
+                        entity.startswith("client.")):
+                    raise cx.AuthError(
+                        f"{entity} may not report perf")
+                # reports are attributed to the AUTHENTICATED wire
+                # entity, never a caller-chosen name — a client must
+                # not be able to overwrite osd.0's utilization row
+                self.mon.record_daemon_perf(
+                    entity, req.get("report") or {})
+                return {"ok": True}
+            if cmd == "cluster_stats":
+                # the aggregated cluster view (`ceph -s` io lines,
+                # `ceph df`, `ceph osd df`, and the cluster
+                # Prometheus scrape text when {"metrics": True})
+                cs = self.mon.cluster_stats
+                out = cs.dump()
+                if bool(req.get("metrics", False)):
+                    out["prometheus"] = cs.render_prometheus()
+                return out
             if cmd == "health":
                 # PG_DEGRADED needs the batched mapper (a compile in
                 # this daemon) — opt-in via {"pgs": True}
@@ -1079,6 +1111,8 @@ class OSDDaemon:
         self.id = osd_id
         self.dir = cluster_dir
         self.entity = f"osd.{osd_id}"
+        # span attribution for cross-process trace assembly
+        _trace.set_service(self.entity)
         self.keyring = cx.Keyring.load(
             os.path.join(cluster_dir, f"keyring.osd.{osd_id}"))
         spec = json.load(open(os.path.join(cluster_dir, "cluster.json")))
@@ -1183,6 +1217,11 @@ class OSDDaemon:
         self._sessions: Dict[Tuple[str, str], Dict[str, Any]] = {}
         self.session_resets = 0       # unknown-sid resumes observed
         self._pc_session = _perf("osd.session")
+        # io accounting (the osd_perf_counters rd/wr families): the
+        # ClusterStats aggregator turns successive heartbeat reports
+        # of these into per-OSD/per-pool io rates for `ceph -s`
+        self._pc_io = _perf("osd.io")
+        self._perf_reported = 0.0     # last report_perf wall time
 
     # ----------------------------------------------------------- mon I/O --
     def _mon_socks(self) -> List[str]:
@@ -1283,7 +1322,11 @@ class OSDDaemon:
             self.sched.enqueue(op, klass=klass)
             _, fn = self.sched.dequeue()
         mark_active("dispatched_device", osd=self.id, klass=klass)
-        return fn()
+        # dispatch-stage span (child of this op's osd.op span when
+        # the op carried a trace context; null otherwise)
+        with _trace.child_span("osd.dispatch", osd=self.id,
+                               klass=klass):
+            return fn()
 
     def _check_pool_live(self, coll) -> None:
         """Refuse mutations into pools the fetched map says are
@@ -1473,13 +1516,63 @@ class OSDDaemon:
                        klass=req.get("klass", "client"))
         error = None
         try:
-            with tr.track(top):
-                return self._handle_inner(entity, req)
+            # daemon-side op span, LINKED under the trace context the
+            # client stamped into the wire request meta (``tctx``) —
+            # this is where a cross-process trace enters this daemon;
+            # peer fan-outs below stamp THIS span as their parent, so
+            # replica daemons' spans land as grandchildren
+            with _trace.linked_span("osd.op", req.get("tctx"),
+                                    osd=self.id, cmd=cmd) as span:
+                if span.trace_id and top.tracked:
+                    top.tags["trace_id"] = span.trace_id
+                with tr.track(top):
+                    reply = self._handle_inner(entity, req)
+                self._account_io(entity, req, reply)
+                return reply
         except BaseException as e:
             error = type(e).__name__
             raise
         finally:
             tr.finish(top, error=error)
+
+    _WR_CMDS = frozenset(("put_shard", "put_object", "setattr_shard",
+                          "copy_from"))
+    _RD_CMDS = frozenset(("get_shard", "getattr_shard", "stat_shard",
+                          "digest_shard"))
+
+    def _account_io(self, entity: str, req: Dict[str, Any],
+                    reply: Any) -> None:
+        """Per-daemon (and per-pool) rd/wr op+byte counters — the
+        sensor the `ceph -s` client io line aggregates from.  Only
+        CLIENT-facing ops count: replica fan-outs and recovery
+        pushes re-enter this handler from peer OSDs, and counting
+        them would inflate "client io" by the replication factor
+        (the PGMap client-vs-recovery distinction)."""
+        if entity.startswith("osd.") or \
+                req.get("klass") == "background_recovery":
+            return
+        cmd = req["cmd"]
+        coll = req.get("coll")
+        pool = int(coll[0]) if coll else -1
+        if cmd in self._WR_CMDS:
+            nbytes = len(req.get("data") or b"")
+            self._pc_io.inc("wr_ops")
+            self._pc_io.inc("wr_bytes", nbytes)
+            if pool >= 0:
+                self._pc_io.inc(f"pool.{pool}.wr_ops")
+                self._pc_io.inc(f"pool.{pool}.wr_bytes", nbytes)
+        elif cmd in self._RD_CMDS:
+            nbytes = len(reply) if isinstance(
+                reply, (bytes, bytearray, memoryview)) else 0
+            self._pc_io.inc("rd_ops")
+            self._pc_io.inc("rd_bytes", nbytes)
+            if pool >= 0:
+                self._pc_io.inc(f"pool.{pool}.rd_ops")
+                self._pc_io.inc(f"pool.{pool}.rd_bytes", nbytes)
+        elif cmd in ("delete_shard", "delete_object"):
+            self._pc_io.inc("wr_ops")
+            if pool >= 0:
+                self._pc_io.inc(f"pool.{pool}.wr_ops")
 
     def _handle_inner(self, entity: str, req: Dict[str, Any]) -> Any:
         cmd = req["cmd"]
@@ -1589,9 +1682,10 @@ class OSDDaemon:
                     except IOError:
                         return None
                 return self._peer_req(int(req["src_osd"]),
-                                      {"cmd": "get_shard",
-                                       "coll": list(src_coll),
-                                       "oid": src_oid})
+                                      _trace.stamp(
+                                          {"cmd": "get_shard",
+                                           "coll": list(src_coll),
+                                           "oid": src_oid}))
             data = read_src()
             if data is None:
                 raise IOError(f"copy_from: source "
@@ -1627,11 +1721,11 @@ class OSDDaemon:
                     if peer == self.id:
                         continue
                     try:
-                        self.peer_client(peer).call({
+                        self.peer_client(peer).call(_trace.stamp({
                             "cmd": "delete_shard", "coll": list(coll),
                             "oid": req["oid"], "klass": klass,
                             "log": {"version": list(version),
-                                    "prev": list(prev)}})
+                                    "prev": list(prev)}}))
                         acks += 1
                     except (OSError, IOError):
                         self.drop_peer(peer)
@@ -1661,12 +1755,16 @@ class OSDDaemon:
                     if peer == self.id:
                         continue
                     try:
-                        self.peer_client(peer).call({
+                        # replica sub-write carries the trace context
+                        # of THIS daemon's active osd.op span, so the
+                        # replica's spans link as its children (the
+                        # >= 3-process trace shape)
+                        self.peer_client(peer).call(_trace.stamp({
                             "cmd": "put_shard", "coll": list(coll),
                             "oid": req["oid"], "data": req["data"],
                             "klass": klass, "attrs": req.get("attrs"),
                             "log": {"version": list(version),
-                                    "prev": list(prev)}})
+                                    "prev": list(prev)}}))
                         acks += 1
                     except (OSError, IOError):
                         self.drop_peer(peer)
@@ -1822,12 +1920,12 @@ class OSDDaemon:
                     if rep == self.id:
                         continue
                     try:
-                        self._peer_req(rep, {
+                        self._peer_req(rep, _trace.stamp({
                             "cmd": "exec_cls", "coll": list(coll),
                             "oid": req["oid"], "cls": req["cls"],
                             "method": req["method"],
                             "payload": req.get("payload", b""),
-                            "replicas": []})
+                            "replicas": []}))
                     except (OSError, IOError):
                         pass      # stale replica heals via recovery
                 return out
@@ -1877,9 +1975,10 @@ class OSDDaemon:
                     return self.store.read(coll, oid)
                 except IOError:
                     continue
-            d = self._peer_req(h, {"cmd": "get_shard",
-                                   "coll": list(coll), "oid": oid,
-                                   "klass": "background_recovery"})
+            d = self._peer_req(h, _trace.stamp(
+                {"cmd": "get_shard",
+                 "coll": list(coll), "oid": oid,
+                 "klass": "background_recovery"}))
             if d is not None:
                 return d
         return None
@@ -1890,9 +1989,10 @@ class OSDDaemon:
             self.store.apply_transaction(
                 Transaction().write_full(coll, oid, data))
             return True
-        return self._peer_req(m, {
+        return self._peer_req(m, _trace.stamp({
             "cmd": "put_shard", "coll": list(coll), "oid": oid,
-            "data": data, "klass": "background_recovery"}) is not None
+            "data": data,
+            "klass": "background_recovery"})) is not None
 
     def _recover_pg(self, coll: Tuple[int, int],
                     members: List[int],
@@ -2022,9 +2122,10 @@ class OSDDaemon:
                         if m == me:
                             self._local_delete(coll, obj)
                         elif self._peer_req(
-                                m, {"cmd": "delete_shard",
-                                    "coll": list(coll),
-                                    "oid": obj}) is None:
+                                m, _trace.stamp(
+                                    {"cmd": "delete_shard",
+                                     "coll": list(coll),
+                                     "oid": obj})) is None:
                             complete = False
                         stats["deletes_applied"] += 1
                         continue
@@ -2119,8 +2220,9 @@ class OSDDaemon:
                         digests[m] = None
                 else:
                     digests[m] = self._peer_req(
-                        m, {"cmd": "digest_shard", "coll": list(coll),
-                            "oid": oid})
+                        m, _trace.stamp(
+                            {"cmd": "digest_shard",
+                             "coll": list(coll), "oid": oid}))
             present = [d for d in digests.values() if d is not None]
             if not present or len(set(present)) == 1 and \
                     len(present) == len(members):
@@ -2213,6 +2315,62 @@ class OSDDaemon:
         except (OSError, IOError):
             self._mon = None
 
+    _UTIL_SCAN_INTERVAL_S = 5.0
+
+    def _store_util(self) -> Dict[str, Any]:
+        """Store utilization snapshot for the ClusterStats rollup:
+        allocator-backed used/total bytes (BlueStore) plus per-pool
+        object counts from the collection listing.  The object scan
+        is O(store) so it runs at most every _UTIL_SCAN_INTERVAL_S;
+        between scans the cached snapshot rides the (cheap, 1 s)
+        perf-counter reports."""
+        now = time.monotonic()
+        cached = getattr(self, "_util_cache", None)
+        if cached is not None and \
+                now - cached[0] < self._UTIL_SCAN_INTERVAL_S:
+            return cached[1]
+        util: Dict[str, Any] = {"bytes": 0, "total_bytes": 0,
+                                "objects": 0, "pools": {}}
+        st = self.store
+        alloc = getattr(st, "alloc", None)
+        if alloc is not None:
+            free = int(alloc.free_blocks)
+            util["bytes"] = (st.n_blocks - free) * st.min_alloc
+            util["total_bytes"] = st.device_bytes
+        try:
+            for coll in st.list_collections():
+                # data shards only (the count_pool convention):
+                # pglog/meta rows are bookkeeping, not user objects
+                n = sum(1 for o in st.list_objects(coll)
+                        if not o.startswith("meta:"))
+                util["objects"] += n
+                pid = int(coll[0])
+                row = util["pools"].setdefault(
+                    pid, {"objects": 0, "bytes": 0})
+                row["objects"] += n
+        except (OSError, IOError):
+            pass          # a store mid-fsck must not kill the report
+        self._util_cache = (now, util)
+        return util
+
+    def _report_perf(self) -> None:
+        """Ship this daemon's perf counters (histograms included) and
+        store utilization to the mon's ClusterStats aggregator — the
+        telemetry half of the heartbeat, next to the slow-op and
+        store-health rollups."""
+        now = time.time()
+        if now - self._perf_reported < 1.0:
+            return        # cheap cadence floor under fast heartbeats
+        report = {"perf": _perf().dump_typed(),
+                  "util": self._store_util(), "ts": now}
+        try:
+            self.mon_client().call({"cmd": "report_perf",
+                                    "osd": self.id,
+                                    "report": report})
+            self._perf_reported = now
+        except (OSError, IOError):
+            self._mon = None
+
     def _report_slow_ops(self) -> None:
         """Roll this process's slow-op summary up to the mon (PR 1's
         known gap: daemon trackers were only visible on their own
@@ -2258,6 +2416,7 @@ class OSDDaemon:
             return
         self._report_slow_ops()
         self._report_store_health()
+        self._report_perf()
         self._purge_dead_pools()
         up = self._map.get("osd_up", [])
         # spuriously marked down (missed heartbeats during a stall

@@ -58,6 +58,7 @@ class HeartbeatMonitor:
         # the tick after, mirroring the daemon tier's slow-op rollup
         self._damage_reported: Set[int] = set()
         self._down_ticks: Dict[int, int] = {}   # map-down tick counts
+        self._util_cache: Dict[int, Dict] = {}  # osd -> last util scan
         self.auto_outs: List[int] = []
         # deterministic time for the mon's flap-dampening windows: the
         # heartbeat tick IS the sim's clock (never clobber a clock a
@@ -75,9 +76,61 @@ class HeartbeatMonitor:
         link counts a net.partition fire (the proof the cut carried)."""
         return not faults.partitioned(f"osd.{src}", dst_entity)
 
+    # utilization scans are O(store); refresh every N ticks and ship
+    # the cached snapshot in between (the daemon tier's
+    # _UTIL_SCAN_INTERVAL_S, sim-clock shaped)
+    UTIL_SCAN_TICKS = 5
+
+    def _scan_util(self, o) -> Dict:
+        """One OSD's store utilization.  Iterates over SNAPSHOTS of
+        the store dicts (dispatcher threads mutate them concurrently)
+        and treats a mid-scan mutation as 'keep last snapshot' — a
+        failed scan must never abort the tick that marks peers down."""
+        objects = 0
+        nbytes = 0
+        pools: Dict = {}
+        try:
+            for coll, objs in list(o.objectstore._colls.items()):
+                vals = list(objs.values())
+                objects += len(vals)
+                row = pools.setdefault(int(coll[0]),
+                                       {"objects": 0, "bytes": 0})
+                row["objects"] += len(vals)
+                for ob in vals:
+                    sz = len(ob.data)
+                    nbytes += sz
+                    row["bytes"] += sz
+        except RuntimeError:
+            return self._util_cache.get(o.id) or {
+                "bytes": 0, "total_bytes": 0, "objects": 0,
+                "pools": {}}
+        return {"bytes": nbytes, "total_bytes": 0,
+                "objects": objects, "pools": pools}
+
+    def _report_telemetry(self) -> None:
+        """ClusterStats rollup, sim tier: per-OSD store utilization
+        plus (once, under the client entity — one process is one perf
+        domain) the process perf dump, mirroring what daemonized OSDs
+        ship on their wire heartbeats."""
+        import time as _time
+        from ..common.perf_counters import perf as _perf
+        now = _time.time()
+        rescan = (self.ticks % self.UTIL_SCAN_TICKS == 1)
+        for o in self.sim.osds:
+            if not o.alive or not self._reaches(o.id, "mon"):
+                continue
+            if rescan or o.id not in self._util_cache:
+                self._util_cache[o.id] = self._scan_util(o)
+            self.mon.record_daemon_perf(
+                f"osd.{o.id}",
+                {"util": self._util_cache[o.id], "ts": now})
+        self.mon.record_daemon_perf(
+            "client", {"perf": _perf().dump_typed(), "ts": now})
+
     def tick(self) -> List[int]:
         """One heartbeat round; returns OSDs newly marked down."""
         self.ticks += 1
+        self._report_telemetry()
         newly_down: List[int] = []
         om = self.sim.osdmap
         # store-damage rollup: deliver boot-fsck counts to the mon

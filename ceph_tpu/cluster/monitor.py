@@ -148,6 +148,12 @@ class Monitor:
         # STORE_DAMAGED health check surfaces them until the daemon
         # reports clean (or the reporter ages out like slow ops)
         self._store_damage: Dict[str, Dict[str, Any]] = {}
+        # ClusterTelemetry stats aggregation (the PGMap + mgr
+        # prometheus role): daemons ship perf counters / histograms /
+        # utilization over the heartbeat path; the aggregator merges
+        # them into cluster p50/p99/p999, io rates, df / osd df
+        from ..mgr.cluster_stats import ClusterStats
+        self.cluster_stats = ClusterStats()
         # ------ flap dampening (the osd_markdown_log role) ------
         # an OSD marked down >= _flap_count times inside _flap_window
         # gets its next boot HELD for a doubling backoff (capped), so
@@ -583,6 +589,13 @@ class Monitor:
                                              ts=_time.time())
         else:
             self._daemon_slow.pop(daemon, None)
+
+    def record_daemon_perf(self, daemon: str,
+                           report: Dict[str, Any]) -> None:
+        """Ingest one daemon's telemetry report (perf counter dump +
+        store utilization, shipped on its heartbeat like the slow-op
+        summaries) into the cluster stats aggregator."""
+        self.cluster_stats.ingest(daemon, report)
 
     def record_store_damage(self, daemon: str, errors: int,
                             repaired: int = 0) -> None:

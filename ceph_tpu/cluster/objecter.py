@@ -151,7 +151,12 @@ class Objecter:
         error = None
         try:
             with _tracer().start_span("objecter.op", pool=pool_id,
-                                      obj=name) as span:
+                                      obj=name, optype=optype) as span:
+                if span.trace_id and top.tracked:
+                    # op id -> trace id mapping: `ceph trace <op>`
+                    # resolves through the tracked-op record, and a
+                    # slow finish auto-pins this trace (op_tracker)
+                    top.tags["trace_id"] = span.trace_id
                 blocked: Optional[WriteBlocked] = None
                 for attempt in range(self.max_retries):
                     transient = False

@@ -24,6 +24,7 @@ from typing import Any, Dict, List, Optional, Tuple
 import numpy as np
 
 from ..common import faults
+from ..common import tracer as _trace
 from ..common.lockdep import LockdepLock
 from ..common.op_tracker import tracker as _op_tracker
 from ..common.perf_counters import perf as _perf
@@ -114,7 +115,16 @@ class OSDService:
         try:
             if self.inject_execute_delay > 0:
                 time.sleep(self.inject_execute_delay)
-            return self._execute_inner(op)
+            # daemon-side dispatch stage span, linked under the
+            # submitting op's trace context (carried on the op dict —
+            # the in-process half of trace propagation); the nested
+            # device.dispatch child covers the store/device access
+            with _trace.linked_span(
+                    "osd.dispatch", op.get("tctx"),
+                    osd=self.osd.id, kind=op["kind"]):
+                with _trace.child_span("device.dispatch",
+                                       osd=self.osd.id):
+                    return self._execute_inner(op)
         finally:
             # device-dispatch latency distribution (the encode/store
             # stage averages hide; acceptance histogram family)
@@ -173,15 +183,22 @@ class OSDService:
             op = dict(op, track_id=top.op_id)
             top.mark_event("queued", osd=self.osd.id,
                            queue_depth=self.in_q.stats()["depth"])
-        payload = encoding.dumps(op)
-        try:
-            self.in_q.push(Envelope(MSG_OSD_OP, op_id, -1, payload),
-                           timeout=timeout)
-        except (QueueFull, QueueClosed):
-            with self._lock:
-                self._events.pop(op_id, None)
-                self._op_objs.pop(op_id, None)
-            raise IOError(f"osd.{self.osd.id}: op queue unavailable")
+        # trace propagation (in-process dispatch half): the active
+        # span's (trace_id, span_id) rides the op dict so the
+        # dispatcher thread's stage spans link under it; the queue
+        # admission itself is the "osd.queue" stage
+        op = _trace.stamp(dict(op)) if _trace.enabled() else op
+        with _trace.child_span("osd.queue", osd=self.osd.id):
+            payload = encoding.dumps(op)
+            try:
+                self.in_q.push(Envelope(MSG_OSD_OP, op_id, -1,
+                                        payload), timeout=timeout)
+            except (QueueFull, QueueClosed):
+                with self._lock:
+                    self._events.pop(op_id, None)
+                    self._op_objs.pop(op_id, None)
+                raise IOError(f"osd.{self.osd.id}: op queue "
+                              f"unavailable")
         return op_id, ev
 
     def wait_async(self, op_id: int, ev: threading.Event,

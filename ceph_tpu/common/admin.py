@@ -41,6 +41,10 @@ class AdminServer:
         self.register("trace dump", lambda a: tracer().dump())
         self.register("trace reset",
                       lambda a: (tracer().reset(), {"success": True})[1])
+        # cross-process trace collection surface (`ceph daemon <name>
+        # dump_traces` / the `ceph trace <op>` assembler's per-daemon
+        # fetch): spans + buffer occupancy/drop health
+        self.register("dump_traces", lambda a: tracer().dump_traces())
         from .op_tracker import tracker
         self.register("dump_ops_in_flight",
                       lambda a: tracker().dump_ops_in_flight())

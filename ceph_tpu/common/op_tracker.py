@@ -42,6 +42,7 @@ from typing import Any, Dict, List, Optional
 
 from .options import OptionError, config
 from .perf_counters import perf as _perf
+from .tracer import pin_trace as _pin_trace
 
 # canonical lifecycle events (free-form names are also accepted)
 EVENT_INITIATED = "initiated"
@@ -254,6 +255,11 @@ class OpTracker:
                 tpc.hinc(key, t1 - t0)
         if slow:
             tpc.inc("slow_ops")
+            # auto-sampling (ISSUE 10): an op that crossed the
+            # complaint time pins its trace, so the slow op's
+            # end-to-end flame trace survives buffer churn and stays
+            # retrievable by op id (`ceph trace <op>`)
+            _pin_trace(op.tags.get("trace_id"))
 
     def mark(self, op_id: Optional[int], event: str, **tags) -> None:
         """Cross-thread event append by op id (below-queue code paths

@@ -321,6 +321,16 @@ _TABLE: Tuple[Option, ...] = (
     Option("op_tracker_max_inflight", TYPE_INT, 1024,
            "bound on the in-flight tracking table; ops past it run "
            "untracked (counted as op_tracker.ops_untracked)", min=1),
+    Option("trace_enabled", TYPE_BOOL, True,
+           "distributed tracing master switch (reference: "
+           "jaeger_tracing_enable): armed, every submitted op carries "
+           "a (trace_id, span_id) context across wire frames and "
+           "in-process dispatch and daemons open linked child spans; "
+           "disarmed, trace sites cost one dict-miss check"),
+    Option("trace_max_spans", TYPE_INT, 10000,
+           "bounded finished-span buffer per process; trims drop the "
+           "oldest half (counted as tracer.spans_dropped) except "
+           "spans of pinned (auto-sampled slow) traces", min=100),
     Option("objecter_wire_streams", TYPE_INT, 4,
            "parallel pipelined connections per OSD daemon in the "
            "async objecter's stream pool (the ms_async_op_threads / "

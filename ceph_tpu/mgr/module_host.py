@@ -106,6 +106,11 @@ class MgrModuleHost:
             return stats
         if what == "pg_counts_per_osd":
             return self.sim.osdmap.pg_counts_per_osd()
+        if what == "cluster_stats":
+            # the ClusterTelemetry aggregator (None without a mon:
+            # modules degrade to the per-process view)
+            return None if self.mon is None \
+                else getattr(self.mon, "cluster_stats", None)
         raise KeyError(f"unknown query {what!r}")
 
     # ------------------------------------------------------- mon commands --

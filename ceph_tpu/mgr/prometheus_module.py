@@ -98,6 +98,18 @@ class PrometheusModule(MgrModule):
                     metric(safe, help_,
                            "gauge" if typ == GAUGE else "counter",
                            [({}, value)])
+        # cluster section (ClusterTelemetry): when a mon with a
+        # ClusterStats aggregator is attached, ONE scrape also serves
+        # every reporting daemon's families under per-daemon labels
+        # plus the bucket-wise merged ceph_cluster_* histograms and
+        # quantile gauges — the reference mgr's cluster-wide
+        # prometheus view replacing the per-process-only one
+        try:
+            cs = self.get("cluster_stats")
+        except KeyError:
+            cs = None
+        if cs is not None and cs.daemons():
+            lines.append(cs.render_prometheus().rstrip("\n"))
         return "\n".join(lines) + "\n"
 
     @staticmethod

@@ -19,6 +19,7 @@ import threading
 from typing import Callable, Dict, List, Optional, Sequence
 
 from ..common import faults
+from ..common import tracer as _trace
 from ..common.lockdep import LockdepLock
 from ..common.perf_counters import perf as _perf
 from .queue import Envelope, MessageQueue
@@ -96,7 +97,13 @@ class ShardFanout:
         self._pc = _perf("msg.fanout")
 
     def submit(self, op_id: int, msg_type: int,
-               shard_payloads: Sequence[bytes]) -> None:
+               shard_payloads: Sequence[bytes],
+               tctx: Optional[Sequence[int]] = None) -> None:
+        """``tctx`` links this fan-out under an active trace: the
+        sub-op scatter is a stage of the op that triggered it (the
+        CTL701 propagation contract for dispatch fan-out sites).
+        Callers without an explicit context inherit the submitting
+        thread's active span."""
         if len(shard_payloads) != len(self.shard_queues):
             raise ValueError("one payload per shard queue")
         with self._lock:
@@ -104,16 +111,19 @@ class ShardFanout:
                 "want": len(shard_payloads), "got": 0, "failed": False,
                 "event": threading.Event()}
         self._pc.inc("ops_submitted")
-        for shard, (q, payload) in enumerate(
-                zip(self.shard_queues, shard_payloads)):
-            if faults.partitioned(self.entity,
-                                  self.shard_entities[shard]):
-                # the frame is lost on the cut link: no push, no ack —
-                # the waiter's timeout is the failure signal, as on a
-                # real netsplit (a nack would be a delivered frame)
-                self._pc.inc("subops_partitioned")
-                continue
-            q.push(Envelope(msg_type, op_id, shard, payload))
+        with _trace.linked_span("msg.fanout", tctx,
+                                shards=len(shard_payloads)):
+            for shard, (q, payload) in enumerate(
+                    zip(self.shard_queues, shard_payloads)):
+                if faults.partitioned(self.entity,
+                                      self.shard_entities[shard]):
+                    # the frame is lost on the cut link: no push, no
+                    # ack — the waiter's timeout is the failure
+                    # signal, as on a real netsplit (a nack would be
+                    # a delivered frame)
+                    self._pc.inc("subops_partitioned")
+                    continue
+                q.push(Envelope(msg_type, op_id, shard, payload))
 
     def ack(self, op_id: int, shard: int, ok: bool = True) -> None:
         """Called by shard servers (normally via the ack queue)."""

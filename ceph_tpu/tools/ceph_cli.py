@@ -33,7 +33,7 @@ from __future__ import annotations
 import argparse
 import json
 import sys
-from typing import List, Optional
+from typing import Dict, List, Optional
 
 
 def _client(cluster_dir: str):
@@ -69,6 +69,17 @@ def cmd_status(rc, out) -> int:
         out.write(f"    pool {pid} '{pool.name}' "
                   f"{names.get(pool.type, pool.type)} "
                   f"size {pool.size} pg_num {pool.pg_num}\n")
+    # the PGMap io line, from the mon's ClusterStats aggregator
+    # (counter deltas across the daemons' heartbeat perf reports)
+    try:
+        io = rc.mon_call({"cmd": "cluster_stats"})["io"]["cluster"]
+        out.write("  io:\n")
+        out.write(f"    client: {io.get('rd_bytes', 0.0) / 2**20:.1f}"
+                  f" MiB/s rd, {io.get('wr_bytes', 0.0) / 2**20:.1f}"
+                  f" MiB/s wr, {io.get('rd_ops', 0.0):.0f} op/s rd, "
+                  f"{io.get('wr_ops', 0.0):.0f} op/s wr\n")
+    except Exception:
+        pass
     return 0
 
 
@@ -229,9 +240,112 @@ def cmd_pg_dump(rc, pool_id: int, out) -> int:
 
 
 def cmd_df(rc, out) -> int:
-    out.write("POOL  OBJECTS\n")
+    stats = {}
+    try:
+        cs = rc.mon_call({"cmd": "cluster_stats"})
+        df = cs.get("df") or {}
+        stats = {int(k): v for k, v in (df.get("pools") or {}).items()}
+        if df.get("total_bytes"):
+            out.write(f"RAW USED: {df['total_used_bytes']} / "
+                      f"{df['total_bytes']} bytes\n")
+    except Exception:
+        pass
+    out.write("POOL  OBJECTS  RAW_SHARDS  RAW_BYTES\n")
     for pid, pool in sorted(rc.osdmap.pools.items()):
-        out.write(f"{pool.name}  {len(rc.list_objects(pid))}\n")
+        row = stats.get(pid) or {}
+        # daemons report per-pool shard COUNTS; byte attribution is
+        # allocator-level (whole-store), so a zero here means "not
+        # reported per pool", never "empty"
+        nbytes = row.get("bytes", 0) or "-"
+        out.write(f"{pool.name}  {len(rc.list_objects(pid))}  "
+                  f"{row.get('objects', 0)}  {nbytes}\n")
+    return 0
+
+
+def cmd_osd_df(rc, out) -> int:
+    """`ceph osd df` — per-OSD utilization from the ClusterStats
+    aggregator (allocator-backed used/total bytes each daemon ships
+    on its heartbeat)."""
+    rows = rc.mon_call({"cmd": "cluster_stats"}).get("osd_df") or []
+    out.write("NAME  OBJECTS  USED  TOTAL  %USE\n")
+    for r in rows:
+        out.write(f"{r['daemon']}  {r['objects']}  "
+                  f"{r['bytes_used']}  {r['bytes_total']}  "
+                  f"{100.0 * r['utilization']:.2f}\n")
+    if not rows:
+        out.write("(no daemon reports yet)\n")
+    return 0
+
+
+def cmd_trace(cluster_dir: str, token: str, out,
+              as_json: bool = False) -> int:
+    """`ceph trace <op_id>` — the cluster-level trace assembly: find
+    the op's trace id in ANY daemon/client tracked-op dump, gather
+    `dump_traces` spans from every admin socket in the cluster dir,
+    and assemble the cross-process tree (the Jaeger query role)."""
+    import glob
+    import os
+
+    from ..common.admin import admin_request
+    from ..common.tracer import assemble, render_trace
+    socks = sorted(glob.glob(os.path.join(cluster_dir, "*.asok")))
+    if not socks:
+        out.write(f"Error: no admin sockets under {cluster_dir}\n")
+        return 1
+    trace_id = None
+    if token.startswith("0x"):
+        trace_id = int(token, 16)
+    # op ids are PER-PROCESS counters, so "op 7" can exist on the
+    # client AND on several daemons: collect every match and refuse
+    # an ambiguous resolution instead of silently rendering the
+    # first asok's unrelated trace
+    matches: Dict[int, str] = {}
+    spans = []
+    for path in socks:
+        name = os.path.basename(path)[:-len(".asok")]
+        if trace_id is None:
+            for dump in ("dump_historic_slow_ops",
+                         "dump_historic_ops", "dump_ops_in_flight"):
+                try:
+                    r = admin_request(path, {"prefix": dump}) \
+                        .get("result") or {}
+                except (OSError, IOError):
+                    break
+                for op in r.get("ops", []):
+                    if str(op.get("op_id")) == token and \
+                            op.get("trace_id"):
+                        matches.setdefault(int(op["trace_id"]), name)
+        try:
+            r = admin_request(path, {"prefix": "dump_traces"}) \
+                .get("result") or {}
+            spans.extend(r.get("spans") or [])
+        except (OSError, IOError):
+            continue
+    if trace_id is None:
+        if len(matches) > 1:
+            out.write(f"Error: op id {token!r} is ambiguous (op ids "
+                      f"are per-process) — candidates:\n")
+            for tid, name in sorted(matches.items()):
+                out.write(f"  {name}: trace {tid:#x}\n")
+            out.write("re-run with the 0x<trace_id> form\n")
+            return 1
+        if matches:
+            trace_id = next(iter(matches))
+    if trace_id is None:
+        out.write(f"Error: op {token!r} not found in any daemon's "
+                  f"tracked-op dumps (or it carries no trace)\n")
+        return 1
+    trees = assemble(s for s in spans
+                     if int(s.get("trace_id", 0)) == trace_id)
+    tree = trees.get(trace_id)
+    if tree is None:
+        out.write(f"Error: no spans for trace {trace_id:#x}\n")
+        return 1
+    if as_json:
+        out.write(json.dumps(tree, indent=2, sort_keys=True,
+                             default=str) + "\n")
+    else:
+        out.write(render_trace(tree) + "\n")
     return 0
 
 
@@ -242,7 +356,8 @@ def cmd_scrub(rc, pool_id: int, out) -> int:
 
 
 DAEMON_COMMANDS = ("dump_ops_in_flight", "dump_historic_ops",
-                   "dump_historic_slow_ops", "perf dump", "perf reset",
+                   "dump_historic_slow_ops", "dump_traces",
+                   "perf dump", "perf reset",
                    "config show", "config get", "config set",
                    "trace dump", "trace reset", "fault_injection",
                    "store_fsck", "help")
@@ -325,6 +440,7 @@ def main(argv: Optional[List[str]] = None,
                          "osd set|unset noout|nodown | osd pool ls | "
                          "osd tier add|remove BASE CACHE | "
                          "osd tier agent BASE [TARGET] | "
+                         "osd df | trace OP_ID [--json] | "
                          "pg dump POOL | df | scrub POOL | "
                          "daemon NAME dump_ops_in_flight|"
                          "dump_historic_ops|dump_historic_slow_ops|"
@@ -346,6 +462,21 @@ def main(argv: Optional[List[str]] = None,
         # invariants — builds its own in-process stack, no --dir
         from ..cluster.thrasher import main as thrash_main
         return thrash_main(ns.words[1:] + extra, out=out)
+    if ns.words[0] == "trace":
+        # cluster-level trace assembly over the daemons' admin
+        # sockets: needs no mon connection (an op is usually traced
+        # BECAUSE something is wedged)
+        if ns.dir is None:
+            ap.error("--dir is required for `trace`")
+        if len(ns.words) < 2:
+            ap.error("trace OP_ID|0xTRACE_ID [--json]")
+        try:
+            return cmd_trace(ns.dir, ns.words[1], out,
+                             as_json="--json" in (ns.words[2:] +
+                                                  extra))
+        except (RuntimeError, ValueError, OSError) as e:
+            out.write(f"Error: {e}\n")
+            return 1
     if extra:
         ap.error(f"unrecognized arguments: {' '.join(extra)}")
     if ns.dir is None:
@@ -420,6 +551,8 @@ def _dispatch(ap, ns, rc, out) -> int:
     if w[:3] == ["osd", "tier", "agent"]:
         return cmd_tier_agent(rc, arg(3),
                               w[4] if len(w) > 4 else None, out)
+    if w[:2] == ["osd", "df"]:
+        return cmd_osd_df(rc, out)
     if w[:2] == ["pg", "dump"]:
         return cmd_pg_dump(rc, int(arg(2)), out)
     if w[0] == "df":

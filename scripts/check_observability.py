@@ -136,8 +136,99 @@ def main() -> int:
         if why:
             return _fail(why)
 
+    # ---- ClusterTelemetry (ISSUE 10): the cluster-level plane ----
+    import time
+
+    from ceph_tpu.cluster.heartbeat import HeartbeatMonitor
+    from ceph_tpu.common import tracer as tracing
+    from ceph_tpu.common.options import config
+
+    # 4) cluster Prometheus scrape: daemons report over the heartbeat
+    # path, the mon's ClusterStats merges, and ONE scrape serves
+    # per-daemon labeled families plus merged cluster histograms
+    hb = HeartbeatMonitor(sim, mon)
+    hb.tick()
+    hb.tick()
+    chost = MgrModuleHost(sim, mon)
+    prometheus_module.register(chost)
+    cmod = chost.enable("prometheus")
+    cport = cmod.start_http(0)
+    try:
+        ctext = urllib.request.urlopen(
+            f"http://127.0.0.1:{cport}/metrics", timeout=10) \
+            .read().decode()
+    finally:
+        cmod.stop_http()
+    if 'ceph_daemon="osd.0"' not in ctext:
+        return _fail("cluster scrape: no per-daemon labels")
+    fams = [ln.split()[2] for ln in ctext.splitlines()
+            if ln.startswith("# TYPE ")]
+    dup = sorted({f for f in fams if fams.count(f) > 1})
+    if dup:
+        return _fail(f"cluster scrape: duplicate # TYPE families "
+                     f"{dup} (a Prometheus parser rejects the whole "
+                     f"scrape)")
+    if "# TYPE ceph_cluster_op_tracker_op_e2e_s" not in ctext and \
+            "# TYPE ceph_cluster_objecter_op_e2e_s" not in ctext:
+        return _fail("cluster scrape: no merged cluster histogram "
+                     "families")
+    if 'quantile="0.99"' not in ctext:
+        return _fail("cluster scrape: no merged p99 quantile gauges")
+    # merged quantiles must agree with the per-daemon sources
+    cs = mon.cluster_stats
+    qq = cs.merged_quantiles()
+    fam = qq.get("objecter.op_e2e_s")
+    if not fam or fam["count"] == 0 or fam["p99"] is None:
+        return _fail(f"cluster stats: empty merged op_e2e_s ({fam})")
+    src = perf("objecter").dump()["op_e2e_s"]
+    if fam["count"] != src["count"]:
+        return _fail(f"merged count {fam['count']} != source "
+                     f"{src['count']}")
+
+    # 5) slow-op auto-sampling: force one slow op, assert its trace
+    # assembles end-to-end (>= 5 linked stages), retrievable by op id
+    config().set("op_tracker_complaint_time", 0.01)
+    for svc in sim.services:
+        svc.inject_execute_delay = 0.02
+    try:
+        client.put(1, "slowpoke", b"s" * 2048)
+    finally:
+        for svc in sim.services:
+            svc.inject_execute_delay = 0.0
+        config().clear("op_tracker_complaint_time")
+    slow = tracker().dump_historic_slow_ops()
+    rec = next((op for op in slow["ops"]
+                if op.get("obj") == "slowpoke"), None)
+    if rec is None or not rec.get("trace_id"):
+        return _fail("slow op missing from the slow ring or carries "
+                     "no trace_id")
+    trees = tracing.assemble(
+        tracing.tracer().spans_for(rec["trace_id"]))
+    tree = trees.get(rec["trace_id"])
+    if tree is None or tree["spans"] < 5:
+        return _fail(f"auto-sampled slow trace too thin: {tree}")
+    if rec["trace_id"] not in tracing.tracer().sampled_traces():
+        return _fail("slow trace was not pinned (auto-sampling)")
+
+    # 6) disarmed tracing is one dict-miss (the faultpoint contract)
+    tracing.disarm()
+    try:
+        t0 = time.perf_counter()
+        for _ in range(100_000):
+            tracing.stamp({"cmd": "put_shard"})
+            with tracing.child_span("x"):
+                pass
+        dt = time.perf_counter() - t0
+    finally:
+        tracing.arm()
+    if dt > 1.0:
+        return _fail(f"disarmed trace sites cost {dt:.2f}s per 100k "
+                     f"(want << 1s)")
+
     print(f"OK: {len(smoke)} tracked ops, per-stage histograms live, "
-          f"/metrics scrapeable ({len(text)} bytes)")
+          f"/metrics scrapeable ({len(text)} bytes), cluster scrape "
+          f"{len(ctext)} bytes ({len(cs.daemons())} daemons), slow "
+          f"trace {tree['spans']} spans, disarmed 100k in {dt:.3f}s")
     return 0
 
 

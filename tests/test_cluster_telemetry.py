@@ -1,0 +1,490 @@
+"""ClusterTelemetry (ISSUE 10): cross-process distributed tracing +
+mgr-style cluster stats aggregation.
+
+Covers the two tentpole halves and their acceptance criteria:
+
+  * tracer contracts — drop counting, buffer occupancy, leaked-span
+    error tagging, slow-trace pinning, the disarmed dict-miss cost;
+  * bucket-wise histogram merge — merged cluster p50/p99/p999 must
+    equal the quantiles of the POOLED samples within one log2
+    bucket's resolution (property test over seeds);
+  * sim-tier slow-op auto-sampling — a slow op's end-to-end trace
+    assembles with linked stages;
+  * process tier — one slow wire op yields an assembled trace
+    spanning >= 3 PROCESSES (client, primary daemon, replica
+    daemons) with >= 5 linked stages, retrievable by op id via
+    `ceph trace`, and the mon's cluster stats / Prometheus scrape
+    agree with the per-daemon asok sources they aggregate.
+"""
+import os
+import random
+import time
+
+import pytest
+
+from ceph_tpu.common import tracer as tracing
+from ceph_tpu.common.op_tracker import tracker
+from ceph_tpu.common.options import config
+from ceph_tpu.common.perf_counters import PerfHistogram, perf
+from ceph_tpu.common.tracer import Tracer, assemble
+from ceph_tpu.mgr.cluster_stats import (ClusterStats, merge_histograms,
+                                        quantile)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_tracer():
+    """Tracing armed + an empty buffer per test; restore tracer AND
+    op-tracker state after (both are process-global like the fault
+    registry — leaked slow ops / a leaked complaint time would
+    poison later suites' health checks).  Restore goes THROUGH
+    set() because the op_tracker config cache is observer-fed and
+    clear() alone does not notify (the test_op_tracker trk idiom)."""
+    tracing.arm()
+    tracing.tracer().reset()
+    yield
+    tracing.arm()
+    tracing.tracer().reset()
+    tracker().reset()
+    config().set("op_tracker_complaint_time", 30.0)
+    config().clear("op_tracker_complaint_time")
+
+
+# ------------------------------------------------ histogram merging ---
+
+@pytest.mark.parametrize("seed", [0, 1, 7])
+def test_merged_quantiles_match_pooled_samples(seed):
+    """Property: bucket-wise merge of N daemons' log2 histograms
+    must yield p50/p99/p999 equal to the pooled samples' quantiles
+    within ONE bucket's resolution (le bound covers the sample, and
+    the bucket below does not — a 2x band for log2 buckets)."""
+    r = random.Random(seed)
+    pooled = []
+    dumps = []
+    for _daemon in range(5):
+        h = PerfHistogram()
+        for _ in range(400):
+            # heavy-tailed latencies: microseconds to seconds
+            v = 10 ** r.uniform(-6, 0.5)
+            h.record(v)
+            pooled.append(v)
+        dumps.append(h.dump())
+    merged = merge_histograms(dumps)
+    assert merged["count"] == len(pooled)
+    assert merged["sum"] == pytest.approx(sum(pooled), rel=1e-6)
+    pooled.sort()
+    for q in (0.5, 0.99, 0.999):
+        est = quantile(merged, q)
+        # the exact quantile of the pooled samples
+        idx = min(len(pooled) - 1, int(q * len(pooled)))
+        exact = pooled[idx]
+        assert est is not None
+        # one log2 bucket of resolution: the reported le bound is >=
+        # the exact sample and within one bucket width above it
+        assert est >= exact * (1 - 1e-9), (q, est, exact)
+        assert est <= exact * 2 * (1 + 1e-9), (q, est, exact)
+
+
+def test_merge_handles_empty_and_overflow_buckets():
+    h = PerfHistogram(n_buckets=4)
+    h.record(1e9)                      # lands in +Inf overflow
+    merged = merge_histograms([h.dump(), {}, None])
+    assert merged["count"] == 1
+    assert merged["buckets"][-1][0] == "+Inf"
+    # +Inf answers quantiles with the last finite bound (or None if
+    # no finite bucket exists at all)
+    assert quantile(merged, 0.5) is None
+    h.record(h.base / 2)               # now one finite bucket too
+    merged = merge_histograms([h.dump()])
+    assert quantile(merged, 0.99) == pytest.approx(h.base)
+
+
+# ----------------------------------------------------- tracer core ---
+
+def test_span_buffer_drops_are_counted_with_occupancy():
+    t = Tracer(max_spans=10)
+    base = perf("tracer").get("spans_dropped") or 0
+    for i in range(25):
+        with t.start_span(f"s{i}"):
+            pass
+    d = t.dump_traces()
+    assert d["occupancy"] <= 10
+    assert t.spans_dropped > 0
+    assert d["spans_dropped"] == t.spans_dropped
+    assert (perf("tracer").get("spans_dropped") or 0) - base == \
+        t.spans_dropped
+    assert d["max_spans"] == 10
+
+
+def test_pinned_trace_survives_buffer_trim():
+    t = Tracer(max_spans=10)
+    with t.start_span("keeper") as span:
+        tid = span.trace_id
+    t.pin_trace(tid)
+    for i in range(50):
+        with t.start_span(f"noise{i}"):
+            pass
+    kept = t.spans_for(tid)
+    assert [s["name"] for s in kept] == ["keeper"]
+    assert tid in t.dump_traces()["sampled"]
+
+
+def test_exception_path_finishes_span_with_error_tag():
+    """Regression (ISSUE 10 satellite): a context-managed span whose
+    body raises must still finish — tagged error — instead of
+    leaking."""
+    t = Tracer()
+    with pytest.raises(ValueError):
+        with t.start_span("boom"):
+            raise ValueError("x")
+    spans = t.dump()
+    assert len(spans) == 1
+    assert spans[0]["tags"]["error"] == "ValueError"
+    assert spans[0]["duration_s"] >= 0
+
+
+def test_leaked_open_span_swept_with_error_tag():
+    """A manually opened span abandoned on an exception path is
+    force-finished by the leak sweep with error=leaked (and counted
+    in dump_traces' open_spans until then)."""
+    t = Tracer()
+    t.span_open("leaky", osd=3)
+    # young open spans are visible in the dump's health fields but
+    # not yet swept (default leak age is minutes)
+    assert t.dump_traces()["open_spans"] == 1
+    assert t.finish_leaked(0.0) == 1
+    spans = [s for s in t.dump() if s["name"] == "leaky"]
+    assert spans and spans[0]["tags"]["error"] == "leaked"
+    assert t.dump_traces()["open_spans"] == 0
+    # a normal finish carries no error
+    sp2 = t.span_open("fine")
+    t.finish_span(sp2)
+    fine = [s for s in t.dump() if s["name"] == "fine"]
+    assert fine and "error" not in fine[0]["tags"]
+
+
+def test_finish_after_leak_sweep_does_not_double_insert():
+    """An op that stalls past the leak age and THEN completes must
+    not land in the buffer twice: the sweep's error=leaked verdict
+    stands and the late finish_span is a no-op."""
+    t = Tracer()
+    sp = t.span_open("stalled")
+    assert t.finish_leaked(0.0) == 1
+    t.finish_span(sp, error="IOError")       # late completion
+    spans = [s for s in t.dump() if s["name"] == "stalled"]
+    assert len(spans) == 1
+    assert spans[0]["tags"]["error"] == "leaked"
+
+
+def test_osd_df_and_df_skip_non_osd_reporters():
+    """Clients report perf too (the sim tier's 'client' entity) but
+    own no store — they must not fabricate `ceph osd df` rows or
+    fold zeros into the RAW totals."""
+    cs = ClusterStats()
+    now = time.time()
+    cs.ingest("client", {"ts": now, "perf": {}})
+    cs.ingest("osd.0", {"ts": now, "perf": {},
+                        "util": {"bytes": 10, "total_bytes": 100,
+                                 "objects": 1, "pools": {}}})
+    assert [r["daemon"] for r in cs.osd_df()] == ["osd.0"]
+    assert cs.df()["total_bytes"] == 100
+    assert "client" in cs.daemons()          # still a live reporter
+
+
+def test_disarmed_tracing_costs_one_dict_miss():
+    """Acceptance: 100k traced-path executions with tracing disarmed
+    complete in << 1 s (the faultpoint dict-miss contract)."""
+    tracing.disarm()
+    try:
+        t0 = time.perf_counter()
+        for _ in range(100_000):
+            tracing.stamp({"cmd": "put_shard"})
+            with tracing.child_span("x"):
+                pass
+            with tracing.start_span("y"):
+                pass
+        dt = time.perf_counter() - t0
+    finally:
+        tracing.arm()
+    assert dt < 1.0, f"disarmed trace sites cost {dt:.2f}s per 100k"
+    assert tracing.tracer().dump_traces()["num_spans"] == 0
+
+
+def test_stamp_propagates_active_context_and_assembles():
+    t = tracing.tracer()
+    with t.start_span("root") as root:
+        req = tracing.stamp({"cmd": "put_shard"})
+        assert req["tctx"] == [root.trace_id, root.span_id]
+    # remote side: a linked child from the carried context
+    with tracing.linked_span("remote.op", req["tctx"], osd=1):
+        pass
+    trees = assemble(t.dump())
+    tree = trees[root.trace_id]
+    assert tree["spans"] == 2
+    assert tree["roots"][0]["name"] == "root"
+    assert tree["roots"][0]["children"][0]["name"] == "remote.op"
+    # no active span + disarmed-like absence: stamp leaves untouched
+    clean = tracing.stamp({"cmd": "get_shard"})
+    assert "tctx" not in clean
+
+
+def test_assemble_surfaces_orphan_spans_as_roots():
+    """A span whose parent never arrived (buffer churn on one
+    daemon) must surface as an extra root, not vanish — a partial
+    trace is still evidence."""
+    spans = [
+        {"trace_id": 9, "span_id": 1, "parent_id": None,
+         "name": "a", "service": "client", "ts": 1.0,
+         "duration_s": 0.5, "tags": {}},
+        {"trace_id": 9, "span_id": 2, "parent_id": 777,
+         "name": "orphan", "service": "osd.1", "ts": 1.1,
+         "duration_s": 0.1, "tags": {}},
+    ]
+    tree = assemble(spans)[9]
+    assert tree["spans"] == 2
+    assert {r["name"] for r in tree["roots"]} == {"a", "orphan"}
+    assert tree["services"] == ["client", "osd.1"]
+
+
+# ----------------------------------------------- cluster stats core ---
+
+def test_io_rates_from_counter_deltas():
+    cs = ClusterStats()
+    t0 = time.time() - 2.0
+    cs.ingest("osd.0", {"ts": t0, "perf": {"osd.io": {
+        "wr_ops": ("counter", 10), "wr_bytes": ("counter", 1000),
+        "pool.1.wr_bytes": ("counter", 1000)}}})
+    cs.ingest("osd.0", {"ts": t0 + 2.0, "perf": {"osd.io": {
+        "wr_ops": ("counter", 30), "wr_bytes": ("counter", 5000),
+        "pool.1.wr_bytes": ("counter", 5000)}}})
+    io = cs.io_rates()
+    assert io["cluster"]["wr_ops"] == pytest.approx(10.0)
+    assert io["cluster"]["wr_bytes"] == pytest.approx(2000.0)
+    assert io["pools"][1]["wr_bytes"] == pytest.approx(2000.0)
+    assert io["daemons"]["osd.0"]["wr_ops"] == pytest.approx(10.0)
+
+
+def test_cluster_stats_merges_and_renders_per_daemon_labels():
+    cs = ClusterStats()
+    now = time.time()
+    total = 0
+    for i in range(3):
+        h = PerfHistogram()
+        for j in range(100 * (i + 1)):
+            h.record(1e-4 * (j + 1))
+        total += h.count
+        cs.ingest(f"osd.{i}", {
+            "ts": now,
+            "perf": {"op_tracker": {
+                "stage_osd_to_device_s": ("histogram", h.dump())}},
+            "util": {"bytes": 1 << 20, "total_bytes": 4 << 20,
+                     "objects": 5,
+                     "pools": {1: {"objects": 5, "bytes": 999}}}})
+    qq = cs.merged_quantiles()
+    fam = qq["op_tracker.stage_osd_to_device_s"]
+    assert fam["count"] == total
+    assert fam["p50"] is not None and fam["p999"] >= fam["p50"]
+    rows = cs.osd_df()
+    assert len(rows) == 3
+    assert rows[0]["utilization"] == pytest.approx(0.25)
+    df = cs.df()
+    assert df["pools"][1]["objects"] == 15
+    text = cs.render_prometheus()
+    for i in range(3):
+        assert f'ceph_daemon="osd.{i}"' in text
+    assert "# TYPE ceph_cluster_op_tracker_stage_osd_to_device_s " \
+        "histogram" in text
+    assert 'quantile="0.99"' in text
+    assert "ceph_osd_utilization" in text
+
+
+def test_stale_reporters_age_out():
+    cs = ClusterStats(stale_s=0.05)
+    cs.ingest("osd.9", {"ts": time.time() - 10.0, "perf": {}})
+    assert cs.daemons() == []
+    cs.ingest("osd.8", {"ts": time.time(), "perf": {}})
+    assert cs.daemons() == ["osd.8"]
+
+
+# --------------------------------------------- sim-tier auto-sample ---
+
+def _make_sim():
+    from ceph_tpu.cluster.monitor import Monitor
+    from ceph_tpu.cluster.objecter import Objecter
+    from ceph_tpu.cluster.osdmap import (OSDMap, PGPool,
+                                         POOL_REPLICATED)
+    from ceph_tpu.cluster.simulator import ClusterSim
+    from ceph_tpu.placement.builder import build_flat_cluster
+    from ceph_tpu.placement.crush_map import (
+        RULE_CHOOSELEAF_FIRSTN, RULE_EMIT, RULE_TAKE, Rule)
+    cmap, root = build_flat_cluster(n_hosts=4, osds_per_host=2,
+                                    seed=3)
+    cmap.add_rule(Rule(steps=[(RULE_TAKE, root, 0),
+                              (RULE_CHOOSELEAF_FIRSTN, 0, 1),
+                              (RULE_EMIT, 0, 0)]))
+    om = OSDMap(cmap)
+    om.mark_all_in_up()
+    om.add_pool(PGPool(id=1, name="rep", type=POOL_REPLICATED,
+                       size=3, pg_num=16, crush_rule=0))
+    sim = ClusterSim(om)
+    mon = Monitor(sim.osdmap)
+    return sim, mon, Objecter(sim, mon)
+
+
+def test_sim_slow_op_auto_samples_linked_trace():
+    """A slow sim-tier op pins its trace; assembly yields one tree
+    with >= 5 linked stages (objecter root, queue, dispatch, device)
+    and the slow ring's record maps op id -> trace id."""
+    sim, mon, client = _make_sim()
+    config().set("op_tracker_complaint_time", 0.01)
+    for svc in sim.services:
+        svc.inject_execute_delay = 0.02
+    try:
+        client.put(1, "laggard", b"l" * 2048)
+    finally:
+        for svc in sim.services:
+            svc.inject_execute_delay = 0.0
+        config().clear("op_tracker_complaint_time")
+    rec = next(op for op in tracker().dump_historic_slow_ops()["ops"]
+               if op.get("obj") == "laggard")
+    tid = rec["trace_id"]
+    assert tid in tracing.tracer().sampled_traces()
+    tree = assemble(tracing.tracer().spans_for(tid))[tid]
+    assert tree["spans"] >= 5
+    names = set()
+
+    def walk(n):
+        names.add(n["name"])
+        for c in n["children"]:
+            walk(c)
+    for r in tree["roots"]:
+        walk(r)
+    assert {"objecter.op", "osd.queue", "osd.dispatch",
+            "device.dispatch"} <= names
+
+
+# ------------------------------------------------- process tier ------
+
+@pytest.mark.smoke
+def test_slow_wire_op_assembles_cross_process_trace(tmp_path,
+                                                    monkeypatch):
+    """Acceptance: an op exceeding op_tracker_complaint_time on the
+    wire tier produces ONE assembled cross-daemon trace with >= 5
+    linked stages spanning >= 3 processes (client, primary OSD,
+    replica OSDs), retrievable by op id via `ceph trace`; and the
+    mon's cluster stats / Prometheus scrape agree with the
+    per-daemon asok sources they aggregate."""
+    from ceph_tpu.common.admin import admin_request
+    from ceph_tpu.tools import ceph_cli
+    from ceph_tpu.tools.vstart import Vstart, build_cluster_dir
+
+    # daemons inherit slow-everything complaint time + tracing on
+    monkeypatch.setenv("CEPH_TPU_OP_TRACKER_COMPLAINT_TIME", "0")
+    d = str(tmp_path / "cluster")
+    build_cluster_dir(d, n_osds=3, osds_per_host=1, fsync=False)
+    v = Vstart(d)
+    v.start(3, hb_interval=0.25)
+    config().set("op_tracker_complaint_time", 0.0)
+    try:
+        from ceph_tpu.client.remote import RemoteCluster
+        rc = RemoteCluster(d)
+        rc.serve_admin()              # objecter.asok for `ceph trace`
+        assert rc.put(1, "traced-obj", b"t" * 2048) >= 2
+        rec = next(
+            op for op in tracker().dump_historic_slow_ops()["ops"]
+            if op.get("obj") == "traced-obj")
+        tid = rec["trace_id"]
+        assert tid in tracing.tracer().sampled_traces()
+
+        # ---- collect spans from every process and assemble
+        spans = list(tracing.tracer().dump_traces()["spans"])
+        for i in range(3):
+            r = admin_request(os.path.join(d, f"osd.{i}.asok"),
+                              {"prefix": "dump_traces"})
+            spans.extend(r["result"]["spans"])
+        tree = assemble(s for s in spans
+                        if s["trace_id"] == tid).get(tid)
+        assert tree is not None, "no spans assembled for the slow op"
+        assert tree["spans"] >= 5, tree
+        # >= 3 PROCESSES: the client plus at least two OSD daemons
+        services = set(tree["services"])
+        assert "client" in services
+        assert len([s for s in services
+                    if s.startswith("osd.")]) >= 2, services
+        # linked stages include the wire submit and daemon-side op +
+        # dispatch stages
+        flat = []
+
+        def walk(n):
+            flat.append(n["name"])
+            for c in n["children"]:
+                walk(c)
+        for r_ in tree["roots"]:
+            walk(r_)
+        assert "objecter.wire_submit" in flat
+        assert "osd.op" in flat and "osd.dispatch" in flat
+
+        # ---- retrievable by op id over the admin sockets
+        import io
+        buf = io.StringIO()
+        rcode = ceph_cli.main(
+            ["--dir", d, "trace", str(rec["op_id"])], out=buf)
+        assert rcode == 0, buf.getvalue()
+        assert "osd." in buf.getvalue()
+        assert f"{tid:x}" in buf.getvalue()
+
+        # ---- cluster stats agree with the per-daemon asok sources
+        deadline = time.monotonic() + 30
+        fam_name = None
+        while time.monotonic() < deadline:
+            cs = rc.mon_call({"cmd": "cluster_stats",
+                              "metrics": True})
+            qq = cs.get("quantiles") or {}
+            candidates = {k: v for k, v in qq.items()
+                          if k.startswith("op_tracker.") and
+                          v.get("count")}
+            if candidates:
+                fam_name, fam = sorted(candidates.items())[0]
+                group, key = fam_name.rsplit(".", 1)
+                src_count = 0
+                for i in range(3):
+                    p = admin_request(
+                        os.path.join(d, f"osd.{i}.asok"),
+                        {"prefix": "perf dump"})["result"]
+                    src_count += ((p.get(group) or {})
+                                  .get(key) or {}).get("count", 0)
+                if src_count == fam["count"] and src_count > 0:
+                    break
+            time.sleep(0.3)
+        else:
+            raise AssertionError(
+                f"cluster stats never agreed with asok sources "
+                f"({fam_name})")
+        assert fam["p50"] is not None and fam["p999"] is not None
+        assert fam["p999"] >= fam["p50"]
+        # the single cluster-wide scrape carries per-daemon labels
+        # and merged families
+        text = cs["prometheus"]
+        assert 'ceph_daemon="osd.0"' in text
+        assert "ceph_cluster_" in text and 'quantile="0.999"' in text
+        # per-OSD utilization present and bounded
+        rows = cs["osd_df"]
+        assert len(rows) == 3
+        assert all(0.0 <= r["utilization"] <= 1.0 for r in rows)
+        # operator surfaces: `ceph osd df` and the `ceph -s` io line
+        buf = io.StringIO()
+        assert ceph_cli.main(["--dir", d, "osd", "df"],
+                             out=buf) == 0
+        assert "osd.0" in buf.getvalue()
+        buf = io.StringIO()
+        assert ceph_cli.main(["--dir", d, "status"], out=buf) == 0
+        assert "io:" in buf.getvalue()
+        rc.close()
+    finally:
+        # drop the env layer BEFORE clearing: clear() notifies
+        # observers with the EFFECTIVE value, and with the env var
+        # still set that would re-pin the op-tracker's cached
+        # complaint time at 0 for the rest of the session
+        monkeypatch.delenv("CEPH_TPU_OP_TRACKER_COMPLAINT_TIME",
+                           raising=False)
+        config().clear("op_tracker_complaint_time")
+        v.stop()

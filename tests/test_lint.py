@@ -541,6 +541,68 @@ def test_ctl604_noqa_suppresses(tmp_path):
     assert not lint(tmp_path, select=["CTL604"]).findings
 
 
+# ------------------------------ CTL7xx: trace-context propagation ---
+
+def test_ctl701_raw_send_without_trace_context(tmp_path):
+    """ISSUE 10: a raw wire send building a data-path request without
+    propagating the active trace context leaves a silent hole in the
+    cross-process trace (the silent-trace-gap bug class)."""
+    write(tmp_path, "cluster/svc.py", """\
+        def fanout(self, peer, coll, oid, data):
+            self.peer_client(peer).call({
+                "cmd": "put_shard", "coll": coll,
+                "oid": oid, "data": data})
+
+        def pull(self, m, coll, oid):
+            return self._peer_req(m, {"cmd": "get_shard",
+                                      "coll": coll, "oid": oid})
+        """)
+    res = lint(tmp_path, select=["CTL701"])
+    assert rules_of(res) == ["CTL701", "CTL701"]
+    assert [f.line for f in res.findings] == [2, 7]
+    assert "tracer.stamp" in res.findings[0].msg
+
+
+def test_ctl701_negatives(tmp_path):
+    """Stamped sends, explicit tctx, control commands, stamping
+    chokepoints and out-of-scope dirs are all clean."""
+    write(tmp_path, "cluster/good.py", """\
+        from ..common import tracer as _trace
+
+        def stamped(self, peer, coll, oid, data):
+            self.peer_client(peer).call(_trace.stamp({
+                "cmd": "put_shard", "coll": coll,
+                "oid": oid, "data": data}))
+
+        def carried(self, peer, ctx):
+            self.peer_client(peer).call({
+                "cmd": "get_shard", "tctx": ctx})
+
+        def control(self, mon):
+            mon.call({"cmd": "get_map"})
+
+        def chokepoint(self, osd, coll, oid, data):
+            # osd_call routes through AsyncObjecter's central stamp
+            self.osd_call(osd, {"cmd": "put_object", "coll": coll,
+                                "oid": oid, "data": data})
+        """)
+    write(tmp_path, "tools/out_of_scope.py", """\
+        def raw(self, c):
+            c.call({"cmd": "put_shard", "coll": [1, 0], "oid": "x"})
+        """)
+    assert not lint(tmp_path, select=["CTL701"]).findings
+
+
+def test_ctl701_noqa_suppresses(tmp_path):
+    write(tmp_path, "client/probe.py", """\
+        def probe(self, c):
+            return c.call(
+                {"cmd": "digest_shard",  # noqa: CTL701 -- probe only
+                 "coll": [1, 0], "oid": "x"})
+        """)
+    assert not lint(tmp_path, select=["CTL701"]).findings
+
+
 # ------------------------------------------- framework behavior ---
 
 def test_noqa_inline_suppression(tmp_path):
@@ -622,7 +684,8 @@ def test_registry_mirrors_plugin_contract():
     reg = RuleRegistry.instance()
     ids = reg.names()
     # one rule family minimum per the six invariant classes
-    for family in ("CTL1", "CTL2", "CTL3", "CTL4", "CTL5", "CTL6"):
+    for family in ("CTL1", "CTL2", "CTL3", "CTL4", "CTL5", "CTL6",
+                   "CTL7"):
         assert any(r.startswith(family) for r in ids), family
     with pytest.raises(LintError, match="already registered"):
         reg.add("CTL301", type(reg.factory("CTL301")))
