@@ -760,6 +760,119 @@ def bench_cluster_sharded(k=4, m=2, obj_bytes=4 << 20, batch_n=16,
         sim.shutdown()
 
 
+def bench_rebuild_osd(k=8, m=3, n_osds=40, pg_num=1 << 20,
+                      n_objs=64, obj_bytes=8 << 20):
+    """HEADLINE (ISSUE 11): rebuild a whole FAILED OSD at 1M PGs.
+    Populate through the batched device client, kill one OSD, mark
+    it out (CRUSH re-homes every shard it held), then ONE full-map
+    remap sweep + ONE device-resident recovery pass rebuilds and
+    re-places all of them — presence probes plan the fetch, bulk
+    async sub-ops gather survivors, the grouped masked-XOR rebuild
+    dispatches (collectively when a mesh is up), and bulk async
+    pushes land the rebuilt shards.  Reports wall-clock, GB/s moved,
+    and the PR-10 trace-driven stage breakdown of where the wall
+    time went."""
+    import jax.numpy as jnp
+    from ceph_tpu.cluster.osdmap import OSDMap, PGPool, POOL_ERASURE
+    from ceph_tpu.cluster.simulator import ClusterSim
+    from ceph_tpu.common.tracer import tracer as _tr
+    from ceph_tpu.placement.builder import TYPE_HOST, build_flat_cluster
+    from ceph_tpu.placement.crush_map import (
+        RULE_CHOOSELEAF_INDEP, RULE_EMIT, RULE_TAKE, Rule)
+    cmap, root = build_flat_cluster(n_hosts=n_osds // 2,
+                                    osds_per_host=2)
+    cmap.add_rule(Rule(steps=[(RULE_TAKE, root, 0),
+                              (RULE_CHOOSELEAF_INDEP, 0, TYPE_HOST),
+                              (RULE_EMIT, 0, 0)]))
+    om = OSDMap(cmap)
+    om.mark_all_in_up()
+    U = 1 << 20
+    W = U // 4
+    om.add_pool(PGPool(id=1, name="ec", type=POOL_ERASURE, size=k + m,
+                       pg_num=pg_num, crush_rule=0,
+                       erasure_code_profile="p", stripe_unit=U))
+    sim = ClusterSim(om)
+    try:
+        sim.create_ec_profile("p", {"plugin": "jax", "k": str(k),
+                                    "m": str(m)})
+        sim.staging_flush = "staged"
+        S = max(1, obj_bytes // (k * U))
+        block = (jnp.arange(k * W, dtype=jnp.int32) *
+                 jnp.int32(-1640531527)).reshape(1, k, W)
+
+        def sync_staged():
+            bufs = {}
+            for o in sim.osds:
+                for e in o.dev._entries.values():
+                    bufs[id(e.arr.buf)] = e.arr.buf
+            if bufs:
+                jnp.stack([b[(0,) * b.ndim] for b in bufs.values()]
+                          ).max().item()
+
+        def place(tag, count):
+            names = [f"{tag}{i}" for i in range(count)]
+            res = sim.put_many_from_device(
+                1, names, jnp.tile(block, (count * S, 1, 1)))
+            sync_staged()
+            counts = {}
+            for placed in res.values():
+                for o in placed:
+                    counts[o] = counts.get(o, 0) + 1
+            return names, counts
+
+        # warm round at the SAME shapes: compile the map-sweep and
+        # assemble/decode executables outside the timed sweep
+        # (remote-compile costs seconds through a driver tunnel),
+        # then remove its objects and revive
+        wnames, wcounts = place("wr", n_objs)
+        wv = max(wcounts, key=wcounts.get)
+        sim.kill_osd(wv)
+        sim.out_osd(wv)
+        sim.osdmap.map_pgs_batch(1)
+        sim.recover_all(1)
+        sync_staged()
+        for nm in wnames:
+            try:
+                sim.delete(1, nm)
+            except (IOError, KeyError):
+                pass
+        sim.revive_osd(wv)
+        # the measured round: one whole-OSD loss
+        names, counts = place("ro", n_objs)
+        victim = max(counts, key=counts.get)
+        victim_shards = counts[victim]
+        sim.kill_osd(victim)
+        sim.out_osd(victim)
+        _tr().reset()
+        t0 = time.perf_counter()
+        with _tr().start_span("rebuild.sweep"):
+            sim.osdmap.map_pgs_batch(1)   # the 1M-PG remap sweep
+            st = sim.recover_all(1)
+            sync_staged()
+        wall = time.perf_counter() - t0
+        spans = _tr().dump_traces()["spans"]
+        ids = {s["trace_id"] for s in spans
+               if s.get("name") == "rebuild.sweep"}
+        moved = st.get("shards_rebuilt", 0) + st.get("shards_copied",
+                                                     0)
+        return {
+            "n_pgs": pg_num,
+            "objects": n_objs,
+            "object_mib": obj_bytes >> 20,
+            "victim_osd": int(victim),
+            "victim_shards": int(victim_shards),
+            "shards_moved": moved,
+            "wall_clock_s": round(wall, 3),
+            "moved_gbps": round(
+                moved * S * U / max(wall, 1e-9) / 1e9, 4),
+            "recover": st,
+            "stage_breakdown": _trace_stage_breakdown(
+                spans, trace_ids=ids),
+        }
+    finally:
+        sim.shutdown()
+
+
 def bench_process_cluster(k=8, m=3, obj_bytes=256 << 20, batch_n=16,
                           rounds=4, n_osds=12, pg_num=32,
                           flush_mib=64, recovery_objects=16,
@@ -925,6 +1038,21 @@ def bench_process_cluster(k=8, m=3, obj_bytes=256 << 20, batch_n=16,
         rS = max(1, recovery_obj_bytes // (k * U))
         rpayload = jnp.tile(block, (recovery_objects * rS, 1, 1))
         rc.put_many_from_device(1, rnames, rpayload, durable=False)
+        # the ASYNC flush drain, measured: bulk readback per staged
+        # buffer + one pipelined put_shard sweep (the satellite
+        # before/after — flush_readback_gbps above is the old
+        # per-shard readback path, this is the rewired flush_staged)
+        sync_staged()
+        dirty_bytes = sum(
+            e.nbytes for e in rc.dev._entries.values()
+            if e.csum is None)
+        t0 = time.perf_counter()
+        fl_n = rc.flush_staged(1)
+        t_fl = time.perf_counter() - t0
+        out["flush_staged_gbps"] = round(
+            dirty_bytes / max(t_fl, 1e-9) / 1e9, 3)
+        out["flush_staged_shards"] = fl_n
+        out["flush_staged_mib"] = dirty_bytes >> 20
         # durable: flush everything (timed separately above; not part
         # of the recovery measurement)
         deadline = time.monotonic() + 120
@@ -1255,6 +1383,12 @@ def main():
                 obj_bytes=32 << 20, rounds=2)
     except Exception as e:
         print(f"# process cluster bench failed: {e}", file=sys.stderr)
+    try:
+        import gc
+        gc.collect()
+        extras["rebuild_osd"] = bench_rebuild_osd()
+    except Exception as e:
+        print(f"# rebuild osd bench failed: {e}", file=sys.stderr)
     try:
         import gc
         gc.collect()

@@ -21,10 +21,20 @@ invocation.  These rules walk the jit-reachable call graph
           every completion pipelined behind it.  Work handed to an
           engine via ``.submit(...)`` is deferred off the callback
           thread and exempt (that is the sanctioned escape hatch).
+  CTL120  per-shard blocking wire round trip inside a loop in a
+          recovery/backfill function (cluster// client//) — the
+          pattern ISSUE 11 retired: a recovery sweep that fetches or
+          pushes one shard per blocking ``osd_call``/``_peer_req``/
+          ``.call`` pays an RTT per shard, which is the 0.002 GB/s
+          wire-recovery floor BENCH r05 measured.  Submit-all-then-
+          gather (``call_async`` + ``gather``) and bulk scatter-
+          gather frames (``get_objects``/``put_objects``) are the
+          sanctioned shapes and exempt.
 """
 from __future__ import annotations
 
 import ast
+import re
 from typing import Iterable, List, Set, Tuple
 
 from . import astutil
@@ -264,8 +274,85 @@ class CallbackBlockingRule(Rule):
         return out
 
 
+# per-shard data-transfer commands: one of these inside a blocking
+# loop is an RTT per shard; control-plane commands (pg_info,
+# recover_pg, reserve_recovery, ...) and the bulk frames
+# (get_objects/put_objects/delete_objects) are per-PG and exempt
+_PER_SHARD_CMDS = frozenset((
+    "get_shard", "put_shard", "getattr_shard", "setattr_shard",
+    "digest_shard", "stat_shard", "delete_shard"))
+
+# blocking senders; the async submission path (call_async) is exempt
+_BLOCKING_SEND_ATTRS = frozenset(("call", "osd_call", "_peer_req"))
+
+_RECOVERY_FN_RE = re.compile(r"recover|backfill")
+
+
+class RecoveryShardLoopRule(Rule):
+    rule_id = "CTL120"
+    name = "recovery-per-shard-blocking-loop"
+    description = ("per-shard blocking wire round trip inside a loop "
+                   "on a recovery/backfill path — use submit-all-"
+                   "then-gather (call_async + gather) or a bulk "
+                   "scatter-gather frame")
+
+    @staticmethod
+    def _req_cmd(call: ast.Call):
+        """The literal ``cmd`` value of a request dict anywhere in
+        the call's argument tree (dicts may ride a tracer.stamp()
+        wrapper or a variable is invisible — literal-only, the same
+        bar CTL701 sets)."""
+        for node in ast.walk(call):
+            if not isinstance(node, ast.Dict):
+                continue
+            for k, v in zip(node.keys, node.values):
+                if isinstance(k, ast.Constant) and k.value == "cmd" \
+                        and isinstance(v, ast.Constant):
+                    return v.value
+        return None
+
+    def check_module(self, mod: ParsedModule) -> Iterable[Finding]:
+        if mod.evidence:
+            return ()
+        parts = mod.parts()
+        if "cluster" not in parts and "client" not in parts:
+            return ()
+        out: List[Finding] = []
+        seen: Set[int] = set()
+        for fn in ast.walk(mod.tree):
+            if not isinstance(fn, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef)):
+                continue
+            if not _RECOVERY_FN_RE.search(fn.name):
+                continue
+            for loop in ast.walk(fn):
+                if not isinstance(loop, (ast.For, ast.While)):
+                    continue
+                for call in ast.walk(loop):
+                    if not isinstance(call, ast.Call) or \
+                            not isinstance(call.func, ast.Attribute):
+                        continue
+                    if call.func.attr not in _BLOCKING_SEND_ATTRS:
+                        continue
+                    cmd = self._req_cmd(call)
+                    if cmd not in _PER_SHARD_CMDS:
+                        continue
+                    if call.lineno in seen:
+                        continue
+                    seen.add(call.lineno)
+                    out.append(self.finding(
+                        mod, call.lineno,
+                        f"blocking {cmd!r} round trip inside a loop "
+                        f"in recovery path {fn.name!r}: one RTT per "
+                        f"shard is the wire-recovery floor — submit "
+                        f"the sweep async (call_async + gather) or "
+                        f"ship a bulk get_objects/put_objects frame"))
+        return out
+
+
 def register(reg) -> None:
     reg.add(HostSyncRule.rule_id, HostSyncRule)
     reg.add(TracerBranchRule.rule_id, TracerBranchRule)
     reg.add(JitPerCallRule.rule_id, JitPerCallRule)
     reg.add(CallbackBlockingRule.rule_id, CallbackBlockingRule)
+    reg.add(RecoveryShardLoopRule.rule_id, RecoveryShardLoopRule)

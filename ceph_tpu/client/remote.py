@@ -32,6 +32,7 @@ from ..common.op_tracker import tracker as _op_tracker
 from ..cluster.daemon import WireClient
 from ..cluster.osdmap import OSDMap, PGPool, POOL_ERASURE
 from ..ec import instance as ec_registry
+from ..ec.interface import ErasureCodeError
 from ..ops import hashing
 from ..placement.compiler import compile_crushmap
 from ..placement.crush_map import ITEM_NONE
@@ -1297,11 +1298,19 @@ class RemoteCluster:
         """Replicated pools: primary-driven PEERING recovery per PG
         (GetInfo/GetLog/GetMissing on the primary daemon; members
         catch up by log delta when the log covers their gap, else
-        backfill — src/osd/PeeringState.h:561, PGLog.h)."""
+        backfill — src/osd/PeeringState.h:561, PGLog.h).
+
+        PGs recover CONCURRENTLY under the daemons' recovery
+        reservations (osd_max_backfills): each primary takes a local
+        slot plus remote slots on its members before moving a byte; a
+        denied PG comes back ``deferred`` and requeues.  When a whole
+        round defers (every slot held elsewhere), one PG runs solo so
+        the loop always advances."""
         pool = self.osdmap.pools[pool_id]
         totals = {"copied": 0, "delta_objects": 0,
                   "backfill_objects": 0, "deletes_applied": 0,
                   "modes": {"delta": 0, "backfill": 0, "clean": 0}}
+        work = []
         for pg in range(pool.pg_num):
             up = self._up(pool, pg)
             members = [o for o in up if o != ITEM_NONE]
@@ -1315,24 +1324,73 @@ class RemoteCluster:
             # to recovery forever
             strays = [int(o) for o in self.addrs
                       if int(o) not in members]
-            r = None
+            work.append((pg, members, strays))
+
+        def run_pg(item):
+            pg, members, strays = item
             for attempt in range(3):  # a skipped PG stays unrepaired
                 try:
-                    r = self.osd_call(members[0], {
+                    return self.osd_call(members[0], {
                         "cmd": "recover_pg", "coll": [pool_id, pg],
                         "members": members, "strays": strays})
-                    break
                 except (OSError, IOError):
                     self._backoff.sleep(attempt)
-            if r is None:
-                continue
+            return None
+
+        def merge(r) -> None:
             for key in ("copied", "delta_objects",
                         "backfill_objects", "deletes_applied"):
                 totals[key] += r.get(key, 0)
             for mode in r.get("mode", {}).values():
                 totals["modes"][mode] = \
                     totals["modes"].get(mode, 0) + 1
+
+        def run(item):
+            r = run_pg(item)
+            if r is None:
+                return {}         # unreachable primary: next pass
+            return None if r.get("deferred") else r
+
+        left = self._drain_pg_queue(list(work), run, merge)
+        if left:
+            totals["deferred_pgs"] = left
         return totals
+
+    def _drain_pg_queue(self, queue: List, run, merge,
+                        max_workers: int = 8) -> int:
+        """Concurrent requeue loop shared by the reservation-gated
+        recovery sweeps: ``run(item)`` returns a stats dict (merged)
+        or None for a DEFERRED item (requeued).  When a whole round
+        defers, one item runs SOLO so the loop always advances; a
+        bounded stall (a foreign client holding every slot) gives up
+        and returns how many items stayed deferred."""
+        import concurrent.futures as cf
+        stalled = 0
+        with cf.ThreadPoolExecutor(
+                max_workers=min(max_workers,
+                                max(1, len(queue) or 1))) as ex:
+            while queue:
+                deferred = []
+                for item, r in zip(queue, ex.map(run, queue)):
+                    if r is None:
+                        deferred.append(item)
+                    else:
+                        merge(r)
+                if len(deferred) == len(queue):
+                    r = run(deferred[0])
+                    if r is not None:
+                        merge(r)
+                        deferred.pop(0)
+                        stalled = 0   # solo progress IS progress
+                    else:
+                        stalled += 1
+                        if stalled > 10:
+                            return len(deferred)
+                        self._backoff.sleep(stalled)
+                else:
+                    stalled = 0
+                queue = deferred
+        return 0
 
     def scrub_pool(self, pool_id: int,
                    repair: bool = False) -> Dict:
@@ -1369,218 +1427,484 @@ class RemoteCluster:
             totals["repaired"] += r["repaired"]
         return totals
 
+    def _reserve_pg_members(self, members: List[int]
+                            ) -> Optional[List[int]]:
+        """Client-side reservation acquisition for CLIENT-driven EC
+        recovery (this client is the TPU-attached primary): one
+        REMOTE slot per member, all-or-nothing with rollback — an
+        explicit denial defers the PG to the caller's requeue loop
+        (returns None), never waits while holding.  Returns the list
+        of members actually holding a slot (the ONLY ones the caller
+        may release — releasing an unreserved member would decrement
+        a concurrent PG's slot)."""
+        got: List[int] = []
+        for m in members:
+            try:
+                r = self.osd_call(m, {"cmd": "reserve_recovery",
+                                      "role": "remote"})
+            except (OSError, IOError):
+                # UNREACHABLE member: nothing to reserve — proceed
+                # without its slot (its pushes will fail and the
+                # object stays visibly missing for the next pass);
+                # deferring on a dead-but-in-map member would block
+                # every reachable member's repair forever
+                continue
+            if not (r or {}).get("granted"):
+                self._release_pg_members(got)
+                return None
+            got.append(m)
+        return got
+
+    def _release_pg_members(self, members: List[int]) -> None:
+        for m in members:
+            try:
+                self.osd_call(m, {"cmd": "release_recovery",
+                                  "role": "remote"})
+            except (OSError, IOError):
+                pass
+
+    def _gather_shard_fetches(self, coll, wants: Dict) -> Dict:
+        """Submit-all-then-gather shard reads for one PG's repair
+        set: every (object, shard) fetch pipelines onto the
+        AsyncObjecter's multi-stream pools as one round per holder
+        rank — the per-shard blocking round trips this replaces were
+        the wire tier's recovery floor.  ``wants`` maps (name, shard)
+        to (ordered holder list, byte ranges|None); a failed holder
+        fails over to the next on the following round."""
+        out: Dict = {}
+        pending = {wk: (list(hs), rg)
+                   for wk, (hs, rg) in wants.items()}
+        while pending:
+            fan = []
+            for wk, (hs, rg) in list(pending.items()):
+                if not hs:
+                    del pending[wk]
+                    continue
+                o = hs.pop(0)
+                name, shard = wk
+                req = {"cmd": "get_shard", "coll": coll,
+                       "oid": f"{shard}:{name}",
+                       "klass": "background_recovery"}
+                if rg:
+                    req["ranges"] = [list(r) for r in rg]
+                fan.append((wk, o, self.aio.call_async(o, req)))
+            if not fan:
+                break
+            for (wk, o, _c), (d, err) in zip(
+                    fan, self.aio.gather([c for _, _, c in fan])):
+                if err is None and d is not None:
+                    out[wk] = (d, o)
+                    pending.pop(wk, None)
+        return out
+
+    def _gather_attrs(self, coll, cands: Dict) -> Dict:
+        """One ``getattrs_shard`` round trip per object (size/S/U in
+        a single frame), submit-all-then-gather; ``cands`` maps name
+        to its ordered (holder, shard) candidates — each candidate is
+        asked about the shard IT served, and one holder supplies ALL
+        attrs (mixing two holders' geometries is how stale attrs
+        corrupt a rebuild)."""
+        out: Dict = {}
+        pending = {nm: list(cs) for nm, cs in cands.items()}
+        while pending:
+            fan = []
+            for nm, cs in list(pending.items()):
+                if not cs:
+                    del pending[nm]
+                    continue
+                o, shard = cs.pop(0)
+                fan.append((nm, self.aio.call_async(o, {
+                    "cmd": "getattrs_shard", "coll": coll,
+                    "oid": f"{shard}:{nm}",
+                    "keys": ["size", "S", "U"],
+                    "klass": "background_recovery"})))
+            if not fan:
+                break
+            for (nm, _c), (d, err) in zip(
+                    fan, self.aio.gather([c for _, c in fan])):
+                if err is None and d:
+                    cand = {ak: bytes(av) for ak, av in d.items()
+                            if av is not None}
+                    if cand:
+                        out[nm] = cand
+                        pending.pop(nm, None)
+        return out
+
     def recover_ec_pool(self, pool_id: int) -> Dict[str, int]:
         """Client-driven EC recovery (the client is the TPU-attached
-        primary), per PG in three passes: (1) union every daemon's
-        shard listing and fetch only the shards each repair requires;
+        primary), reservation-gated and CONCURRENT across PGs, each
+        PG in three passes: (1) union every daemon's shard listing
+        and fetch only the shards the codec's MINIMAL repair plan
+        requires (``minimum_to_decode`` — LRC repairs inside the
+        covering local group, Clay single losses fetch d helpers'
+        repair SUB-CHUNK ranges and regenerate via ``codec.repair``);
         (2) decode the PG's lost shards in signature-GROUPED device
-        dispatches — every object that lost the same shard set
-        rebuilds in one masked-XOR kernel call, the bench_recovery
-        machinery on the serving path (src/osd/ECBackend.cc:757 →
-        ECUtil::decode, batched); (3) push surviving copies and
-        rebuilt shards to their up targets.  PG-scoped batching keeps
-        client memory bounded by one PG's repair set (objects in one
-        PG share an up set, hence a signature — cross-PG grouping
-        would add residency, not dispatch savings)."""
+        dispatches; (3) push surviving copies and rebuilt shards to
+        their up targets.  Every fetch and push is submit-all-then-
+        gather on the AsyncObjecter's pipelined streams; pushes carry
+        (session, seq) stamps so a stream-death replay applies at
+        most once.  PG-scoped batching keeps client memory bounded by
+        one PG's repair set."""
         pool = self.osdmap.pools[pool_id]
         be = self.ec_backend(pool_id)
-        codec, k, n = be.codec, be.k, be.n
-        stats = {"objects": 0, "shards_copied": 0, "shards_rebuilt": 0}
+        stats: Dict[str, int] = {"objects": 0, "shards_copied": 0,
+                                 "shards_rebuilt": 0}
         live = [o for o in self.addrs
                 if self.osdmap.osd_up[o]]
-        for pg in range(pool.pg_num):
-            records = []      # this PG's repair work items
-            coll = [pool_id, pg]
-            holdings: Dict[int, set] = {}
-            for o in live:
-                try:
-                    holdings[o] = set(self.osd_client(o).call(
-                        {"cmd": "list_pg", "coll": coll}))
-                except (OSError, IOError):
-                    self.drop_osd_client(o)
-            names = set()
-            for objs in holdings.values():
-                for oid in objs:
-                    shard_s, nm = oid.split(":", 1)
-                    names.add(nm)
-            up = self._up(pool, pg)
-            for name in sorted(names):
-                stats["objects"] += 1
-                # cheap membership pass first: skip healthy objects
-                # without moving a byte (holdings already lists every
-                # daemon's oids)
-                have_somewhere = {s for s in range(n)
-                                  if any(f"{s}:{name}" in objs
-                                         for objs in holdings.values())}
-                need = [s for s in range(n)
-                        if s < len(up) and up[s] != ITEM_NONE and
-                        f"{s}:{name}" not in holdings.get(up[s], set())]
-                if not need:
-                    continue
-                lost = [s for s in need if s not in have_somewhere]
-                # fetch only what the repair requires: the sources of
-                # displaced shards, plus k survivors when decoding
-                fetch = set(need) & have_somewhere
-                if lost:
-                    fetch |= set(sorted(have_somewhere)[:n])
 
-                def _get(shard, name=name, coll=coll,
-                         holdings=holdings):
-                    oid = f"{shard}:{name}"
-                    for o in [x for x, objs in holdings.items()
-                              if oid in objs]:
-                        try:
-                            d = self.osd_client(o).call(
-                                _trace.stamp(
-                                    {"cmd": "get_shard",
-                                     "coll": coll, "oid": oid,
-                                     "klass":
-                                     "background_recovery"}))
-                        except (OSError, IOError):
-                            self.drop_osd_client(o)
-                            continue
-                        if d is not None:
-                            return d, o
-                    return None, None
+        def sweep(pg: int) -> Optional[Dict[str, int]]:
+            return self._recover_ec_pg(pool, be, pg, live)
 
-                shards: Dict[int, bytes] = {}
-                shard_src: Dict[int, int] = {}
-                for shard in sorted(fetch):
-                    d, src = _get(shard)
-                    if d is not None:
-                        shards[shard] = d
-                        shard_src[shard] = src
-                missing = [s for s in lost if s not in shards]
-                if missing and len(shards) < k:
-                    # fewer than k survivors: the object is UNFOUND —
-                    # callers must see this, a clean-looking stats
-                    # dict would hide data loss
-                    stats["unrecoverable"] = \
-                        stats.get("unrecoverable", 0) + 1
-                    continue
-                # stripewise objects (batched put) must decode with
-                # per-stripe plane geometry: the bitsliced plane
-                # regions live inside each U-byte chunk, and viewing
-                # S concatenated chunks as one big chunk scrambles
-                # the plane boundaries.  The attrs also ride along to
-                # the re-homed copies — a recovered shard without its
-                # size/S/U would strand geometry after the original
-                # holders die.
-                # attrs come from the SAME holder each shard's bytes
-                # came from: a holder serving stale bytes with fresh
-                # attrs (or vice versa) must not mix geometries —
-                # prefer the holders that actually answered the byte
-                # fetches, asking each about the shard IT served
-                S_obj, obj_attrs = 1, {}
-                for shard, o in sorted(shard_src.items()):
-                    cand: Dict[str, bytes] = {}
-                    try:
-                        for akey in ("size", "S", "U"):
-                            raw = self.osd_client(o).call(
-                                _trace.stamp({
-                                    "cmd": "getattr_shard",
-                                    "coll": coll,
-                                    "oid": f"{shard}:{name}",
-                                    "key": akey}))
-                            if raw is not None:
-                                cand[akey] = bytes(raw)
-                    except (OSError, IOError):
-                        # a holder that died MID-fetch contributes
-                        # nothing: merging its partial attrs with the
-                        # next holder's would mix geometries from two
-                        # sources — the invariant is one holder, all
-                        # attrs
-                        self.drop_osd_client(o)
-                        continue
-                    if cand:
-                        obj_attrs = cand
-                        break       # this holder answered with attrs
-                if "S" in obj_attrs:
-                    S_obj = int(obj_attrs["S"])
-                # geometry gate: every fetched shard must be ONE
-                # consistent length L with L == S_obj * U (attrs) —
-                # a mismatched holder (truncated shard, stale attrs)
-                # counts the object unrecoverable/skipped instead of
-                # an uncaught reshape ValueError killing the whole
-                # pool sweep
-                lengths = {len(d) for d in shards.values()}
-                L = lengths.pop() if len(lengths) == 1 else None
-                bad = shards and (
-                    L is None or (S_obj > 1 and L % S_obj != 0))
-                if not bad and shards and "U" in obj_attrs:
-                    bad = L != S_obj * int(obj_attrs["U"])
-                if bad:
-                    stats["unrecoverable"] = \
-                        stats.get("unrecoverable", 0) + 1
-                    stats["geometry_skipped"] = \
-                        stats.get("geometry_skipped", 0) + 1
-                    continue
-                records.append({"pg": pg, "coll": coll, "name": name,
-                                "up": up, "holdings": holdings,
-                                "shards": shards, "missing": missing,
-                                "S": S_obj, "attrs": obj_attrs,
-                                "rebuilt": set()})
-            # -- signature-grouped decode of this PG's rebuilds
-            jobs, job_recs = [], []
-            for rec in records:
-                missing, shards = rec["missing"], rec["shards"]
-                if not missing:
-                    continue
-                plan = sorted(codec.minimum_to_decode(set(missing),
-                                                      set(shards)))
-                L = len(rec["shards"][plan[0]])
-                S_obj = rec["S"]
-                if be.words_supported() and L % 4 == 0 and \
-                        L % max(S_obj, 1) == 0:
-                    import jax.numpy as jnp
-                    # [S, n_avail, W]: per-stripe plane geometry
-                    stack = np.stack(
-                        [np.frombuffer(shards[c], dtype="<i4")
-                         .reshape(S_obj, -1) for c in plan], axis=1)
-                    jobs.append((plan, jnp.asarray(stack), missing))
-                    job_recs.append(rec)
-                else:
-                    stackb = np.stack(
-                        [np.frombuffer(shards[c], dtype=np.uint8)
-                         .reshape(S_obj, -1) for c in plan], axis=1)
-                    dec = np.asarray(codec.decode_chunks_batch(
-                        plan, stackb, missing))
-                    for i, s in enumerate(missing):
-                        shards[s] = np.ascontiguousarray(
-                            dec[:, i]).tobytes()
-                        rec["rebuilt"].add(s)
-                        stats["shards_rebuilt"] += 1
-            if jobs:
-                decs = be.decode_signature_groups(jobs)
-                for rec, dec in zip(job_recs, decs):
-                    out = np.asarray(dec)          # [S, n_erased, W]
-                    for i, s in enumerate(rec["missing"]):
-                        rec["shards"][s] = np.ascontiguousarray(
-                            out[:, i]).tobytes()
-                        rec["rebuilt"].add(s)
-                        stats["shards_rebuilt"] += 1
-            # -- push surviving copies + rebuilt shards to up targets
-            for rec in records:
-                up, holdings = rec["up"], rec["holdings"]
-                for shard, data in rec["shards"].items():
-                    if shard >= len(up) or up[shard] == ITEM_NONE:
-                        continue
-                    tgt = up[shard]
-                    oid = f"{shard}:{rec['name']}"
-                    if oid in holdings.get(tgt, set()):
-                        continue
-                    try:
-                        self.osd_client(tgt).call(_trace.stamp({
-                            "cmd": "put_shard", "coll": rec["coll"],
-                            "oid": oid, "data": data,
-                            "attrs": rec["attrs"],
-                            "klass": "background_recovery"}))
-                        holdings.setdefault(tgt, set()).add(oid)
-                        if shard not in rec["rebuilt"]:
-                            stats["shards_copied"] += 1
-                    except (OSError, IOError):
-                        self.drop_osd_client(tgt)
+        def merge(r) -> None:
+            for kk, v in r.items():
+                stats[kk] = stats.get(kk, 0) + v
+
+        left = self._drain_pg_queue(list(range(pool.pg_num)), sweep,
+                                    merge)
+        if left:
+            stats["deferred_pgs"] = left
         return stats
+
+    def _recover_ec_pg(self, pool: PGPool, be, pg: int,
+                       live: List[int]) -> Optional[Dict[str, int]]:
+        """One PG's repair sweep; None = reservation denied (the
+        caller requeues).  The reservation is taken only once the
+        plan pass proves there is work to move — a clean PG costs
+        its listings, never a reservation round."""
+        codec, k, n = be.codec, be.k, be.n
+        stats = {"objects": 0, "shards_copied": 0, "shards_rebuilt": 0}
+        coll = [pool.id, pg]
+        # -- listings: one async gather across every live daemon
+        fan = [(o, self.aio.call_async(o, {"cmd": "list_pg",
+                                           "coll": coll}))
+               for o in live]
+        holdings: Dict[int, set] = {}
+        for (o, _c), (r, err) in zip(
+                fan, self.aio.gather([c for _, c in fan])):
+            if err is None and r is not None:
+                holdings[o] = set(r)
+        names = set()
+        for objs in holdings.values():
+            for oid in objs:
+                shard_s, nm = oid.split(":", 1)
+                names.add(nm)
+        up = self._up(pool, pg)
+
+        def holders_of(name, shard):
+            oid = f"{shard}:{name}"
+            return [x for x, objs in holdings.items() if oid in objs]
+
+        # -- plan pass: decide, per object, the minimal fetch set
+        plans = {}
+        for name in sorted(names):
+            stats["objects"] += 1
+            # cheap membership pass first: skip healthy objects
+            # without moving a byte (holdings already lists every
+            # daemon's oids)
+            have_somewhere = {s for s in range(n)
+                              if any(f"{s}:{name}" in objs
+                                     for objs in holdings.values())}
+            need = [s for s in range(n)
+                    if s < len(up) and up[s] != ITEM_NONE and
+                    f"{s}:{name}" not in holdings.get(up[s], set())]
+            if not need:
+                continue
+            lost = [s for s in need if s not in have_somewhere]
+            # fetch only what the repair requires: the sources of
+            # displaced shards, plus the codec's MINIMAL decode set
+            # (not every survivor) when shards must be rebuilt
+            fetch = set(need) & have_somewhere
+            sub_plan = None
+            if lost:
+                try:
+                    sub_plan = codec.minimum_to_decode(
+                        set(lost), set(have_somewhere))
+                except ErasureCodeError:
+                    sub_plan = None
+                if sub_plan is None:
+                    fetch |= set(sorted(have_somewhere)[:n])
+                else:
+                    fetch |= set(sub_plan)
+            plans[name] = (sorted(fetch), lost, have_somewhere,
+                           sub_plan)
+        if not plans:
+            return stats      # clean PG: listings only, no reservation
+        # there IS work to move: take the recovery reservations
+        # (one REMOTE slot per member, all-or-nothing) before the
+        # first payload byte; an explicit denial defers the whole PG
+        members = [o for o in up if o != ITEM_NONE]
+        reserved = self._reserve_pg_members(members)
+        if reserved is None:
+            return None
+        try:
+            return self._recover_ec_pg_move(
+                pool, be, pg, coll, up, plans, holdings, holders_of,
+                stats)
+        finally:
+            self._release_pg_members(reserved)
+
+    def _recover_ec_pg_move(self, pool: PGPool, be, pg: int, coll,
+                            up: List[int], plans: Dict,
+                            holdings: Dict[int, set], holders_of,
+                            stats: Dict[str, int]) -> Dict[str, int]:
+        codec, k, n = be.codec, be.k, be.n
+        sub_chunks = codec.get_sub_chunk_count()
+        records: List[Dict] = []
+        # -- ranged (regenerating-code) single-loss repair CANDIDATES
+        # — the partial-plan shape is decidable from the SubChunkPlan
+        # alone; only these need geometry attrs BEFORE their byte
+        # fetch (byte ranges derive from U), so only they pay a
+        # pre-fetch attr round against listing-derived holders
+        maybe_ranged = {
+            name for name, (fetch, lost, _h, sub_plan)
+            in plans.items()
+            if sub_plan is not None and len(lost) == 1 and
+            not (set(fetch) - set(sub_plan)) and
+            any(sum(c for _o, c in rg) < sub_chunks
+                for rg in sub_plan.values())}
+        attrs_by_name = self._gather_attrs(coll, {
+            name: [(h, s) for s in plans[name][0]
+                   for h in holders_of(name, s)]
+            for name in sorted(maybe_ranged)})
+        ranged = {name: plans[name][3] for name in maybe_ranged
+                  if "U" in attrs_by_name.get(name, {})}
+        wants: Dict = {}
+        for name, (fetch, lost, have, sub_plan) in plans.items():
+            if name in ranged:
+                continue
+            for shard in fetch:
+                wants[(name, shard)] = (holders_of(name, shard), None)
+        fetched = self._gather_shard_fetches(coll, wants)
+        # -- attrs for the decode/push path come from the holders
+        # that actually SERVED each object's bytes (one holder, all
+        # attrs — a holder serving stale bytes with fresh attrs, or
+        # vice versa, must not mix geometries; stripewise objects
+        # must decode with per-stripe plane geometry, and the attrs
+        # ride along to re-homed copies so geometry never strands)
+        attrs_by_name.update(self._gather_attrs(coll, {
+            name: [(src, shard)
+                   for shard in fetch
+                   if (name, shard) in fetched
+                   for src in [fetched[(name, shard)][1]]]
+            for name, (fetch, _l, _h, _p) in plans.items()
+            if name not in ranged and fetch}))
+        for name, sub_plan in ranged.items():
+            st = self._repair_ranged_wire(pool, be, pg, name, up,
+                                          plans[name],
+                                          attrs_by_name.get(name, {}),
+                                          holders_of, holdings)
+            for kk, v in st.items():
+                stats[kk] = stats.get(kk, 0) + v
+        # top-up round: ONLY a name whose minimal-plan fetch actually
+        # FAILED a shard widens to the survivors the plan skipped
+        # (the old fetch-everything slack, paid strictly on failure —
+        # a successful LRC local-group plan is SMALLER than k by
+        # design and must not trigger a fetch of every survivor)
+        topup: Dict = {}
+        for name, (fetch, lost, have, sub_plan) in plans.items():
+            if name in ranged or not lost:
+                continue
+            if any((name, s) not in fetched for s in fetch):
+                for s in sorted(have - set(fetch)):
+                    topup[(name, s)] = (holders_of(name, s), None)
+        if topup:
+            fetched.update(self._gather_shard_fetches(coll, topup))
+            # a top-up source may be the only holder that answered
+            # at all: its attrs must be fetchable too (an object
+            # decoded without its S would scramble stripewise plane
+            # boundaries past the geometry gate)
+            attrs_by_name.update(self._gather_attrs(coll, {
+                name: [(src, shard)
+                       for (nm, shard), (_d, src) in sorted(
+                           fetched.items(),
+                           key=lambda it: it[0][1])
+                       if nm == name]
+                for name in {nm for nm, _s in topup}
+                if name not in attrs_by_name}))
+        for name, (fetch, lost, have, sub_plan) in plans.items():
+            if name in ranged:
+                continue
+            shards: Dict[int, bytes] = {}
+            shard_src: Dict[int, int] = {}
+            for shard in set(fetch) | (set(have) if lost else set()):
+                hit = fetched.get((name, shard))
+                if hit is not None:
+                    shards[shard], shard_src[shard] = hit
+            missing = [s for s in lost if s not in shards]
+            if missing:
+                # decodability gate: can the FETCHED set regenerate
+                # the losses?  (Not `len(shards) < k` — an LRC
+                # local-group plan is SMALLER than k by design and
+                # still decodes; only the codec can answer.)  A 'no'
+                # is an UNFOUND object callers must see — a
+                # clean-looking stats dict would hide data loss
+                try:
+                    codec.minimum_to_decode(set(missing), set(shards))
+                except ErasureCodeError:
+                    stats["unrecoverable"] = \
+                        stats.get("unrecoverable", 0) + 1
+                    continue
+            obj_attrs = attrs_by_name.get(name, {})
+            S_obj = int(obj_attrs["S"]) if "S" in obj_attrs else 1
+            # geometry gate: every fetched shard must be ONE
+            # consistent length L with L == S_obj * U (attrs) —
+            # a mismatched holder (truncated shard, stale attrs)
+            # counts the object unrecoverable/skipped instead of
+            # an uncaught reshape ValueError killing the whole
+            # pool sweep
+            lengths = {len(d) for d in shards.values()}
+            L = lengths.pop() if len(lengths) == 1 else None
+            bad = shards and (
+                L is None or (S_obj > 1 and L % S_obj != 0))
+            if not bad and shards and "U" in obj_attrs:
+                bad = L != S_obj * int(obj_attrs["U"])
+            if bad:
+                stats["unrecoverable"] = \
+                    stats.get("unrecoverable", 0) + 1
+                stats["geometry_skipped"] = \
+                    stats.get("geometry_skipped", 0) + 1
+                continue
+            records.append({"pg": pg, "coll": coll, "name": name,
+                            "up": up, "holdings": holdings,
+                            "shards": shards, "missing": missing,
+                            "S": S_obj, "attrs": obj_attrs,
+                            "rebuilt": set()})
+        # -- signature-grouped decode of this PG's rebuilds
+        jobs, job_recs = [], []
+        for rec in records:
+            missing, shards = rec["missing"], rec["shards"]
+            if not missing:
+                continue
+            plan = sorted(codec.minimum_to_decode(set(missing),
+                                                  set(shards)))
+            # decode-fetch payload only (same semantics as the sim
+            # tier's counter: displaced-copy traffic is re-placement,
+            # not repair bandwidth)
+            stats["repair_bytes_fetched"] = \
+                stats.get("repair_bytes_fetched", 0) + \
+                sum(len(shards[c]) for c in plan)
+            L = len(rec["shards"][plan[0]])
+            S_obj = rec["S"]
+            if be.words_supported() and L % 4 == 0 and \
+                    L % max(S_obj, 1) == 0:
+                import jax.numpy as jnp
+                # [S, n_avail, W]: per-stripe plane geometry
+                stack = np.stack(
+                    [np.frombuffer(shards[c], dtype="<i4")
+                     .reshape(S_obj, -1) for c in plan], axis=1)
+                jobs.append((plan, jnp.asarray(stack), missing))
+                job_recs.append(rec)
+            else:
+                stackb = np.stack(
+                    [np.frombuffer(shards[c], dtype=np.uint8)
+                     .reshape(S_obj, -1) for c in plan], axis=1)
+                dec = np.asarray(codec.decode_chunks_batch(
+                    plan, stackb, missing))
+                for i, s in enumerate(missing):
+                    shards[s] = np.ascontiguousarray(
+                        dec[:, i]).tobytes()
+                    rec["rebuilt"].add(s)
+                    stats["shards_rebuilt"] += 1
+        if jobs:
+            decs = be.decode_signature_groups(jobs)
+            for rec, dec in zip(job_recs, decs):
+                out = np.asarray(dec)          # [S, n_erased, W]
+                for i, s in enumerate(rec["missing"]):
+                    rec["shards"][s] = np.ascontiguousarray(
+                        out[:, i]).tobytes()
+                    rec["rebuilt"].add(s)
+                    stats["shards_rebuilt"] += 1
+        # -- push surviving copies + rebuilt shards to up targets:
+        # submit-all-then-gather on the async streams; put_shard is a
+        # replay-stamped mutation, so the one fresh-stream resubmit
+        # after a stream death applies at most once
+        push_fan = []
+        for rec in records:
+            up_r, holdings_r = rec["up"], rec["holdings"]
+            for shard, data in rec["shards"].items():
+                if shard >= len(up_r) or up_r[shard] == ITEM_NONE:
+                    continue
+                tgt = up_r[shard]
+                oid = f"{shard}:{rec['name']}"
+                if oid in holdings_r.get(tgt, set()):
+                    continue
+                push_fan.append(
+                    (rec, shard, tgt, oid,
+                     self.aio.call_async(tgt, {
+                         "cmd": "put_shard", "coll": rec["coll"],
+                         "oid": oid, "data": data,
+                         "attrs": rec["attrs"],
+                         "klass": "background_recovery"})))
+        for (rec, shard, tgt, oid, _c), (_r, err) in zip(
+                push_fan,
+                self.aio.gather([c for *_ign, c in push_fan])):
+            if err is not None:
+                continue          # dropped push: next pass
+            rec["holdings"].setdefault(tgt, set()).add(oid)
+            if shard not in rec["rebuilt"]:
+                stats["shards_copied"] += 1
+        return stats
+
+    def _repair_ranged_wire(self, pool: PGPool, be, pg: int,
+                            name: str, up: List[int], plan_item,
+                            obj_attrs: Dict[str, bytes], holders_of,
+                            holdings: Dict[int, set]
+                            ) -> Dict[str, int]:
+        """Minimum-bandwidth single-loss repair over the wire: each
+        helper in the codec's SubChunkPlan ships ONLY its repair
+        sub-chunk byte ranges (ranged get_shard), ``codec.repair``
+        regenerates the lost chunk client-side, and the rebuilt shard
+        pushes with its attrs.  Returns stats including
+        ``repair_bytes_fetched`` so benches/tests can assert the
+        saving vs k full-chunk reads."""
+        codec = be.codec
+        _fetch, lost, _have, sub_plan = plan_item
+        (lost_shard,) = lost
+        coll = [pool.id, pg]
+        if "U" not in obj_attrs:
+            return {"unrecoverable": 1}
+        U = int(obj_attrs["U"])
+        S = int(obj_attrs["S"]) if "S" in obj_attrs else 1
+        sc = U // codec.get_sub_chunk_count()
+        # per-stripe ranges: a striped object's shard file is S
+        # independent U-byte codeword chunks back to back
+        wants = {(name, c): (holders_of(name, c),
+                             [(s * U + off * sc, cnt * sc)
+                              for s in range(S) for off, cnt in rg])
+                 for c, rg in sorted(sub_plan.items())}
+        got = self._gather_shard_fetches(coll, wants)
+        if len(got) < len(wants):
+            return {"unrecoverable": 1}   # helper lost: next pass
+        helpers = {c: np.frombuffer(got[(name, c)][0], dtype=np.uint8)
+                   for c, _rg in sub_plan.items()}
+        fetched = sum(h.size for h in helpers.values())
+        per_stripe = {c: h.size // S for c, h in helpers.items()}
+        try:
+            rebuilt = np.concatenate([codec.repair(
+                lost_shard,
+                {c: h[s * per_stripe[c]:(s + 1) * per_stripe[c]]
+                 for c, h in helpers.items()}, U)
+                for s in range(S)])
+        except ErasureCodeError:
+            return {"unrecoverable": 1}
+        tgt = up[lost_shard] if lost_shard < len(up) else ITEM_NONE
+        if tgt == ITEM_NONE:
+            return {}
+        oid = f"{lost_shard}:{name}"
+        try:
+            self.osd_call(tgt, {
+                "cmd": "put_shard", "coll": coll, "oid": oid,
+                "data": np.ascontiguousarray(rebuilt).tobytes(),
+                "attrs": obj_attrs,
+                "klass": "background_recovery"})
+        except (OSError, IOError):  # noqa: CTL603 — not a swallowed
+            # loss: the shard stays missing in the NEXT sweep's
+            # listings and the returned stats surface it as
+            # unrecoverable this pass (recovery is re-driven)
+            return {"unrecoverable": 1}
+        holdings.setdefault(tgt, set()).add(oid)
+        return {"shards_rebuilt": 1, "ranged_repairs": 1,
+                "repair_bytes_fetched": fetched}
 
     # ------------------------------------------ batched EC device plane --
     def put_many(self, pool_id: int, names: List[str],
@@ -1704,13 +2028,15 @@ class RemoteCluster:
         device copy remains authoritative and a later flush (after
         the map re-homes it) retries; returns the count flushed.
 
-        The drain rides the ASYNC multi-stream path: shards group by
-        target daemon and each group's put_shard frames pipeline onto
-        that daemon's stream pool as ONE async gather — the
-        device->host readback of shard i+1 overlaps the wire
-        transmission of shard i (double buffering), instead of one
-        blocking readback + RTT per shard."""
+        The drain is ONE bulk device->host readback per DISTINCT
+        staged buffer (shards are columns of shared encode/stripe
+        buffers — materialize_bulk slices them host-side) followed by
+        an async scatter-gather sweep: every put_shard frame
+        pipelines onto its daemon's stream pool round-robin, ONE
+        gather for the whole drain instead of a blocking readback +
+        RTT per shard."""
         import zlib
+        from ..cluster.device_store import materialize_bulk
         pool = self.osdmap.pools[pool_id]
         by_tgt: Dict[int, List] = {}
         for key, ref in self.dev.dirty_items():
@@ -1725,9 +2051,18 @@ class RemoteCluster:
                                                shard))
         if not by_tgt:
             return 0
+        # bulk readback first: one transfer per distinct buffer
+        flat = [it for items in by_tgt.values() for it in items]
+        hosts = materialize_bulk([ref for _k, ref, *_r in flat])
+        host_of = {}
+        i = 0
+        for items in by_tgt.values():
+            for it in items:
+                host_of[it[0]] = hosts[i]
+                i += 1
         fan: List[Tuple[Any, int, object]] = []
-        # round-robin across daemons so every stream pool starts
-        # transmitting while later shards are still reading back
+        # round-robin across daemons so every stream pool fills while
+        # the others' frames are still queueing
         queues = {t: list(items) for t, items in by_tgt.items()}
         while queues:
             for tgt in list(queues):
@@ -1736,7 +2071,7 @@ class RemoteCluster:
                     del queues[tgt]
                     continue
                 key, ref, pg, name, shard = items.pop(0)
-                data = np.asarray(ref).tobytes()     # device readback
+                data = host_of[key].tobytes()
                 fan.append((key, zlib.crc32(data),
                             self.aio.call_async(tgt, {
                                 "cmd": "put_shard",

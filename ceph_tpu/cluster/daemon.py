@@ -1222,6 +1222,43 @@ class OSDDaemon:
         # of these into per-OSD/per-pool io rates for `ceph -s`
         self._pc_io = _perf("osd.io")
         self._perf_reported = 0.0     # last report_perf wall time
+        # recovery/backfill reservations (the reference's AsyncReserver
+        # pair + osd_max_backfills): LOCAL = this OSD driving a PG's
+        # recovery as primary, REMOTE = this OSD receiving a recovery/
+        # backfill stream as member/target.  Held counts are capped by
+        # osd_max_backfills; peaks are exposed on `status` so chaos
+        # tests can assert the cap was never exceeded.
+        # slots are LEASES (grant timestamps), not bare counters: a
+        # holder that dies mid-recovery (primary kill9 between
+        # reserve and release, a client crash, a lost grant reply
+        # re-executed by the one-shot stream retry) would otherwise
+        # leak its slot until this daemon restarts and wedge every
+        # later recovery under the cap — expired grants purge on the
+        # next reserve/release/status touch
+        self._resv_lock = LockdepLock("osd.resv", recursive=False)
+        self._resv: Dict[str, List[float]] = {"local": [],
+                                              "remote": []}
+        self._resv_peak = {"local": 0, "remote": 0}
+        self._pc_resv = _perf("osd.recovery")
+
+    _RESV_TTL_S = 60.0
+
+    def _resv_purge(self, role: str) -> None:
+        """Drop expired leases (caller holds _resv_lock)."""
+        floor = time.monotonic() - self._RESV_TTL_S
+        ts = self._resv[role]
+        expired = 0
+        while ts and ts[0] < floor:
+            ts.pop(0)
+            expired += 1
+        if expired:
+            self._pc_resv.inc(f"{role}_expired", expired)
+
+    def _resv_held(self) -> Dict[str, int]:
+        with self._resv_lock:
+            for role in self._resv:
+                self._resv_purge(role)
+            return {r: len(ts) for r, ts in self._resv.items()}
 
     # ----------------------------------------------------------- mon I/O --
     def _mon_socks(self) -> List[str]:
@@ -1632,10 +1669,97 @@ class OSDDaemon:
             coll = tuple(req["coll"])
             def read():
                 try:
-                    return self.store.read(coll, req["oid"])
+                    data = self.store.read(coll, req["oid"])
                 except IOError:
                     return None
+                rg = req.get("ranges")
+                if rg:
+                    # sub-shard ranged read: only the requested byte
+                    # ranges cross the wire (a regenerating-code
+                    # helper ships its repair sub-chunks, not the
+                    # whole shard — the Clay minimum-bandwidth fetch)
+                    data = b"".join(bytes(data[int(o):int(o) + int(n)])
+                                    for o, n in rg)
+                return data
             return self._run_sched(read, klass)
+        if cmd == "getattrs_shard":
+            # all requested attrs in ONE round trip (the recovery
+            # geometry probe used to cost one blocking call per key)
+            coll = tuple(req["coll"])
+
+            def rda():
+                out = {}
+                for akey in req["keys"]:
+                    try:
+                        out[akey] = self.store.getattr(
+                            coll, req["oid"], akey)
+                    except (IOError, KeyError):
+                        out[akey] = None
+                return out
+            return self._run_sched(rda, klass)
+        if cmd == "get_objects":
+            # bulk recovery pull: one scatter-gather frame for a
+            # whole chunk of objects ({oid: bytes|None}).  The reply
+            # is BYTE-CAPPED server-side (an uncapped 64-object chunk
+            # of 8 MiB objects would exceed the 256 MiB wire frame
+            # limit and fail the member's recovery forever): oids the
+            # budget excludes are simply OMITTED — absent, not None —
+            # and the puller re-requests them next round
+            coll = tuple(req["coll"])
+
+            def read_many():
+                out = {}
+                nbytes = 0
+                for oid in req["oids"]:
+                    if out and nbytes >= self._RECOVERY_CHUNK_BYTES:
+                        break     # omitted: the caller re-requests
+                    try:
+                        data = self.store.read(coll, oid)
+                        nbytes += len(data)
+                        out[oid] = data
+                    except IOError:
+                        out[oid] = None
+                return out
+            return self._run_sched(read_many, klass)
+        if cmd == "put_objects":
+            # bulk recovery push: the whole chunk lands in ONE
+            # transaction (apply is atomic per store barrier)
+            coll = tuple(req["coll"])
+            self._check_pool_live(coll)
+            from .objectstore import Transaction
+
+            def put_many():
+                txn = Transaction()
+                for oid, data in req["objs"]:
+                    txn.write_full(coll, oid, data)
+                self.store.apply_transaction(txn)
+                return len(req["objs"])
+            return self._run_sched(put_many, klass)
+        if cmd == "delete_objects":
+            coll = tuple(req["coll"])
+            from .objectstore import Transaction
+
+            def rm_many():
+                txn = Transaction()
+                for oid in req["oids"]:
+                    if self.store.exists(coll, oid):
+                        txn.remove(coll, oid)
+                if len(txn):
+                    self.store.apply_transaction(txn)
+                return len(req["oids"])
+            return self._run_sched(rm_many, klass)
+        if cmd == "reserve_recovery":
+            role = str(req.get("role", "remote"))
+            if role not in self._resv:
+                raise ValueError(f"unknown reservation role {role!r}")
+            granted = self._reserve(role)
+            return {"granted": granted,
+                    "held": self._resv_held()[role]}
+        if cmd == "release_recovery":
+            role = str(req.get("role", "remote"))
+            if role in self._resv:
+                self._release(role)
+            return {"held": self._resv_held().get(role, 0)}
         if cmd == "delete_shard":
             coll = tuple(req["coll"])
             from .objectstore import Transaction
@@ -1949,13 +2073,16 @@ class OSDDaemon:
         if cmd == "status":
             with self._session_lock:
                 n_sessions = len(self._sessions)
+            resv = {"held": self._resv_held(),
+                    "peak": dict(self._resv_peak)}
             return {"osd": self.id,
                     "objects": sum(
                         len(self.store.list_objects(c))
                         for c in self.store.list_collections()),
                     "injected_failures": self.server.injected,
                     "sessions": n_sessions,
-                    "session_resets": self.session_resets}
+                    "session_resets": self.session_resets,
+                    "recovery_reservations": resv}
         if cmd == "fsck":
             return [list(map(str, b)) for b in self.store.fsck()]
         raise ValueError(f"unknown osd command {cmd!r}")
@@ -1967,6 +2094,106 @@ class OSDDaemon:
         except (OSError, IOError):
             self.drop_peer(m)
             return None
+
+    # ---------------------------------------------- recovery reservations --
+    def _reserve(self, role: str) -> bool:
+        """One reservation lease under the osd_max_backfills cap;
+        False = denied (the caller defers and requeues, never
+        waits)."""
+        from ..common.options import config
+        cap = int(config().get("osd_max_backfills"))
+        with self._resv_lock:
+            self._resv_purge(role)
+            if len(self._resv[role]) >= cap:
+                self._pc_resv.inc(f"{role}_denials")
+                return False
+            self._resv[role].append(time.monotonic())
+            held = len(self._resv[role])
+            self._resv_peak[role] = max(self._resv_peak[role], held)
+        self._pc_resv.inc(f"{role}_grants")
+        self._pc_resv.set(f"{role}_held", held)
+        return True
+
+    def _release(self, role: str) -> None:
+        with self._resv_lock:
+            self._resv_purge(role)
+            if self._resv[role]:
+                self._resv[role].pop(0)
+            held = len(self._resv[role])
+        self._pc_resv.set(f"{role}_held", held)
+
+    # ------------------------------------------------- bulk object moves --
+    _RECOVERY_CHUNK_OBJS = 64
+    _RECOVERY_CHUNK_BYTES = 64 << 20
+
+    def _pull_objects(self, coll, src: int,
+                      oids: List[str]) -> Dict[str, Any]:
+        """{oid: bytes|None} from ONE holder — scatter-gather
+        ``get_objects`` frames instead of a blocking round trip per
+        object (the per-object `_pull_object` loop this replaces was
+        the wire tier's recovery bottleneck).  The server byte-caps
+        each reply and OMITS overflow oids; the loop re-requests the
+        omissions until everything is answered or a round makes no
+        progress (which reads as failure — None — for the rest)."""
+        out: Dict[str, Any] = {}
+        pending = list(oids)
+        while pending:
+            chunk = pending[:self._RECOVERY_CHUNK_OBJS]
+            if src == self.id:
+                for oid in chunk:
+                    try:
+                        out[oid] = self.store.read(coll, oid)
+                    except IOError:
+                        out[oid] = None
+                pending = pending[len(chunk):]
+                continue
+            r = self._peer_req(src, _trace.stamp({
+                "cmd": "get_objects", "coll": list(coll),
+                "oids": chunk, "klass": "background_recovery"}))
+            if not r:
+                for oid in pending:
+                    out.setdefault(oid, None)
+                break
+            out.update(r)
+            pending = [o for o in pending if o not in out]
+        return out
+
+    def _push_objects(self, coll, dst: int, items) -> int:
+        """Push [(oid, data)] to one member in bounded
+        ``put_objects`` frames; returns objects landed."""
+        from .objectstore import Transaction
+        n = i = 0
+        while i < len(items):
+            chunk, nbytes = [], 0
+            while i < len(items) and \
+                    len(chunk) < self._RECOVERY_CHUNK_OBJS and \
+                    nbytes < self._RECOVERY_CHUNK_BYTES:
+                chunk.append(items[i])
+                nbytes += len(items[i][1])
+                i += 1
+            if dst == self.id:
+                txn = Transaction()
+                for oid, data in chunk:
+                    txn.write_full(coll, oid, data)
+                self.store.apply_transaction(txn)
+                n += len(chunk)
+            elif self._peer_req(dst, _trace.stamp({
+                    "cmd": "put_objects", "coll": list(coll),
+                    "objs": [[oid, data] for oid, data in chunk],
+                    "klass": "background_recovery"})) is not None:
+                n += len(chunk)
+        return n
+
+    def _move_objects(self, coll, src: int, dst: int,
+                      oids: List[str]) -> int:
+        """Bulk pull from ``src`` + bulk push to ``dst``; returns
+        objects moved (missing pulls and failed pushes both count
+        against completeness — the caller must not advance
+        last_complete past them)."""
+        pulled = self._pull_objects(coll, src, oids)
+        items = [(oid, pulled[oid]) for oid in oids
+                 if pulled.get(oid) is not None]
+        return self._push_objects(coll, dst, items)
 
     def _pull_object(self, coll, oid, holders) -> Optional[bytes]:
         for h in holders:
@@ -1998,6 +2225,46 @@ class OSDDaemon:
                     members: List[int],
                     strays: Optional[List[int]] = None
                     ) -> Dict[str, Any]:
+        """Reservation gate around one PG's recovery: LOCAL slot on
+        this primary, REMOTE slot on every other member — acquired
+        all-or-nothing with rollback (never wait while holding, so
+        concurrent primaries cannot deadlock); any denial returns
+        ``{"deferred": True}`` for the caller's requeue loop.  This is
+        the osd_max_backfills contract: concurrent PG recoveries
+        saturate spare bandwidth without unbounded fan-in on one OSD,
+        and client QoS survives because every recovery op already
+        rides the background_recovery dmClock class."""
+        me = self.id
+        if not self._reserve("local"):
+            return {"deferred": True, "by": me}
+        got: List[int] = []
+        try:
+            for m in members:
+                if m == me:
+                    continue
+                r = self._peer_req(m, {"cmd": "reserve_recovery",
+                                       "role": "remote"})
+                if r is None:
+                    # UNREACHABLE member: no slot to take and no
+                    # reason to defer — the recovery pass itself
+                    # marks it incomplete (deferring here would let
+                    # one dead-but-in-map member block every
+                    # reachable member's recovery forever)
+                    continue
+                if not r.get("granted"):
+                    return {"deferred": True, "by": m}
+                got.append(m)
+            return self._recover_pg_inner(coll, members, strays)
+        finally:
+            for m in got:
+                self._peer_req(m, {"cmd": "release_recovery",
+                                   "role": "remote"})
+            self._release("local")
+
+    def _recover_pg_inner(self, coll: Tuple[int, int],
+                          members: List[int],
+                          strays: Optional[List[int]] = None
+                          ) -> Dict[str, Any]:
         """Primary-driven PG recovery running the PeeringState shape
         over the wire (GetInfo -> GetLog -> GetMissing -> Recovering
         or Backfilling, src/osd/PeeringState.h:561):
@@ -2112,31 +2379,32 @@ class OSDDaemon:
             if entries is not None:
                 stats["mode"][str(m)] = "delta"
                 # latest op per object wins (missing-set semantics of
-                # PGLog::missing_since, over the fetched entries)
+                # PGLog::missing_since, over the fetched entries);
+                # movement is BULK scatter-gather — one get_objects /
+                # put_objects / delete_objects frame per bounded
+                # chunk, not a blocking round trip per object
                 latest: Dict[str, int] = {}
                 for v, obj, op in entries:
                     latest[obj] = op
-                for obj, op in sorted(latest.items()):
-                    stats["delta_objects"] += 1
-                    if op == OP_DELETE:
-                        if m == me:
+                dels = sorted(o for o, op in latest.items()
+                              if op == OP_DELETE)
+                copies = sorted(o for o, op in latest.items()
+                                if op != OP_DELETE)
+                stats["delta_objects"] += len(latest)
+                if dels:
+                    if m == me:
+                        for obj in dels:
                             self._local_delete(coll, obj)
-                        elif self._peer_req(
-                                m, _trace.stamp(
-                                    {"cmd": "delete_shard",
-                                     "coll": list(coll),
-                                     "oid": obj})) is None:
-                            complete = False
-                        stats["deletes_applied"] += 1
-                        continue
-                    data = self._pull_object(coll, obj, [auth])
-                    if data is None:
+                    elif self._peer_req(m, _trace.stamp(
+                            {"cmd": "delete_objects",
+                             "coll": list(coll),
+                             "oids": dels})) is None:
                         complete = False
-                        continue
-                    if self._push_object(coll, obj, data, m):
-                        stats["copied"] += 1
-                    else:
-                        complete = False
+                    stats["deletes_applied"] += len(dels)
+                moved = self._move_objects(coll, auth, m, copies)
+                stats["copied"] += moved
+                if moved < len(copies):
+                    complete = False
             else:
                 stats["mode"][str(m)] = "backfill"
                 if auth_listing is None:
@@ -2154,16 +2422,12 @@ class OSDDaemon:
                     # the remaining members)
                     stats["mode"][str(m)] += "-incomplete"
                     continue
-                for obj in sorted(auth_listing - have):
-                    stats["backfill_objects"] += 1
-                    data = self._pull_object(coll, obj, [auth])
-                    if data is None:
-                        complete = False
-                        continue
-                    if self._push_object(coll, obj, data, m):
-                        stats["copied"] += 1
-                    else:
-                        complete = False
+                objs = sorted(auth_listing - have)
+                stats["backfill_objects"] += len(objs)
+                moved = self._move_objects(coll, auth, m, objs)
+                stats["copied"] += moved
+                if moved < len(objs):
+                    complete = False
                 entries = auth_entries_after(lc)
                 if entries is None:
                     # the log fetch failed: the data may have moved
@@ -2341,13 +2605,24 @@ class OSDDaemon:
             for coll in st.list_collections():
                 # data shards only (the count_pool convention):
                 # pglog/meta rows are bookkeeping, not user objects
-                n = sum(1 for o in st.list_objects(coll)
-                        if not o.startswith("meta:"))
-                util["objects"] += n
                 pid = int(coll[0])
                 row = util["pools"].setdefault(
                     pid, {"objects": 0, "bytes": 0})
-                row["objects"] += n
+                for o in st.list_objects(coll):
+                    if o.startswith("meta:"):
+                        continue
+                    util["objects"] += 1
+                    row["objects"] += 1
+                    try:
+                        # per-pool BYTE accounting (onode sizes, the
+                        # PGMap per-pool STORED figure): this is what
+                        # lets `ceph df` quote bytes per pool — and a
+                        # rebuild bench quote bytes-remaining —
+                        # instead of the allocator-level '-'
+                        row["bytes"] += int(
+                            st.stat(coll, o)["size"])
+                    except (IOError, KeyError):
+                        pass      # torn object mid-fsck: count 0
         except (OSError, IOError):
             pass          # a store mid-fsck must not kill the report
         self._util_cache = (now, util)

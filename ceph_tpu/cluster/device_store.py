@@ -97,6 +97,31 @@ def as_ref(arr) -> ShardRef:
     return ShardRef(arr.reshape(1, -1), 0)
 
 
+def materialize_bulk(refs) -> list:
+    """Host arrays for many refs with ONE readback per DISTINCT
+    buffer: refs sharing a packed buffer (an encode output's parity
+    columns, a put batch's stripe view, a rebuilt decode batch) read
+    back together and slice host-side.  The per-ref alternative pays
+    one slice dispatch plus one device->host readback EACH — on a
+    remote-attached driver that is the flush-readback floor BENCH r05
+    measured at ~0.024 GB/s; batching by buffer collapses it to a
+    handful of bulk transfers."""
+    import numpy as np
+    host = {}
+    for r in refs:
+        if id(r.buf) not in host:
+            host[id(r.buf)] = np.asarray(r.buf)
+    out = []
+    for r in refs:
+        b = host[id(r.buf)]
+        if r.axis == 0:
+            out.append(np.ascontiguousarray(b[r.idx]))
+        else:
+            out.append(np.ascontiguousarray(
+                b[r.s0:r.s0 + r._rows(), r.idx]).reshape(-1))
+    return out
+
+
 @dataclass
 class _Entry:
     arr: ShardRef          # plane words (row of a packed buffer)

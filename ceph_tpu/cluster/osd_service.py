@@ -132,11 +132,30 @@ class OSDService:
 
     def _execute_inner(self, op: Dict[str, Any]):
         kind = op["kind"]
+        if kind == "get_dev_many":
+            # bulk device read: ONE queue->scheduler->dispatch round
+            # for a whole recovery gather (None per absent/EIO key —
+            # the caller's per-key failover decides what that means)
+            return [self.osd.get_device(tuple(k))
+                    for k in op["keys"]]
+        if kind == "put_dev_many":
+            # bulk device push (the recovery-push scatter half): the
+            # HBM refs ride the _obj side table as one list; optional
+            # per-key durable bytes ride ``datas`` (eager mode)
+            arrs = op["_obj"]
+            datas = op.get("datas") or [None] * len(op["keys"])
+            for k, a, d in zip(op["keys"], arrs, datas):
+                self.osd.put_device(tuple(k), a, d)
+            return len(op["keys"])
         key: ShardKey = tuple(op["key"])   # typed encoding lists it
         if kind == "put":
             self.osd.put(key, np.frombuffer(op["data"], dtype=np.uint8))
             return True
         if kind == "get":
+            if op.get("ranges"):
+                # sub-shard ranged read (Clay repair helpers): only
+                # the requested byte ranges cross the messenger
+                return self.osd.get_ranges(key, op["ranges"])
             return self.osd.get(key)
         if kind == "put_dev":
             self.osd.put_device(key, op["_obj"], op.get("data"))
@@ -226,9 +245,12 @@ class OSDService:
         self._call({"kind": "put", "key": key, "klass": klass,
                     "data": np.asarray(data, dtype=np.uint8).tobytes()})
 
-    def get(self, key: ShardKey,
-            klass: str = CLASS_CLIENT) -> Optional[np.ndarray]:
-        return self._call({"kind": "get", "key": key, "klass": klass})
+    def get(self, key: ShardKey, klass: str = CLASS_CLIENT,
+            ranges=None) -> Optional[np.ndarray]:
+        op = {"kind": "get", "key": key, "klass": klass}
+        if ranges:
+            op["ranges"] = [list(r) for r in ranges]
+        return self._call(op)
 
     def delete(self, key: ShardKey, klass: str = CLASS_CLIENT) -> None:
         self._call({"kind": "delete", "key": key, "klass": klass})
@@ -255,6 +277,31 @@ class OSDService:
     def put_device_recovery(self, key: ShardKey, arr,
                             data_bytes: Optional[bytes] = None) -> None:
         self.put_device(key, arr, data_bytes, klass=CLASS_RECOVERY)
+
+    # --------------------------------------------- bulk recovery sub-ops --
+    def get_device_many_async(self, keys: List[ShardKey],
+                              klass: str = CLASS_RECOVERY
+                              ) -> Tuple[int, threading.Event]:
+        """Submit ONE bulk device read for ``keys`` (pair with
+        wait_async; result is a per-key list, None per miss).  The
+        recovery sweep's gather half: submit-all-then-gather across
+        OSDs instead of one blocking round trip per shard."""
+        return self.call_async({"kind": "get_dev_many",
+                                "keys": [list(k) for k in keys],
+                                "klass": klass})
+
+    def put_device_many_async(self, items: List[Tuple[ShardKey, Any,
+                                                      Optional[bytes]]],
+                              klass: str = CLASS_RECOVERY
+                              ) -> Tuple[int, threading.Event]:
+        """Submit ONE bulk device push of (key, ref, durable_bytes)
+        triples — the recovery-push scatter half."""
+        return self.call_async(
+            {"kind": "put_dev_many",
+             "keys": [list(k) for k, _, _ in items],
+             "datas": [d for _, _, d in items],
+             "klass": klass},
+            obj=[a for _, a, _ in items])
 
     def stats(self) -> Dict[str, int]:
         return self.in_q.stats()

@@ -203,6 +203,35 @@ class SimOSD:
             return True
         return self.objectstore.verify(*self._split(key))
 
+    def probe(self, key: ShardKey) -> int:
+        """Presence + SIZE probe (the MissingLoc role extended with
+        pg_info sizes): -1 when absent/dead/corrupt, else the shard's
+        byte size — recovery plans its minimal fetch set from probes
+        without moving a payload byte."""
+        if not self.alive:
+            return -1
+        d = self.dev.dirty_get(key)
+        if d is not None:
+            return int(d.size)
+        coll, oid = self._split(key)
+        if not self.objectstore.verify(coll, oid):
+            return -1
+        try:
+            return int(self.objectstore.stat(coll, oid)["size"])
+        except ObjectStoreError:
+            return -1
+
+    def get_ranges(self, key: ShardKey,
+                   ranges) -> Optional[np.ndarray]:
+        """Sub-shard ranged read: only the requested (offset, length)
+        byte ranges leave this OSD — the messenger-honest form of a
+        regenerating-code helper read (Clay's repair sub-chunks)."""
+        r = self.get(key)
+        if r is None:
+            return None
+        return np.concatenate([r[int(o):int(o) + int(n)]
+                               for o, n in ranges])
+
     # -------------------------------------------------- device staging --
     def _csum(self, coll, oid) -> Optional[int]:
         try:
@@ -1719,28 +1748,109 @@ class ClusterSim:
 
         codec = self.codec_for(pool)
         k, mm = codec.get_data_chunk_count(), codec.get_coding_chunk_count()
+        if self._device_staging(codec):
+            return self._recover_all_dev(pool, pool_id, codec, k, mm,
+                                         stats)
+        return self._recover_all_host(pool, pool_id, codec, k, mm,
+                                      stats)
+
+    # ------------------------------------------ bulk recovery sub-ops --
+    def _bulk_get_device(self, reads: Dict[Tuple, List[int]]
+                         ) -> Dict[Tuple, object]:
+        """Submit-all-then-gather device reads: ``reads`` maps each
+        ShardKey to its ordered holder chain (presence-probed, the
+        MissingLoc contract); ONE ``get_dev_many`` sub-op per holder
+        OSD per round replaces the per-shard blocking round trips.  A
+        holder that fails (drop injection, death mid-sweep) fails over
+        to the next in the key's chain on the following round."""
+        out: Dict[Tuple, object] = {rk: None for rk in reads}
+        pending = {rk: list(chain) for rk, chain in reads.items()}
+        while True:
+            by_osd: Dict[int, List[Tuple]] = {}
+            for rk, chain in pending.items():
+                if out[rk] is not None or not chain:
+                    continue
+                by_osd.setdefault(chain.pop(0), []).append(rk)
+            if not by_osd:
+                return out
+            fan = []
+            for o, rkeys in sorted(by_osd.items()):
+                try:
+                    fan.append((o, rkeys, self.services[o]
+                                .get_device_many_async(rkeys)))
+                except IOError:
+                    continue      # dropped sub-op: chains advance
+            for o, rkeys, handle in fan:
+                try:
+                    res = self.services[o].wait_async(*handle)
+                except IOError:
+                    continue      # failed gather: chains advance
+                for rk, r in zip(rkeys, res):
+                    if r is not None:
+                        out[rk] = r
+
+    def _bulk_put_device(self, pushes: Dict[int, List[Tuple]]
+                         ) -> Tuple[int, Set[int]]:
+        """Submit-all-then-gather device pushes: ``pushes`` maps each
+        target OSD to its (key, ref, durable_bytes) items; one
+        ``put_dev_many`` sub-op per target under the
+        background_recovery class.  Returns (landed count, targets
+        whose batch landed) — a failed batch stays missing for the
+        next pass (the dropped-push contract, batch-granular)."""
+        fan = []
+        for tgt, items in sorted(pushes.items()):
+            if not items:
+                continue
+            try:
+                fan.append((tgt, items, self.services[tgt]
+                            .put_device_many_async(items)))
+            except IOError:
+                continue          # dropped push: next pass
+        n = 0
+        landed: Set[int] = set()
+        for tgt, items, handle in fan:
+            try:
+                self.services[tgt].wait_async(*handle)
+            except IOError:
+                continue          # dropped push: next pass
+            n += len(items)
+            landed.add(tgt)
+        return n, landed
+
+    def _recover_all_dev(self, pool, pool_id: int, codec, k: int,
+                         mm: int, stats: Dict[str, int]
+                         ) -> Dict[str, int]:
+        """Device-resident EC recovery sweep: host-side presence
+        probes plan the fetch set, surviving shard refs gather through
+        bulk async sub-ops, the grouped masked-XOR rebuild dispatches
+        (collectively, when the data plane is up), and rebuilt/copied
+        shards scatter back through bulk async pushes — no per-shard
+        blocking round trip anywhere on the path."""
         n_shards = k + mm
-        dev = self._device_staging(codec)
         eager = self.staging_flush == "eager"
-        if dev:
-            import jax.numpy as jnp
-            from .device_store import ShardRef, assemble_refs
-        # (avail_plan, missing, U) -> list of (name, up, shard_files,
-        #  n_stripes) sharing one decode executable
-        groups: Dict[Tuple, List] = {}
+        objs, reads = [], {}
         for (pid, name), info in self.objects.items():
             if pid != pool_id:
                 continue
             stats["objects_scanned"] += 1
             pg = self.object_pg(pool, name)
             up = self.pg_up(pool, pg)
+            objs.append((name, info, pg, up))
+            for shard in range(n_shards):
+                key = (pool_id, pg, name, shard)
+                chain = [o for o in self._shard_sources(up, shard)
+                         if self.osds[o].has(key)]
+                if chain:
+                    reads[key] = chain
+        refs = self._bulk_get_device(reads)
+        groups: Dict[Tuple, List] = {}
+        copies: Dict[int, List[Tuple]] = {}
+        for name, info, pg, up in objs:
             U = info.chunk_size
-            shard_files: Dict[int, np.ndarray] = {}
+            shard_files: Dict[int, object] = {}
             missing: List[int] = []
             for shard in range(n_shards):
-                f = (self._read_shard_dev(pool_id, pg, name, shard, up)
-                     if dev else
-                     self._read_shard(pool_id, pg, name, shard, up))
+                f = refs.get((pool_id, pg, name, shard))
                 if f is None or f.size < info.n_stripes * U:
                     missing.append(shard)
                 else:
@@ -1749,19 +1859,12 @@ class ClusterSim:
             for shard, payload in shard_files.items():
                 tgt = up[shard] if shard < len(up) else ITEM_NONE
                 if tgt != ITEM_NONE and self.osds[tgt].alive and \
-                        not self.osds[tgt].has((pool_id, pg, name, shard)):
-                    try:
-                        if dev:
-                            self.services[tgt].put_device_recovery(
-                                (pool_id, pg, name, shard), payload,
-                                np.asarray(payload).tobytes() if eager
-                                else None)
-                        else:
-                            self.services[tgt].put_recovery(
-                                (pool_id, pg, name, shard), payload)
-                    except IOError:
-                        continue          # dropped push: next pass
-                    stats["shards_copied"] += 1
+                        not self.osds[tgt].has(
+                            (pool_id, pg, name, shard)):
+                    copies.setdefault(tgt, []).append(
+                        ((pool_id, pg, name, shard), payload,
+                         np.asarray(payload).tobytes() if eager
+                         else None))
             if not missing:
                 continue
             avail = set(shard_files)
@@ -1773,10 +1876,163 @@ class ClusterSim:
             key = (plan, tuple(missing), U)
             groups.setdefault(key, []).append(
                 (name, up, shard_files, info.n_stripes, pg))
-        if dev:
-            self._rebuild_groups_dev(pool_id, codec, k, mm, groups,
-                                     eager, stats)
-            return stats
+        stats["shards_copied"] += self._bulk_put_device(copies)[0]
+        self._rebuild_groups_dev(pool_id, codec, k, mm, groups,
+                                 eager, stats)
+        return stats
+
+    def _read_shard_ranges(self, pool_id: int, pg: int, name: str,
+                           shard: int, up: List[int],
+                           ranges) -> Optional[np.ndarray]:
+        """Ranged shard read with the same holder failover as
+        _read_shard; only the requested byte ranges move."""
+        from .osd_service import CLASS_RECOVERY
+        for o in self._shard_sources(up, shard):
+            try:
+                p = self.services[o].get((pool_id, pg, name, shard),
+                                         klass=CLASS_RECOVERY,
+                                         ranges=ranges)
+            except IOError:
+                continue
+            if p is not None:
+                return p
+        return None
+
+    def _repair_one_ranged(self, pool_id: int, pg: int, name: str,
+                           up: List[int], codec, plan, lost: int,
+                           U: int, S: int, sub_chunks: int,
+                           stats: Dict[str, int]) -> bool:
+        """Single-loss minimum-bandwidth repair: each helper in the
+        codec's SubChunkPlan ships only its repair sub-chunk ranges
+        (per stripe — a striped object's shard file is S independent
+        U-byte codeword chunks back to back); ``codec.repair``
+        regenerates the lost chunk stripe by stripe.  A failed helper
+        aborts the object to the next pass (partial fetches must not
+        decode)."""
+        tgt = up[lost] if lost < len(up) else ITEM_NONE
+        if tgt == ITEM_NONE or not self.osds[tgt].alive:
+            return True        # homeless loss: nothing to land
+        sc = U // sub_chunks
+        helpers: Dict[int, np.ndarray] = {}
+        fetched = 0
+        for c, rg in sorted(plan.items()):
+            r = self._read_shard_ranges(
+                pool_id, pg, name, c, up,
+                [(s * U + off * sc, cnt * sc)
+                 for s in range(S) for off, cnt in rg])
+            if r is None:
+                return False   # helper lost mid-repair: next pass
+            helpers[c] = r
+            fetched += int(r.size)
+        per_stripe = {c: h.size // S for c, h in helpers.items()}
+        parts: List[np.ndarray] = []
+        try:
+            for s in range(S):
+                parts.append(codec.repair(
+                    lost,
+                    {c: h[s * per_stripe[c]:(s + 1) * per_stripe[c]]
+                     for c, h in helpers.items()}, U))
+        except ErasureCodeError:
+            return False
+        rebuilt = np.concatenate(parts)
+        try:
+            self.services[tgt].put_recovery(
+                (pool_id, pg, name, lost), rebuilt)
+        except IOError:
+            return False       # dropped push: next pass
+        stats["shards_rebuilt"] += 1
+        stats["repair_bytes_fetched"] = \
+            stats.get("repair_bytes_fetched", 0) + fetched
+        stats["ranged_repairs"] = stats.get("ranged_repairs", 0) + 1
+        return True
+
+    def _recover_all_host(self, pool, pool_id: int, codec, k: int,
+                          mm: int, stats: Dict[str, int]
+                          ) -> Dict[str, int]:
+        """Host-tier EC recovery (layered codecs — clay/lrc/shec —
+        and staging-off pools): presence+size probes plan the fetch,
+        then ONLY the codec's minimal repair set moves — Clay single
+        losses fetch d helpers' repair SUB-CHUNK ranges
+        (``codec.repair``), LRC losses fetch the covering local
+        group — instead of every surviving shard.
+        ``repair_bytes_fetched`` counts the decode-fetch payload so
+        callers can assert the repair-bandwidth saving against
+        full-stripe k reads."""
+        n_shards = k + mm
+        groups: Dict[Tuple, List] = {}
+        sub_chunks = codec.get_sub_chunk_count()
+        for (pid, name), info in self.objects.items():
+            if pid != pool_id:
+                continue
+            stats["objects_scanned"] += 1
+            pg = self.object_pg(pool, name)
+            up = self.pg_up(pool, pg)
+            U = info.chunk_size
+            want = info.n_stripes * U
+            holders: Dict[int, List[int]] = {}
+            for shard in range(n_shards):
+                key = (pool_id, pg, name, shard)
+                chain = [o for o in self._shard_sources(up, shard)
+                         if self.osds[o].probe(key) >= want]
+                if chain:
+                    holders[shard] = chain
+            missing = [s for s in range(n_shards) if s not in holders]
+            # displaced survivors re-place regardless of decode fate
+            fetch_copy = {}
+            for shard in holders:
+                tgt = up[shard] if shard < len(up) else ITEM_NONE
+                if tgt != ITEM_NONE and self.osds[tgt].alive and \
+                        not self.osds[tgt].has(
+                            (pool_id, pg, name, shard)):
+                    fetch_copy[shard] = tgt
+            plan = None
+            if missing:
+                try:
+                    plan = codec.minimum_to_decode(set(missing),
+                                                   set(holders))
+                except ErasureCodeError:
+                    plan = None   # unrecoverable: copies still move
+            partial = plan is not None and any(
+                sum(cnt for _, cnt in rg) < sub_chunks
+                for rg in plan.values())
+            if partial and len(missing) == 1 and not fetch_copy:
+                # regenerating-code single-loss repair (Clay): d
+                # helpers each ship ONLY their repair sub-chunk
+                # ranges, per stripe — the minimum-bandwidth
+                # property, on the recovery path rather than just in
+                # the codec registry
+                self._repair_one_ranged(pool_id, pg, name, up, codec,
+                                        plan, missing[0], U,
+                                        info.n_stripes, sub_chunks,
+                                        stats)
+                continue
+            files: Dict[int, np.ndarray] = {}
+            for shard in sorted(set(fetch_copy) |
+                                set(plan or {})):
+                f = self._read_shard(pool_id, pg, name, shard, up)
+                if f is not None and f.size >= want:
+                    files[shard] = f
+            for shard, tgt in fetch_copy.items():
+                payload = files.get(shard)
+                if payload is None:
+                    continue      # probe raced a drop: next pass
+                try:
+                    self.services[tgt].put_recovery(
+                        (pool_id, pg, name, shard), payload)
+                except IOError:
+                    continue      # dropped push: next pass
+                stats["shards_copied"] += 1
+            if not missing or plan is None:
+                continue
+            plan_files = {c: files[c] for c in plan if c in files}
+            if len(plan_files) < len(plan):
+                continue          # a fetch dropped: next pass
+            stats["repair_bytes_fetched"] = \
+                stats.get("repair_bytes_fetched", 0) + \
+                sum(f.size for f in plan_files.values())
+            key = (tuple(sorted(plan)), tuple(missing), U)
+            groups.setdefault(key, []).append(
+                (name, up, plan_files, info.n_stripes, pg))
         for (plan, missing, U), members in groups.items():
             stats["batches"] += 1
             batch = np.concatenate([
@@ -1972,17 +2228,20 @@ class ClusterSim:
         from ..parallel.data_plane import plane as _data_plane
         dp = _data_plane()
         if dp is not None:
-            # sharded recovery: the (stripe, signature) batch splits
-            # across the mesh — each stripe carries its own full-width
-            # signature mask, so the shard axis needs no cross-chip
-            # traffic and the rebuilt-stripe accounting psums back
-            # over the ICI ring (bit-identical to the plain kernel)
-            rebuilt = dp.xor_matmul_w32(
+            # sharded COLLECTIVE recovery: the (stripe, signature)
+            # batch splits across the mesh — each stripe carries its
+            # own full-width signature mask — and the rebuilt rows
+            # all-gather over the ICI ring inside the same dispatch,
+            # so every target OSD's affine chip holds its rebuilt
+            # shard chip-to-chip (no host staging hop; bit-identical
+            # to the plain kernel, padding rows sliced off)
+            rebuilt = dp.rebuild_collective(
                 masks_d, planes, kind="recover")[:T].reshape(T, mm, W)
         else:
             rebuilt = xor_kernel.xor_matmul_w32(
                 masks_d, planes)[:T].reshape(T, mm, W)
         rebuilt_host = np.asarray(rebuilt) if eager else None
+        pushes: Dict[int, List[Tuple]] = {}
         for j, mem in enumerate(mems):
             name, up, files, n_str_m, pg, missing = mem[:6]
             pos = j * n_str
@@ -1993,14 +2252,19 @@ class ClusterSim:
                 b = np.ascontiguousarray(
                     rebuilt_host[pos:pos + n_str, i]
                 ).tobytes() if eager else None
-                try:
-                    self.services[tgt].put_device_recovery(
-                        (pool_id, pg, name, shard),
-                        ShardRef(rebuilt, i, axis=1, s0=pos,
-                                 s1=pos + n_str), b)
-                except IOError:
-                    continue              # dropped push: next pass
-                stats["shards_rebuilt"] += 1
+                pushes.setdefault(tgt, []).append(
+                    ((pool_id, pg, name, shard),
+                     ShardRef(rebuilt, i, axis=1, s0=pos,
+                              s1=pos + n_str), b))
+        n_landed, landed_tgts = self._bulk_put_device(pushes)
+        stats["shards_rebuilt"] += n_landed
+        if dp is not None:
+            # chip-landing accounting for pushes that actually
+            # LANDED (telemetry must agree with the recovery stats a
+            # failed batch excludes)
+            for tgt in landed_tgts:
+                for _key, _ref, _b in pushes[tgt]:
+                    dp.account_landed(tgt, n_str, U)
 
     def recover_delta(self, pool_id: int) -> Dict[str, int]:
         """Log-based delta recovery (the PGLog path the reference

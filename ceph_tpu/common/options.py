@@ -304,6 +304,13 @@ _TABLE: Tuple[Option, ...] = (
            "mesh size for the sharded data plane (0 = every visible "
            "device); values above the visible device count disable "
            "the plane rather than fail mid-dispatch", min=0),
+    Option("osd_max_backfills", TYPE_INT, 1,
+           "recovery/backfill reservations an OSD grants concurrently "
+           "per role (local primary-side + remote replica-side, the "
+           "reference's AsyncReserver pair, src/common/AsyncReserver.h "
+           "/ osd_max_backfills): concurrent PG recoveries above the "
+           "cap are deferred and requeued, so recovery saturates spare "
+           "bandwidth without unbounded fan-in on one OSD", min=1),
     Option("perf_counters_enabled", TYPE_BOOL, True,
            "collect dispatch/cache/bytes counters"),
     Option("op_tracker_enabled", TYPE_BOOL, True,

@@ -150,6 +150,134 @@ class ShardedDataPlane:
                 out_specs=(P(SHARD_AXIS), P())))
         return step
 
+    def _collective_step(self, per_batch: bool):
+        """Jitted sharded rebuild step with the RECOVERY collectives:
+        each chip decodes its stripe slice with the real kernel, then
+        the rebuilt rows ALL-GATHER across the mesh (tiled on the
+        stripe axis), so every chip — hence every OSD-shard partition
+        landing a rebuilt shard — holds the bytes chip-to-chip, with
+        no host staging hop in between.  The psum row counter rides
+        the same dispatch (the accounting collective).
+
+        out_specs P() with check_rep=False: a tiled all_gather leaves
+        the value identical on every mesh position by construction;
+        shard_map cannot prove that, so the replication is asserted
+        by the bit-identity tests instead."""
+        from .mesh import SHARD_AXIS, mesh_cache_key
+        key = ("collective", per_batch) + mesh_cache_key(self.mesh)
+        step = self._steps.get(key)
+        if step is None:
+            import jax
+            import jax.numpy as jnp
+            from jax.experimental.shard_map import shard_map
+            from jax.sharding import PartitionSpec as P
+            from ..ops import xor_kernel
+
+            def local(masks, words):
+                out = xor_kernel.xor_matmul_w32(masks, words)
+                rows = jax.lax.psum(
+                    jnp.sum(jnp.ones((words.shape[0],), jnp.int32)
+                            .astype(jnp.int64)), SHARD_AXIS)
+                full = jax.lax.all_gather(out, SHARD_AXIS, axis=0,
+                                          tiled=True)
+                return full, rows
+
+            mspec = P(SHARD_AXIS) if per_batch else P()
+            step = self._steps[key] = jax.jit(shard_map(
+                local, mesh=self.mesh,
+                in_specs=(mspec, P(SHARD_AXIS)),
+                out_specs=(P(), P()), check_rep=False))
+        return step
+
+    def _ppermute_step(self, shift: int):
+        """Jitted ring ppermute: each chip's stripe block moves
+        ``shift`` positions around the ICI ring — the pairwise
+        shard-landing primitive (a rebuilt block computed on chip i
+        delivered to the chip owning its target OSD), and the
+        building block the 2-D (stripe, shard) mesh plan composes."""
+        from .mesh import SHARD_AXIS, mesh_cache_key
+        key = ("ppermute", shift) + mesh_cache_key(self.mesh)
+        step = self._steps.get(key)
+        if step is None:
+            import jax
+            from jax.experimental.shard_map import shard_map
+            from jax.sharding import PartitionSpec as P
+            n = self.n_shards
+            perm = [(i, (i + shift) % n) for i in range(n)]
+
+            def local(x):
+                return jax.lax.ppermute(x, SHARD_AXIS, perm=perm)
+
+            step = self._steps[key] = jax.jit(shard_map(
+                local, mesh=self.mesh,
+                in_specs=(P(SHARD_AXIS),),
+                out_specs=P(SHARD_AXIS)))
+        return step
+
+    def ppermute_shift(self, arr, shift: int = 1):
+        """Rotate a batch-sharded array ``shift`` mesh positions along
+        the ring (block-granular: each chip's whole slice moves).  The
+        leading axis must be a mesh multiple."""
+        import jax
+        from .mesh import batch_sharding
+        if int(arr.shape[0]) % self.n_shards:
+            raise ValueError(
+                f"ppermute batch {arr.shape[0]} not a multiple of "
+                f"{self.n_shards} mesh positions")
+        arr = jax.device_put(arr, batch_sharding(self.mesh))
+        out = self._ppermute_step(int(shift) % self.n_shards)(arr)
+        self._pc.inc("ppermute_rows", int(arr.shape[0]))
+        return out
+
+    def rebuild_collective(self, masks, words, kind: str = "recover"):
+        """The device-resident recovery dispatch: identical operands
+        and bit-identical result to :meth:`xor_matmul_w32`, but the
+        rebuilt rows land on EVERY chip via an in-graph tiled
+        all-gather — a recovered shard's new home reads its bytes
+        from its own chip's copy of the gathered buffer instead of a
+        per-shard host round trip.  Padding rows (zero masks, zero
+        words) gather as zeros and are sliced off."""
+        import jax
+        import jax.numpy as jnp
+        words = jnp.asarray(words, jnp.int32)
+        masks = jnp.asarray(masks, jnp.int32)
+        lead = words.shape[:-2]
+        C, W = words.shape[-2:]
+        per_batch = masks.ndim > 2
+        if per_batch and masks.shape[:-2] != lead:
+            raise ValueError(
+                f"mask batch {masks.shape[:-2]} != data batch {lead}")
+        R = masks.shape[-2]
+        B = int(np.prod(lead)) if lead else 1
+        w3 = words.reshape(B, C, W)
+        m3 = masks.reshape(B, R, masks.shape[-1]) if per_batch \
+            else masks
+        pad = (-B) % self.n_shards
+        if pad:
+            w3 = jnp.pad(w3, ((0, pad), (0, 0), (0, 0)))
+            if per_batch:
+                m3 = jnp.pad(m3, ((0, pad), (0, 0), (0, 0)))
+        from .mesh import batch_sharding, replicated_sharding
+        w3 = jax.device_put(w3, batch_sharding(self.mesh))
+        m3 = jax.device_put(m3, batch_sharding(self.mesh) if per_batch
+                            else replicated_sharding(self.mesh))
+        out, rows = self._collective_step(per_batch)(m3, w3)
+        self.last_psum = rows
+        self.account(kind, B, 4 * C * W, padded_rows=B + pad)
+        self._pc.inc("allgather_rows", B + pad)
+        out = out[:B] if pad else out
+        return out.reshape(lead + (R, W)) if lead else \
+            out.reshape(R, W)
+
+    def account_landed(self, target_osd: int, rows: int,
+                       row_bytes: int) -> None:
+        """One rebuilt shard landed chip-to-chip on ``target_osd``'s
+        affine chip (the delivery half of rebuild_collective)."""
+        chip = self.chip_of(target_osd)
+        self._pc.inc(f"shard{chip}.recover_landed")
+        self._pc.inc(f"shard{chip}.recover_landed_bytes",
+                     rows * row_bytes)
+
     def xor_matmul_w32(self, masks, words, kind: str = "encode"):
         """Drop-in for ``ops.xor_kernel.xor_matmul_w32``, sharded on
         the leading (stripe) axis.  masks [R, C] (replicated) or

@@ -69,6 +69,51 @@ def test_sharded_xor_bit_identical_to_kernel(plane_on):
     assert dp.psum_probe() == dp.n_shards
 
 
+def test_rebuild_collective_bit_identical_and_ppermute(plane_on):
+    """ISSUE 11 tentpole (a): the collective rebuild dispatch —
+    per-chip masked-XOR plus an in-graph tiled all-gather, so every
+    chip lands its rebuilt shards chip-to-chip — is bit-identical to
+    the single-device kernel for replicated and per-stripe signature
+    masks at ragged batch sizes; the ring ppermute landing primitive
+    rotates batch blocks exactly one mesh position."""
+    from ceph_tpu.ops import xor_kernel
+    from ceph_tpu.parallel.data_plane import plane
+    dp = plane()
+    assert dp is not None and dp.n_shards >= 2
+    perf("dataplane").reset()
+    rng = np.random.default_rng(5)
+    for B in (1, 6, 8, 17):
+        masks = (rng.integers(0, 2, (16, 24), dtype=np.int64)
+                 .astype(np.int32) * -1)
+        words = rng.integers(-2**31, 2**31 - 1, (B, 24, 8),
+                             dtype=np.int64).astype(np.int32)
+        np.testing.assert_array_equal(
+            np.asarray(dp.rebuild_collective(masks, words)),
+            np.asarray(xor_kernel.xor_matmul_w32(masks, words)))
+        mb = (rng.integers(0, 2, (B, 16, 24), dtype=np.int64)
+              .astype(np.int32) * -1)
+        np.testing.assert_array_equal(
+            np.asarray(dp.rebuild_collective(mb, words)),
+            np.asarray(xor_kernel.xor_matmul_w32(mb, words)))
+    n = dp.n_shards
+    x = np.arange(2 * n * 4, dtype=np.int32).reshape(2 * n, 4)
+    rolled = np.asarray(dp.ppermute_shift(x, 1))
+    np.testing.assert_array_equal(
+        rolled, np.roll(x.reshape(n, 2, 4), 1, axis=0)
+        .reshape(2 * n, 4))
+    with pytest.raises(ValueError):
+        dp.ppermute_shift(np.zeros((n + 1, 2), np.int32))
+    d = perf("dataplane").dump()
+    assert d.get("allgather_rows", 0) > 0
+    assert d.get("ppermute_rows", 0) == 2 * n
+    assert d.get("recover_dispatches", 0) > 0
+    dp.account_landed(3, 4, 128)
+    d = perf("dataplane").dump()
+    chip = dp.chip_of(3)
+    assert d.get(f"shard{chip}.recover_landed") == 1
+    assert d.get(f"shard{chip}.recover_landed_bytes") == 512
+
+
 def _drive_cluster(shard: bool, seed=7, n_objs=12):
     """put_many -> kill 2 up-set members -> degraded gets -> out ->
     recover_all -> remap sweep -> gets again; returns everything
@@ -126,6 +171,12 @@ def test_cluster_step_bit_identical_and_per_chip_counters():
     assert d.get("recover_dispatches", 0) > 0     # rebuild sweep
     assert d.get("map_dispatches", 0) > 0         # remap sweeps
     assert d.get("psum_rows", 0) > 0              # the ICI collective
+    # the kill->out->rebuild sweep ran COLLECTIVELY: rebuilt rows
+    # all-gathered across the mesh and landed on their target OSDs'
+    # affine chips (ISSUE 11 device-resident recovery)
+    assert d.get("allgather_rows", 0) > 0
+    assert any(d.get(f"shard{i}.recover_landed", 0) > 0
+               for i in range(n_dev))
     # staging-affinity partitions saw entries on at least one chip
     assert any(d.get(f"shard{i}.staged_entries", 0) > 0
                for i in range(n_dev))

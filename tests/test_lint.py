@@ -152,6 +152,61 @@ def test_ctl110_done_callbacks_and_result_wait(tmp_path):
     assert "result" in res.findings[0].msg
 
 
+def test_ctl120_per_shard_blocking_recovery_loop(tmp_path):
+    """ISSUE 11: a recovery/backfill sweep that fetches or pushes one
+    shard per blocking round trip pays an RTT per shard — the 0.002
+    GB/s wire-recovery floor.  Async submit-all-then-gather and bulk
+    frames are the sanctioned shapes."""
+    write(tmp_path, "cluster/rec.py", """\
+        def recover_pg(client, peers, shards, coll):
+            for s in shards:
+                client.call({"cmd": "get_shard", "coll": coll,
+                             "oid": s})                    # flagged
+            for s in shards:
+                client._peer_req(1, {"cmd": "put_shard",
+                                     "coll": coll, "oid": s,
+                                     "data": b""})         # flagged
+            for attempt in range(3):
+                client.osd_call(0, {"cmd": "recover_pg",
+                                    "coll": coll})         # per-PG: ok
+            fan = [client.call_async(0, {"cmd": "get_shard",
+                                         "coll": coll, "oid": s})
+                   for s in shards]                        # async: ok
+            for s in shards:
+                client._peer_req(1, {"cmd": "get_objects",
+                                     "coll": coll,
+                                     "oids": [s]})         # bulk: ok
+            return fan
+
+        def scrub_pg(client, shards, coll):
+            for s in shards:
+                client.call({"cmd": "digest_shard", "coll": coll,
+                             "oid": s})    # not a recovery fn: ok
+        """)
+    res = lint(tmp_path, select=["CTL120"])
+    assert rules_of(res) == ["CTL120", "CTL120"], res.findings
+    assert sorted(f.line for f in res.findings) == [3, 6]
+    assert all("RTT per shard" in f.msg for f in res.findings)
+
+
+def test_ctl120_scope_and_noqa(tmp_path):
+    # outside cluster//client/ the rule does not apply
+    write(tmp_path, "tools/rec.py", """\
+        def recover_stuff(client, shards, coll):
+            for s in shards:
+                client.call({"cmd": "get_shard", "coll": coll,
+                             "oid": s})
+        """)
+    write(tmp_path, "client/rec.py", """\
+        def backfill(client, shards, coll):
+            for s in shards:
+                client.call({"cmd": "get_shard",  # noqa: CTL120
+                             "coll": coll, "oid": s})
+        """)
+    res = lint(tmp_path, select=["CTL120"])
+    assert rules_of(res) == [], res.findings
+
+
 # --------------------------------------- CTL2xx: dtype invariants ---
 
 def test_ctl201_implicit_dtype_scoped_to_ops_placement(tmp_path):
