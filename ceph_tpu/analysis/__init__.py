@@ -4,14 +4,19 @@ invariants.
 PR 1 added *runtime* guards (lockdep cycle detection, immutable
 perf-counter types); this package is the *static* counterpart: the
 properties the two TPU inner loops (CRUSH mapping, GF(2^8) EC) and the
-daemon plane depend on are checked at lint time, across every module,
-before any test runs.  Five rule families (ids are stable and
-suppressable via ``# noqa: CTL###`` or the checked-in baseline):
+daemon plane depend on are checked at lint time, before any test
+runs.  Since CTLint v2 the reachability-based rules run on a
+WHOLE-PROGRAM, import-resolving call graph (astutil.ProgramGraph,
+built once per run and shared by every rule), so a violation one
+module away from its root is no longer invisible.  Eight rule
+families (ids are stable and suppressable via ``# noqa: CTL###`` or
+the checked-in baseline):
 
   CTL1xx  hot-path hygiene: JAX (host syncs / tracer branches /
-          per-call jit inside jit-reachable code) and the messenger
-          (110: blocking calls reachable from completion-callback
-          context)
+          per-call jit inside jit-reachable code, cross-module),
+          the messenger (110: blocking calls reachable from
+          completion-callback context) and recovery loops (120:
+          per-shard blocking round trips, helpers included)
   CTL2xx  GF(2^8)/CRUSH dtype invariants (implicit dtypes that drift
           under jax_enable_x64; unpinned array ingestion in ops/)
   CTL3xx  concurrency (static lock-order inversions against the same
@@ -19,11 +24,21 @@ suppressable via ``# noqa: CTL###`` or the checked-in baseline):
           threading locks in daemon-plane modules)
   CTL4xx  perf-counter / config registry hygiene
   CTL5xx  admin-command registry (dispatched vs registered)
+  CTL6xx  faultpoint registry closure (fires declared; fires outside
+          jit; swallowed IO errors; store writes off the barrier API)
+  CTL7xx  trace-context propagation closure (stamped wire sends —
+          direct, var-flow, and cross-module wrapper shapes)
+  CTL8xx  wire-protocol contract closure (sent cmds handled, arms
+          exercised, mutations (session,seq)-stamped, sender keys
+          cover handler reads, faultpoint grammar single-declare) —
+          the ceph-dencoder / ceph-object-corpus role, statically
 
 Entry points: ``scripts/lint.py`` (CI driver), ``ceph_tpu.tools.
-ceph_cli lint`` (operator surface), ``ceph_tpu.analysis.runner.run``
-(library).  Reference role: src/test/static-analysis + the sanitizer
-wiring — regressions caught by machinery, not review.
+ceph_cli lint`` (operator surface; ``--rule`` family filter,
+``--graph module.fn`` call-graph dump), ``ceph_tpu.analysis.runner.
+run`` (library), ``scripts/check_static.py`` (seeded smoke).
+Reference role: src/test/static-analysis + the sanitizer wiring —
+regressions caught by machinery, not review.
 """
 from .core import Finding, LintError, Rule  # noqa: F401
 from .registry import RuleRegistry, instance  # noqa: F401

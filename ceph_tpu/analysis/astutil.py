@@ -1,11 +1,25 @@
-"""Shared AST analyses: import-alias-aware name resolution and the
-jit-reachability ("hot") call graph the CTL1xx/CTL2xx rules key off.
+"""Shared AST analyses: import-alias-aware name resolution, the
+whole-program interprocedural call graph, and the jit-reachability
+("hot") set the CTL1xx/CTL6xx rules key off.
 
-Everything here is intentionally module-local and name-based: a call
-``dt.bucket_row(...)`` marks every same-module function NAMED
-``bucket_row`` — an over-approximation that is cheap, deterministic,
-and right for this codebase's idiom (helpers live next to the jitted
-entry points that trace them).
+Two resolution tiers coexist (CTLint v2):
+
+  * **Precise, cross-module** — ``ProgramGraph`` resolves
+    ``from x import f`` / ``import pkg.mod as m`` (absolute AND
+    relative forms), ``self._method`` against the enclosing class,
+    and ``module.func`` attribute calls against the imported module's
+    top-level functions, across every file of the run.  Built once
+    per run and cached on the ``Program``, shared by all rules.
+  * **Module-local, name-based fallback** — when resolution is
+    ambiguous (an attribute call on an arbitrary object,
+    ``dt.bucket_row(...)``), the graph falls back to today's idiom:
+    every same-module function NAMED ``bucket_row`` is a candidate
+    callee.  Cheap, deterministic, and right for this codebase's
+    helpers-next-to-entry-points layout — and it means the widened
+    graph can only ADD reachability, never silently lose it.
+
+A module parsed outside a run (no ``Program``) keeps the pure
+module-local behavior.
 """
 from __future__ import annotations
 
@@ -125,11 +139,336 @@ def _static_params(fn: ast.AST, spec: ast.Call) -> Optional[Set[str]]:
     return names
 
 
+def aliases_of(mod) -> Dict[str, str]:
+    """Per-module ``import_aliases``, computed once and cached —
+    every rule shares one pass instead of re-walking the imports."""
+    cached = mod._cache.get("aliases")
+    if cached is None:
+        cached = mod._cache["aliases"] = import_aliases(mod.tree)
+    return cached
+
+
+def module_dotted(relpath: str) -> str:
+    """'ceph_tpu/cluster/daemon.py' -> 'ceph_tpu.cluster.daemon';
+    a package ``__init__.py`` maps to the package itself."""
+    parts = relpath.rsplit(".", 1)[0].split("/")
+    if parts and parts[-1] == "__init__":
+        parts = parts[:-1]
+    return ".".join(parts)
+
+
+def program_aliases_of(mod) -> Dict[str, str]:
+    """local name -> ABSOLUTE dotted import target, with relative
+    imports (``from .objectstore import T`` / ``from ..common import
+    tracer as _trace``) anchored at the module's package path.  The
+    cross-module half of name resolution; ``import_aliases`` stays
+    the canonical-spelling half (jax/np normalization)."""
+    cached = mod._cache.get("prog_aliases")
+    if cached is not None:
+        return cached
+    mparts = [p for p in module_dotted(mod.relpath).split(".") if p]
+    is_pkg = mod.relpath.endswith("__init__.py")
+    pkg = mparts if is_pkg else mparts[:-1]
+    out: Dict[str, str] = {}
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                out[a.asname or a.name.split(".")[0]] = \
+                    a.name if a.asname else a.name.split(".")[0]
+        elif isinstance(node, ast.ImportFrom):
+            if node.level == 0:
+                base = node.module or ""
+            else:
+                drop = node.level - 1
+                if drop > len(pkg):
+                    continue                  # beyond the lint root
+                anchor = pkg[:len(pkg) - drop] if drop else pkg
+                base = ".".join(anchor + ([node.module]
+                                          if node.module else []))
+            for a in node.names:
+                if a.name == "*":
+                    continue
+                out[a.asname or a.name] = \
+                    f"{base}.{a.name}" if base else a.name
+    mod._cache["prog_aliases"] = out
+    return out
+
+
+def _partial_aliases(mod) -> Dict[str, str]:
+    """name -> callee name for ``g = functools.partial(f, ...)``."""
+    cached = mod._cache.get("partial_aliases")
+    if cached is not None:
+        return cached
+    aliases = aliases_of(mod)
+    out: Dict[str, str] = {}
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and isinstance(node.value, ast.Call) \
+                and resolve(node.value.func, aliases) in PARTIAL_NAMES \
+                and node.value.args:
+            base = dotted(node.value.args[0])
+            if base:
+                out[node.targets[0].id] = _tail(base)
+    mod._cache["partial_aliases"] = out
+    return out
+
+
+class ProgramGraph:
+    """The whole-tree, import-resolving call graph (CTLint v2).
+
+    Resolution order for a call / function reference in module M,
+    enclosing class C:
+
+      1. ``self.x`` / ``cls.x``     -> method ``x`` of C in M (precise);
+                                       no such method: module-local
+                                       name fallback
+      2. bare ``f``                 -> function named ``f`` in M, else
+                                       the ``from x import f`` target's
+                                       top-level ``f`` (cross-module)
+      3. ``m.f`` / ``pkg.m.f``      -> top-level ``f`` of the imported
+                                       in-tree module ``m`` (precise;
+                                       a resolved module WITHOUT such a
+                                       function is a miss, not a
+                                       fallback — it is a class or
+                                       dynamic attribute)
+      4. anything else (``obj.f``)  -> module-local name fallback:
+                                       every function in M named ``f``
+
+    ``functools.partial`` rebindings resolve through their base
+    callable first.  Evidence modules participate in the indexes (so
+    --graph can answer questions about them) but never in the hot
+    set.
+    """
+
+    def __init__(self, program) -> None:
+        self.program = program
+        self.mod_of: Dict[ast.AST, object] = {}
+        self.cls_of: Dict[ast.AST, Optional[str]] = {}
+        # (relpath, name) -> fns; (relpath, cls, name) -> methods;
+        # (dotted module, name) -> top-level fns
+        self.local: Dict[Tuple[str, str], List[ast.AST]] = {}
+        self.methods: Dict[Tuple[str, Optional[str], str],
+                           List[ast.AST]] = {}
+        self.top: Dict[Tuple[str, str], List[ast.AST]] = {}
+        self.mod_dotted: Dict[str, object] = {}
+        self._edges: Dict[ast.AST, Set[ast.AST]] = {}
+        self._redges: Optional[Dict[ast.AST, Set[ast.AST]]] = None
+        for mod in program.modules.values():
+            dn = module_dotted(mod.relpath)
+            self.mod_dotted.setdefault(dn, mod)
+            for fn, cls in walk_functions(mod.tree):
+                self.mod_of[fn] = mod
+                self.cls_of[fn] = cls
+                self.local.setdefault((mod.relpath, fn.name),
+                                      []).append(fn)
+                self.methods.setdefault((mod.relpath, cls, fn.name),
+                                        []).append(fn)
+                if cls is None:
+                    self.top.setdefault((dn, fn.name), []).append(fn)
+
+    # --------------------------------------------------------- naming --
+    def qualname(self, fn: ast.AST) -> str:
+        mod = self.mod_of[fn]
+        cls = self.cls_of[fn]
+        dn = module_dotted(mod.relpath)
+        mid = f"{cls}." if cls else ""
+        return f"{dn}.{mid}{fn.name}"
+
+    def find(self, pattern: str) -> List[ast.AST]:
+        """Functions matching a dotted pattern: the last part names
+        the function, the rest must appear in the qualified name in
+        order — so 'daemon._recover_pg' matches
+        'ceph_tpu.cluster.daemon.OSDDaemon._recover_pg' without the
+        caller knowing the class."""
+        pat = pattern.split(".")
+        out = []
+        for fn in self.mod_of:
+            q = self.qualname(fn).split(".")
+            if q[-1] != pat[-1]:
+                continue
+            i = 0
+            for part in q[:-1]:
+                if i < len(pat) - 1 and part == pat[i]:
+                    i += 1
+            if i == len(pat) - 1:
+                out.append(fn)
+        return sorted(out, key=self.qualname)
+
+    # ----------------------------------------------------- resolution --
+    def resolve_call(self, mod, cls: Optional[str], call: ast.Call,
+                     precise: bool = False) -> List[ast.AST]:
+        """Callee candidates.  ``precise=True`` drops the ambiguous
+        module-local name fallback (an attribute call on an arbitrary
+        object resolves to NOTHING instead of every same-named local
+        function) — for traversals where an over-approximate edge is
+        worse than a missed one."""
+        d = dotted(call.func)
+        if d is None:
+            return []
+        return self._resolve(mod, cls, d, precise)
+
+    def resolve_ref(self, mod, cls: Optional[str],
+                    node: ast.AST) -> List[ast.AST]:
+        """A function-OBJECT reference (jit(f) argument, ``cb=f``
+        registration) rather than a call."""
+        d = dotted(node)
+        if d is None:
+            return []
+        return self._resolve(mod, cls, d, False)
+
+    def _resolve(self, mod, cls: Optional[str], d: str,
+                 precise: bool) -> List[ast.AST]:
+        parts = d.split(".")
+        pa = _partial_aliases(mod)
+        if len(parts) == 2 and parts[0] in ("self", "cls"):
+            name = pa.get(parts[1], parts[1])
+            if cls is not None:
+                hit = self.methods.get((mod.relpath, cls, name))
+                if hit:
+                    return list(hit)
+            if precise:
+                return []
+            return list(self.local.get((mod.relpath, name), ()))
+        if len(parts) == 1:
+            name = pa.get(parts[0], parts[0])
+            hit = self.local.get((mod.relpath, name))
+            if hit:
+                return list(hit)
+            tgt = program_aliases_of(mod).get(name)
+            if tgt and "." in tgt:
+                mn, _, fname = tgt.rpartition(".")
+                if mn in self.mod_dotted:
+                    return list(self.top.get((mn, fname), ()))
+            return []
+        head = program_aliases_of(mod).get(parts[0])
+        if head:
+            mn = ".".join([head] + parts[1:-1])
+            if mn in self.mod_dotted:
+                return list(self.top.get((mn, parts[-1]), ()))
+        if precise:
+            return []
+        name = pa.get(parts[-1], parts[-1])
+        return list(self.local.get((mod.relpath, name), ()))
+
+    # ----------------------------------------------------------- edges --
+    def callees(self, fn: ast.AST) -> Set[ast.AST]:
+        """Resolved direct callees of ``fn`` (cached)."""
+        cached = self._edges.get(fn)
+        if cached is not None:
+            return cached
+        mod = self.mod_of[fn]
+        cls = self.cls_of[fn]
+        out: Set[ast.AST] = set()
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                out.update(self.resolve_call(mod, cls, node))
+        out.discard(fn)
+        self._edges[fn] = out
+        return out
+
+    def callers_of(self, fn: ast.AST) -> Set[ast.AST]:
+        if self._redges is None:
+            redges: Dict[ast.AST, Set[ast.AST]] = {}
+            for f in self.mod_of:
+                for tgt in self.callees(f):
+                    redges.setdefault(tgt, set()).add(f)
+            self._redges = redges
+        return self._redges.get(fn, set())
+
+    def reachable(self, roots, forward: bool = True) -> Set[ast.AST]:
+        """Transitive closure from ``roots`` (roots excluded unless
+        re-reached) over resolved call edges."""
+        step = self.callees if forward else self.callers_of
+        seen: Set[ast.AST] = set()
+        work = list(roots)
+        while work:
+            for nxt in step(work.pop()):
+                if nxt not in seen:
+                    seen.add(nxt)
+                    work.append(nxt)
+        return seen
+
+
+def program_graph(program) -> ProgramGraph:
+    """The per-run shared graph (built once, cached on Program)."""
+    g = program._cache.get("graph")
+    if g is None:
+        g = program._cache["graph"] = ProgramGraph(program)
+    return g
+
+
+def _program_hot(program) -> HotInfo:
+    """Whole-program jit-reachable set: roots collected from every
+    lint module (jit decorators, jit/combinator call forms — the
+    argument may be imported from another module), then propagated
+    to a fixed point over the resolved cross-module call graph.
+    Evidence modules contribute neither roots nor members."""
+    cached = program._cache.get("hot")
+    if cached is not None:
+        return cached
+    g = program_graph(program)
+    info = HotInfo()
+
+    def mark_direct(fn: ast.AST, spec: Optional[ast.Call]) -> None:
+        info.hot.add(fn)
+        statics = _static_params(fn, spec) if spec is not None \
+            else set()
+        info.direct.setdefault(fn, statics)
+
+    for mod in program.modules.values():
+        if mod.evidence:
+            continue
+        aliases = aliases_of(mod)
+        for fn, _cls in walk_functions(mod.tree):
+            for dec in fn.decorator_list:
+                if is_jit_expr(dec, aliases):
+                    spec = dec if isinstance(dec, ast.Call) else None
+                    mark_direct(fn, spec)
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            cn = resolve(node.func, aliases)
+            if cn in JIT_NAMES:
+                for a in node.args[:1]:
+                    for fn in g.resolve_ref(mod, None, a):
+                        if not g.mod_of[fn].evidence:
+                            mark_direct(fn, node)
+            elif cn in TRACE_COMBINATORS:
+                for a in node.args:
+                    for fn in g.resolve_ref(mod, None, a):
+                        if not g.mod_of[fn].evidence:
+                            info.hot.add(fn)
+
+    work = list(info.hot)
+    while work:
+        fn = work.pop()
+        for tgt in g.callees(fn):
+            if tgt not in info.hot and not g.mod_of[tgt].evidence:
+                info.hot.add(tgt)
+                work.append(tgt)
+    program._cache["hot"] = info
+    return info
+
+
 def hot_functions(mod) -> HotInfo:
-    """Compute (and cache on the module) the jit-reachable set."""
+    """The jit-reachable set, per module (cached).  Inside a run the
+    module belongs to a Program and the set is the PER-MODULE SLICE
+    of the whole-program reachability closure; a standalone module
+    keeps the original module-local computation."""
     cached = mod._cache.get("hot")
     if cached is not None:
         return cached
+    program = getattr(mod, "program", None)
+    if program is not None:
+        g = program_graph(program)
+        ph = _program_hot(program)
+        info = HotInfo()
+        info.hot = {fn for fn in ph.hot if g.mod_of.get(fn) is mod}
+        info.direct = {fn: s for fn, s in ph.direct.items()
+                       if g.mod_of.get(fn) is mod}
+        mod._cache["hot"] = info
+        return info
     tree = mod.tree
     aliases = import_aliases(tree)
     info = HotInfo()

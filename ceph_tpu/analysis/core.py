@@ -62,6 +62,7 @@ class ParsedModule:
         self.evidence = evidence
         self.lines = source.splitlines()
         self._cache: Dict[str, Any] = {}   # shared per-module analyses
+        self.program: Optional["Program"] = None   # set by the runner
 
     def parts(self) -> Tuple[str, ...]:
         return tuple(self.relpath.split("/"))
@@ -79,6 +80,23 @@ class ParsedModule:
         codes = {c.upper()
                  for c in _NOQA_CODE_RE.findall(m.group("codes"))}
         return rule.upper() in codes
+
+
+class Program:
+    """The whole parsed tree of one lint run — every ParsedModule
+    (evidence included) plus a shared cache for cross-module analyses
+    (the resolved call graph, the whole-program jit-reachability set).
+    Built once per run by the runner and handed to every rule through
+    ``Rule.begin``; the cache is what keeps the interprocedural graph
+    a one-time cost no matter how many rules walk it."""
+
+    def __init__(self, modules: Dict[str, "ParsedModule"]):
+        self.modules = modules
+        self._cache: Dict[str, Any] = {}
+
+    def lint_modules(self) -> Iterable["ParsedModule"]:
+        """Modules findings may be reported in (evidence excluded)."""
+        return (m for m in self.modules.values() if not m.evidence)
 
 
 def parse_module(path: str, relpath: str,
@@ -109,6 +127,15 @@ class Rule:
     rule_id = "CTL000"
     name = "base"
     description = ""
+
+    def __init__(self) -> None:
+        self.program: Optional[Program] = None
+
+    def begin(self, program: Program) -> None:
+        """Called once, before any ``check_module``, with the whole
+        parsed tree — whole-program rules keep the handle for
+        ``finish`` and for the shared interprocedural graph."""
+        self.program = program
 
     def check_module(self, mod: ParsedModule) -> Iterable[Finding]:
         return ()

@@ -20,6 +20,11 @@ surface, tools/ceph_cli.py).  Register evidence: two-argument
 ``.register("prefix", handler)`` calls — the arity plus literal first
 argument distinguishes admin registrations from the EC/mgr/cls
 registries that share the method name.
+
+The WIRE protocol's twin closure (``{"cmd": ...}`` sends vs daemon
+dispatch arms) is the CTL8xx family (rules_protocol.py) — same
+two-sided dead-surface/unreachable-command model, applied to the
+messenger seam instead of the admin socket.
 """
 from __future__ import annotations
 

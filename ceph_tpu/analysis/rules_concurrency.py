@@ -147,7 +147,7 @@ class LockOrderRule(Rule):
     def check_module(self, mod: ParsedModule) -> Iterable[Finding]:
         if mod.evidence:
             return ()          # tests invert deliberately (lockdep's own)
-        aliases = astutil.import_aliases(mod.tree)
+        aliases = astutil.aliases_of(mod)
         v = _ModuleLocks(mod, aliases)
         v.visit(mod.tree)
         for outer, inner, line in v.edges:
@@ -198,7 +198,7 @@ class RawLockRule(Rule):
         if mod.evidence or not ({"cluster", "msg"} & set(parts)) or \
                 parts[-1] in _ENGINE_EXEMPT:
             return ()
-        aliases = astutil.import_aliases(mod.tree)
+        aliases = astutil.aliases_of(mod)
         out: List[Finding] = []
         for node in ast.walk(mod.tree):
             if isinstance(node, ast.Call) and \

@@ -47,7 +47,7 @@ class ImplicitDtypeRule(Rule):
         parts = mod.parts()
         if mod.evidence or not ({"ops", "placement"} & set(parts)):
             return ()
-        aliases = astutil.import_aliases(mod.tree)
+        aliases = astutil.aliases_of(mod)
         out: List[Finding] = []
         for node in ast.walk(mod.tree):
             if not isinstance(node, ast.Call):
@@ -72,7 +72,7 @@ class UnpinnedIngestRule(Rule):
     def check_module(self, mod: ParsedModule) -> Iterable[Finding]:
         if mod.evidence or "ops" not in mod.parts():
             return ()
-        aliases = astutil.import_aliases(mod.tree)
+        aliases = astutil.aliases_of(mod)
         out: List[Finding] = []
         seen = set()                      # nested-function walk dedup
         for fn, _cls in astutil.walk_functions(mod.tree):

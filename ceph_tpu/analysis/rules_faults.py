@@ -56,7 +56,7 @@ def _collect(mod: ParsedModule):
     cached = mod._cache.get("faultpoints")
     if cached is not None:
         return cached
-    aliases = astutil.import_aliases(mod.tree)
+    aliases = astutil.aliases_of(mod)
     declared: Dict[str, Tuple[str, int]] = {}
     fired: Dict[str, Tuple[str, int]] = {}
     for node in ast.walk(mod.tree):
@@ -123,7 +123,7 @@ class FireInJitRule(Rule):
         info = astutil.hot_functions(mod)
         if not info.hot:
             return ()
-        aliases = astutil.import_aliases(mod.tree)
+        aliases = astutil.aliases_of(mod)
         out: List[Finding] = []
         seen: Set[int] = set()               # nested-hot dedup
         for fn in info.hot:
@@ -264,7 +264,7 @@ class StoreBypassRule(Rule):
         if "cluster" not in parts[:-1] or \
                 parts[-1] not in _STORE_MODULES:
             return ()
-        aliases = astutil.import_aliases(mod.tree)
+        aliases = astutil.aliases_of(mod)
         out: List[Finding] = []
         for node in ast.walk(mod.tree):
             if not isinstance(node, ast.Call):

@@ -56,7 +56,7 @@ class HostSyncRule(Rule):
         info = astutil.hot_functions(mod)
         if not info.hot:
             return ()
-        aliases = astutil.import_aliases(mod.tree)
+        aliases = astutil.aliases_of(mod)
         out: List[Finding] = []
         seen: Set[Tuple[int, str]] = set()   # nested-hot dedup
         for fn in info.hot:
@@ -135,7 +135,7 @@ class JitPerCallRule(Rule):
     def check_module(self, mod: ParsedModule) -> Iterable[Finding]:
         if mod.evidence:
             return ()
-        aliases = astutil.import_aliases(mod.tree)
+        aliases = astutil.aliases_of(mod)
         out: List[Finding] = []
         for node in ast.walk(mod.tree):
             if isinstance(node, ast.Call) and \
@@ -169,7 +169,14 @@ class CallbackBlockingRule(Rule):
     name = "msgr-callback-blocking"
     description = ("blocking socket/wait call reachable from "
                    "messenger callback context (cb= / done-callback "
-                   "functions run on stream reader threads)")
+                   "functions run on stream reader threads) — "
+                   "whole-program: the callback may be registered "
+                   "in one module and block in another")
+
+    def __init__(self) -> None:
+        super().__init__()
+        # root callable -> (origin name, ParsedModule, enclosing cls)
+        self.roots: dict = {}
 
     @staticmethod
     def _own_calls(fn: ast.AST) -> List[ast.Call]:
@@ -197,62 +204,65 @@ class CallbackBlockingRule(Rule):
         return out
 
     def check_module(self, mod: ParsedModule) -> Iterable[Finding]:
+        """Collect callback ROOTS only; reachability and reporting
+        run once, whole-program, in finish()."""
         if mod.evidence:
             return ()
-        tree = mod.tree
-        aliases = astutil.import_aliases(tree)
-        funcs = {}
-        for node in ast.walk(tree):
-            if isinstance(node, (ast.FunctionDef,
-                                 ast.AsyncFunctionDef)):
-                funcs.setdefault(node.name, []).append(node)
+        graph = astutil.program_graph(mod.program)
 
-        # roots: callables registered as completion callbacks
-        roots: Set[ast.AST] = set()
-        root_names: dict = {}
-        for node in ast.walk(tree):
-            if not isinstance(node, ast.Call):
-                continue
-            cands = [kw.value for kw in node.keywords
-                     if kw.arg == "cb"]
-            if isinstance(node.func, ast.Attribute) and \
-                    node.func.attr in _CB_REG_ATTRS and node.args:
-                cands.append(node.args[0])
-            for v in cands:
-                if isinstance(v, ast.Lambda):
-                    roots.add(v)
-                    root_names[v] = "<lambda callback>"
-                else:
-                    base = astutil.dotted(v)
-                    if base:
-                        for fn in funcs.get(base.rsplit(".", 1)[-1],
-                                            ()):
-                            roots.add(fn)
-                            root_names[fn] = fn.name
-        if not roots:
+        def note(v: ast.AST, cls) -> None:
+            if isinstance(v, ast.Lambda):
+                self.roots.setdefault(
+                    v, ("<lambda callback>", mod, cls))
+            else:
+                for fn in graph.resolve_ref(mod, cls, v):
+                    tmod = graph.mod_of[fn]
+                    if not tmod.evidence:
+                        self.roots.setdefault(
+                            fn, (fn.name, tmod, graph.cls_of[fn]))
+
+        def visit(node: ast.AST, cls) -> None:
+            for ch in ast.iter_child_nodes(node):
+                ncls = ch.name if isinstance(ch, ast.ClassDef) else cls
+                if isinstance(ch, ast.Call):
+                    for kw in ch.keywords:
+                        if kw.arg == "cb":
+                            note(kw.value, cls)
+                    if isinstance(ch.func, ast.Attribute) and \
+                            ch.func.attr in _CB_REG_ATTRS and ch.args:
+                        note(ch.args[0], cls)
+                visit(ch, ncls)
+
+        visit(mod.tree, None)
+        return ()
+
+    def finish(self) -> Iterable[Finding]:
+        if not self.roots:
             return ()
-
-        # propagate through the in-module call graph (name-based,
-        # the hot_functions idiom) to everything callback-reachable
-        reach = set(roots)
-        origin = dict((fn, root_names[fn]) for fn in roots)
-        changed = True
-        while changed:
-            changed = False
-            for fn in list(reach):
-                for call in self._own_calls(fn):
-                    base = astutil.dotted(call.func)
-                    if base is None:
-                        continue
-                    for tgt in funcs.get(base.rsplit(".", 1)[-1], ()):
-                        if tgt not in reach:
-                            reach.add(tgt)
-                            origin[tgt] = origin[fn]
-                            changed = True
+        graph = astutil.program_graph(self.program)
+        # callback-context reachability over the resolved
+        # cross-module graph, own-frame calls only (deferred
+        # arguments escape callback context by design)
+        origin = {fn: name for fn, (name, _m, _c) in
+                  self.roots.items()}
+        ctx = {fn: (m, c) for fn, (_n, m, c) in self.roots.items()}
+        work = list(self.roots)
+        while work:
+            fn = work.pop()
+            mod, cls = ctx[fn]
+            for call in self._own_calls(fn):
+                for tgt in graph.resolve_call(mod, cls, call):
+                    tmod = graph.mod_of[tgt]
+                    if tgt not in origin and not tmod.evidence:
+                        origin[tgt] = origin[fn]
+                        ctx[tgt] = (tmod, graph.cls_of[tgt])
+                        work.append(tgt)
 
         out: List[Finding] = []
-        seen: Set[Tuple[int, str]] = set()
-        for fn in reach:
+        seen: Set[Tuple[str, int, str]] = set()
+        for fn in origin:
+            mod, _cls = ctx[fn]
+            aliases = astutil.aliases_of(mod)
             for call in self._own_calls(fn):
                 msg = None
                 if isinstance(call.func, ast.Attribute) and \
@@ -268,8 +278,8 @@ class CallbackBlockingRule(Rule):
                                f"context (reachable from callback "
                                f"{origin[fn]!r}) stalls every "
                                f"completion behind it")
-                if msg and (call.lineno, msg) not in seen:
-                    seen.add((call.lineno, msg))
+                if msg and (mod.relpath, call.lineno, msg) not in seen:
+                    seen.add((mod.relpath, call.lineno, msg))
                     out.append(self.finding(mod, call.lineno, msg))
         return out
 
@@ -311,42 +321,93 @@ class RecoveryShardLoopRule(Rule):
                     return v.value
         return None
 
-    def check_module(self, mod: ParsedModule) -> Iterable[Finding]:
-        if mod.evidence:
-            return ()
+    def __init__(self) -> None:
+        super().__init__()
+        # whole-program site dedup: a helper reachable from recovery
+        # loops in SEVERAL modules must report once, at one site
+        self.seen: Set[Tuple[str, int]] = set()
+
+    @staticmethod
+    def _in_scope(mod: ParsedModule) -> bool:
         parts = mod.parts()
-        if "cluster" not in parts and "client" not in parts:
-            return ()
-        out: List[Finding] = []
-        seen: Set[int] = set()
-        for fn in ast.walk(mod.tree):
-            if not isinstance(fn, (ast.FunctionDef,
-                                   ast.AsyncFunctionDef)):
+        return "cluster" in parts or "client" in parts
+
+    def _direct_hits(self, fn_name: str, root: ast.AST,
+                     mod: ParsedModule, out: List[Finding],
+                     seen: Set[Tuple[str, int]],
+                     via: str = "") -> None:
+        for call in ast.walk(root):
+            if not isinstance(call, ast.Call) or \
+                    not isinstance(call.func, ast.Attribute):
                 continue
+            if call.func.attr not in _BLOCKING_SEND_ATTRS:
+                continue
+            cmd = self._req_cmd(call)
+            if cmd not in _PER_SHARD_CMDS:
+                continue
+            if (mod.relpath, call.lineno) in seen:
+                continue
+            seen.add((mod.relpath, call.lineno))
+            out.append(self.finding(
+                mod, call.lineno,
+                f"blocking {cmd!r} round trip inside a loop "
+                f"in recovery path {fn_name!r}{via}: one RTT per "
+                f"shard is the wire-recovery floor — submit "
+                f"the sweep async (call_async + gather) or "
+                f"ship a bulk get_objects/put_objects frame"))
+
+    def check_module(self, mod: ParsedModule) -> Iterable[Finding]:
+        if mod.evidence or not self._in_scope(mod):
+            return ()
+        graph = astutil.program_graph(mod.program)
+        out: List[Finding] = []
+        seen = self.seen
+        for fn, cls in astutil.walk_functions(mod.tree):
             if not _RECOVERY_FN_RE.search(fn.name):
                 continue
             for loop in ast.walk(fn):
                 if not isinstance(loop, (ast.For, ast.While)):
                     continue
+                # sends lexically inside the loop
+                self._direct_hits(fn.name, loop, mod, out, seen)
+                # whole-program: a helper CALLED from the loop that
+                # performs the per-shard blocking send pays the same
+                # RTT per iteration — follow PRECISE edges only
+                # (self-methods, local names, resolved imports;
+                # ambiguous obj.attr fallback edges would drag in
+                # every same-named function) and stop at the wire
+                # layer itself (the send primitives' own bodies are
+                # engine internals, not callers' loop shapes)
+                helpers: Set[ast.AST] = set()
+                work: List[ast.AST] = []
                 for call in ast.walk(loop):
-                    if not isinstance(call, ast.Call) or \
-                            not isinstance(call.func, ast.Attribute):
+                    if isinstance(call, ast.Call):
+                        work.extend(graph.resolve_call(
+                            mod, cls, call, precise=True))
+                while work:
+                    h = work.pop()
+                    if h in helpers or \
+                            h.name in _BLOCKING_SEND_ATTRS:
                         continue
-                    if call.func.attr not in _BLOCKING_SEND_ATTRS:
+                    helpers.add(h)
+                    for call in ast.walk(h):
+                        if isinstance(call, ast.Call):
+                            work.extend(graph.resolve_call(
+                                graph.mod_of[h], graph.cls_of[h],
+                                call, precise=True))
+                for h in helpers:
+                    hmod = graph.mod_of[h]
+                    if hmod.evidence or not self._in_scope(hmod):
                         continue
-                    cmd = self._req_cmd(call)
-                    if cmd not in _PER_SHARD_CMDS:
-                        continue
-                    if call.lineno in seen:
-                        continue
-                    seen.add(call.lineno)
-                    out.append(self.finding(
-                        mod, call.lineno,
-                        f"blocking {cmd!r} round trip inside a loop "
-                        f"in recovery path {fn.name!r}: one RTT per "
-                        f"shard is the wire-recovery floor — submit "
-                        f"the sweep async (call_async + gather) or "
-                        f"ship a bulk get_objects/put_objects frame"))
+                    # recovery-named helpers are NOT skipped: their
+                    # own check only covers sends inside their own
+                    # loops, while a straight-line per-shard send in
+                    # a helper called from THIS loop still pays an
+                    # RTT per iteration (site dedup prevents double
+                    # reports)
+                    self._direct_hits(
+                        fn.name, h, hmod, out, seen,
+                        via=f" (via helper {h.name!r})")
         return out
 
 

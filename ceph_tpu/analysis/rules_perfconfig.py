@@ -132,7 +132,7 @@ class _PerfUsages(ast.NodeVisitor):
         CTL402/CTL403 rules (same pattern as astutil.hot_functions)."""
         cached = mod._cache.get("perf_usages")
         if cached is None:
-            v = cls(astutil.import_aliases(mod.tree))
+            v = cls(astutil.aliases_of(mod))
             v.visit(mod.tree)
             cached = mod._cache["perf_usages"] = v.usages
         return cached

@@ -9,25 +9,40 @@ or dispatch fan-out site that builds its own request dict and ships
 it through a raw connection never propagates the context, the trace
 simply has a hole where that hop's spans should be, and nobody
 notices until a slow op's flame trace dead-ends mid-cluster — the
-silent-trace-gap bug class (this sweep found 11 real gaps: the
+silent-trace-gap bug class (the v1 sweep found 11 real gaps: the
 client's snapset/digest/recovery sends and every daemon peer_req).
 
   CTL701  a raw wire send (``<conn>.call({...})`` / ``_peer_req(n,
-          {...})``) in cluster//client/ whose dict-literal request
-          names a DATA-PATH command but neither passed through
+          {...})``) in cluster//client/ whose request names a
+          DATA-PATH command but neither passed through
           ``tracer.stamp(...)`` nor carries a ``tctx`` key
+
+CTLint v2 promotes the check to the whole-program graph; three send
+shapes are covered:
+
+  * the dict literal passed directly to the raw send (v1);
+  * a dict literal bound to a LOCAL NAME first and sent later in the
+    same function (``req = {...}; conn.call(req)``) — clean when the
+    function stamps the name in between (``req = stamp(req)`` /
+    ``req["tctx"] = ...``);
+  * a dict literal handed to a WRAPPER function that forwards its
+    parameter to a raw send (resolved through the import-aware call
+    graph, wrapper-of-wrapper included) — the hop that v1 could not
+    see because the send lives one module away.
 
 Sends through the stamping chokepoints (``osd_call`` /
 ``call_async`` / ``aio_osd_call``) are exempt — AsyncObjecter.
-call_async stamps centrally.  Control traffic (maps, pings, boots,
-mon commands) is exempt: only the tracked data-path commands carry
-op traces.
+call_async stamps centrally — as is any wrapper that itself stamps
+(calls ``*.stamp(...)`` or assigns a ``tctx`` key) before sending.
+Control traffic (maps, pings, boots, mon commands) is exempt: only
+the tracked data-path commands carry op traces.
 """
 from __future__ import annotations
 
 import ast
-from typing import Iterable, List
+from typing import Dict, Iterable, List, Optional, Set, Tuple
 
+from . import astutil
 from .core import Finding, ParsedModule, Rule
 
 # the tracked wire data-path commands (cluster/daemon.py
@@ -41,7 +56,19 @@ _DATA_CMDS = frozenset((
 # call_async route through AsyncObjecter's stamping and are exempt
 _RAW_SENDS = frozenset(("call", "_peer_req"))
 
+# chokepoint names that must never be treated as gap wrappers even
+# though their bodies forward to a raw send: they stamp centrally
+# (call_async) or route through something that does (osd_call ->
+# aio.call -> call_async)
+_CHOKEPOINT_FNS = frozenset(("osd_call", "aio_osd_call",
+                             "call_async", "mon_call"))
+
 _SCOPE_DIRS = frozenset(("cluster", "client"))
+
+
+def _in_scope(mod: ParsedModule) -> bool:
+    parts = mod.relpath.replace("\\", "/").split("/")[:-1]
+    return any(p in _SCOPE_DIRS for p in parts)
 
 
 def _data_cmd_of(node: ast.AST):
@@ -65,6 +92,38 @@ def _data_cmd_of(node: ast.AST):
     return None
 
 
+def _send_name(call: ast.Call) -> Optional[str]:
+    fn = call.func
+    if isinstance(fn, ast.Attribute):
+        return fn.attr
+    if isinstance(fn, ast.Name):
+        return fn.id
+    return None
+
+
+def _fn_stamps(fn: ast.AST) -> bool:
+    """Does this function stamp a request itself?  True for a
+    ``*.stamp(...)`` call or a ``x["tctx"] = ...`` assignment
+    anywhere in the body — the AsyncObjecter.call_async shape."""
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            name = _send_name(node)
+            if name == "stamp":
+                return True
+        elif isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Subscript) and \
+                        isinstance(tgt.slice, ast.Constant) and \
+                        tgt.slice.value == "tctx":
+                    return True
+    return False
+
+
+def _param_names(fn: ast.AST) -> List[str]:
+    a = fn.args
+    return [p.arg for p in a.posonlyargs + a.args]
+
+
 class TraceGapRule(Rule):
     rule_id = "CTL701"
     name = "wire-send-without-trace-context"
@@ -72,41 +131,163 @@ class TraceGapRule(Rule):
                    "data-path request without propagating the active "
                    "trace context (the silent-trace-gap bug class): "
                    "wrap the request in tracer.stamp(...) or route "
-                   "through the stamping chokepoints")
+                   "through the stamping chokepoints — checked over "
+                   "the whole-program graph (wrapper sends included)")
 
+    def __init__(self) -> None:
+        super().__init__()
+        self.mods: List[ParsedModule] = []
+
+    # ------------------------------------------------------- wrappers --
+    def _raw_wrappers(self, graph) -> Dict[ast.AST, Set[int]]:
+        """fn -> positions of parameters forwarded (transitively) to
+        a raw send.  A function that stamps internally, or bears a
+        chokepoint name, is never a gap wrapper."""
+        wrappers: Dict[ast.AST, Set[int]] = {}
+        candidates = []
+        for fn, mod in ((f, graph.mod_of[f]) for f in graph.mod_of):
+            if mod.evidence or not _in_scope(mod):
+                continue
+            if fn.name in _CHOKEPOINT_FNS or \
+                    not isinstance(fn, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef)):
+                continue
+            if _fn_stamps(fn):
+                continue
+            candidates.append(fn)
+        changed = True
+        while changed:
+            changed = False
+            for fn in candidates:
+                mod = graph.mod_of[fn]
+                cls = graph.cls_of[fn]
+                params = _param_names(fn)
+                fwd: Set[int] = set(wrappers.get(fn, set()))
+                for call in ast.walk(fn):
+                    if not isinstance(call, ast.Call):
+                        continue
+                    positions: Set[int] = set()
+                    name = _send_name(call)
+                    if name in _RAW_SENDS:
+                        positions = set(range(len(call.args)))
+                    else:
+                        for tgt in graph.resolve_call(mod, cls, call):
+                            for i in wrappers.get(tgt, ()):
+                                # account for the bound self arg of
+                                # method calls: wrapper param i is
+                                # caller arg i-1 when the target is a
+                                # method invoked via attribute access
+                                off = 1 if (graph.cls_of[tgt] and
+                                            isinstance(call.func,
+                                                       ast.Attribute)
+                                            ) else 0
+                                positions.add(i - off)
+                    for pos in positions:
+                        if not 0 <= pos < len(call.args):
+                            continue
+                        a = call.args[pos]
+                        if isinstance(a, ast.Name) and \
+                                a.id in params:
+                            idx = params.index(a.id)
+                            if idx not in fwd:
+                                fwd.add(idx)
+                                changed = True
+                if fwd:
+                    wrappers[fn] = fwd
+        return wrappers
+
+    # ------------------------------------------------------ collection --
     def check_module(self, mod: ParsedModule) -> Iterable[Finding]:
-        if mod.evidence:
-            return ()
-        parts = mod.relpath.replace("\\", "/").split("/")[:-1]
-        if not any(p in _SCOPE_DIRS for p in parts):
-            return ()
+        if not mod.evidence and _in_scope(mod):
+            self.mods.append(mod)
+        return ()
+
+    def finish(self) -> Iterable[Finding]:
+        graph = astutil.program_graph(self.program)
+        wrappers = self._raw_wrappers(graph)
         out: List[Finding] = []
-        for node in ast.walk(mod.tree):
-            if not isinstance(node, ast.Call):
-                continue
-            fn = node.func
-            if isinstance(fn, ast.Attribute):
-                name = fn.attr
-            elif isinstance(fn, ast.Name):
-                name = fn.id
-            else:
-                continue
-            if name not in _RAW_SENDS:
-                continue
-            for arg in node.args:
-                cmd = _data_cmd_of(arg)
-                if cmd is None:
-                    continue
-                # a stamp(...)-wrapped dict is not a direct arg of
-                # the send, so reaching here means the context was
-                # dropped on the floor
-                out.append(self.finding(
-                    mod, arg.lineno,
-                    f"data-path request {cmd!r} sent over a raw "
-                    f"connection without trace propagation — wrap "
-                    f"it in tracer.stamp(...) (or carry 'tctx') so "
-                    f"the receiving daemon's spans link into the "
-                    f"op's trace instead of leaving a silent gap"))
+        seen: Set[Tuple[str, int]] = set()
+
+        def emit(mod: ParsedModule, node: ast.AST, cmd: str,
+                 how: str) -> None:
+            if (mod.relpath, node.lineno) in seen:
+                return
+            seen.add((mod.relpath, node.lineno))
+            out.append(self.finding(
+                mod, node.lineno,
+                f"data-path request {cmd!r} {how} without trace "
+                f"propagation — wrap it in tracer.stamp(...) (or "
+                f"carry 'tctx') so the receiving daemon's spans "
+                f"link into the op's trace instead of leaving a "
+                f"silent gap"))
+
+        for mod in self.mods:
+            for fn, cls in astutil.walk_functions(mod.tree):
+                # local names bound to an unstamped data-cmd dict,
+                # minus names the function later stamps
+                bound: Dict[str, Tuple[ast.AST, str]] = {}
+                stamped: Set[str] = set()
+                for node in ast.walk(fn):
+                    if isinstance(node, ast.Assign) and \
+                            len(node.targets) == 1 and \
+                            isinstance(node.targets[0], ast.Name):
+                        cmd = _data_cmd_of(node.value)
+                        if cmd is not None:
+                            bound[node.targets[0].id] = \
+                                (node.value, cmd)
+                        elif isinstance(node.value, ast.Call):
+                            # req = stamp(req) / req = dict(req, ...)
+                            stamped.add(node.targets[0].id)
+                    elif isinstance(node, ast.Assign):
+                        for tgt in node.targets:
+                            if isinstance(tgt, ast.Subscript) and \
+                                    isinstance(tgt.value, ast.Name) \
+                                    and isinstance(tgt.slice,
+                                                   ast.Constant) \
+                                    and tgt.slice.value == "tctx":
+                                stamped.add(tgt.value.id)
+                    elif isinstance(node, ast.Call) and \
+                            _send_name(node) == "stamp":
+                        for a in node.args:
+                            if isinstance(a, ast.Name):
+                                stamped.add(a.id)
+                for node in ast.walk(fn):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    name = _send_name(node)
+                    if name in _RAW_SENDS:
+                        for arg in node.args:
+                            cmd = _data_cmd_of(arg)
+                            if cmd is not None:
+                                emit(mod, arg, cmd,
+                                     "sent over a raw connection")
+                            elif isinstance(arg, ast.Name) and \
+                                    arg.id in bound and \
+                                    arg.id not in stamped:
+                                emit(mod, node,
+                                     bound[arg.id][1],
+                                     "sent over a raw connection")
+                        continue
+                    # wrapper send: the dict rides a parameter that
+                    # the callee (possibly in another module)
+                    # forwards to a raw send
+                    for tgt in graph.resolve_call(mod, cls, node):
+                        fwd = wrappers.get(tgt)
+                        if not fwd:
+                            continue
+                        off = 1 if (graph.cls_of[tgt] and
+                                    isinstance(node.func,
+                                               ast.Attribute)) else 0
+                        for i in fwd:
+                            pos = i - off
+                            if not 0 <= pos < len(node.args):
+                                continue
+                            arg = node.args[pos]
+                            cmd = _data_cmd_of(arg)
+                            if cmd is not None:
+                                emit(mod, arg, cmd,
+                                     f"handed to raw-send wrapper "
+                                     f"{tgt.name!r}")
         return out
 
 

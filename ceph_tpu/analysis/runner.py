@@ -26,9 +26,10 @@ import os
 import sys
 from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
+from . import astutil
 from . import baseline as baseline_mod
-from .core import Finding, LintError, ParsedModule, apply_noqa, \
-    parse_module
+from .core import Finding, LintError, ParsedModule, Program, \
+    apply_noqa, parse_module
 from .registry import RuleRegistry
 
 DEFAULT_LINT_PATHS = ("ceph_tpu", "scripts")
@@ -74,11 +75,13 @@ class Result:
     def __init__(self, findings: List[Finding],
                  baselined: List[Finding],
                  noqa: List[Finding],
-                 stale_baseline: List[Tuple[str, str, str]]):
+                 stale_baseline: List[Tuple[str, str, str]],
+                 program: Optional[Program] = None):
         self.findings = findings          # unsuppressed
         self.baselined = baselined
         self.noqa = noqa
         self.stale_baseline = stale_baseline
+        self.program = program            # the parsed tree (--graph)
 
     @property
     def all_findings(self) -> List[Finding]:
@@ -117,6 +120,16 @@ def run(root: str,
                     continue
                 modules[relpath] = mod
 
+    # the whole parsed tree: whole-program rules resolve cross-module
+    # calls through ONE shared graph cached on this object (built on
+    # first use, reused by every rule in the run — the wall-time
+    # budget depends on it)
+    program = Program(modules)
+    for mod in modules.values():
+        mod.program = program
+
+    for rule in rules:
+        rule.begin(program)
     for mod in modules.values():
         for rule in rules:
             findings.extend(rule.check_module(mod))
@@ -130,7 +143,7 @@ def run(root: str,
     # a scoped run (--select / explicit paths) cannot see findings
     # outside its scope: their baseline entries are not stale
     stale = [k for k in stale if _scope_covers(k, select, paths)]
-    return Result(new, old, noqa, stale)
+    return Result(new, old, noqa, stale, program=program)
 
 
 # ----------------------------------------------------------------- CLI ----
@@ -150,8 +163,8 @@ def main(argv: Optional[Sequence[str]] = None, out=None) -> int:
     ap.add_argument("--json", action="store_true",
                     help="machine-readable output")
     ap.add_argument("--check", action="store_true",
-                    help="exit 1 when unsuppressed findings exist "
-                         "(the CI gate)")
+                    help="exit 1 when unsuppressed findings OR stale "
+                         "baseline entries exist (the CI gate)")
     ap.add_argument("--baseline", default=None,
                     help=f"baseline file (default: "
                          f"{DEFAULT_BASELINE}; 'none' disables)")
@@ -162,8 +175,19 @@ def main(argv: Optional[Sequence[str]] = None, out=None) -> int:
                     metavar="CTL###",
                     help="run only matching rules (exact id or "
                          "family prefix, repeatable)")
+    ap.add_argument("--rule", action="append", default=None,
+                    metavar="CTL###", dest="rule",
+                    help="family filter, alias of --select "
+                         "(`ceph lint --rule CTL8`)")
+    ap.add_argument("--graph", default=None, metavar="module.fn",
+                    help="dump the whole-program call graph around "
+                         "one function (who-reaches-this / "
+                         "what-this-reaches) and exit — the triage "
+                         "companion for whole-program findings")
     ap.add_argument("--list-rules", action="store_true")
     ns = ap.parse_args(argv)
+    if ns.rule:
+        ns.select = (ns.select or []) + ns.rule
 
     if ns.list_rules:
         for rid, meta in RuleRegistry.instance().describe().items():
@@ -200,6 +224,8 @@ def main(argv: Optional[Sequence[str]] = None, out=None) -> int:
             out.write(f"wrote {len(entries)} finding(s) to "
                       f"{bpath}\n")
             return 0
+        if ns.graph is not None:
+            return _dump_graph(root, ns, out)
         res = run(root, paths=ns.paths or None, select=ns.select,
                   baseline=bpath)
     except LintError as e:
@@ -230,8 +256,56 @@ def main(argv: Optional[Sequence[str]] = None, out=None) -> int:
         out.write(f"{len(res.findings)} finding(s), "
                   f"{len(res.baselined)} baselined, "
                   f"{len(res.noqa)} noqa-suppressed\n")
-    if ns.check and res.findings:
+    if ns.check and (res.findings or res.stale_baseline):
+        # stale baseline entries fail the gate too: a suppression
+        # whose finding no longer fires anywhere has stopped guarding
+        # anything and silently shrinks the gate — remove it
         return 1
+    return 0
+
+
+def _dump_graph(root: str, ns, out) -> int:
+    """`--graph module.fn`: resolve the function by dotted-suffix
+    match and print its direct callers/callees plus the transitive
+    closure sizes — who-reaches-this / what-this-reaches."""
+    mods: Dict[str, ParsedModule] = {}
+    paths = list(ns.paths) if ns.paths else \
+        [p for p in DEFAULT_LINT_PATHS
+         if os.path.exists(os.path.join(root, p))]
+    evidence = [p for p in DEFAULT_EVIDENCE_PATHS
+                if os.path.exists(os.path.join(root, p))]
+    for ev, rels in ((False, paths), (True, evidence)):
+        for rel in rels:
+            for full, relpath in _iter_py(root, rel):
+                if relpath in mods:
+                    continue
+                m, err = parse_module(full, relpath, evidence=ev)
+                if err is None:
+                    mods[relpath] = m
+    program = Program(mods)
+    for m in mods.values():
+        m.program = program
+    g = astutil.program_graph(program)
+    targets = g.find(ns.graph)
+    if not targets:
+        out.write(f"--graph: no function matches {ns.graph!r}\n")
+        return 2
+    for fn in targets:
+        mod = g.mod_of[fn]
+        out.write(f"{g.qualname(fn)}  "
+                  f"({mod.relpath}:{fn.lineno})\n")
+        callers = sorted(g.qualname(c) for c in g.callers_of(fn))
+        callees = sorted(g.qualname(c) for c in g.callees(fn))
+        up = g.reachable([fn], forward=False)
+        down = g.reachable([fn], forward=True)
+        out.write(f"  reached-by ({len(callers)} direct, "
+                  f"{len(up)} transitive):\n")
+        for q in callers:
+            out.write(f"    < {q}\n")
+        out.write(f"  reaches ({len(callees)} direct, "
+                  f"{len(down)} transitive):\n")
+        for q in callees:
+            out.write(f"    > {q}\n")
     return 0
 
 

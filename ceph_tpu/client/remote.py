@@ -350,10 +350,13 @@ class RemoteCluster:
 
     # mutations that ride the (session, seq) replay contract: the
     # daemon applies each at most once, so the reconnect-retry below
-    # (and any caller resending the SAME dict) is a safe replay
+    # (and any caller resending the SAME dict) is a safe replay.
+    # Mirrors OSDDaemon._REPLAY_CMDS — the bulk frames joined in
+    # CTLint v2
     _REPLAY_CMDS = frozenset((
         "put_shard", "put_object", "delete_shard", "delete_object",
-        "setattr_shard", "copy_from", "exec_cls"))
+        "setattr_shard", "copy_from", "exec_cls",
+        "put_objects", "delete_objects", "delete_shards"))
 
     def osd_call(self, osd: int, req: Dict):
         """One OSD request — a THIN BLOCKING SHIM over the async
@@ -465,6 +468,22 @@ class RemoteCluster:
         self.refresh_map()
         return int(r["snap_seq"])
 
+    def snap_remove(self, pool_id: int, name: str) -> Dict:
+        """Remove a pool snapshot by name (rados rmsnap): committed
+        mon state like creation; clones already materialized by COW
+        stay readable through their object snapsets until trimmed."""
+        r = self.mon_call({"cmd": "pool_snap_remove",
+                           "pool": pool_id, "name": name})
+        self.refresh_map()
+        return r
+
+    def snap_ls(self, pool_id: int) -> Dict:
+        """List a pool's snapshots (rados lssnap): the mon's
+        committed {"seq": int, "snaps": {id: name}} state, read from
+        the quorum rather than this client's possibly-stale map."""
+        return self.mon_call({"cmd": "pool_snap_ls",
+                              "pool": pool_id})
+
     def snap_lookup(self, pool_id: int, name: str) -> int:
         snaps = self.pool_snaps.get(pool_id, {}).get("snaps", {})
         for sid, nm in snaps.items():
@@ -506,7 +525,7 @@ class RemoteCluster:
         n_shards = self.codec_for(pool).get_chunk_count() \
             if pool.type == POOL_ERASURE else len(
                 [x for x in up if x != ITEM_NONE])
-        acks = 0
+        fan = []
         for shard in range(n_shards):
             if pool.type == POOL_ERASURE:
                 tgt = up[shard] if shard < len(up) else ITEM_NONE
@@ -516,13 +535,21 @@ class RemoteCluster:
                 oid = f"0:{name}"
             if tgt == ITEM_NONE:
                 continue
+            # the async chokepoint stamps BOTH the trace context and
+            # the (session, seq) replay id (setattr_shard is a
+            # mutating cmd: a reconnect retry must not double-apply
+            # around a concurrent snapset update), and the fan-out
+            # pipelines instead of paying one RTT per shard
+            fan.append(self.aio.call_async(tgt, {
+                "cmd": "setattr_shard", "coll": coll,
+                "oid": oid, "attrs": {"snapset": blob}}))
+        acks = 0
+        for comp in fan:
             try:
-                self.osd_client(tgt).call(_trace.stamp({
-                    "cmd": "setattr_shard", "coll": coll,
-                    "oid": oid, "attrs": {"snapset": blob}}))
+                comp.get_return_value()
                 acks += 1
             except (OSError, IOError):
-                self.drop_osd_client(tgt)
+                pass
         if acks == 0:
             raise IOError(f"{name}: snapset not persisted anywhere")
 
@@ -1688,12 +1715,31 @@ class RemoteCluster:
                    for src in [fetched[(name, shard)][1]]]
             for name, (fetch, _l, _h, _p) in plans.items()
             if name not in ranged and fetch}))
+        pushes: List[Tuple] = []
         for name, sub_plan in ranged.items():
             st = self._repair_ranged_wire(pool, be, pg, name, up,
                                           plans[name],
                                           attrs_by_name.get(name, {}),
-                                          holders_of, holdings)
+                                          holders_of, pushes)
             for kk, v in st.items():
+                stats[kk] = stats.get(kk, 0) + v
+        # gather the rebuilt-shard pushes submitted above: one
+        # blocking put_shard RTT per repaired object was the ranged
+        # loop's wire floor (CTL120) — the pushes pipeline on the
+        # async objecter and complete here in one gather
+        for comp, tgt, oid, nbytes_fetched in pushes:
+            try:
+                comp.get_return_value()
+            except (OSError, IOError):
+                # not a swallowed loss: the shard stays missing in
+                # the next sweep's listings; this pass reports it
+                stats["unrecoverable"] = \
+                    stats.get("unrecoverable", 0) + 1
+                continue
+            holdings.setdefault(tgt, set()).add(oid)
+            for kk, v in (("shards_rebuilt", 1),
+                          ("ranged_repairs", 1),
+                          ("repair_bytes_fetched", nbytes_fetched)):
                 stats[kk] = stats.get(kk, 0) + v
         # top-up round: ONLY a name whose minimal-plan fetch actually
         # FAILED a shard widens to the survivors the plan skipped
@@ -1848,15 +1894,17 @@ class RemoteCluster:
     def _repair_ranged_wire(self, pool: PGPool, be, pg: int,
                             name: str, up: List[int], plan_item,
                             obj_attrs: Dict[str, bytes], holders_of,
-                            holdings: Dict[int, set]
+                            pushes: List[Tuple]
                             ) -> Dict[str, int]:
         """Minimum-bandwidth single-loss repair over the wire: each
         helper in the codec's SubChunkPlan ships ONLY its repair
         sub-chunk byte ranges (ranged get_shard), ``codec.repair``
-        regenerates the lost chunk client-side, and the rebuilt shard
-        pushes with its attrs.  Returns stats including
-        ``repair_bytes_fetched`` so benches/tests can assert the
-        saving vs k full-chunk reads."""
+        regenerates the lost chunk client-side, and the rebuilt
+        shard's push is SUBMITTED async onto ``pushes`` — the caller
+        gathers all pushes after its ranged loop (submit-all-then-
+        gather) and accounts ``shards_rebuilt``/``ranged_repairs``/
+        ``repair_bytes_fetched`` per landed push, so benches/tests
+        can assert the byte saving vs k full-chunk reads."""
         codec = be.codec
         _fetch, lost, _have, sub_plan = plan_item
         (lost_shard,) = lost
@@ -1891,20 +1939,12 @@ class RemoteCluster:
         if tgt == ITEM_NONE:
             return {}
         oid = f"{lost_shard}:{name}"
-        try:
-            self.osd_call(tgt, {
-                "cmd": "put_shard", "coll": coll, "oid": oid,
-                "data": np.ascontiguousarray(rebuilt).tobytes(),
-                "attrs": obj_attrs,
-                "klass": "background_recovery"})
-        except (OSError, IOError):  # noqa: CTL603 — not a swallowed
-            # loss: the shard stays missing in the NEXT sweep's
-            # listings and the returned stats surface it as
-            # unrecoverable this pass (recovery is re-driven)
-            return {"unrecoverable": 1}
-        holdings.setdefault(tgt, set()).add(oid)
-        return {"shards_rebuilt": 1, "ranged_repairs": 1,
-                "repair_bytes_fetched": fetched}
+        pushes.append((self.aio.call_async(tgt, {
+            "cmd": "put_shard", "coll": coll, "oid": oid,
+            "data": np.ascontiguousarray(rebuilt).tobytes(),
+            "attrs": obj_attrs,
+            "klass": "background_recovery"}), tgt, oid, fetched))
+        return {}
 
     # ------------------------------------------ batched EC device plane --
     def put_many(self, pool_id: int, names: List[str],
@@ -2205,6 +2245,12 @@ class RemoteCluster:
 
     def mon_status(self) -> Dict:
         return self.mon_call({"cmd": "mon_status"})
+
+    def osd_fsck(self, osd: int) -> List:
+        """On-demand store consistency walk on one live OSD over the
+        wire (the asok ``store_fsck`` twin for wire-only callers):
+        returns the store's error list — [] is clean."""
+        return self.osd_call(osd, {"cmd": "fsck"})
 
     def close(self) -> None:
         if self._aio is not None:

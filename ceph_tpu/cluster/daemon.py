@@ -1217,6 +1217,15 @@ class OSDDaemon:
         self._sessions: Dict[Tuple[str, str], Dict[str, Any]] = {}
         self.session_resets = 0       # unknown-sid resumes observed
         self._pc_session = _perf("osd.session")
+        # OUTBOUND peer sessions: this daemon's own (session, seq)
+        # stamps for mutating peer traffic (replica sub-writes,
+        # recovery pushes), so the receiving daemon's dup table
+        # covers daemon->daemon mutations with the same at-most-once
+        # contract clients get — _peer_req is the stamping chokepoint
+        # (lint CTL802)
+        self._peer_sess_lock = LockdepLock("osd.peer_sessions",
+                                           recursive=False)
+        self._peer_sessions: Dict[int, Dict[str, Any]] = {}
         # io accounting (the osd_perf_counters rd/wr families): the
         # ClusterStats aggregator turns successive heartbeat reports
         # of these into per-OSD/per-pool io rates for `ceph -s`
@@ -1389,10 +1398,14 @@ class OSDDaemon:
         "put_object", "delete_object", "exec_cls"))
 
     # mutations covered by (session, seq) dup detection: a replay of
-    # an already-applied op must not apply a second time
+    # an already-applied op must not apply a second time.  The bulk
+    # recovery frames and the stray purge joined in CTLint v2
+    # (a replayed old bulk push interleaving with a newer write has
+    # the same clobber hazard the per-object table was built for)
     _REPLAY_CMDS = frozenset((
         "put_shard", "put_object", "delete_shard", "delete_object",
-        "setattr_shard", "copy_from", "exec_cls"))
+        "setattr_shard", "copy_from", "exec_cls",
+        "put_objects", "delete_objects", "delete_shards"))
 
     _SESSION_REPLY_WINDOW = 64        # cached replies per session
     _MAX_SESSIONS = 256               # LRU cap across clients
@@ -1844,15 +1857,15 @@ class OSDDaemon:
                 for peer in req["replicas"]:
                     if peer == self.id:
                         continue
-                    try:
-                        self.peer_client(peer).call(_trace.stamp({
+                    # replica sub-delete through the _peer_req
+                    # chokepoint: trace-stamped AND (session, seq)-
+                    # stamped (at-most-once on the replica)
+                    if self._peer_req(peer, _trace.stamp({
                             "cmd": "delete_shard", "coll": list(coll),
                             "oid": req["oid"], "klass": klass,
                             "log": {"version": list(version),
-                                    "prev": list(prev)}}))
+                                    "prev": list(prev)}})) is not None:
                         acks += 1
-                    except (OSError, IOError):
-                        self.drop_peer(peer)
             return {"acks": acks, "version": list(version)}
         if cmd == "put_object":
             # replicated primary: assign the version, persist object +
@@ -1878,20 +1891,18 @@ class OSDDaemon:
                 for peer in req["replicas"]:
                     if peer == self.id:
                         continue
-                    try:
-                        # replica sub-write carries the trace context
-                        # of THIS daemon's active osd.op span, so the
-                        # replica's spans link as its children (the
-                        # >= 3-process trace shape)
-                        self.peer_client(peer).call(_trace.stamp({
+                    # replica sub-write through the _peer_req
+                    # chokepoint: carries the trace context of THIS
+                    # daemon's active osd.op span (replica spans link
+                    # as children, the >= 3-process trace shape) AND
+                    # a (session, seq) stamp (at-most-once replay)
+                    if self._peer_req(peer, _trace.stamp({
                             "cmd": "put_shard", "coll": list(coll),
                             "oid": req["oid"], "data": req["data"],
                             "klass": klass, "attrs": req.get("attrs"),
                             "log": {"version": list(version),
-                                    "prev": list(prev)}}))
+                                    "prev": list(prev)}})) is not None:
                         acks += 1
-                    except (OSError, IOError):
-                        self.drop_peer(peer)
             return {"acks": acks, "version": list(version)}
         if cmd == "list_pg":
             coll = tuple(req["coll"])
@@ -2087,8 +2098,28 @@ class OSDDaemon:
             return [list(map(str, b)) for b in self.store.fsck()]
         raise ValueError(f"unknown osd command {cmd!r}")
 
+    def _peer_stamp(self, m: int) -> Dict[str, Any]:
+        """Draw one (session, seq) replay stamp for a mutating
+        request bound for peer ``m`` — the daemon-side twin of the
+        client's ``_next_stamp`` (sid kept across reconnects)."""
+        with self._peer_sess_lock:
+            st = self._peer_sessions.get(m)
+            if st is None:
+                st = self._peer_sessions[m] = {
+                    "sid": f"osd{self.id}-{secrets.token_hex(8)}",
+                    "seq": 0}
+            st["seq"] += 1
+            return {"session": st["sid"], "seq": st["seq"]}
+
     def _peer_req(self, m: int, req: Dict[str, Any]):
-        """One guarded peer call (None on failure)."""
+        """One guarded peer call (None on failure).  Mutating
+        commands are stamped with this daemon's per-peer
+        (session, seq) so the receiver applies them at most once —
+        every daemon->daemon mutation must route through here (or
+        carry its own stamp): the CTL802 chokepoint contract."""
+        if req.get("cmd") in self._REPLAY_CMDS and \
+                "session" not in req:
+            req = dict(req, **self._peer_stamp(m))
         try:
             return self.peer_client(m).call(req)
         except (OSError, IOError):

@@ -1,0 +1,179 @@
+#!/usr/bin/env python
+"""Static-analysis smoke check — CTLint v2 verified end to end.
+
+Two tiers, both fast enough for the smoke sweep:
+
+  1. SEEDED tier: a throwaway fixture tree carries one deliberate
+     violation per headline family — a cross-module host sync under
+     jit (CTL101, resolvable only by the whole-program graph), a raw
+     daemon-plane lock (CTL302), an undeclared faultpoint fire
+     (CTL601), an unstamped data-path send through a cross-module
+     wrapper (CTL701), a typo'd wire cmd (CTL801), an unstamped
+     mutating send (CTL802), a short send missing a handler-read key
+     (CTL803), and a duplicate faultpoint declare (CTL804).  Every
+     seeded violation must be caught, or the gate is lying.
+
+  2. REAL tier: the repo tree must be lint-clean against the
+     committed baseline with ZERO stale entries, inside the 30 s
+     wall-time budget the tier-1 gate depends on.
+
+Runs on CPU:
+
+    python scripts/check_static.py            # both tiers
+    python scripts/check_static.py --quick    # seeded tier only
+
+Also wired as a fast pytest test (tests/test_lint.py, `smoke`
+marker) so CI covers it without a separate job.
+"""
+from __future__ import annotations
+
+import os
+import sys
+import tempfile
+import textwrap
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+
+def _fail(msg: str) -> int:
+    print(f"FAIL: {msg}", file=sys.stderr)
+    return 1
+
+
+# (relpath, source, expected rule ids at least once in that file)
+_SEEDS = (
+    ("pkg/__init__.py", "", ()),
+    ("pkg/hot_helper.py", """
+        import numpy as np
+
+        def mix(y):
+            return np.asarray(y).item()
+        """, ("CTL101",)),
+    ("pkg/hot_entry.py", """
+        import jax
+        from .hot_helper import mix
+
+        @jax.jit
+        def f(x):
+            return mix(x)
+        """, ()),
+    ("cluster/locks.py", """
+        import threading
+        L = threading.Lock()
+        """, ("CTL302",)),
+    ("cluster/fire.py", """
+        from ceph_tpu.common import faults
+
+        def send():
+            return faults.fire("never.declared")
+        """, ("CTL601",)),
+    ("cluster/wrapper.py", """
+        def fanout(conn, req):
+            return conn.call(req)
+        """, ()),
+    ("cluster/sender.py", """
+        from .wrapper import fanout
+
+        def gap(conn, coll, oid):
+            # CTL701 reports at the call site handing the unstamped
+            # dict to the cross-module raw-send wrapper
+            return fanout(conn, {"cmd": "get_shard", "coll": coll,
+                                 "oid": oid})
+
+        def typo(conn, coll):
+            return conn.osd_call(0, {"cmd": "get_shrad",
+                                     "coll": coll, "oid": "o"})
+
+        def unstamped(conn, coll, data):
+            return conn.call({"cmd": "put_thing", "coll": coll,
+                              "data": data, "tctx": None})
+
+        def short(conn, coll):
+            return conn.osd_call(0, {"cmd": "put_thing",
+                                     "coll": coll, "tctx": None})
+        """, ("CTL701", "CTL801", "CTL802", "CTL803")),
+    ("cluster/daemon.py", """
+        _REPLAY_CMDS = frozenset(("put_thing",))
+
+        class Daemon:
+            def _handle(self, entity, req):
+                cmd = req["cmd"]
+                if cmd == "put_thing":
+                    return (req["coll"], req["data"])
+                if cmd == "get_shard":
+                    return req["oid"]
+        """, ()),
+    ("cluster/decl.py", """
+        from ceph_tpu.common import faults
+        faults.declare("twice.over", "first")
+        """, ()),
+    ("cluster/decl2.py", """
+        from ceph_tpu.common import faults
+        faults.declare("twice.over", "second site")
+        """, ("CTL804",)),
+)
+
+
+def _check_seeded() -> int:
+    from ceph_tpu.analysis import runner
+    with tempfile.TemporaryDirectory(prefix="ctlint-smoke-") as tmp:
+        for rel, src, _want in _SEEDS:
+            p = os.path.join(tmp, rel)
+            os.makedirs(os.path.dirname(p), exist_ok=True)
+            with open(p, "w", encoding="utf-8") as f:
+                f.write(textwrap.dedent(src))
+        res = runner.run(tmp, paths=["."], evidence_paths=[],
+                         baseline=None)
+        got = {}
+        for f in res.findings:
+            got.setdefault(f.path, set()).add(f.rule)
+        for rel, _src, want in _SEEDS:
+            missed = set(want) - got.get(rel, set())
+            if missed:
+                return _fail(
+                    f"seeded violation(s) NOT caught in {rel}: "
+                    f"{sorted(missed)} (caught: "
+                    f"{sorted(got.get(rel, set()))})")
+    n = sum(len(w) for _r, _s, w in _SEEDS)
+    print(f"OK: seeded tier — all {n} seeded violations caught")
+    return 0
+
+
+def _check_real_tree() -> int:
+    from ceph_tpu.analysis import runner
+    t0 = time.perf_counter()
+    res = runner.run(
+        _REPO,
+        baseline=os.path.join(_REPO, "scripts",
+                              "lint_baseline.json"))
+    elapsed = time.perf_counter() - t0
+    if res.findings:
+        lines = "\n  ".join(f.render() for f in res.findings[:20])
+        return _fail(f"tree is not lint-clean:\n  {lines}")
+    if res.stale_baseline:
+        return _fail(f"stale baseline entries: "
+                     f"{res.stale_baseline}")
+    if elapsed >= 30.0:
+        return _fail(f"full-tree lint took {elapsed:.1f}s — past "
+                     f"the 30 s CI budget")
+    print(f"OK: real tier — tree clean, "
+          f"{len(res.baselined)} baselined, "
+          f"{elapsed:.1f}s (< 30 s budget)")
+    return 0
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    rc = _check_seeded()
+    if rc:
+        return rc
+    if "--quick" not in argv:
+        rc = _check_real_tree()
+    return rc
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

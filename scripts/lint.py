@@ -6,10 +6,15 @@ Thin wrapper over ceph_tpu.analysis.runner (also surfaced as
 
     python scripts/lint.py                   # human-readable report
     python scripts/lint.py --check           # CI gate: exit 1 on any
-                                             # unsuppressed finding
+                                             # unsuppressed finding OR
+                                             # stale baseline entry
     python scripts/lint.py --json            # machine-readable (shape
                                              # documented in runner.py)
     python scripts/lint.py --select CTL3     # one rule family
+    python scripts/lint.py --rule CTL8       # same, triage spelling
+    python scripts/lint.py --graph daemon._recover_pg
+                                             # whole-program call-graph
+                                             # dump around one function
     python scripts/lint.py --list-rules
     python scripts/lint.py --write-baseline  # grandfather current
                                              # findings (review the

@@ -230,15 +230,84 @@ def test_snapshots_over_the_wire(cluster):
     # a second snapshot without further writes reads the current head
     sid2 = rc.snap_create(1, "s2")
     assert rc.get_snap(1, "snappy", sid2) == v2
-    # snapshots survive a mon restart (committed state)
+    # the full wire snap surface: ls sees both, remove drops one
+    # (committed mon state, CTL801 closure: every arm exercised)
+    ls = rc.snap_ls(1)
+    assert {int(s) for s in ls["snaps"]} >= {sid, sid2}
+    rc.snap_remove(1, "s2")
+    ls2 = rc.snap_ls(1)
+    assert str(sid2) not in ls2["snaps"]
+    assert str(sid) in ls2["snaps"]
+    # snapshots (and the removal) survive a mon restart
     v.kill9("mon")
     v.start_mon()
     time.sleep(0.5)
     rc2 = _client(d)
     assert rc2.snap_lookup(1, "s1") == sid
     assert rc2.get_snap(1, "snappy", sid) == v1
+    assert str(sid2) not in rc2.snap_ls(1)["snaps"]
     rc2.close()
     rc.close()
+
+
+def test_clay_ranged_repair_over_the_wire_mixed_shapes(tmp_path):
+    """Wire-tier minimum-bandwidth (clay) repair against live
+    daemons, with MIXED plan shapes in one PG sweep: one object
+    repairs through the ranged sub-chunk path (async rebuilt-shard
+    push gathered after the loop), another lost an EXTRA shard
+    out-of-band and must take the full-decode path in the same
+    `_recover_ec_pg_move` call.  Regression: the push-gather loop
+    once rebound the shard-fetch dict (`fetched`), crashing exactly
+    this mixed sweep."""
+    d = str(tmp_path / "clay_cluster")
+    profs = {"cp": {"plugin": "clay", "k": "2", "m": "2", "d": "3"}}
+    build_cluster_dir(
+        d, n_osds=6, osds_per_host=1, fsync=False,
+        pools=[{"id": 1, "name": "clay", "type": 3, "size": 4,
+                "pg_num": 2, "crush_rule": 1,
+                "erasure_code_profile": "cp"}])
+    v = Vstart(d)
+    v.start(6, hb_interval=0.25)
+    try:
+        from ceph_tpu.client.remote import RemoteCluster
+        rc = RemoteCluster(d, ec_profiles=profs)
+        pool = rc.osdmap.pools[1]
+        # two objects in the SAME PG: one stays single-loss
+        # (ranged), one loses an extra shard (full decode)
+        names = ["ranged0"]
+        pg = rc._pg_for(pool, names[0])
+        i = 0
+        while len(names) < 2:
+            cand = f"mixed{i}"
+            i += 1
+            if rc._pg_for(pool, cand) == pg:
+                names.append(cand)
+        rng = np.random.default_rng(23)
+        datas = {n: rng.integers(0, 256, 30_000,
+                                 dtype=np.uint8).tobytes()
+                 for n in names}
+        for n in names:
+            assert rc.put(1, n, datas[n]) >= 3
+        up = rc._up(pool, pg)
+        victim = up[1]
+        # out-of-band second loss for the mixed object only: shard 2
+        # deleted from its live holder
+        rc.osd_call(up[2], {"cmd": "delete_shard", "coll": [1, pg],
+                            "oid": f"2:{names[1]}"})
+        v.kill9(f"osd.{victim}")
+        wait_for_state(lambda: rc.status()["n_up"] <= 5,
+                       desc="clay victim marked down")
+        rc.mon_call({"cmd": "mark_out", "osd": victim})
+        rc.refresh_map()
+        st = rc.recover_ec_pool(1)
+        assert st.get("unrecoverable", 0) == 0, st
+        assert st.get("ranged_repairs", 0) >= 1, st
+        assert st.get("shards_rebuilt", 0) >= 2, st
+        for n in names:
+            assert rc.get(1, n) == datas[n], n
+        rc.close()
+    finally:
+        v.stop()
 
 
 def test_scrub_over_the_wire(cluster):
@@ -257,6 +326,9 @@ def test_scrub_over_the_wire(cluster):
     clean = rc.scrub_pool(1)
     assert clean["objects"] >= 4
     assert clean["inconsistent"] == []
+    # wire-level store fsck on live daemons: clean before the
+    # corruption below (the asok store_fsck twin, CTL801 closure)
+    assert rc.osd_fsck(0) == []
     # corrupt ONE replica of one object out-of-band (direct shard
     # write to a non-primary member — the objectstore-surgery shape)
     pool = rc.osdmap.pools[1]
