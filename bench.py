@@ -1350,6 +1350,42 @@ def bench_crash_recovery(n_wal_batches=1500, batch_kib=8,
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def bench_s3_serving(seed=0, n_osds=4, shards=8, clients_scale=4.0,
+                     ops_scale=3.0):
+    """The millions-of-users serving headline (ROADMAP item 3):
+    multi-tenant S3 workload over live daemons through the async
+    wire core — zipfian keys, sharded bucket indexes, per-tenant
+    dmClock QoS — reporting ops/s plus per-tenant p50/p99/p999 read
+    from the mon's cluster histogram merge, with the SLO/QoS gate's
+    verdict riding along (a red gate in a bench run is a datapoint,
+    not an exception)."""
+    from ceph_tpu.rgw.serving import (ServeConfig, default_tenants,
+                                      run_serve)
+    tenants = default_tenants()
+    for t in tenants:
+        t.ops = max(10, int(t.ops * ops_scale))
+        t.clients = max(1, int(t.clients * clients_scale))
+    cfg = ServeConfig(seed=seed, n_osds=n_osds, index_shards=shards,
+                      tenants=tenants)
+    r = run_serve(cfg)
+    return {
+        "n_osds": n_osds,
+        "index_shards": r["index_shards"],
+        "clients": sum(t.clients for t in tenants),
+        "total_ops": r["total_ops"],
+        "ops_s": r["ops_s"],
+        "wall_s": r["wall_s"],
+        "tenants": {
+            name: {k: m[k] for k in ("ops", "ops_s", "share",
+                                     "p50_s", "p99_s", "p999_s",
+                                     "errors")}
+            for name, m in r["tenants"].items()},
+        "sched_tenant_shares": r["scheduler"]["tenant_shares"],
+        "slo_gate_ok": r["ok"],
+        "breaches": r["breaches"],
+    }
+
+
 def main():
     out = {"metric": "ec_encode_rs8_3_gbps", "unit": "GB/s"}
     extras = {}
@@ -1449,6 +1485,12 @@ def main():
         extras["cluster_sharded"] = bench_cluster_sharded()
     except Exception as e:
         print(f"# cluster sharded bench failed: {e}", file=sys.stderr)
+    try:
+        import gc
+        gc.collect()
+        extras["s3_serving"] = bench_s3_serving()
+    except Exception as e:
+        print(f"# s3 serving bench failed: {e}", file=sys.stderr)
     out["extras"] = extras
     print(json.dumps(out))
 

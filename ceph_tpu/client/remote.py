@@ -88,6 +88,15 @@ class RemoteCluster:
         self._admin_path: Optional[str] = None
         import threading
         self._client_lock = threading.Lock()
+        # tenant identity for per-tenant QoS (S3 auth -> objecter ->
+        # op dispatch): a handle-wide default (one gateway client per
+        # tenant, the serving harness shape) plus a thread-local
+        # override (one frontend serving many tenants on request
+        # threads).  Stamped onto client-class data-path requests by
+        # the async objecter; daemons dispatch them under the
+        # tenant's own dmClock class.
+        self._tenant_default: Optional[str] = None
+        self._tenant_tls = threading.local()
         # every retry sweep in this client paces itself here:
         # exponential with deterministic per-entity jitter, so N
         # clients hammering a recovering daemon decorrelate instead
@@ -304,6 +313,24 @@ class RemoteCluster:
             st = self._session(osd)
             st["seq"] += 1
             return {"session": st["sid"], "seq": st["seq"]}
+
+    # ------------------------------------------------------------ tenant --
+    def set_tenant(self, tenant: Optional[str],
+                   thread_only: bool = False) -> None:
+        """Bind a tenant identity (an S3-auth-verified uid) to this
+        handle's data-path ops.  ``thread_only`` scopes the binding
+        to the calling thread — the S3 frontend sets it per request
+        after SigV4 verification, so one shared cluster handle serves
+        many tenants without cross-talk."""
+        if thread_only:
+            self._tenant_tls.tenant = tenant
+        else:
+            self._tenant_default = tenant
+
+    @property
+    def tenant(self) -> Optional[str]:
+        t = getattr(self._tenant_tls, "tenant", None)
+        return t if t is not None else self._tenant_default
 
     def add_session_reset_cb(self, cb) -> None:
         self._session_reset_cbs.append(cb)

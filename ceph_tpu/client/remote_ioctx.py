@@ -125,21 +125,40 @@ class RemoteIoCtx:
     def _aio_key(self, oid: str):
         return ("obj", self.pool_id, oid)
 
+    def _bind_tenant(self, fn):
+        """Capture the SUBMITTING thread's tenant identity into the
+        closure: aio ops execute on engine worker threads, where the
+        request thread's thread-local tenant binding (set by the S3
+        frontend after SigV4 verification) would otherwise be lost."""
+        tenant = self._rc.tenant
+        if tenant is None:
+            return fn
+
+        def run():
+            self._rc.set_tenant(tenant, thread_only=True)
+            try:
+                return fn()
+            finally:
+                self._rc.set_tenant(None, thread_only=True)
+        return run
+
     def aio_write_full(self, oid: str, data: bytes):
         buf = bytes(data)
         return self._rc.aio.engine.submit(
-            lambda: self.write_full(oid, buf),
+            self._bind_tenant(lambda: self.write_full(oid, buf)),
             key=self._aio_key(oid))
 
     def aio_read(self, oid: str, length: Optional[int] = None,
                  offset: int = 0, snap: Optional[int] = None):
         return self._rc.aio.engine.submit(
-            lambda: self.read(oid, length, offset, snap),
+            self._bind_tenant(
+                lambda: self.read(oid, length, offset, snap)),
             key=self._aio_key(oid))
 
     def aio_remove(self, oid: str):
         return self._rc.aio.engine.submit(
-            lambda: self.remove(oid), key=self._aio_key(oid))
+            self._bind_tenant(lambda: self.remove(oid)),
+            key=self._aio_key(oid))
 
     def _shard0_probe(self, oid: str, cmd: str):
         """No-payload probe against the acting set (authoritative

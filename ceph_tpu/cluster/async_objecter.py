@@ -273,6 +273,14 @@ class AsyncObjecter:
         dup table applies the op at most once (the PR-5 session-replay
         contract, unchanged underneath the async core)."""
         comp = completion or AioCompletion()
+        tenant = getattr(self.rc, "tenant", None)
+        if tenant is not None and "tenant" not in req and \
+                req.get("klass", "client") == "client":
+            # tenant identity (S3 auth -> set_tenant) rides every
+            # client-class request so the daemon dispatches it under
+            # the tenant's own dmClock class; background traffic
+            # (recovery, scrub) keeps its background class untagged
+            req = dict(req, tenant=tenant)
         if req.get("cmd") in self.rc._REPLAY_CMDS and \
                 "session" not in req:
             req = dict(req, **self.rc._next_stamp(osd))

@@ -1161,8 +1161,25 @@ class OSDDaemon:
             self.store_fsck_errors = len(bad)
             self.store_fsck_repaired = len(bad)
             clear_power_loss_markers(store_path)
-        from ..msg.scheduler import MClockScheduler
-        self.sched = MClockScheduler()
+        from ..common.options import config as _config
+        from ..msg.scheduler import MClockScheduler, QoS, tenant_class
+        cfg = _config()
+        lim = float(cfg.get("osd_mclock_scheduler_client_lim"))
+        self.sched = MClockScheduler(tenant_default=QoS(
+            reservation=float(
+                cfg.get("osd_mclock_scheduler_client_res")),
+            weight=float(cfg.get("osd_mclock_scheduler_client_wgt")),
+            limit=lim if lim > 0 else float("inf")))
+        # per-tenant QoS overrides from the cluster spec (the
+        # osd_mclock_scheduler_client_* per-client profiles): tenants
+        # named here get their own (r, w, l); unnamed tenants vivify
+        # with the config defaults above
+        for t, q in (spec.get("qos_tenants") or {}).items():
+            tlim = float(q.get("lim", 0.0))
+            self.sched.set_qos(tenant_class(t), QoS(
+                reservation=float(q.get("res", 0.0)),
+                weight=float(q.get("wgt", 1.0)),
+                limit=tlim if tlim > 0 else float("inf")))
         self._sched_lock = LockdepLock("osd.sched", recursive=False)
         # durable per-PG op logs (process-tier PGLog, daemon_pglog.py)
         from .daemon_pglog import DurablePGLog
@@ -1361,18 +1378,55 @@ class OSDDaemon:
 
     # ------------------------------------------------------------ serving --
     def _run_sched(self, op: Callable[[], Any], klass: str) -> Any:
-        """Every op passes through the mClock scheduler (the dispatch
-        ordering seam; single dequeue here since the wire server is
-        already one thread per connection)."""
-        with self._sched_lock:
-            self.sched.enqueue(op, klass=klass)
-            _, fn = self.sched.dequeue()
+        """Every op passes through the mClock scheduler — and the
+        scheduler now actually ARBITRATES: the op is parked in the
+        queue and connection threads cooperatively drain it in
+        dmClock tag order, so under contention (many connections
+        enqueueing at once) a reserved tenant's ops are dispatched
+        ahead of a noisy tenant's backlog regardless of arrival
+        order.  The old shape enqueued and immediately dequeued under
+        one lock — the queue was empty between calls and QoS never
+        reordered anything.
+
+        A thread may execute ANOTHER connection's op (the one the
+        tags say goes first) and have its own executed elsewhere;
+        results route back through per-op completion events.  The
+        caller's trace context is captured at enqueue so the
+        dispatch span lands under the op's own osd.op span, whichever
+        thread runs it."""
         mark_active("dispatched_device", osd=self.id, klass=klass)
-        # dispatch-stage span (child of this op's osd.op span when
-        # the op carried a trace context; null otherwise)
-        with _trace.child_span("osd.dispatch", osd=self.id,
-                               klass=klass):
-            return fn()
+        tctx = _trace.tracer().current_ctx() if _trace.enabled() \
+            else None
+        entry = {"fn": op, "tctx": tctx, "klass": klass,
+                 "done": threading.Event(), "result": None,
+                 "exc": None}
+        with self._sched_lock:
+            self.sched.enqueue(entry, klass=klass)
+        while not entry["done"].is_set():
+            with self._sched_lock:
+                item = None if entry["done"].is_set() \
+                    else self.sched.dequeue()
+            if item is None:
+                # our op was claimed by another thread (or just
+                # finished): wait for its completion
+                entry["done"].wait()
+                break
+            _klass, e = item
+            # dispatch-stage span under the EXECUTED op's own trace
+            # context (child of its osd.op span; null when untraced)
+            try:
+                with _trace.linked_span("osd.dispatch", e["tctx"],
+                                        osd=self.id,
+                                        klass=e["klass"]):
+                    e["result"] = e["fn"]()
+            except BaseException as ex:
+                e["exc"] = ex
+            e["done"].set()
+            if e is entry:
+                break
+        if entry["exc"] is not None:
+            raise entry["exc"]
+        return entry["result"]
 
     def _check_pool_live(self, coll) -> None:
         """Refuse mutations into pools the fetched map says are
@@ -1627,6 +1681,15 @@ class OSDDaemon:
     def _handle_inner(self, entity: str, req: Dict[str, Any]) -> Any:
         cmd = req["cmd"]
         klass = req.get("klass", "client")
+        tenant = req.get("tenant")
+        if tenant and klass == "client":
+            # tenant identity propagated from S3 auth through the
+            # objecter: client ops dispatch under the tenant's OWN
+            # dmClock class (auto-vivified with the
+            # osd_mclock_scheduler_client_* defaults, or the spec's
+            # qos_tenants override)
+            from ..msg.scheduler import tenant_class
+            klass = tenant_class(str(tenant))
         if cmd in ("put_shard", "put_object", "delete_object",
                    "setattr_shard"):
             self._check_pool_live(req["coll"])
@@ -2086,6 +2149,10 @@ class OSDDaemon:
                 n_sessions = len(self._sessions)
             resv = {"held": self._resv_held(),
                     "peak": dict(self._resv_peak)}
+            with self._sched_lock:
+                sched = {"dequeued": dict(self.sched.stats),
+                         "queued": len(self.sched),
+                         "classes": sorted(self.sched.qos)}
             return {"osd": self.id,
                     "objects": sum(
                         len(self.store.list_objects(c))
@@ -2093,7 +2160,8 @@ class OSDDaemon:
                     "injected_failures": self.server.injected,
                     "sessions": n_sessions,
                     "session_resets": self.session_resets,
-                    "recovery_reservations": resv}
+                    "recovery_reservations": resv,
+                    "scheduler": sched}
         if cmd == "fsck":
             return [list(map(str, b)) for b in self.store.fsck()]
         raise ValueError(f"unknown osd command {cmd!r}")

@@ -354,6 +354,22 @@ _TABLE: Tuple[Option, ...] = (
            "(integrity only, the reference's intra-cluster default), "
            "'secure' = sealed payloads",
            enum_values=("crc", "secure")),
+    Option("osd_mclock_scheduler_client_res", TYPE_FLOAT, 0.2,
+           "default dmClock RESERVATION for a per-tenant client "
+           "class (reference osd_mclock_scheduler_client_res): the "
+           "fraction of dispatch slots a tenant is guaranteed under "
+           "backlog before weights share the leftovers; per-tenant "
+           "overrides ride the cluster spec's qos_tenants table",
+           min=0.0),
+    Option("osd_mclock_scheduler_client_wgt", TYPE_FLOAT, 1.0,
+           "default dmClock WEIGHT for a per-tenant client class "
+           "(reference osd_mclock_scheduler_client_wgt): the "
+           "tenant's share of capacity left over after every "
+           "reservation is met", min=0.0),
+    Option("osd_mclock_scheduler_client_lim", TYPE_FLOAT, 0.0,
+           "default dmClock LIMIT for a per-tenant client class "
+           "(reference osd_mclock_scheduler_client_lim); 0 = "
+           "unlimited", min=0.0),
 )
 
 _config: Optional[Options] = None

@@ -92,16 +92,27 @@ class S3Frontend:
                               head_only: bool = False) -> bool:
                 """SigV4 verification against the frontend's user set
                 (True = proceed).  Anonymous requests are refused when
-                auth is enabled."""
+                auth is enabled.  The verified uid is bound as this
+                request thread's TENANT on the gateway's cluster
+                handle, so every RADOS op this request issues
+                dispatches under the tenant's own dmClock class (the
+                S3-auth -> objecter -> op-dispatch QoS plumbing)."""
+                from .auth_s3 import S3AuthError, verify_request
+                rc = getattr(fe.gw.ioctx, "_rc", None)
+                if rc is not None and hasattr(rc, "set_tenant"):
+                    # clear any binding a previous request left on
+                    # this pooled server thread
+                    rc.set_tenant(None, thread_only=True)
                 if fe.users is None:
                     return True
-                from .auth_s3 import S3AuthError, verify_request
                 parsed = urllib.parse.urlparse(self.path)
                 try:
-                    verify_request(self.command, parsed.path,
-                                   parsed.query,
-                                   dict(self.headers.items()), body,
-                                   fe.users)
+                    uid = verify_request(self.command, parsed.path,
+                                         parsed.query,
+                                         dict(self.headers.items()),
+                                         body, fe.users)
+                    if rc is not None and hasattr(rc, "set_tenant"):
+                        rc.set_tenant(uid, thread_only=True)
                     return True
                 except S3AuthError as e:
                     self._fail(e, head_only=head_only)

@@ -449,7 +449,9 @@ def main(argv: Optional[List[str]] = None,
                          "lint [--check|--json|--rule CTL###|"
                          "--graph module.fn|...] | "
                          "thrash [--seed N --cycles K --netsplit "
-                         "--powercycle --json]")
+                         "--powercycle --json] | "
+                         "serve [--seed N --chaos --starve --json] | "
+                         "rgw POOL bucket reshard|limit ...")
     ns, extra = ap.parse_known_args(argv)
     if ns.words[0] == "lint":
         # static-analysis surface (ceph_tpu/analysis): needs no
@@ -463,6 +465,33 @@ def main(argv: Optional[List[str]] = None,
         # invariants — builds its own in-process stack, no --dir
         from ..cluster.thrasher import main as thrash_main
         return thrash_main(ns.words[1:] + extra, out=out)
+    if ns.words[0] == "serve":
+        # serving surface (`ceph serve [--chaos --starve --json]`):
+        # the multi-tenant S3 workload with the enforced SLO/QoS
+        # gate — builds its own vstart cluster, exits nonzero on
+        # any per-tenant breach (rgw/serving.py)
+        from ..rgw.serving import serve_main
+        return serve_main(ns.words[1:] + extra, out=out)
+    if ns.words[0] == "rgw":
+        # gateway admin over a live cluster: `ceph rgw <pool>
+        # <radosgw-admin words...>` builds the pool's IoCtx and
+        # hands through to radosgw-admin (bucket reshard / bucket
+        # limit check / user ... against daemons)
+        if ns.dir is None:
+            ap.error("--dir is required for `rgw`")
+        if len(ns.words) < 3:
+            ap.error("rgw POOL COMMAND...")
+        from ..client.remote_ioctx import RemoteIoCtx
+        from .radosgw_admin import main as rgw_main
+        rc = _client(ns.dir)
+        try:
+            io = RemoteIoCtx(rc, ns.words[1])
+            return rgw_main(ns.words[2:] + extra, ioctx=io, out=out)
+        except (RuntimeError, ValueError, OSError, KeyError) as e:
+            out.write(f"Error: {e}\n")
+            return 1
+        finally:
+            rc.close()
     if ns.words[0] == "trace":
         # cluster-level trace assembly over the daemons' admin
         # sockets: needs no mon connection (an op is usually traced

@@ -33,6 +33,11 @@ def main(argv: Optional[List[str]] = None, ioctx=None, out=None) -> int:
     ap.add_argument("--uid")
     ap.add_argument("--display-name", default="")
     ap.add_argument("--bucket")
+    ap.add_argument("--num-shards", type=int, default=0,
+                    help="target shard count for `bucket reshard`")
+    ap.add_argument("--max-entries", type=int, default=1000,
+                    help="per-shard entry ceiling for `bucket limit "
+                         "check` (WARN past 90%%, OVER past it)")
     ap.add_argument("--realm", default="default")
     ap.add_argument("--rgw-zonegroup")
     ap.add_argument("--rgw-zone")
@@ -86,8 +91,23 @@ def main(argv: Optional[List[str]] = None, ioctx=None, out=None) -> int:
                     objs = b.list_objects(max_keys=1 << 30)["contents"]
                     stats[name] = {
                         "num_objects": len(objs),
+                        "num_shards": b.num_shards(),
                         "size": sum(o["size"] for o in objs)}
                 return emit(stats)
+            if w[1] == "reshard":
+                # online bucket reshard (RGWBucketReshard role): a
+                # new generation of index shards, committed in the
+                # bucket directory, old generation dropped
+                if not ns.bucket or ns.num_shards < 1:
+                    ap.error("bucket reshard requires --bucket and "
+                             "--num-shards >= 1")
+                return emit(gw.reshard_bucket(ns.bucket,
+                                              ns.num_shards))
+            if w[1] == "limit" and len(w) > 2 and w[2] == "check":
+                # per-shard entry counts + fill verdict (the hot-
+                # shard / reshard-needed signal)
+                return emit(gw.bucket_limit_check(
+                    max_entries_per_shard=ns.max_entries))
         # --------------------------------------------------------- gc --
         if w[0] == "gc":
             if w[1] == "list":

@@ -34,8 +34,15 @@ def build_cluster_dir(cluster_dir: str, n_osds: int = 6,
                       bluestore_min_alloc_size: int = 4096,
                       bluestore_compression: str = "",
                       fsck_on_mount: bool = False,
-                      ms_inject_socket_failures: int = 0) -> None:
-    """Write crushmap.txt, cluster.json and keyrings."""
+                      ms_inject_socket_failures: int = 0,
+                      qos_tenants: Optional[Dict[str, dict]] = None
+                      ) -> None:
+    """Write crushmap.txt, cluster.json and keyrings.
+
+    ``qos_tenants``: {tenant: {"res": r, "wgt": w, "lim": l}} —
+    per-tenant dmClock client-class overrides every OSD daemon loads
+    at boot (the osd_mclock_scheduler_client_* per-client profiles).
+    """
     os.makedirs(cluster_dir, exist_ok=True)
     from ..placement.builder import TYPE_HOST, build_flat_cluster
     from ..placement.compiler import decompile_crushmap
@@ -62,7 +69,8 @@ def build_cluster_dir(cluster_dir: str, n_osds: int = 6,
                "bluestore_min_alloc_size": bluestore_min_alloc_size,
                "bluestore_compression_algorithm": bluestore_compression,
                "fsck_on_mount": fsck_on_mount,
-               "ms_inject_socket_failures": ms_inject_socket_failures},
+               "ms_inject_socket_failures": ms_inject_socket_failures,
+               "qos_tenants": qos_tenants or {}},
               open(os.path.join(cluster_dir, "cluster.json"), "w"))
     names = ["mon.", "client.admin"] + \
         [f"mon.{r}" for r in range(n_mons)] + \

@@ -1305,6 +1305,65 @@ def test_full_tree_lint_wall_time_budget():
         f"full-tree lint took {elapsed:.1f}s — past the CI budget"
 
 
+# --------------------------------------- CTL9xx: serving paths ---
+
+def test_ctl901_full_index_read_on_request_path(tmp_path):
+    """Direct positive: a per-request gateway op loading the whole
+    bucket index; negative: the shard read and the listing merge."""
+    write(tmp_path, "rgw/gw.py", """\
+        class Bucket:
+            def _read_index(self):
+                merged = {}
+                for s in range(self.num_shards()):
+                    merged.update(self._read_index_shard(s))
+                return merged
+
+            def _read_index_shard(self, s):
+                return self.io.read(f"idx.{s}")
+
+            def get_object(self, key):
+                return self._read_index()[key]
+
+            def head_object(self, key):
+                return self._read_index_shard(0)[key]
+
+            def list_objects(self):
+                return sorted(self._read_index())
+        """)
+    res = lint(tmp_path, select=["CTL901"])
+    assert [(f.path, f.line) for f in res.findings] == \
+        [("rgw/gw.py", 12)], res.findings
+    assert "get_object" in res.findings[0].msg
+    assert "shard" in res.findings[0].msg
+
+
+def test_ctl901_reaches_through_helper_and_scope_and_noqa(tmp_path):
+    """Interprocedural positive (the wrapper shape), out-of-scope
+    module stays clean, and # noqa suppresses."""
+    write(tmp_path, "rgw/gw.py", """\
+        class Bucket:
+            def _read_index(self):
+                return dict(self.io.read("idx"))
+
+            def _lookup(self, key):
+                return self._read_index().get(key)
+
+            def delete_object(self, key):
+                return self._lookup(key)
+
+            def put_object(self, key, data):
+                return self._read_index()  # noqa: CTL901
+        """)
+    write(tmp_path, "cluster/other.py", """\
+        def get_object(store):
+            return store._read_index()
+        """)
+    res = lint(tmp_path, select=["CTL901"])
+    assert [(f.path, f.line) for f in res.findings] == \
+        [("rgw/gw.py", 8)], res.findings
+    assert "via" in res.findings[0].msg
+
+
 @pytest.mark.smoke
 def test_check_static_smoke():
     """scripts/check_static.py end to end: the seeded fixture tree's
