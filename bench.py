@@ -1203,7 +1203,7 @@ def bench_wire_async(n_osds=4, frame_kib=1024, blocking_mib=48,
         out["single_stream_gbps"] = round(statistics.median(
             blocking_phase(blocking_mib) for _ in range(reps)), 3)
 
-        def async_phase(mib, n_streams, win, mode):
+        def async_phase(mib, n_streams, win, mode, counts=None):
             from ceph_tpu.cluster.async_objecter import AsyncObjecter
             config().set("objecter_wire_streams", n_streams)
             config().set("objecter_wire_window", win)
@@ -1216,6 +1216,9 @@ def bench_wire_async(n_osds=4, frame_kib=1024, blocking_mib=48,
                     for tgt, req in reqs(1):
                         aio.call(tgt, req)
                     vals = []
+                    c0 = _wire_zero_counters(d, n_osds) \
+                        if counts is not None else None
+                    moved = 0
                     for _ in range(reps):
                         work = reqs(mib)
                         t0 = time.perf_counter()
@@ -1225,7 +1228,21 @@ def bench_wire_async(n_osds=4, frame_kib=1024, blocking_mib=48,
                             if err is not None:
                                 raise err
                         t = time.perf_counter() - t0
+                        moved += len(work) * len(frame)
                         vals.append(len(work) * len(frame) / t / 1e9)
+                    if counts is not None:
+                        # the ZeroWire stage decomposition: crc
+                        # passes and copies per payload MiB, summed
+                        # over the client + every daemon's counters
+                        delta = _counter_delta(
+                            c0, _wire_zero_counters(d, n_osds))
+                        counts.update({
+                            "crc_passes_per_mib": round(
+                                delta.get("crc_scan_bytes", 0)
+                                / max(moved, 1), 2),
+                            "copies_per_mib": round(
+                                delta.get("copy_bytes", 0)
+                                / max(moved, 1), 2)})
                     return statistics.median(vals)
                 finally:
                     aio.close()
@@ -1234,8 +1251,11 @@ def bench_wire_async(n_osds=4, frame_kib=1024, blocking_mib=48,
                 config().clear("objecter_wire_window")
                 config().clear("objecter_wire_mode")
 
+        counts: dict = {}
         out["async_1stream_gbps"] = round(
-            async_phase(blocking_mib, 1, 1, "crc"), 3)
+            async_phase(blocking_mib, 1, 1, "crc", counts=counts), 3)
+        out["crc_passes_per_mib"] = counts.get("crc_passes_per_mib")
+        out["copies_per_mib"] = counts.get("copies_per_mib")
         out["multi_stream_gbps"] = round(
             async_phase(async_mib, streams, 1, "crc"), 3)
         out["pipelined_gbps"] = round(
@@ -1277,6 +1297,257 @@ def bench_wire_async(n_osds=4, frame_kib=1024, blocking_mib=48,
             config().clear("objecter_wire_streams")
             config().clear("objecter_wire_window")
             config().clear("objecter_wire_mode")
+        rc.close()
+        return out
+    finally:
+        v.stop()
+        gc.collect()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def _wire_zero_counters(cluster_dir, n_osds):
+    """Client + every daemon's perf('wire.zero') counters — the
+    falsifiable sensor behind crc-passes/MiB and copies/MiB."""
+    from ceph_tpu.common import crcutil
+    return crcutil.wire_zero_counters(cluster_dir, n_osds)
+
+
+def _counter_delta(before, after):
+    return {k: after.get(k, 0) - before.get(k, 0)
+            for k in set(before) | set(after)}
+
+
+def bench_wire_zero(n_osds=2, mib=32, frame_kib=1024):
+    """ZeroWire decomposition (ISSUE 15): the SAME single-stream
+    crc-mode put workload priced on the legacy wire (3 crc passes +
+    bytes() copies per payload byte; daemons booted with the legacy
+    env so both sides regress) and on the one-pass/zero-copy wire
+    (client csums precomputed by the device crc kernel, daemon's one
+    verify scan feeding BlueStore's blob csums) — crc passes/MiB,
+    copies/MiB and GB/s, before vs after, measured not asserted."""
+    import gc
+    import shutil
+    import tempfile
+    from ceph_tpu.client.remote import RemoteCluster
+    from ceph_tpu.common.options import config
+    from ceph_tpu.cluster.async_objecter import AsyncObjecter
+    from ceph_tpu.tools.vstart import Vstart, build_cluster_dir
+
+    frame = os.urandom(frame_kib << 10)
+    seq = [0]
+    legacy_env = {"CEPH_TPU_WIRE_ONE_PASS": "0",
+                  "CEPH_TPU_WIRE_ZERO_COPY": "0"}
+    # the shm lane is priced by bench_wire_shm; keep it out of the
+    # crc/copy comparison so the deltas isolate ONE axis
+    client_opts = {"objecter_wire_streams": 1,
+                   "objecter_wire_window": 8,
+                   "objecter_wire_mode": "crc",
+                   "wire_shm_ring_kib": 0}
+
+    def run_cluster(env, phases):
+        """One vstart cluster, N measured client phases on it (same
+        daemons ⇒ phase-to-phase comparisons dodge the cross-cluster
+        scheduling noise this sandbox swings by 2x).  ``phases`` =
+        [(label, opts, csums_for_frame), ...]."""
+        tmp = tempfile.mkdtemp(prefix="bench-zw-")
+        d = os.path.join(tmp, "cluster")
+        build_cluster_dir(d, n_osds=n_osds, osds_per_host=1,
+                          fsync=False,
+                          bluestore_device_bytes=4 << 30)
+        old_env = {k: os.environ.get(k) for k in env}
+        os.environ.update(env)
+        v = Vstart(d)
+        results = {}
+        try:
+            v.start(n_osds, hb_interval=60.0)
+            rc = RemoteCluster(d)
+            pool = rc.osdmap.pools[1]
+            for label, opts, csums_for_frame in phases:
+                for k, val in opts.items():
+                    config().set(k, val)
+                aio = AsyncObjecter(rc)
+                try:
+                    def reqs(n):
+                        work = []
+                        for _i in range(n):
+                            name = f"zw{seq[0]}"
+                            seq[0] += 1
+                            pg = rc._pg_for(pool, name)
+                            tgt = [o for o in rc._up(pool, pg)
+                                   if o >= 0][0]
+                            req = {"cmd": "put_shard",
+                                   "coll": [1, pg],
+                                   "oid": f"0:{name}",
+                                   "data": frame, "attrs": {}}
+                            if csums_for_frame is not None:
+                                req = dict(req,
+                                           _csums=csums_for_frame)
+                            work.append((tgt, req))
+                        return work
+
+                    for tgt, req in reqs(2):   # warm streams
+                        aio.call(tgt, req)
+                    n_frames = max(1, (mib << 20) // len(frame))
+                    c0 = _wire_zero_counters(d, n_osds)
+                    vals = []
+                    for _rep in range(3):   # median of 3 batches
+                        work = reqs(n_frames)
+                        t0 = time.perf_counter()
+                        comps = [aio.call_async(t, r)
+                                 for t, r in work]
+                        for _r, err in aio.gather(comps):
+                            if err is not None:
+                                raise err
+                        vals.append(n_frames * len(frame) /
+                                    (time.perf_counter() - t0))
+                    c1 = _wire_zero_counters(d, n_osds)
+                    delta = _counter_delta(c0, c1)
+                    nbytes = 3 * n_frames * len(frame)
+                    results[label] = {
+                        "gbps": round(
+                            statistics.median(vals) / 1e9, 3),
+                        "crc_passes_per_mib": round(
+                            delta.get("crc_scan_bytes", 0)
+                            / nbytes, 2),
+                        "copies_per_mib": round(
+                            delta.get("copy_bytes", 0) / nbytes, 2),
+                        "trusted_csum_mib": round(
+                            delta.get("trusted_csum_bytes", 0)
+                            / 2**20, 1),
+                        "scan_sites": {
+                            k[len("scan_"):-len("_bytes")]: round(
+                                delta[k] / nbytes, 2)
+                            for k in delta
+                            if k.startswith("scan_") and
+                            k.endswith("_bytes") and delta[k]},
+                    }
+                finally:
+                    aio.close()
+                    for k in opts:
+                        config().clear(k)
+            rc.close()
+            return results
+        finally:
+            for k, old in old_env.items():
+                if old is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = old
+            v.stop()
+            gc.collect()
+            shutil.rmtree(tmp, ignore_errors=True)
+
+    out = {"frame_kib": frame_kib, "mib": mib, "n_osds": n_osds}
+    legacy_opts = dict(client_opts, wire_one_pass=False,
+                       wire_zero_copy=False)
+    # the legacy daemons regress BOTH sides (env inherited by vstart);
+    # its cluster also hosts a defaults lane so the before/after
+    # ratio has an in-cluster control against scheduling noise
+    before = run_cluster(legacy_env,
+                         [("before", legacy_opts, None)])
+    out["before"] = before["before"]
+    # the device crc kernel prices the client's pass at one GF(2)
+    # matmul over the staged frame — computed once, reused per send
+    # (the shards-already-in-HBM shape); the remaining CPU pass is
+    # the daemon's single verify scan
+    from ceph_tpu.ops import crc32_gf2
+    t0 = time.perf_counter()
+    cs = crc32_gf2.csums_for(frame)
+    device_crc_s = time.perf_counter() - t0
+    # after = ALL THREE ZeroWire legs composed (one-pass + zero-copy
+    # + shm lane); after_socket isolates the crc/copy axes with the
+    # lane off — BOTH on one cluster so their ratio is clean
+    after = run_cluster({}, [
+        ("after", dict(client_opts, wire_shm_ring_kib=16384), cs),
+        ("after_socket", dict(client_opts), cs),
+    ])
+    out["after"] = after["after"]
+    out["after"]["device_crc_s_per_frame"] = round(device_crc_s, 4)
+    out["after_socket"] = after["after_socket"]
+    out["speedup_crc_mode"] = round(
+        out["after"]["gbps"] / max(out["before"]["gbps"], 1e-9), 2)
+    out["speedup_crc_mode_socket_only"] = round(
+        out["after_socket"]["gbps"] / max(out["before"]["gbps"],
+                                          1e-9), 2)
+    return out
+
+
+def bench_wire_shm(n_osds=2, mib=64, frame_kib=1024):
+    """Same-host shared-memory lane vs the socket path: identical
+    put workload against the same daemons, once with the ring
+    (payload via mmap, doorbell on the socket) and once with
+    wire_shm_ring_kib=0 (pure socket scatter-gather) — the syscall
+    tax of moving bulk bytes through two kernel socket buffers,
+    priced directly."""
+    import gc
+    import shutil
+    import tempfile
+    from ceph_tpu.client.remote import RemoteCluster
+    from ceph_tpu.common.options import config
+    from ceph_tpu.cluster.async_objecter import AsyncObjecter
+    from ceph_tpu.tools.vstart import Vstart, build_cluster_dir
+
+    frame = os.urandom(frame_kib << 10)
+    tmp = tempfile.mkdtemp(prefix="bench-shm-")
+    d = os.path.join(tmp, "cluster")
+    build_cluster_dir(d, n_osds=n_osds, osds_per_host=1, fsync=False,
+                      bluestore_device_bytes=2 << 30)
+    v = Vstart(d)
+    v.start(n_osds, hb_interval=60.0)
+    seq = [0]
+    out = {"frame_kib": frame_kib, "mib": mib}
+    try:
+        rc = RemoteCluster(d)
+        pool = rc.osdmap.pools[1]
+
+        def phase(ring_kib):
+            config().set("wire_shm_ring_kib", ring_kib)
+            config().set("objecter_wire_mode", "crc")
+            try:
+                aio = AsyncObjecter(rc)
+                try:
+                    def reqs(n):
+                        work = []
+                        for _i in range(n):
+                            name = f"shm{seq[0]}"
+                            seq[0] += 1
+                            pg = rc._pg_for(pool, name)
+                            tgt = [o for o in rc._up(pool, pg)
+                                   if o >= 0][0]
+                            work.append((tgt, {
+                                "cmd": "put_shard", "coll": [1, pg],
+                                "oid": f"0:{name}", "data": frame,
+                                "attrs": {}}))
+                        return work
+                    for tgt, req in reqs(2):
+                        aio.call(tgt, req)
+                    vals = []
+                    for _rep in range(3):
+                        work = reqs(max(1, (mib << 20) //
+                                        len(frame)))
+                        t0 = time.perf_counter()
+                        comps = [aio.call_async(t, r)
+                                 for t, r in work]
+                        for _r, err in aio.gather(comps):
+                            if err is not None:
+                                raise err
+                        vals.append(len(work) * len(frame) /
+                                    (time.perf_counter() - t0) / 1e9)
+                    return statistics.median(vals)
+                finally:
+                    aio.close()
+            finally:
+                config().clear("wire_shm_ring_kib")
+                config().clear("objecter_wire_mode")
+
+        from ceph_tpu.common.perf_counters import perf
+        c0 = perf("wire.zero").dump().get("shm_bytes", 0)
+        out["shm_gbps"] = round(phase(8192), 3)
+        shm_moved = perf("wire.zero").dump().get("shm_bytes", 0) - c0
+        out["shm_ring_mib_moved"] = round(shm_moved / 2**20, 1)
+        out["socket_gbps"] = round(phase(0), 3)
+        out["speedup_shm_vs_socket"] = round(
+            out["shm_gbps"] / max(out["socket_gbps"], 1e-9), 2)
         rc.close()
         return out
     finally:
@@ -1441,6 +1712,13 @@ def main():
         extras["wire_async"] = bench_wire_async()
     except Exception as e:
         print(f"# wire async bench failed: {e}", file=sys.stderr)
+    try:
+        import gc
+        gc.collect()
+        extras["wire_zero"] = bench_wire_zero()
+        extras["wire_zero"]["shm"] = bench_wire_shm()
+    except Exception as e:
+        print(f"# wire zero bench failed: {e}", file=sys.stderr)
     if "cold_restart" not in extras.get("rebuild_osd", {}):
         # rebuild bench (or its fold) failed: keep the cold-restart
         # datapoint as its own entry rather than losing it

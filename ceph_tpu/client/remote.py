@@ -44,6 +44,35 @@ class RemoteObjectMissing(IOError):
     probes skip the retry sweep (rados ENOENT vs EIO distinction)."""
 
 
+def _as_buf(arr) -> memoryview:
+    """A numpy array's bytes as a flat uint8 memoryview — the
+    zero-copy handoff to the scatter-gather wire path (tobytes()
+    duplicated every shard before it ever reached the socket)."""
+    from ..common import crcutil
+    return crcutil.as_u8(np.ascontiguousarray(arr))
+
+
+def _staged_csums(arrs):
+    """Per-shard Csums for a flush batch, computed ONCE per byte:
+    on device via the GF(2) crc matmul (ops/crc32_gf2) when the
+    backend makes it worthwhile — the shards were just staged in
+    HBM — else a single host scan each.  ``wire_device_crc``:
+    auto/on/off."""
+    from ..common import crcutil
+    from ..common.options import config
+    mode = str(config().get("wire_device_crc"))
+    if mode == "on" or (mode == "auto" and _device_crc_ok()):
+        from ..ops import crc32_gf2
+        return crc32_gf2.csums_many([_as_buf(a) for a in arrs])
+    return [crcutil.Csums.scan(_as_buf(a), site="client")
+            for a in arrs]
+
+
+def _device_crc_ok() -> bool:
+    from ..ops import crc32_gf2
+    return crc32_gf2.device_worthwhile()
+
+
 class RemoteCluster:
     def __init__(self, cluster_dir: str, entity: str = "client.admin",
                  ec_profiles: Optional[Dict[str, Dict[str, str]]] = None):
@@ -189,22 +218,31 @@ class RemoteCluster:
         'bounded stall or redirect, never a stale map' contract."""
         last: Optional[Exception] = None
         for attempt in range(3):
-            if self.mon is None:
+            # snapshot the shared client: a CONCURRENT mon_call that
+            # hit its own failure may null/replace self.mon between
+            # our check and use (seen as AttributeError under the
+            # socket-failure soak)
+            mon = self.mon
+            if mon is None:
                 try:
                     self._connect_mon()
                 except (OSError, IOError) as e:
                     last = e
                     self._backoff.sleep(attempt)
                     continue
+                mon = self.mon
+                if mon is None:
+                    continue
             try:
-                return self.mon.call(req)
+                return mon.call(req)
             except (OSError, IOError) as e:
                 last = e
                 try:
-                    self.mon.close()
+                    mon.close()
                 except OSError:
                     pass
-                self.mon = None
+                if self.mon is mon:
+                    self.mon = None
                 self._mon_rot += 1       # next reconnect: next mon
                 if attempt < 2:
                     self._backoff.sleep(attempt)
@@ -923,7 +961,10 @@ class RemoteCluster:
                 fan.append((shard, tgt, self.aio.call_async(tgt, {
                     "cmd": "put_shard", "coll": coll,
                     "oid": f"{shard}:{name}",
-                    "data": np.asarray(chunks[shard]).tobytes(),
+                    # zero-copy: the encoded shard's buffer view goes
+                    # straight to the SG frame / shm ring — tobytes()
+                    # re-copied every shard byte client-side
+                    "data": _as_buf(chunks[shard]),
                     # logical object size travels as shard metadata
                     # so ANY client can unpad reads (object_info_t)
                     "attrs": obj_attrs})))
@@ -2101,8 +2142,15 @@ class RemoteCluster:
         an async scatter-gather sweep: every put_shard frame
         pipelines onto its daemon's stream pool round-robin, ONE
         gather for the whole drain instead of a blocking readback +
-        RTT per shard."""
-        import zlib
+        RTT per shard.
+
+        ZeroWire: each flushed shard's per-4KiB sub-crcs are computed
+        ONCE — on device (ops/crc32_gf2's GF(2) matmul, when the
+        backend makes it worthwhile) or by a single host scan — and
+        that one Csums feeds the frame crc, the daemon's trusted blob
+        csums AND the staging digest; the shard bytes themselves ride
+        as memoryviews (no tobytes() materialization)."""
+        from ..common import crcutil
         from ..cluster.device_store import materialize_bulk
         pool = self.osdmap.pools[pool_id]
         by_tgt: Dict[int, List] = {}
@@ -2122,11 +2170,15 @@ class RemoteCluster:
         flat = [it for items in by_tgt.values() for it in items]
         hosts = materialize_bulk([ref for _k, ref, *_r in flat])
         host_of = {}
+        csums_of = {}
         i = 0
         for items in by_tgt.values():
             for it in items:
                 host_of[it[0]] = hosts[i]
                 i += 1
+        for key, cs in zip(host_of,
+                           _staged_csums(list(host_of.values()))):
+            csums_of[key] = cs
         fan: List[Tuple[Any, int, object]] = []
         # round-robin across daemons so every stream pool fills while
         # the others' frames are still queueing
@@ -2138,13 +2190,14 @@ class RemoteCluster:
                     del queues[tgt]
                     continue
                 key, ref, pg, name, shard = items.pop(0)
-                data = host_of[key].tobytes()
-                fan.append((key, zlib.crc32(data),
+                cs = csums_of[key]
+                fan.append((key, cs.combined,
                             self.aio.call_async(tgt, {
                                 "cmd": "put_shard",
                                 "coll": [pool_id, pg],
                                 "oid": f"{shard}:{name}",
-                                "data": data,
+                                "data": _as_buf(host_of[key]),
+                                "_csums": cs,
                                 "attrs": self._staged_attrs.get(
                                     key, {})})))
         flushed = 0
@@ -2331,11 +2384,11 @@ class WireShardIO:
         double-buffering the flush path needed), and the gather step
         collects every commit before the verdict."""
         rc = self.rc
-        import zlib
+        from ..common import crcutil
 
         sweep: List = []
         results: List = []
-        fan: List[Tuple[Any, bytes, object]] = []
+        fan: List[Tuple[Any, object, object]] = []
         for w in writes:
             key = (self.pool_id, w.pg, w.name, w.shard)
             data = w.bytes_fn()
@@ -2348,13 +2401,19 @@ class WireShardIO:
                 rc._staged_attrs[key] = w.attrs
                 results.append(w)
                 continue
-            fan.append((w, data, rc.aio.call_async(w.target, {
+            # ONE client-side scan per sub-write: the same sub-crcs
+            # feed the frame crc (combine, no re-scan in the sender),
+            # the daemon's trusted blob csums, and the staging digest
+            # below — this fan-out used to scan every byte twice
+            # (frame crc + zlib.crc32 digest)
+            cs = crcutil.Csums.scan(data, site="client")
+            fan.append((w, cs, rc.aio.call_async(w.target, {
                 "cmd": "put_shard",
                 "coll": [self.pool_id, w.pg],
                 "oid": f"{w.shard}:{w.name}",
-                "data": data, "attrs": w.attrs})))
+                "data": data, "_csums": cs, "attrs": w.attrs})))
         fatal: Optional[BaseException] = None
-        for (w, data, comp), (_r, err) in zip(
+        for (w, cs, comp), (_r, err) in zip(
                 fan, rc.aio.gather([c for _, _, c in fan])):
             key = (self.pool_id, w.pg, w.name, w.shard)
             if err is not None:
@@ -2378,7 +2437,7 @@ class WireShardIO:
                 self.purge_shard(w.pg, w.shard, w.name, None)
                 self._committed_to.pop((w.pg, w.shard, w.name), None)
                 continue
-            rc.dev.put(key, w.ref, zlib.crc32(data))
+            rc.dev.put(key, w.ref, cs.combined)
             # success supersedes strays: a RE-HOMED shard's previous
             # copy on its old home must not outlive this commit (the
             # peering-time supersession SimShardIO.fanout applies) —

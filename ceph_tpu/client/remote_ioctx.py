@@ -85,7 +85,11 @@ class RemoteIoCtx:
 
     # ------------------------------------------------------------- data --
     def write_full(self, oid: str, data: bytes) -> None:
-        self._rc.put(self.pool_id, oid, bytes(data))
+        # no snapshot: put() gathers every sub-write commit before
+        # returning, so the caller's buffer is done being read when
+        # control comes back (the zero-copy spine carries it as a
+        # view all the way to the frames)
+        self._rc.put(self.pool_id, oid, data)
 
     def write(self, oid: str, data: bytes, offset: int = 0) -> None:
         try:
@@ -143,7 +147,9 @@ class RemoteIoCtx:
         return run
 
     def aio_write_full(self, oid: str, data: bytes):
-        buf = bytes(data)
+        buf = bytes(data)  # noqa: CTL130 — deliberate snapshot: the
+        # op outlives this call and the caller may reuse its buffer
+        # (librados aio semantics made safe instead of documented-UB)
         return self._rc.aio.engine.submit(
             self._bind_tenant(lambda: self.write_full(oid, buf)),
             key=self._aio_key(oid))

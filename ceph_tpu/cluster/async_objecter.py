@@ -44,6 +44,7 @@ window that ``dump_ops_in_flight`` exposes.
 from __future__ import annotations
 
 import concurrent.futures as _cf
+import os
 import queue as _queue
 import threading
 from collections import deque
@@ -206,7 +207,7 @@ class AsyncObjecter:
     # payloads at or above this ride the scatter-gather frame tail,
     # straight from their buffer (below it, the typed encoder's copy
     # is cheaper than a second sendmsg segment)
-    SG_MIN = 1024
+    SG_MIN = wire.SG_MIN
 
     def __init__(self, rc):
         self.rc = rc
@@ -214,6 +215,15 @@ class AsyncObjecter:
         self.n_streams = int(cfg.get("objecter_wire_streams"))
         self.window = int(cfg.get("objecter_wire_window"))
         self.mode = str(cfg.get("objecter_wire_mode"))
+        # same-host shared-memory lane (msg/shm_ring.py): ring bytes
+        # per OSD pool; 0 disables and every payload rides the socket
+        self.shm_bytes = int(cfg.get("wire_shm_ring_kib")) << 10
+        if self.mode == wire.MODE_SECURE:
+            # sealed payloads must never cross the plaintext mmap
+            # ring: the lane is integrity-only (crc bound into the
+            # MAC'd doorbell), so secure mode keeps every byte on
+            # the sealed socket frames
+            self.shm_bytes = 0
         self._pools: Dict[int, wire.StreamPool] = {}
         self._lock = LockdepLock("objecter.async", recursive=False)
         self.engine = AioEngine(workers=2, name="objecter-aio")
@@ -230,10 +240,20 @@ class AsyncObjecter:
         with self._lock:
             p = self._pools.get(osd)
             if p is None:
+                # ring files live next to the daemon's socket (both
+                # processes reach them through the cluster dir, and
+                # the server only maps paths from its own dir)
+                shm_dir = None
+                try:
+                    shm_dir = os.path.dirname(self.rc.addrs[osd])
+                except (KeyError, IndexError, AttributeError,
+                        TypeError):
+                    pass
                 p = self._pools[osd] = wire.StreamPool(
                     factory=lambda o=osd: self.rc._stream_conn(o),
                     size=self.n_streams, mode=self.mode,
-                    window=self.window, name=f"osd.{osd}")
+                    window=self.window, name=f"osd.{osd}",
+                    shm_dir=shm_dir, shm_bytes=self.shm_bytes)
             return p
 
     def drop_pool(self, osd: int) -> None:
@@ -251,17 +271,10 @@ class AsyncObjecter:
     @staticmethod
     def _sg_payload(req: Dict[str, Any]):
         """Split a bulk ``data`` payload out of the request for the
-        scatter-gather frame tail; returns (meta_req, data|None)."""
-        payload = req.get("data")
-        if isinstance(payload, memoryview):
-            payload = payload.tobytes()
-            req = dict(req, data=payload)
-        if isinstance(payload, (bytes, bytearray)) and \
-                len(payload) >= AsyncObjecter.SG_MIN:
-            req = dict(req)
-            data = req.pop("data")
-            return req, bytes(data)
-        return req, None
+        scatter-gather frame tail; returns (meta_req, data|None,
+        csums|None) — the shared wire.extract_bulk contract (one
+        threshold, one view-passing policy, for every sender)."""
+        return wire.extract_bulk(req, "sg_payload")
 
     def call_async(self, osd: int, req: Dict[str, Any],
                    completion: Optional[AioCompletion] = None
@@ -296,8 +309,28 @@ class AsyncObjecter:
             if tr_span.trace_id:
                 req = dict(req)
                 req["tctx"] = [tr_span.trace_id, tr_span.span_id]
-        req, data = self._sg_payload(req)
-        meta = encoding.dumps(req)
+        req, data, csums = self._sg_payload(req)
+        pool = self.pool(osd)
+        shm_tok = None
+        if data is not None:
+            # same-host shared-memory lane: the payload goes to the
+            # ring and only a doorbell (meta + extent + crc) crosses
+            # the socket.  Any failure (ring full, lane refused,
+            # daemon restarted without the mapping) falls back to the
+            # socket scatter-gather tail for THIS frame — the lane is
+            # an optimization, never a dependency.
+            shm_tok = pool.ring_put(data, csums)
+        if shm_tok is not None:
+            meta = encoding.dumps(dict(req, _shm=shm_tok.meta))
+            # the ORIGINAL payload stays referenced: the one resend
+            # re-frames it onto the socket (below) instead of
+            # replaying a doorbell whose ring record may be the very
+            # thing that failed (poisoned/overwritten extent — a
+            # doorbell replay would fail identically forever)
+            send_data = send_csums = None
+        else:
+            meta = encoding.dumps(req)
+            send_data, send_csums = data, csums
         self._pc.inc("submits")
         tr = _op_tracker()
         cur = tr.current()
@@ -316,6 +349,11 @@ class AsyncObjecter:
         state = {"retried": False}
 
         def _finish(result, exc) -> None:
+            if shm_tok is not None:
+                # the op is terminal either way: the ring extent is
+                # reusable (a resubmit-in-flight never reaches here —
+                # it reuses the SAME extent until its own completion)
+                pool.ring_free(shm_tok)
             if tr_span is not None:
                 _trace.tracer().finish_span(
                     tr_span, error=None if exc is None
@@ -329,6 +367,17 @@ class AsyncObjecter:
                 self._pc.inc("errors")
                 comp._fail(exc)
 
+        def _resend_args():
+            """The one resubmit always rides the SOCKET: re-encode
+            the meta WITHOUT the doorbell and re-frame the original
+            payload — a dead stream, a refused re-attach and a
+            poisoned ring record all heal the same way (the (session,
+            seq) stamp makes the replay at-most-once regardless of
+            which lane the first attempt used)."""
+            if shm_tok is None:
+                return meta, data, csums
+            return encoding.dumps(req), data, csums
+
         def _cb(result, exc) -> None:
             if exc is not None and isinstance(exc, (OSError, IOError)) \
                     and not state["retried"]:
@@ -340,13 +389,14 @@ class AsyncObjecter:
                 state["retried"] = True
                 self._pc.inc("resubmits")
                 self._io.submit(
-                    lambda: self._resend(osd, meta, data, _cb,
-                                         _finish))
+                    lambda: self._resend(osd, _resend_args(),
+                                         _cb, _finish))
                 return
             _finish(result, exc)
 
         try:
-            self.pool(osd).submit(meta, data=data, cb=_cb)
+            pool.submit(meta, data=send_data, cb=_cb,
+                        csums=send_csums)
         except (OSError, IOError) as e:
             if state["retried"]:
                 _finish(None, e)
@@ -354,13 +404,15 @@ class AsyncObjecter:
                 state["retried"] = True
                 self._pc.inc("resubmits")
                 self._io.submit(
-                    lambda: self._resend(osd, meta, data, _cb,
-                                         _finish))
+                    lambda: self._resend(osd, _resend_args(),
+                                         _cb, _finish))
         return comp
 
-    def _resend(self, osd: int, meta: bytes, data, cb, finish) -> None:
+    def _resend(self, osd: int, framed, cb, finish) -> None:
+        meta, data, csums = framed
         try:
-            self.pool(osd).submit(meta, data=data, cb=cb)
+            self.pool(osd).submit(meta, data=data, cb=cb,
+                                  csums=csums)
         except (OSError, IOError) as e:
             finish(None, e)
 

@@ -176,7 +176,11 @@ class BlockDevice:
 
     # ------------------------------------------------------------ write --
     def pwrite(self, data: bytes, offset: int) -> int:
-        data = bytes(data)
+        # no bytes() snapshot: os.pwrite takes any buffer, and the
+        # zero-copy wire path hands views straight off the receive
+        # buffer — materializing here re-copied EVERY stored byte.
+        # The recorder path (crash harness) still snapshots its own
+        # stable copy below.
         p = faults.fire("device.torn_write", path=self.path)
         if p is not None:
             keep = int(p.get("keep", max(1, len(data) // 2)))
@@ -193,7 +197,9 @@ class BlockDevice:
         os.pwrite(self._fd, data, offset)
         self._size = max(self._size, offset + len(data))
         if self.rec is not None:
-            self.rec.record(OP_WRITE, self.path, offset, data)
+            # the recorder replays writes long after the caller's
+            # buffer view is reused: snapshot (harness-only cost)
+            self.rec.record(OP_WRITE, self.path, offset, bytes(data))
         return len(data)
 
     def append(self, data: bytes) -> int:

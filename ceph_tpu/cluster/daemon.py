@@ -84,6 +84,13 @@ MSG_ERR = wire.MSG_ERR
 _dumps = encoding.dumps
 
 
+class _ShmPoisoned(Exception):
+    """A shared-memory doorbell's ring record failed its verify scan
+    (bit flip, torn record, client overwrite): the connection must
+    DROP without a reply, exactly like a corrupt socket frame —
+    an error reply would acknowledge bytes that were never valid."""
+
+
 def mon_sockets(cluster_dir: str) -> List[str]:
     """The cluster's mon socket paths (single source of the naming
     convention: 'mon.sock' for a lone mon, 'mon.{r}.sock' per rank
@@ -145,6 +152,11 @@ class WireServer:
         self._stop = threading.Event()
         if os.path.exists(sock_path):
             os.unlink(sock_path)
+        # reap ring files orphaned by kill9'd clients (a crashed
+        # client never reaches its StreamPool.close unlink; the
+        # files are sparse but accumulate across chaos soaks)
+        from ..msg.shm_ring import sweep_stale
+        sweep_stale(os.path.dirname(sock_path) or ".")
         self._sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
         self._sock.bind(sock_path)
         # deep backlog: injected-drop reconnect storms (every client
@@ -209,6 +221,7 @@ class WireServer:
         raise cx.AuthError(f"unsupported auth frame {env.type:#x}")
 
     def _serve_conn(self, conn: socket.socket) -> None:
+        shm_reader = None           # per-connection mapped client ring
         try:
             # deep kernel buffers: one pipelined client window should
             # land in as few recv syscalls as possible (syscalls are
@@ -282,6 +295,43 @@ class WireServer:
                         return
                     mode = want
                     continue
+                if env.type == wire.MSG_SHM_ATTACH:
+                    # same-host shared-memory lane negotiation: map
+                    # the authenticated client's ring file, but ONLY
+                    # from this daemon's own cluster directory — an
+                    # arbitrary path from a (still authenticated)
+                    # client must not make the daemon mmap foreign
+                    # files.  Refusal is an ok=False ack: the client
+                    # keeps the pure socket lane.
+                    ok = False
+                    try:
+                        blob = encoding.loads(bytes(env.payload))
+                        path = os.path.realpath(str(blob["path"]))
+                        root = os.path.realpath(
+                            os.path.dirname(self.sock_path))
+                        if os.path.dirname(path) == root:
+                            from ..msg.shm_ring import RingReader
+                            if shm_reader is not None:
+                                shm_reader.close()
+                            shm_reader = RingReader(
+                                path, int(blob["size"]))
+                            ok = True
+                    except (OSError, KeyError, ValueError, TypeError):
+                        # (EncodingError is a ValueError)
+                        # ANY malformed attach (non-dict blob, bad
+                        # size type, undecodable payload) is a
+                        # refusal, never a torn-down connection —
+                        # the client just keeps the socket lane
+                        ok = False
+                    try:
+                        wire.send_frame(conn, Envelope(
+                            MSG_REPLY, env.id, -1,
+                            _dumps({"ok": ok})),
+                            session_key=key, src=self.net_entity,
+                            dst=entity, mode=mode)
+                    except OSError:
+                        return
+                    continue
                 if env.type not in (MSG_REQ, wire.MSG_REQ_SG):
                     continue
                 if faults.fire("net.partition", src=entity,
@@ -307,14 +357,41 @@ class WireServer:
                     if env.type == wire.MSG_REQ_SG:
                         # scatter-gather request: bulk payload rides
                         # outside the typed encoding and lands back
-                        # on the meta dict's "data" key
+                        # on the meta dict's "data" key — as a
+                        # zero-copy view over the receive buffer,
+                        # with the one-pass verify scan's TRUSTED
+                        # sub-crcs alongside (the store consumes them
+                        # as ready-made blob csums)
                         meta, data = wire.split_sg(env.payload)
                         req = encoding.loads(meta)
                         req["data"] = data
+                        if env.csums is not None:
+                            req["_csums"] = env.csums
                     else:
-                        req = encoding.loads(env.payload)
+                        req = encoding.loads(bytes(env.payload))
+                    shm_meta = req.pop("_shm", None) \
+                        if isinstance(req, dict) else None
+                    if shm_meta is not None:
+                        # shared-memory doorbell: the payload lives
+                        # in the client's mapped ring; resolve +
+                        # verify it in ONE scan.  A poisoned record
+                        # (flip_bit, torn, overwritten) is rejected
+                        # like a corrupt socket frame — connection
+                        # drop, never a delivered payload.
+                        if shm_reader is None:
+                            raise IOError(
+                                "shm doorbell but no ring attached "
+                                "on this connection")
+                        try:
+                            data, csums = shm_reader.read(shm_meta)
+                        except wire.WireError as e:
+                            raise _ShmPoisoned(str(e))
+                        req["data"] = data
+                        req["_csums"] = csums
                     reply = self.handler(entity, req)
                     out = Envelope(MSG_REPLY, env.id, -1, _dumps(reply))
+                except _ShmPoisoned:
+                    return
                 except Exception as e:
                     out = Envelope(MSG_ERR, env.id, -1,
                                    _dumps((type(e).__name__, str(e))))
@@ -335,6 +412,8 @@ class WireServer:
                 except OSError:
                     return
         finally:
+            if shm_reader is not None:
+                shm_reader.close()
             conn.close()
 
     def stop(self) -> None:
@@ -356,7 +435,8 @@ class WireClient:
                  ticket: Optional[bytes] = None,
                  session_key: Optional[bytes] = None,
                  timeout: float = 10.0,
-                 peer: Optional[str] = None):
+                 peer: Optional[str] = None,
+                 mode: str = wire.MODE_SECURE):
         self.entity = entity
         # the peer's entity name, when the caller knows it: the
         # net.partition faultpoint severs (entity -> peer) traffic at
@@ -409,16 +489,52 @@ class WireClient:
         # to take three syscalls); created after the handshake so no
         # handshake byte is ever buffered past a raw recv_frame
         self._rd = wire.SockReader(self.sock)
+        self.mode = wire.MODE_SECURE
+        if mode == wire.MODE_CRC:
+            # authenticated downgrade to crc data mode (the
+            # Stream._negotiate_crc contract): the request and its
+            # ack travel sealed+MAC'd; only then do frames switch to
+            # crc'd plaintext under header-only HMAC.  Required for
+            # the one-pass handoff — only crc-mode SG frames carry
+            # verify-derived trusted csums to the receiver's store.
+            wire.send_frame(self.sock, Envelope(
+                wire.MSG_SET_MODE, 0, -1,
+                _dumps({"mode": wire.MODE_CRC})),
+                session_key=self.key, src=self.entity, dst=self.peer)
+            env = self._rd.read_frame(session_key=self.key)
+            if env.type != MSG_REPLY:
+                raise wire.WireError("mode negotiation rejected")
+            self.mode = wire.MODE_CRC
+
+    SG_MIN = wire.SG_MIN
 
     def call(self, req: Dict[str, Any]) -> Any:
+        """One request/reply RTT.  A bulk ``data`` payload rides the
+        scatter-gather frame tail (MSG_REQ_SG) — same one-pass
+        integrity contract as the async streams (the shared
+        wire.extract_bulk split): precomputed ``_csums`` fold into
+        the frame crc with no sender scan, and the receiver's single
+        verify scan hands trusted csums to its store.  This is the
+        daemon->replica sub-write path, so without it every replica
+        paid a second (store) scan."""
+        req, data, csums = wire.extract_bulk(req, "peer_call")
         with self._lock:
             self._id += 1
             rid = self._id
-            wire.send_frame(self.sock, Envelope(MSG_REQ, rid, -1,
-                                                _dumps(req)),
-                            session_key=self.key,
-                            src=self.entity, dst=self.peer)
-            env = self._rd.read_frame(session_key=self.key)
+            if data is not None:
+                wire.send_frame_sg(self.sock, wire.MSG_REQ_SG, rid,
+                                   _dumps(req), data,
+                                   session_key=self.key,
+                                   src=self.entity, dst=self.peer,
+                                   mode=self.mode, data_csums=csums)
+            else:
+                wire.send_frame(self.sock, Envelope(MSG_REQ, rid, -1,
+                                                    _dumps(req)),
+                                session_key=self.key,
+                                src=self.entity, dst=self.peer,
+                                mode=self.mode)
+            env = self._rd.read_frame(session_key=self.key,
+                                      mode=self.mode)
         if env.type == MSG_ERR:
             wire.raise_reply_error(env.payload)
         return encoding.loads(env.payload)
@@ -1319,10 +1435,18 @@ class OSDDaemon:
                           "service": f"osd.{osd}"})
         key = cx.open_key_box(self.keyring.secret(self.entity),
                               grant["key_box"])
+        from ..common.options import config
         c = WireClient(os.path.join(self.dir, f"osd.{osd}.sock"),
                        self.entity, ticket=grant["ticket"],
                        session_key=key, timeout=5.0,
-                       peer=f"osd.{osd}")
+                       peer=f"osd.{osd}",
+                       # intra-cluster data mode (the reference's
+                       # ms_cluster_mode, its own knob — sealing
+                       # client streams must not silently downgrade
+                       # peer links or vice versa): crc by default,
+                       # which is what lets replica sub-writes carry
+                       # the one-pass trusted-csum handoff
+                       mode=str(config().get("osd_cluster_wire_mode")))
         with self._peer_lock:
             self._peers[osd] = c
         return c
@@ -1698,8 +1822,15 @@ class OSDDaemon:
             from .objectstore import Transaction
 
             def put():
-                txn = Transaction().write_full(coll, req["oid"],
-                                               req["data"])
+                # trusted csums from the wire's one-pass verify scan
+                # (socket SG frame or shm ring): the store writes the
+                # payload WITHOUT re-scanning it — its per-block blob
+                # csums are the very values that just verified these
+                # bytes.  copy=False: the buffer is a per-frame view
+                # nobody mutates; write_full must not materialize it.
+                txn = Transaction().write_full(
+                    coll, req["oid"], req["data"],
+                    csums=req.get("_csums"), copy=False)
                 for ak, av in (req.get("attrs") or {}).items():
                     txn.setattr(coll, req["oid"], ak, av)
                 lg = req.get("log")
@@ -1943,8 +2074,9 @@ class OSDDaemon:
                     int(self._map.get("epoch", prev[0] or 1)))
 
                 def put_primary():
-                    txn = Transaction().write_full(coll, req["oid"],
-                                                   req["data"])
+                    txn = Transaction().write_full(
+                        coll, req["oid"], req["data"],
+                        csums=req.get("_csums"), copy=False)
                     for ak, av in (req.get("attrs") or {}).items():
                         txn.setattr(coll, req["oid"], ak, av)
                     log.append_txn(txn, version, req["oid"])
@@ -1962,6 +2094,11 @@ class OSDDaemon:
                     if self._peer_req(peer, _trace.stamp({
                             "cmd": "put_shard", "coll": list(coll),
                             "oid": req["oid"], "data": req["data"],
+                            # the primary's verify-trusted csums fold
+                            # into the peer frame crc (no re-scan on
+                            # this send) and become the replica's
+                            # trusted handoff in turn
+                            "_csums": req.get("_csums"),
                             "klass": klass, "attrs": req.get("attrs"),
                             "log": {"version": list(version),
                                     "prev": list(prev)}})) is not None:

@@ -50,6 +50,12 @@ class Transaction:
 
     def __init__(self):
         self.ops: List[Tuple] = []
+        # trusted per-block csums riding ALONGSIDE the op list (a
+        # side table keyed (coll, oid), so stores that know nothing
+        # about checksums keep unpacking the same op tuples): the
+        # wire's one-pass verify scan hands its sub-crcs here and
+        # BlueStore._make_blob adopts them instead of re-scanning
+        self.csums: dict = {}
 
     def touch(self, coll: Coll, oid: str) -> "Transaction":
         self.ops.append((OP_TOUCH, coll, oid))
@@ -61,8 +67,24 @@ class Transaction:
         return self
 
     def write_full(self, coll: Coll, oid: str,
-                   data: bytes) -> "Transaction":
-        self.ops.append((OP_WRITE_FULL, coll, oid, bytes(data)))
+                   data: bytes, csums=None,
+                   copy: bool = True) -> "Transaction":
+        """``copy=False`` keeps ``data`` as the caller's buffer view
+        (zero-copy wire path — the view must stay immutable until the
+        transaction applies); the default snapshot stays for callers
+        handing in mutable buffers.  ``csums`` (common/crcutil.Csums
+        over exactly these bytes) marks them pre-verified."""
+        if copy and not isinstance(data, bytes):
+            data = bytes(data)
+        self.ops.append((OP_WRITE_FULL, coll, oid, data))
+        if csums is not None:
+            self.csums[(coll, oid)] = csums
+        else:
+            # a later uncsummed rewrite of the same oid must not
+            # adopt an earlier write's now-stale trusted csums (the
+            # store would commit valid bytes under wrong checksums
+            # and EIO every future read)
+            self.csums.pop((coll, oid), None)
         return self
 
     def truncate(self, coll: Coll, oid: str, size: int) -> "Transaction":

@@ -354,6 +354,42 @@ _TABLE: Tuple[Option, ...] = (
            "(integrity only, the reference's intra-cluster default), "
            "'secure' = sealed payloads",
            enum_values=("crc", "secure")),
+    Option("osd_cluster_wire_mode", TYPE_STR, "crc",
+           "data mode of intra-cluster daemon->daemon links "
+           "(replica sub-writes, recovery pushes — the reference's "
+           "ms_cluster_mode, independent of the client-facing "
+           "objecter_wire_mode): 'crc' keeps the one-pass "
+           "trusted-csum handoff at zlib speed, 'secure' seals peer "
+           "payloads",
+           enum_values=("crc", "secure")),
+    Option("wire_one_pass", TYPE_BOOL, True,
+           "ZeroWire one-pass integrity: scatter-gather frame crcs "
+           "are computed/verified as per-4KiB sub-crcs folded by "
+           "crc32_combine (wire values bit-identical to a whole-"
+           "payload crc32), and the receive-side verify scan's "
+           "sub-crcs flow to BlueStore as trusted blob csums — one "
+           "crc pass per byte per process instead of three on the "
+           "put path; off = the legacy whole-buffer scans (the "
+           "bench's 'before' lane)"),
+    Option("wire_zero_copy", TYPE_BOOL, True,
+           "ZeroWire buffer spine: bulk payloads move as memoryviews "
+           "end to end (SockReader hands out views, split_sg does "
+           "not materialize, _make_blob pwrites views) — off = the "
+           "legacy bytes() materializations, each COUNTED on "
+           "perf('wire.zero') so the bench can price copies/MiB"),
+    Option("wire_shm_ring_kib", TYPE_INT, 4096,
+           "shared-memory ring bytes (KiB) per client<->OSD stream "
+           "pool for the same-host lane (msg/shm_ring.py): bulk "
+           "payloads cross via mmap with only a doorbell on the "
+           "socket; 0 disables the lane (pure socket fallback, same "
+           "bytes on the wire)", min=0),
+    Option("wire_device_crc", TYPE_STR, "auto",
+           "batched crc32 as a GF(2) matmul next to the EC kernels "
+           "(ops/crc32_gf2.py) for shards already staged in HBM: "
+           "'auto' engages on accelerator backends only (a CPU "
+           "matmul loses to a zlib scan), 'on' forces it (bench/"
+           "test), 'off' always scans on host",
+           enum_values=("auto", "on", "off")),
     Option("osd_mclock_scheduler_client_res", TYPE_FLOAT, 0.2,
            "default dmClock RESERVATION for a per-tenant client "
            "class (reference osd_mclock_scheduler_client_res): the "

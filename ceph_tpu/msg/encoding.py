@@ -157,7 +157,9 @@ def _dec(buf: bytes, pos: int, depth: int) -> Tuple[Any, int]:
 
 
 def loads(buf: bytes) -> Any:
-    obj, pos = _dec(bytes(buf), 0, 0)
+    obj, pos = _dec(bytes(buf), 0, 0)  # noqa: CTL130 — typed metas
+    # are ~100 bytes; bulk payloads never pass through this decoder
+    # (they ride the scatter-gather frame tail / shm ring)
     if pos != len(buf):
         raise EncodingError(f"{len(buf) - pos} trailing bytes")
     return obj

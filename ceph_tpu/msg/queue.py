@@ -51,7 +51,13 @@ class Envelope:
     type: int
     id: int
     shard: int
-    payload: bytes
+    payload: bytes          # bytes, or a zero-copy memoryview over
+    #                         the receive buffer (wire.SockReader)
+    # trusted per-block sub-crcs from the wire's one-pass verify scan
+    # (common/crcutil.Csums) — present only on scatter-gather request
+    # frames received in crc mode; the store consumes them as blob
+    # csums without re-scanning the payload
+    csums: Optional[object] = None
 
 
 _U8P = ctypes.POINTER(ctypes.c_uint8)

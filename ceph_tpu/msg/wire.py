@@ -28,7 +28,7 @@ import time
 import zlib
 from typing import Optional
 
-from ..common import faults
+from ..common import crcutil, faults
 from .queue import Envelope
 
 # messenger-frame faultpoints (the qa msgr-failures suite axes): armed
@@ -74,6 +74,12 @@ MSG_REQ_SG = 0x13            # scatter-gather request: u32 metalen |
 MSG_SET_MODE = 0x14          # authenticated per-connection downgrade
 #                              to "crc" data mode (the reference's
 #                              ms_mode crc vs secure negotiation)
+MSG_SHM_ATTACH = 0x15        # same-host shared-memory ring handoff:
+#                              the client asks the daemon to map its
+#                              ring file; subsequent requests may then
+#                              carry payloads out-of-band with only a
+#                              doorbell (meta + ring extent + crc)
+#                              crossing the socket (msg/shm_ring.py)
 
 # per-connection data modes after the auth handshake (the reference's
 # ms_cluster_mode / ms_client_mode values, src/msg/msg_types.h):
@@ -95,6 +101,15 @@ class WireClosed(WireError):
     pass
 
 
+# cached ZeroWire config flags (common/crcutil.flag, observer-refreshed
+# — the hot path must not pay a layered-options lookup per frame):
+# wire_one_pass gates the sub-crc/combine integrity scan, wire_zero_copy
+# the buffer-view spine (both default True; the bench's "before" phases
+# flip them to price the legacy 3-pass/copying path against the same
+# daemons)
+_opt = crcutil.flag
+
+
 def _recv_exact(sock: socket.socket, n: int) -> bytes:
     # recv_into a preallocated buffer: bulk payloads land in place
     # (one allocation, no per-chunk copies) — on the multi-stream
@@ -107,7 +122,8 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
         if not r:
             raise WireClosed("peer closed")
         got += r
-    return bytes(buf)
+    return bytes(buf)  # noqa: CTL130 — pre-auth handshake frames
+    # only (banner/nonce/auth blobs): small and off the data path
 
 
 _IOV_MAX = 1024      # POSIX sysconf(_SC_IOV_MAX) floor; sendmsg with
@@ -130,30 +146,41 @@ def _sendmsg_all(sock: socket.socket, parts) -> None:
 
 def _frame_parts(env_type: int, env_id: int, shard: int, parts,
                  session_key: Optional[bytes],
-                 mode: str) -> list:
+                 mode: str, data_csums=None) -> list:
     """Assemble one frame as a buffer list: header | payload [| mac].
     Per-byte integrity is mode-priced the way the reference prices
     ms_mode: secure seals and MACs every payload byte; crc mode runs
     one crc32 pass (C speed) and binds the digest into the header,
     whose HMAC is then constant-cost — the payload never feeds SHA256,
     which is the difference between ~150 MiB/s and line rate on a
-    syscall-priced host.  Plaintext (no session key) is crc-only."""
-    if session_key is None:
-        crc = 0
-        for p in parts:
-            crc = zlib.crc32(p, crc)
-        total = sum(len(p) for p in parts)
-        hdr = _FHDR.pack(MAGIC, env_type, env_id, shard, total, crc)
-        return [hdr] + list(parts)
+    syscall-priced host.  Plaintext (no session key) is crc-only.
+
+    ``data_csums`` (a crcutil.Csums for the LAST part — the bulk data
+    segment) is the one-pass handoff: its combined sub-crcs are FOLDED
+    into the frame crc via crc32_combine, so a payload whose csums are
+    already known (device crc kernel, staging digest, content cache)
+    crosses the sender with ZERO crc scans.  The wire value is
+    bit-identical to a whole-payload zlib.crc32 — receivers cannot
+    tell the difference."""
     crc = 0
-    if mode == MODE_SECURE:
+    if session_key is not None and mode == MODE_SECURE:
         from ..common.auth import seal_parts
         parts = seal_parts(session_key, parts)
+    elif data_csums is not None and parts and \
+            data_csums.length == len(parts[-1]) and _opt("wire_one_pass"):
+        for p in parts[:-1]:
+            crc = zlib.crc32(p, crc)
+            crcutil.note_scan(len(p), "send")
+        crc = crcutil.crc32_combine(crc, data_csums.combined,
+                                    data_csums.length)
     else:
         for p in parts:
             crc = zlib.crc32(p, crc)
+            crcutil.note_scan(len(p), "send")
     total = sum(len(p) for p in parts)
     hdr = _FHDR.pack(MAGIC, env_type, env_id, shard, total, crc)
+    if session_key is None:
+        return [hdr] + list(parts)
     mac = hmac.new(session_key, hdr, "sha256")
     if mode == MODE_SECURE:
         for p in parts:
@@ -164,7 +191,8 @@ def _frame_parts(env_type: int, env_id: int, shard: int, parts,
 def prepare_frame(sock: socket.socket, env_type: int, env_id: int,
                   shard: int, parts,
                   session_key: Optional[bytes], mode: str,
-                  src: Optional[str], dst: Optional[str]) -> list:
+                  src: Optional[str], dst: Optional[str],
+                  data_csums=None) -> list:
     """Per-frame assembly with every wire faultpoint applied; returns
     the frame's buffer list WITHOUT sending it, so callers (the
     stream sender, the server's reply batching) can coalesce many
@@ -174,11 +202,12 @@ def prepare_frame(sock: socket.socket, env_type: int, env_id: int,
             faults.partitioned(src, dst):
         raise WireClosed(f"fault injected: {src} -> {dst} partitioned")
     blobs = _frame_parts(env_type, env_id, shard, parts,
-                         session_key, mode)
+                         session_key, mode, data_csums=data_csums)
     if faults.fire("wire.drop_frame", type=env_type) is not None:
         raise WireClosed("fault injected: frame dropped before send")
     if faults.fire("wire.truncate_frame", type=env_type) is not None:
-        whole = b"".join(bytes(p) for p in blobs)
+        whole = b"".join(bytes(p) for p in blobs)  # noqa: CTL130 —
+        # fault path only: the half-frame join never runs in production
         sock.sendall(whole[:max(1, len(whole) // 2)])
         raise WireClosed("fault injected: frame truncated mid-send")
     if faults.fire("wire.flip_bit", type=env_type) is not None:
@@ -197,10 +226,11 @@ def _send_parts(sock: socket.socket, env_type: int, env_id: int,
                 shard: int, parts,
                 session_key: Optional[bytes],
                 mode: str,
-                src: Optional[str], dst: Optional[str]) -> None:
+                src: Optional[str], dst: Optional[str],
+                data_csums=None) -> None:
     _sendmsg_all(sock, prepare_frame(sock, env_type, env_id, shard,
                                      parts, session_key, mode,
-                                     src, dst))
+                                     src, dst, data_csums=data_csums))
 
 
 def send_frame(sock: socket.socket, env: Envelope,
@@ -226,37 +256,118 @@ def send_frame_sg(sock: socket.socket, env_type: int, env_id: int,
                   session_key: Optional[bytes] = None,
                   src: Optional[str] = None,
                   dst: Optional[str] = None,
-                  mode: str = MODE_SECURE) -> None:
+                  mode: str = MODE_SECURE,
+                  data_csums=None) -> None:
     """Scatter-gather frame: typed-encoded ``meta`` plus a raw bulk
     ``data`` buffer shipped as separate segments of ONE frame
     (u32 metalen | meta | data), so multi-MB shard payloads go from
     their staging buffers to the socket without passing through the
     typed encoder or any intermediate join (crc mode: zero copies;
-    secure mode: single cipher+MAC pass via auth.seal_parts)."""
+    secure mode: single cipher+MAC pass via auth.seal_parts).
+    ``data_csums`` (crcutil.Csums of ``data``) folds precomputed
+    sub-crcs into the frame crc instead of re-scanning."""
     _send_parts(sock, env_type, env_id, -1,
                 [_U32.pack(len(meta)), meta, data],
-                session_key, mode, src, dst)
+                session_key, mode, src, dst, data_csums=data_csums)
 
 
-def split_sg(payload: bytes):
-    """Inverse of the SG payload layout: -> (meta_bytes, data_bytes)."""
-    mv = memoryview(payload)
+def split_sg(payload):
+    """Inverse of the SG payload layout: -> (meta_bytes, data).
+
+    ``data`` is a zero-copy memoryview over the received frame buffer
+    (the buffer stays alive as long as the view does — Python buffer
+    semantics carry the lifetime); the meta prefix is materialized
+    because the typed decoder wants bytes and it is ~100 bytes.  With
+    ``wire_zero_copy`` off the legacy whole-payload copy runs and is
+    COUNTED (copies/MiB in the bench decomposition)."""
+    mv = crcutil.as_u8(payload)
     if len(mv) < 4:
         raise WireError("SG frame truncated")
     (mlen,) = _U32.unpack_from(mv, 0)
     if 4 + mlen > len(mv):
         raise WireError("SG meta length exceeds frame")
-    return bytes(mv[4:4 + mlen]), bytes(mv[4 + mlen:])
+    data = mv[4 + mlen:]
+    if not _opt("wire_zero_copy"):
+        crcutil.note_copy(len(data), "split_sg")
+        data = bytes(data)  # noqa: CTL130 — the counted legacy path
+    return bytes(mv[4:4 + mlen]), data
 
 
-def _parse_frame(hdr: bytes, payload: bytes, mac: Optional[bytes],
+# bulk payloads at/above this ride a scatter-gather frame: below it
+# the typed encoder re-buffers anyway and the SG framing overhead
+# dominates.  ONE constant shared by both senders (the async
+# objecter's client streams and the daemon's peer client) — the
+# zero-copy view contract relies on every sender agreeing on it.
+SG_MIN = 1024
+
+
+def extract_bulk(req, site: str):
+    """Split a bulk ``data`` payload (and its precomputed ``_csums``)
+    out of a request dict for the scatter-gather frame tail; returns
+    (req, data|None, csums|None).  Zero-copy: the payload buffer
+    (bytes, bytearray or memoryview — staged numpy shards arrive as
+    views) goes to the frame assembly UNTOUCHED; with
+    ``wire_zero_copy`` off the legacy materialization runs and is
+    COUNTED at ``site``.  Sub-SG_MIN payloads ride the typed encoder
+    (memoryviews materialized — tiny by definition) and drop their
+    ``_csums`` (not wire-encodable, and the scan saved is tiny)."""
+    payload = req.get("data") if isinstance(req, dict) else None
+    if isinstance(payload, (bytes, bytearray, memoryview)) and \
+            len(payload) >= SG_MIN:
+        req = dict(req)
+        data = req.pop("data")
+        csums = req.pop("_csums", None)
+        if not _opt("wire_zero_copy") and not isinstance(data, bytes):
+            crcutil.note_copy(len(data), site)
+            data = bytes(data)  # noqa: CTL130 — counted legacy path
+        return req, data, csums
+    if isinstance(req, dict) and ("_csums" in req or
+                                  isinstance(payload, memoryview)):
+        req = dict(req)
+        req.pop("_csums", None)
+        if isinstance(payload, memoryview):
+            req["data"] = bytes(payload)  # noqa: CTL130 — sub-SG_MIN
+            # payloads ride the typed encoder, which re-buffers
+            # anyway (tiny by definition)
+    return req, None, None
+
+
+def _parse_frame(hdr: bytes, payload, mac: Optional[bytes],
                  session_key: Optional[bytes],
                  mode: str) -> Envelope:
     """Verify one received frame (crc / MAC / unseal) — shared by the
-    raw-socket recv_frame and the buffered SockReader."""
+    raw-socket recv_frame and the buffered SockReader.
+
+    One-pass integrity (ZeroWire): for a scatter-gather request the
+    verify scan runs per 4-KiB sub-block of the data segment and the
+    sub-crcs are COMBINED (crc32_combine) against the header crc —
+    same accept/reject verdict as a whole-payload crc32, but the
+    sub-crcs survive the verify as TRUSTED values on the returned
+    envelope, which the daemon hands to BlueStore as ready-made blob
+    csums: the store never scans payload bytes again."""
     magic, typ, mid, shard, ln, crc = _FHDR.unpack(hdr)
-    if crc and zlib.crc32(payload) != crc:
-        raise WireError("payload crc mismatch")
+    csums = None
+    if crc and typ == MSG_REQ_SG and _opt("wire_one_pass"):
+        mv = crcutil.as_u8(payload)
+        if len(mv) < 4:
+            raise WireError("payload crc mismatch")
+        (mlen,) = _U32.unpack_from(mv, 0)
+        dstart = 4 + mlen
+        if dstart > len(mv):
+            raise WireError("payload crc mismatch")
+        head_crc = zlib.crc32(mv[:dstart])
+        crcutil.note_scan(dstart, "verify")
+        csums = crcutil.Csums.scan(mv[dstart:],
+                                   block=crcutil.CSUM_BLOCK,
+                                   site="verify")
+        got = crcutil.crc32_combine(head_crc, csums.combined,
+                                    csums.length)
+        if got != crc:
+            raise WireError("payload crc mismatch")
+    elif crc:
+        if zlib.crc32(payload) != crc:
+            raise WireError("payload crc mismatch")
+        crcutil.note_scan(len(payload), "verify")
     if session_key is not None:
         # the MAC covers the header always (which binds the crc field,
         # hence the payload, in crc mode) and the payload bytes only
@@ -269,10 +380,12 @@ def _parse_frame(hdr: bytes, payload: bytes, mac: Optional[bytes],
         if mode == MODE_SECURE:
             from ..common.auth import AuthError, unseal
             try:
-                payload = unseal(session_key, payload)
+                payload = unseal(session_key, bytes(payload))  # noqa: CTL130
+                # — secure mode decrypts into fresh bytes by nature;
+                # zero-copy applies to the crc data mode
             except AuthError as e:
                 raise WireError(f"secure payload rejected: {e}")
-    return Envelope(typ, mid, shard, payload)
+    return Envelope(typ, mid, shard, payload, csums)
 
 
 def _check_hdr(hdr: bytes) -> int:
@@ -317,6 +430,12 @@ class SockReader:
     # 1 MiB frame cost four recvs before any byte was parsed
     CHUNK = 1 << 21
 
+    # payloads at/above this size take the DIRECT path: recv_into a
+    # dedicated exact-size buffer handed out as a zero-copy memoryview
+    # (no scratch->buf append, no _take materialization — the two
+    # avoidable copies the legacy reader charged every bulk byte)
+    BIG = 1 << 16
+
     def __init__(self, sock: socket.socket):
         self.sock = sock
         self._buf = bytearray()
@@ -326,6 +445,10 @@ class SockReader:
         # Starts small so the many control connections don't each pin
         # 2 MiB; the first bulk frame upgrades it to CHUNK for good.
         self._scratch = bytearray(1 << 16)
+        # a direct big-frame read interrupted by a socket timeout
+        # parks here and resumes on the next read_frame call (the
+        # buffered path gets the same resume property from _buf)
+        self._partial: Optional[tuple] = None
 
     def _avail(self) -> int:
         return len(self._buf) - self._pos
@@ -351,6 +474,18 @@ class SockReader:
             self._pos = 0
         return out
 
+    def _take_view(self, n: int):
+        """Zero-copy take: hand out a memoryview over the CURRENT
+        buffer and retire it (a bytearray with an exported buffer can
+        never be resized, so the reader starts a fresh one seeded
+        with the few bytes that followed this frame — those would
+        have been copied by their own _take anyway)."""
+        old = self._buf
+        view = memoryview(old)[self._pos:self._pos + n]
+        self._buf = bytearray(memoryview(old)[self._pos + n:])
+        self._pos = 0
+        return view
+
     def _frame_len(self, with_mac: bool) -> Optional[int]:
         """Total length of the next frame if its header is buffered
         (validates it), else None."""
@@ -371,17 +506,70 @@ class SockReader:
 
     def read_frame(self, session_key: Optional[bytes] = None,
                    mode: str = MODE_SECURE) -> Envelope:
-        """Blocking read of one frame (buffered)."""
+        """Blocking read of one frame (buffered; bulk payloads land
+        DIRECTLY in a dedicated buffer — one recv-side copy total,
+        handed out as a zero-copy view)."""
+        if self._partial is not None:
+            hdr, buf, got = self._partial
+            return self._finish_big(hdr, buf, got, session_key, mode)
         self._fill(_FHDR.size)
         total = self._frame_len(session_key is not None)
+        ln = total - _FHDR.size - \
+            (_MAC_LEN if session_key is not None else 0)
+        if ln >= self.BIG and _opt("wire_zero_copy"):
+            hdr = self._take(_FHDR.size)
+            buf = bytearray(ln)
+            mv = memoryview(buf)
+            have = min(self._avail(), ln)
+            if have:
+                mv[:have] = memoryview(self._buf)[
+                    self._pos:self._pos + have]
+                self._pos += have
+                if self._pos == len(self._buf):
+                    self._buf.clear()
+                    self._pos = 0
+            return self._finish_big(hdr, buf, have, session_key, mode)
         self._fill(total)
         return self._consume(session_key, mode)
+
+    def _finish_big(self, hdr: bytes, buf: bytearray, got: int,
+                    session_key: Optional[bytes],
+                    mode: str) -> Envelope:
+        """Drain the rest of a direct big-frame read; a socket timeout
+        parks the partial state for the next call (the stream reader's
+        idle/stall loop relies on resumability)."""
+        mv = memoryview(buf)
+        try:
+            while got < len(buf):
+                r = self.sock.recv_into(mv[got:])
+                if not r:
+                    raise WireClosed("peer closed")
+                got += r
+            mac = None
+            if session_key is not None:
+                self._fill(_MAC_LEN)
+        except socket.timeout:
+            self._partial = (hdr, buf, got)
+            raise
+        self._partial = None
+        if session_key is not None:
+            mac = self._take(_MAC_LEN)
+        return _parse_frame(hdr, mv, mac, session_key, mode)
 
     def _consume(self, session_key: Optional[bytes],
                  mode: str) -> Envelope:
         hdr = self._take(_FHDR.size)
         ln = _FHDR.unpack(hdr)[4]
-        payload = self._take(ln) if ln else b""
+        if ln >= self.BIG and _opt("wire_zero_copy"):
+            # whole frame already buffered (pipelined window): hand
+            # out a view instead of materializing the payload
+            payload = self._take_view(ln)
+        elif ln:
+            payload = self._take(ln)
+            if ln >= self.BIG:
+                crcutil.note_copy(ln, "reader")
+        else:
+            payload = b""
         mac = self._take(_MAC_LEN) if session_key is not None \
             else None
         return _parse_frame(hdr, payload, mac, session_key, mode)
@@ -429,7 +617,7 @@ class Stream:
     """
 
     def __init__(self, conn, mode: str = MODE_SECURE,
-                 window: int = 16):
+                 window: int = 16, ring=None):
         import queue as _queue
         from ..common.lockdep import LockdepLock
         self._conn = conn                  # owns the socket lifetime
@@ -438,6 +626,7 @@ class Stream:
         self.entity = conn.entity
         self.peer = getattr(conn, "peer", None)
         self.mode = MODE_SECURE
+        self.ring_ok = False
         self.dead = False
         # True while the sender thread is inside sendmsg: a full
         # window + a socket-blocked sender means the PEER is the
@@ -459,6 +648,8 @@ class Stream:
                 pass
         if mode == MODE_CRC:
             self._negotiate_crc()
+        if ring is not None:
+            self._attach_ring(ring)
         self._sender = threading.Thread(
             target=self._sender_loop, daemon=True,
             name=f"stream-send-{self.peer}")
@@ -484,17 +675,39 @@ class Stream:
             raise WireError("mode negotiation rejected")
         self.mode = MODE_CRC
 
+    def _attach_ring(self, ring) -> None:
+        """Shared-memory lane negotiation (the session_hello-time
+        handoff): ask the daemon to map this client's ring file.  The
+        request and ack ride the authenticated connection, so only
+        the cephx-verified peer learns the path.  A daemon that
+        refuses (shm disabled, foreign path) leaves the stream on the
+        pure socket lane — fallback is per-stream and silent."""
+        from . import encoding
+        send_frame(self.sock, Envelope(
+            MSG_SHM_ATTACH, 0, -1,
+            encoding.dumps({"path": ring.path, "size": ring.size})),
+            session_key=self.key, src=self.entity, dst=self.peer,
+            mode=self.mode)
+        env = recv_frame(self.sock, session_key=self.key,
+                         mode=self.mode)
+        self.ring_ok = env.type == MSG_REPLY and \
+            bool(encoding.loads(bytes(env.payload)).get("ok"))
+
     # --------------------------------------------------------- submit --
     def inflight(self) -> int:
         with self._lock:
             return len(self._pending)
 
-    def submit(self, req_meta: bytes, data=None, cb=None) -> None:
+    def submit(self, req_meta: bytes, data=None, cb=None,
+               csums=None) -> None:
         """Queue one request frame (blocks only on the send window).
         ``req_meta`` is the typed-encoded request dict; ``data``, when
         given, rides the scatter-gather tail (MSG_REQ_SG) straight
-        from its buffer.  ``cb(result, exc)`` fires from the reader
-        thread on reply, or with the error that killed the stream."""
+        from its buffer; ``csums`` (crcutil.Csums of ``data``) lets
+        the sender fold precomputed sub-crcs into the frame crc
+        instead of re-scanning.  ``cb(result, exc)`` fires from the
+        reader thread on reply, or with the error that killed the
+        stream."""
         with self._lock:
             if self.dead:
                 raise WireClosed(f"stream to {self.peer} is dead")
@@ -508,7 +721,8 @@ class Stream:
         import queue as _q
         while True:
             try:
-                self._sendq.put((rid, req_meta, data), timeout=0.2)
+                self._sendq.put((rid, req_meta, data, csums),
+                                timeout=0.2)
                 return
             except _q.Full:
                 with self._lock:
@@ -516,7 +730,8 @@ class Stream:
                         raise WireClosed(
                             f"stream to {self.peer} died mid-submit")
 
-    def try_submit(self, req_meta: bytes, data=None, cb=None) -> bool:
+    def try_submit(self, req_meta: bytes, data=None, cb=None,
+                   csums=None) -> bool:
         """Non-blocking submit: False when the send window is full
         (the pool's spill signal — this sender is saturated)."""
         import queue as _q
@@ -527,7 +742,7 @@ class Stream:
             rid = self._id
             self._pending[rid] = (cb, time.monotonic())
         try:
-            self._sendq.put_nowait((rid, req_meta, data))
+            self._sendq.put_nowait((rid, req_meta, data, csums))
             return True
         except _q.Full:
             with self._lock:
@@ -559,7 +774,7 @@ class Stream:
                 pass
             try:
                 blobs: list = []
-                for rid, meta, data in batch:
+                for rid, meta, data, csums in batch:
                     if data is None:
                         typ, parts = MSG_REQ, [meta]
                     else:
@@ -567,7 +782,8 @@ class Stream:
                         parts = [_U32.pack(len(meta)), meta, data]
                     blobs.extend(prepare_frame(
                         self.sock, typ, rid, -1, parts, self.key,
-                        self.mode, self.entity, self.peer))
+                        self.mode, self.entity, self.peer,
+                        data_csums=csums))
                 self.sending = True
                 try:
                     _sendmsg_all(self.sock, blobs)
@@ -671,7 +887,8 @@ class StreamPool:
 
     def __init__(self, factory, size: int = 4,
                  mode: str = MODE_CRC, window: int = 16,
-                 name: str = ""):
+                 name: str = "", shm_dir: Optional[str] = None,
+                 shm_bytes: int = 0):
         from ..common.lockdep import LockdepLock
         self._factory = factory
         self.size = max(1, int(size))
@@ -680,6 +897,83 @@ class StreamPool:
         self.name = name
         self._lock = LockdepLock("wire.streampool", recursive=False)
         self._streams = []
+        # same-host shared-memory lane (msg/shm_ring.py): ONE ring
+        # per (client, daemon) pair shared by every stream of this
+        # pool — a resubmit on a fresh stream must still find the
+        # payload at the extents baked into the doorbell meta.  Built
+        # lazily with the first stream; any daemon refusal disables
+        # the lane for good (pure-socket fallback, no renegotiation
+        # churn).
+        self._shm_dir = shm_dir
+        self._shm_bytes = int(shm_bytes)
+        self._ring_obj = None
+        self._ring_dead = shm_bytes <= 0 or shm_dir is None
+        # True only after a stream's MSG_SHM_ATTACH was ACCEPTED: a
+        # doorbell baked into a frame before the verdict is known
+        # would turn an attach refusal into a hard op failure (the
+        # daemon cannot resolve it), so payloads ride the socket
+        # until the lane is proven up
+        self._ring_attached = False
+
+    def _ring(self):
+        with self._lock:
+            if self._ring_dead:
+                return None
+            if self._ring_obj is None:
+                try:
+                    from .shm_ring import ShmRing
+                    self._ring_obj = ShmRing.create(
+                        self._shm_dir, self.name, self._shm_bytes)
+                except OSError:
+                    self._ring_dead = True
+                    return None
+            return self._ring_obj
+
+    def _ensure_attach(self) -> None:
+        """Resolve the attach verdict BEFORE any doorbell is staged:
+        grow the first stream (whose construction runs the
+        MSG_SHM_ATTACH handshake synchronously) when none is live
+        yet.  Streams that already exist carry a verdict — attach
+        happens inside Stream.__init__, so 'live stream + not
+        attached' can only mean the daemon refused (lane dead)."""
+        with self._lock:
+            if self._ring_dead or self._ring_attached:
+                return
+            have = any(not s.dead for s in self._streams)
+        if not have:
+            try:
+                self._grow()
+            except (OSError, IOError):
+                pass          # daemon unreachable: submit will retry
+
+    def ring_put(self, data, csums=None):
+        """Stage one payload in the shared-memory ring; returns the
+        doorbell token (meta extent + crc) or None when the lane is
+        unavailable/full — the caller falls back to the socket
+        scatter-gather tail transparently.  Never stages before some
+        stream's attach handshake has been ACCEPTED: a doorbell baked
+        into a frame before the verdict would turn a refusal into a
+        hard op failure (the daemon cannot resolve it)."""
+        self._ensure_attach()
+        with self._lock:
+            if not self._ring_attached or self._ring_dead:
+                return None
+        ring = self._ring()
+        if ring is None:
+            return None
+        combined = csums.combined if (
+            csums is not None and csums.length == len(data)) else None
+        return ring.put(data, combined)
+
+    def ring_free(self, tok) -> None:
+        with self._lock:
+            ring = self._ring_obj
+        if ring is not None:
+            ring.free(tok)
+
+    def ring_live(self) -> bool:
+        with self._lock:
+            return self._ring_obj is not None and not self._ring_dead
 
     def _live(self) -> list:
         with self._lock:
@@ -689,12 +983,23 @@ class StreamPool:
     def _grow(self) -> Stream:
         # build outside the pool lock: the factory does wire RTTs
         st = Stream(self._factory(), mode=self.mode,
-                    window=self.window)
+                    window=self.window, ring=self._ring())
+        if self._ring() is not None:
+            with self._lock:
+                if st.ring_ok:
+                    self._ring_attached = True
+                else:
+                    # the daemon refused the mapping: disable the
+                    # lane (every stream of a pool must agree — a
+                    # doorbell routed to a ring-less connection
+                    # would error)
+                    self._ring_dead = True
         with self._lock:
             self._streams.append(st)
         return st
 
-    def submit(self, req_meta: bytes, data=None, cb=None) -> None:
+    def submit(self, req_meta: bytes, data=None, cb=None,
+               csums=None) -> None:
         """Fill-first with spill-on-backpressure: the frame goes to
         the FIRST live stream whose send window has room — frames
         concentrate on few streams (deep sender batches, few hot
@@ -711,7 +1016,8 @@ class StreamPool:
             try:
                 taken = False
                 for st in live:
-                    if st.try_submit(req_meta, data=data, cb=cb):
+                    if st.try_submit(req_meta, data=data, cb=cb,
+                                     csums=csums):
                         taken = True
                         break
                 if taken:
@@ -723,13 +1029,14 @@ class StreamPool:
                     # (A sender blocked INSIDE sendmsg means the
                     # peer is saturated — more connections to the
                     # same daemon add contention, not capacity.)
-                    self._grow().submit(req_meta, data=data, cb=cb)
+                    self._grow().submit(req_meta, data=data, cb=cb,
+                                        csums=csums)
                 else:
                     # every window full at the cap: block on the
                     # least-loaded sender until it drains
                     min(live,
                         key=lambda s: s.inflight()).submit(
-                            req_meta, data=data, cb=cb)
+                            req_meta, data=data, cb=cb, csums=csums)
                 return
             except (OSError, IOError) as e:
                 last = e
@@ -742,5 +1049,9 @@ class StreamPool:
     def close(self) -> None:
         with self._lock:
             streams, self._streams = self._streams, []
+            ring, self._ring_obj = self._ring_obj, None
+            self._ring_dead = True
         for s in streams:
             s.close()
+        if ring is not None:
+            ring.close(unlink=True)

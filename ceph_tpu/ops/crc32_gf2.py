@@ -1,0 +1,193 @@
+"""Batched CRC32 as a GF(2) matmul — the device crc kernel (ZeroWire).
+
+CRC32 over a fixed-length block is an AFFINE map over GF(2): for a
+block of B bytes viewed as a bit vector m in GF(2)^(8B),
+
+    crc(m) = A @ m  ^  c        (A: 32 x 8B over GF(2), c = crc(0^B))
+
+which puts per-block wire checksums on the same hardware path as the
+erasure-code contraction (ops/xor_kernel.py's region-XOR matmuls —
+PAPERS 2108.02692's program-optimization framing: integrity folded
+into the GF(2) algebra the kernels already run).  A batch of N staged
+blocks is ONE [N, 8B] @ [8B, 32] matmul — no host scan at all when
+the shards already sit in HBM.
+
+The matrix is built from the crc's own algebra, not 8B brute-force
+scans: column (p, b) — bit b of byte p — equals Z^(B-1-p) @ L0[b],
+where L0[b] is the linear crc of the single byte (1<<b) and Z is the
+advance-one-zero-byte operator (common/crcutil's combine matrix), so
+construction is an O(B) table walk.
+
+On CPU backends the matmul costs more than a zlib scan — callers gate
+on :func:`device_worthwhile` (TPU/GPU backends) or pass small batches
+for equivalence testing; the NumPy oracle :func:`crc32_blocks_np`
+validates the jax path bit-for-bit.
+"""
+from __future__ import annotations
+
+import zlib
+from typing import Dict, List, Sequence, Tuple
+
+import numpy as np
+
+from ..common import crcutil
+
+_M32 = 0xFFFFFFFF
+
+# block -> (A [8B, 32] uint8, affine const crc(0^B))
+_matrix_cache: Dict[int, Tuple[np.ndarray, int]] = {}
+
+
+def crc_matrix(block: int) -> Tuple[np.ndarray, int]:
+    """The affine map of crc32 over ``block``-byte messages:
+    (A [8*block, 32] uint8 over GF(2), c = crc32 of the zero block).
+    Row 8p+b of A is the crc image of bit b of byte p."""
+    hit = _matrix_cache.get(block)
+    if hit is not None:
+        return hit
+    if block <= 0:
+        raise ValueError(f"block must be positive, got {block}")
+    z0 = zlib.crc32(b"\x00")
+    base = [zlib.crc32(bytes([1 << b])) ^ z0 for b in range(8)]
+    z1 = crcutil._zero_op(1)           # advance one zero byte
+
+    def _adv(v: int) -> int:
+        return (z1[0][v & 0xFF] ^ z1[1][(v >> 8) & 0xFF] ^
+                z1[2][(v >> 16) & 0xFF] ^ z1[3][v >> 24])
+
+    cols = np.zeros((8 * block,), dtype=np.uint32)
+    cur = list(base)
+    for p in range(block - 1, -1, -1):
+        for b in range(8):
+            cols[8 * p + b] = cur[b]
+        cur = [_adv(v) for v in cur]
+    # unpack each column's 32 output bits -> [8B, 32] uint8
+    bits = ((cols[:, None] >> np.arange(32, dtype=np.uint32)[None, :])
+            & 1).astype(np.uint8)
+    const = zlib.crc32(b"\x00" * block)
+    _matrix_cache[block] = (bits, const)
+    return bits, const
+
+
+def _block_bits_np(blocks: np.ndarray) -> np.ndarray:
+    """[N, B] uint8 -> [N, 8B] bit planes, bit b of byte p at 8p+b
+    (matching crc_matrix's row order)."""
+    a = np.ascontiguousarray(blocks, dtype=np.uint8)
+    return np.unpackbits(a, axis=-1, bitorder="little")
+
+
+def crc32_blocks_np(blocks: np.ndarray) -> np.ndarray:
+    """NumPy oracle: crc32 of each row of ``blocks`` [N, B] uint8."""
+    a = np.ascontiguousarray(blocks, dtype=np.uint8)
+    if a.ndim != 2:
+        raise ValueError("blocks must be [N, B]")
+    A, const = crc_matrix(a.shape[1])
+    bits = _block_bits_np(a).astype(np.int64)
+    out_bits = (bits @ A.astype(np.int64)) & 1
+    vals = (out_bits.astype(np.uint64)
+            << np.arange(32, dtype=np.uint64)[None, :]).sum(
+                axis=1).astype(np.uint32)
+    return vals ^ np.uint32(const)
+
+
+# -------------------------------------------------------------- device ---
+
+_jit_cache: Dict[int, object] = {}
+
+
+def _device_fn(block: int):
+    """jit'd [N, B] uint8 -> [N] uint32 crc kernel for one block size
+    (the GF(2) matmul; shape-cached like the EC kernels)."""
+    fn = _jit_cache.get(block)
+    if fn is not None:
+        return fn
+    import jax
+    import jax.numpy as jnp
+    A, const = crc_matrix(block)
+    A_dev = jnp.asarray(A.astype(np.int32))
+
+    @jax.jit
+    def kern(blocks):
+        b = blocks.astype(jnp.uint8)
+        shifts = jnp.arange(8, dtype=jnp.uint8)
+        bits = ((b[..., None] >> shifts) & 1).astype(jnp.int32)
+        bits = bits.reshape(bits.shape[0], -1)       # [N, 8B]
+        out = jnp.matmul(bits, A_dev) & 1            # GF(2) matmul
+        weights = (jnp.uint32(1) <<
+                   jnp.arange(32, dtype=jnp.uint32))
+        vals = jnp.sum(out.astype(jnp.uint32) * weights, axis=1,
+                       dtype=jnp.uint32)
+        return vals ^ jnp.uint32(const)
+
+    _jit_cache[block] = kern
+    return kern
+
+
+def crc32_blocks(blocks, block: int = crcutil.CSUM_BLOCK) -> np.ndarray:
+    """Device-batched crc32 of ``blocks`` ([N, block] uint8, device or
+    host array): ONE GF(2) matmul dispatch for the whole batch."""
+    import jax.numpy as jnp
+    arr = jnp.asarray(blocks, dtype=jnp.uint8)
+    if arr.ndim != 2 or arr.shape[1] != block:
+        raise ValueError(f"blocks must be [N, {block}]")
+    out = _device_fn(block)(arr)
+    vals = np.asarray(out).astype(np.uint32)
+    _counters_inc(int(arr.shape[0]) * block)
+    return vals
+
+
+def device_worthwhile() -> bool:
+    """True when the default jax backend is an accelerator — the
+    matmul beats a host zlib scan there; on CPU backends it does not
+    (``wire_device_crc`` option: auto/on/off)."""
+    try:
+        import jax
+        return jax.default_backend() not in ("cpu",)
+    except Exception:
+        return False
+
+
+def _counters_inc(nbytes: int) -> None:
+    from ..common.perf_counters import perf
+    pc = perf("wire.zero")
+    pc.inc("device_crc_dispatches")
+    pc.inc("device_crc_bytes", int(nbytes))
+
+
+def csums_for(buf, block: int = crcutil.CSUM_BLOCK) -> crcutil.Csums:
+    """One buffer's Csums with the full blocks crc'd ON DEVICE (one
+    matmul) and only the sub-block tail scanned by the host — zero
+    host passes over the aligned payload body."""
+    return csums_many([buf], block=block)[0]
+
+
+def csums_many(bufs: Sequence, block: int = crcutil.CSUM_BLOCK
+               ) -> List[crcutil.Csums]:
+    """Batched Csums for many buffers: every full block across every
+    buffer rides ONE device dispatch; tails (len % block) fall back to
+    a host scan (counted, negligible)."""
+    views = [crcutil.as_u8(np.ascontiguousarray(buf)
+                           if isinstance(buf, np.ndarray) else buf)
+             for buf in bufs]
+    stacked: List[np.ndarray] = []
+    spans: List[Tuple[int, int]] = []     # (first_row, n_rows) per buf
+    row = 0
+    for mv in views:
+        n_full = len(mv) // block
+        if n_full:
+            stacked.append(np.frombuffer(
+                mv[:n_full * block], dtype=np.uint8).reshape(
+                    n_full, block))
+        spans.append((row, n_full))
+        row += n_full
+    full_crcs = (crc32_blocks(np.concatenate(stacked, axis=0), block)
+                 if stacked else np.zeros((0,), dtype=np.uint32))
+    out: List[crcutil.Csums] = []
+    for mv, (first, n_full) in zip(views, spans):
+        subs = [int(c) for c in full_crcs[first:first + n_full]]
+        tail = mv[n_full * block:]
+        if len(tail):
+            subs.append(zlib.crc32(tail))
+            crcutil.note_scan(len(tail), "device_tail")
+        out.append(crcutil.Csums(block, subs, len(mv)))
+    return out

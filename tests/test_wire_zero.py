@@ -1,0 +1,643 @@
+"""ZeroWire (ISSUE 15) — one-pass integrity, zero-copy spine, shm lane.
+
+What this file proves, falsifiably:
+
+  * the crc32 combine algebra matches zlib over random splits
+    (including empty and 1-byte parts) and the one-pass sub-crc scan
+    is bit-identical to the legacy three-pass values;
+  * the device crc kernel (GF(2) matmul) agrees with zlib per block;
+  * frame crcs are BIT-IDENTICAL between the one-pass/combine path
+    and a legacy whole-payload scan — the wire format never changed;
+  * BlueStore actually USES the trusted csums (wrong csums ⇒ EIO on
+    read — the handoff is load-bearing, not decorative), and the
+    deferred-write read-merge no longer re-verifies blocks the write
+    fully covers;
+  * over live daemons: sync / async / shm-lane puts and gets are
+    byte-identical; the shm ring negotiates and moves the payload
+    bytes; the store performs ZERO crc scans on the put path; a
+    daemon kill9 mid-ring falls back with no acked-write loss; a
+    ``wire.flip_bit`` fired in the ring is rejected exactly like the
+    socket path.
+"""
+import os
+import random
+import socket
+import threading
+import time
+import zlib
+
+import pytest
+
+from ceph_tpu.common import crcutil, faults
+from ceph_tpu.common.perf_counters import perf
+from ceph_tpu.msg import encoding, wire
+
+
+# ------------------------------------------------------- combine algebra ---
+
+def test_crc32_combine_matches_zlib_over_random_splits():
+    rng = random.Random(7)
+    for _ in range(150):
+        n = rng.randrange(0, 6000)
+        data = os.urandom(n)
+        cut = rng.randrange(0, n + 1)
+        a, b = data[:cut], data[cut:]
+        got = crcutil.crc32_combine(zlib.crc32(a), zlib.crc32(b),
+                                    len(b))
+        assert got == zlib.crc32(data)
+    # edge cases: empty parts, 1-byte parts
+    assert crcutil.crc32_combine(0, 0, 0) == 0
+    assert crcutil.crc32_combine(zlib.crc32(b"x"), 0, 0) == \
+        zlib.crc32(b"x")
+    assert crcutil.crc32_combine(zlib.crc32(b""), zlib.crc32(b"y"),
+                                 1) == zlib.crc32(b"y")
+    assert crcutil.crc32_combine(zlib.crc32(b"x"), zlib.crc32(b"y"),
+                                 1) == zlib.crc32(b"xy")
+
+
+def test_one_pass_scan_equals_legacy_three_pass():
+    """Property: over random buffers and block sizes, ONE scan yields
+    exactly the values the legacy path computed in three — per-block
+    sub-crcs (the blob csums), and the combined whole-buffer crc (the
+    frame crc / staging digest)."""
+    rng = random.Random(13)
+    for _ in range(60):
+        n = rng.randrange(0, 40000)
+        block = rng.choice([1, 3, 512, 4096, 65536])
+        data = os.urandom(n)
+        cs = crcutil.Csums.scan(data, block=block)
+        assert cs.combined == zlib.crc32(data)
+        assert cs.subs == [zlib.crc32(data[o:o + block])
+                           for o in range(0, n, block)]
+        assert cs.length == n
+        # reconstruction from parts alone (no rescan)
+        assert crcutil.Csums(block, cs.subs, n).combined == \
+            cs.combined
+
+
+def test_combine_series_folds_in_order():
+    parts = [os.urandom(n) for n in (0, 1, 4096, 777, 0, 9000)]
+    crc = crcutil.combine_series(
+        0, [zlib.crc32(p) for p in parts], [len(p) for p in parts])
+    assert crc == zlib.crc32(b"".join(parts))
+
+
+# ------------------------------------------------------ device crc kernel ---
+
+def test_device_crc_matmul_matches_zlib():
+    from ceph_tpu.ops import crc32_gf2
+    import numpy as np
+    rng = np.random.default_rng(3)
+    for block in (1, 64, 512):
+        blocks = rng.integers(0, 256, (6, block), dtype=np.uint8)
+        want = np.array([zlib.crc32(row.tobytes()) for row in blocks],
+                        dtype=np.uint32)
+        assert (crc32_gf2.crc32_blocks_np(blocks) == want).all()
+        assert (crc32_gf2.crc32_blocks(blocks, block=block)
+                == want).all()
+
+
+def test_device_csums_many_with_tails():
+    from ceph_tpu.ops import crc32_gf2
+    bufs = [os.urandom(n) for n in (0, 100, 512, 5000, 1536)]
+    for buf, cs in zip(bufs, crc32_gf2.csums_many(bufs, block=512)):
+        assert cs.combined == zlib.crc32(buf)
+        assert cs.subs == [zlib.crc32(buf[o:o + 512])
+                           for o in range(0, len(buf), 512)]
+
+
+def test_staged_csums_device_mode_wiring():
+    """The flush path's csum source honors wire_device_crc: 'on'
+    routes through the GF(2) matmul kernel, 'off' through the host
+    scan — identical values either way (flush attaches them to the
+    put_shard frames, so a divergence would corrupt stores)."""
+    import numpy as np
+    from ceph_tpu.client.remote import _staged_csums
+    from ceph_tpu.common.options import config
+    rng = np.random.default_rng(5)
+    arrs = [rng.integers(0, 256, n, dtype=np.uint8)
+            for n in (8192, 4096 * 3 + 7, 100)]
+    for mode in ("on", "off"):
+        config().set("wire_device_crc", mode)
+        try:
+            for arr, cs in zip(arrs, _staged_csums(arrs)):
+                assert cs.combined == zlib.crc32(arr.tobytes()), mode
+                assert cs.block == crcutil.CSUM_BLOCK
+        finally:
+            config().clear("wire_device_crc")
+
+
+# ------------------------------------------------------------ wire frames ---
+
+def test_frame_crc_bit_identical_one_pass_vs_legacy():
+    """The wire format is unchanged: a frame assembled from
+    precomputed sub-crcs (combine path) is byte-for-byte the frame a
+    whole-payload zlib scan produces."""
+    key = os.urandom(32)
+    meta = encoding.dumps({"cmd": "put_shard"})
+    data = os.urandom(37 * 1024 + 5)
+    parts = [wire._U32.pack(len(meta)), meta, data]
+    cs = crcutil.Csums.scan(data)
+    legacy = wire._frame_parts(wire.MSG_REQ_SG, 5, -1, list(parts),
+                               key, wire.MODE_CRC)
+    onepass = wire._frame_parts(wire.MSG_REQ_SG, 5, -1, list(parts),
+                                key, wire.MODE_CRC, data_csums=cs)
+    assert [bytes(p) for p in legacy] == [bytes(p) for p in onepass]
+
+
+def _sg_roundtrip(data, key, mode=wire.MODE_CRC):
+    a, b = socket.socketpair()
+    try:
+        meta = encoding.dumps({"cmd": "put_shard", "oid": "x"})
+        rd = wire.SockReader(b)
+        out = {}
+
+        def reader():
+            try:
+                out["env"] = rd.read_frame(session_key=key, mode=mode)
+            except Exception as e:          # surfaced by the caller
+                out["env"] = e
+        t = threading.Thread(target=reader)
+        t.start()
+        wire.send_frame_sg(a, wire.MSG_REQ_SG, 1, meta, data,
+                           session_key=key, mode=mode)
+        t.join(20)
+        return meta, out["env"]
+    finally:
+        a.close()
+        b.close()
+
+
+def test_sg_receive_one_pass_csums_and_zero_copy_views():
+    key = os.urandom(32)
+    data = os.urandom(200 * 1024 + 77)
+    meta, env = _sg_roundtrip(data, key)
+    assert env.type == wire.MSG_REQ_SG
+    m2, d2 = wire.split_sg(env.payload)
+    assert m2 == meta
+    assert isinstance(d2, memoryview) and bytes(d2) == data
+    cs = env.csums
+    assert cs is not None and cs.block == crcutil.CSUM_BLOCK
+    assert cs.combined == zlib.crc32(data)
+    assert cs.subs == [zlib.crc32(data[o:o + 4096])
+                       for o in range(0, len(data), 4096)]
+
+
+def test_sg_flip_bit_still_rejected():
+    key = os.urandom(32)
+    faults.arm("wire.flip_bit", mode="always", count=1)
+    try:
+        _meta, env = _sg_roundtrip(os.urandom(96 * 1024), key)
+    finally:
+        faults.disarm("wire.flip_bit")
+    assert isinstance(env, wire.WireError)
+
+
+def test_legacy_flags_reproduce_old_behavior():
+    """wire_one_pass/zero_copy off: payload arrives as bytes, no
+    csums on the envelope, and the copies are COUNTED."""
+    from ceph_tpu.common.options import config
+    key = os.urandom(32)
+    data = os.urandom(128 * 1024)
+    config().set("wire_one_pass", False)
+    config().set("wire_zero_copy", False)
+    try:
+        c0 = perf("wire.zero").dump().get("copy_bytes", 0)
+        _meta, env = _sg_roundtrip(data, key)
+        assert env.csums is None
+        _m, d2 = wire.split_sg(env.payload)
+        assert isinstance(d2, bytes) and d2 == data
+        assert perf("wire.zero").dump().get("copy_bytes", 0) > c0
+    finally:
+        config().clear("wire_one_pass")
+        config().clear("wire_zero_copy")
+
+
+# -------------------------------------------------- store trusted csums ---
+
+def test_bluestore_uses_trusted_csums_falsifiably(tmp_path):
+    """Right csums: write + read round-trips with ZERO store scans.
+    WRONG csums: the store records them verbatim and the next read
+    FAILS the checksum — proof the handoff is used, not re-derived."""
+    from ceph_tpu.cluster.bluestore import BlueStore
+    from ceph_tpu.cluster.objectstore import ChecksumError, Transaction
+    st = BlueStore(str(tmp_path / "s"), device_bytes=64 << 20,
+                   fsync=False)
+    data = os.urandom(3 * 4096 + 100)
+    cs = crcutil.Csums.scan(data)
+    s0 = perf("wire.zero").dump().get("scan_store_bytes", 0)
+    st.apply_transaction(Transaction().write_full(
+        (1, 0), "good", data, csums=cs, copy=False))
+    assert perf("wire.zero").dump().get("scan_store_bytes", 0) == s0, \
+        "store re-scanned bytes that arrived with trusted csums"
+    assert st.read((1, 0), "good") == data
+    bad = crcutil.Csums(4096, [c ^ 0xDEAD for c in cs.subs],
+                        len(data))
+    st.apply_transaction(Transaction().write_full(
+        (1, 0), "bad", data, csums=bad, copy=False))
+    with pytest.raises(ChecksumError):
+        st.read((1, 0), "bad")
+    # geometry mismatch (wrong block size) falls back to the scan
+    odd = crcutil.Csums(1024, [0], 1024)
+    st.apply_transaction(Transaction().write_full(
+        (1, 0), "odd", data, csums=odd, copy=False))
+    assert st.read((1, 0), "odd") == data
+    st.close()
+
+
+def test_rewrite_without_csums_drops_stale_trusted(tmp_path):
+    """A later uncsummed write_full of the SAME oid in one txn must
+    not adopt the earlier write's trusted csums — the store would
+    commit valid bytes under wrong checksums and EIO every read."""
+    from ceph_tpu.cluster.bluestore import BlueStore
+    from ceph_tpu.cluster.objectstore import Transaction
+    st = BlueStore(str(tmp_path / "s"), device_bytes=64 << 20,
+                   fsync=False)
+    a = os.urandom(2 * 4096)
+    b = os.urandom(2 * 4096)            # same length, different bytes
+    txn = Transaction()
+    txn.write_full((1, 0), "o", a, csums=crcutil.Csums.scan(a),
+                   copy=False)
+    txn.write_full((1, 0), "o", b)      # rewrite, no csums
+    st.apply_transaction(txn)
+    assert st.read((1, 0), "o") == b    # was: ChecksumError
+    st.close()
+
+
+def test_deferred_merge_skips_fully_covered_blocks(tmp_path):
+    """The read-back double-verify fix: a deferred overwrite that
+    fully covers a stored block no longer reads (and re-crcs) the
+    doomed bytes — a corrupt block that is wholly overwritten heals
+    instead of EIO-ing the write path."""
+    from ceph_tpu.cluster.bluestore import BlueStore
+    from ceph_tpu.cluster.objectstore import Transaction
+    st = BlueStore(str(tmp_path / "s"), device_bytes=64 << 20,
+                   fsync=False)
+    base = os.urandom(3 * 4096)
+    st.apply_transaction(Transaction().write_full((1, 0), "o", base))
+    # corrupt the MIDDLE stored block (device bytes now fail csum)
+    st.corrupt((1, 0), "o", offset=4096 + 10)
+    new_block = os.urandom(4096)
+    txn = Transaction()
+    txn.write((1, 0), "o", 4096, new_block)     # fully covers block 1
+    st.apply_transaction(txn)                   # legacy: ChecksumError
+    want = base[:4096] + new_block + base[2 * 4096:]
+    assert st.read((1, 0), "o") == want
+    # partial overwrites still verify the merged-in OLD bytes: a
+    # corrupt block the write only grazes surfaces as EIO, as before
+    st.corrupt((1, 0), "o", offset=10)
+    from ceph_tpu.cluster.objectstore import ChecksumError
+    with pytest.raises(ChecksumError):
+        txn2 = Transaction()
+        txn2.write((1, 0), "o", 100, b"z" * 50)  # partial block 0
+        st.apply_transaction(txn2)
+    st.close()
+
+
+def test_secure_mode_disables_shm_lane():
+    """objecter_wire_mode=secure promises sealed payloads: they must
+    never cross the plaintext mmap ring, whatever wire_shm_ring_kib
+    says."""
+    from ceph_tpu.cluster.async_objecter import AsyncObjecter
+    from ceph_tpu.common.options import config
+    config().set("objecter_wire_mode", "secure")
+    try:
+        ao = AsyncObjecter(object())
+        try:
+            assert ao.shm_bytes == 0
+        finally:
+            ao.close()
+    finally:
+        config().clear("objecter_wire_mode")
+
+
+def test_sweep_stale_reaps_only_dead_pid_rings(tmp_path):
+    import subprocess
+    from ceph_tpu.msg import shm_ring
+    d = str(tmp_path)
+    p = subprocess.Popen(["true"])
+    p.wait()                            # reaped: pid provably dead
+    dead = os.path.join(d, f"zwring.osd.0.{p.pid}.abcd1234")
+    live = os.path.join(d, f"zwring.osd.1.{os.getpid()}.ffff0000")
+    other = os.path.join(d, "osd.0.sock")
+    for f in (dead, live, other):
+        open(f, "wb").close()
+    assert shm_ring.sweep_stale(d) == 1
+    assert not os.path.exists(dead)
+    assert os.path.exists(live) and os.path.exists(other)
+
+
+# ------------------------------------------------------------- shm ring ---
+
+def test_shm_ring_fallback_when_full_and_seqlock():
+    import tempfile
+    from ceph_tpu.msg.shm_ring import RingReader, ShmRing
+    d = tempfile.mkdtemp()
+    ring = ShmRing.create(d, "t", 256 << 10)
+    rdr = RingReader(ring.path, ring.size)
+    toks = []
+    while True:
+        tok = ring.put(b"Q" * 60_000)
+        if tok is None:
+            break                       # full -> socket fallback
+        toks.append(tok)
+    assert len(toks) >= 3
+    view, cs = rdr.read(toks[0].meta)
+    assert bytes(view) == b"Q" * 60_000
+    # freeing the oldest reopens space (ring reclaim)
+    ring.free(toks[0])
+    assert ring.put(b"R" * 50_000) is not None
+    # stale generation: the extent was reused -> seqlock rejects
+    with pytest.raises(wire.WireError):
+        rdr.read(toks[0].meta)
+    rdr.close()
+    ring.close(unlink=True)
+
+
+def test_shm_ring_exact_fill_is_full_not_empty():
+    """Regression: uniform records filling the ring EXACTLY leave the
+    alloc head equal to the tail — which must read as FULL (socket
+    fallback), not empty: the old path handed out offset 0 again and
+    overwrote the oldest in-flight record's seqlock header, poisoning
+    its already-sent doorbell."""
+    import tempfile
+    from ceph_tpu.msg.shm_ring import _REC, RingReader, ShmRing
+    d = tempfile.mkdtemp()
+    ln = 4096 - _REC.size               # whole record = 4096 aligned
+    ring = ShmRing.create(d, "t", 4 * 4096)
+    rdr = RingReader(ring.path, ring.size)
+    toks = [ring.put(bytes([i]) * ln) for i in range(4)]
+    assert all(t is not None for t in toks)
+    assert ring.put(b"X" * ln) is None, \
+        "exact-fill ring handed out an extent over a live record"
+    # every in-flight doorbell still resolves (nothing was clobbered)
+    for i, tok in enumerate(toks):
+        view, _cs = rdr.read(tok.meta)
+        assert bytes(view) == bytes([i]) * ln
+    ring.free(toks[0])                  # reclaim reopens the ring
+    assert ring.put(b"Y" * ln) is not None
+    rdr.close()
+    ring.close(unlink=True)
+
+
+# ------------------------------------------------------- live daemons ---
+
+N_OSDS = 2
+
+
+@pytest.fixture(scope="module")
+def live_cluster(tmp_path_factory):
+    from ceph_tpu.client.remote import RemoteCluster
+    from ceph_tpu.tools.vstart import Vstart, build_cluster_dir
+    d = str(tmp_path_factory.mktemp("zw") / "cluster")
+    build_cluster_dir(d, n_osds=N_OSDS, osds_per_host=1, fsync=False)
+    v = Vstart(d)
+    # fast heartbeats: the kill9 leg needs the mon to mark the victim
+    # down promptly so writes re-home during the outage
+    v.start(N_OSDS, hb_interval=0.5)
+    rc = RemoteCluster(d)
+    yield d, v, rc
+    rc.close()
+    v.stop()
+
+
+def _daemon_counters(d):
+    return crcutil.wire_zero_counters(d, N_OSDS, include_local=False)
+
+
+def test_sync_async_shm_byte_identity(live_cluster):
+    """The acceptance matrix: puts via the blocking shim, the async
+    core (shm lane on), and the async core with the lane disabled
+    all read back byte-identical through both read paths."""
+    from ceph_tpu.common.options import config
+    d, v, rc = live_cluster
+    payloads = {f"idn{i}": os.urandom((1 << 20) + i * 1111)
+                for i in range(3)}
+    small = {f"idn-s{i}": os.urandom(600 + i) for i in range(3)}
+    payloads.update(small)
+
+    stored = {}
+    for i, (name, data) in enumerate(payloads.items()):
+        if i % 3 == 0:                    # blocking shim (osd_call)
+            rc.put(1, name, data)
+        elif i % 3 == 1:                  # async completion, shm on
+            rc.aio_put(1, name, data).get_return_value()
+        else:                             # async, lane disabled
+            config().set("wire_shm_ring_kib", 0)
+            try:
+                rc.aio_put(1, name, data).get_return_value()
+            finally:
+                config().clear("wire_shm_ring_kib")
+        stored[name] = data
+    for name, data in stored.items():
+        assert rc.get(1, name) == data
+        assert rc.aio_get(1, name).get_return_value() == data
+
+
+def test_shm_lane_negotiates_and_moves_bytes(live_cluster):
+    d, v, rc = live_cluster
+    c0 = perf("wire.zero").dump()
+    d0 = _daemon_counters(d)
+    data = os.urandom(2 << 20)
+    rc.put(1, "shmmove", data)
+    assert rc.get(1, "shmmove") == data
+    c1 = perf("wire.zero").dump()
+    d1 = _daemon_counters(d)
+    moved = c1.get("shm_bytes", 0) - c0.get("shm_bytes", 0)
+    served = d1.get("shm_bytes_served", 0) - \
+        d0.get("shm_bytes_served", 0)
+    assert moved >= len(data), (c0, c1)
+    assert served >= len(data), (d0, d1)
+
+
+def test_one_crc_pass_per_byte_and_store_never_scans(live_cluster):
+    """The headline contract over REAL daemons: with client csums
+    precomputed (the staged-in-HBM shape), the payload is scanned
+    EXACTLY once — the daemon's verify — and BlueStore adopts the
+    verified sub-crcs without a third pass."""
+    d, v, rc = live_cluster
+    data = os.urandom(4 << 20)
+    cs = crcutil.Csums.scan(data)       # stands in for the device crc
+    pool = rc.osdmap.pools[1]
+    pg = rc._pg_for(pool, "onepass")
+    tgt = [o for o in rc._up(pool, pg) if o >= 0][0]
+    d0 = _daemon_counters(d)
+    c0 = perf("wire.zero").dump()
+    assert rc.osd_call(tgt, {
+        "cmd": "put_shard", "coll": [1, pg], "oid": "0:onepass",
+        "data": data, "_csums": cs, "attrs": {}})
+    d1 = _daemon_counters(d)
+    c1 = perf("wire.zero").dump()
+    n = len(data)
+    verify = d1.get("scan_verify_bytes", 0) - \
+        d0.get("scan_verify_bytes", 0)
+    store = d1.get("scan_store_bytes", 0) - \
+        d0.get("scan_store_bytes", 0)
+    trusted = d1.get("trusted_csum_bytes", 0) - \
+        d0.get("trusted_csum_bytes", 0)
+    sent = c1.get("scan_send_bytes", 0) - c0.get("scan_send_bytes", 0)
+    assert verify >= n and verify < 1.05 * n + 65536, \
+        f"daemon verify scanned {verify} of {n}"
+    assert store == 0, f"store re-scanned {store} bytes"
+    assert trusted >= n
+    assert sent < 65536, \
+        f"client re-scanned {sent} bytes despite precomputed csums"
+
+
+def test_replicated_put_one_pass_through_replicas(live_cluster):
+    """The fan-out leg of the one-pass contract: a replicated put's
+    primary forwards its verify-trusted csums on the peer sub-write
+    (scatter-gather, crc mode), so the PRIMARY sends without a
+    re-scan and the REPLICA's single verify scan feeds its own store
+    — every process on the path pays exactly one pass, and no store
+    anywhere re-scans."""
+    d, v, rc = live_cluster
+    data = os.urandom(2 << 20)
+    n = len(data)
+    d0 = _daemon_counters(d)
+    rc.put(1, "repl1p", data)
+    time.sleep(0.3)
+    d1 = _daemon_counters(d)
+    verify = d1.get("scan_verify_bytes", 0) - \
+        d0.get("scan_verify_bytes", 0)
+    store = d1.get("scan_store_bytes", 0) - \
+        d0.get("scan_store_bytes", 0)
+    trusted = d1.get("trusted_csum_bytes", 0) - \
+        d0.get("trusted_csum_bytes", 0)
+    sent = d1.get("scan_send_bytes", 0) - d0.get("scan_send_bytes", 0)
+    assert verify >= 2 * n, "replica did not verify-scan its copy"
+    assert verify < 2.1 * n + 131072, \
+        f"more than one pass per process ({verify} for {2 * n})"
+    assert trusted >= 2 * n, "a store fell back to its own scan"
+    assert store == 0, f"a store re-scanned {store} bytes"
+    assert sent < 65536, \
+        f"the peer fan-out re-scanned {sent} bytes on send"
+
+
+def test_shm_kill9_falls_back_without_acked_write_loss(live_cluster):
+    """Chaos leg: daemon kill9 with the ring mid-flight — every
+    ACKED write must read back after revival (fallback/replay, never
+    loss), and the lane keeps working afterwards."""
+    d, v, rc = live_cluster
+    acked = {}
+    for i in range(4):
+        name = f"k9a{i}"
+        data = os.urandom(1 << 20)
+        rc.put(1, name, data)
+        acked[name] = data
+    victim = 0
+    v.kill9(f"osd.{victim}")
+    # writes during the outage: either they ack (rerouted/replayed)
+    # or they raise — only ACKED ones join the oracle
+    for i in range(4):
+        name = f"k9b{i}"
+        data = os.urandom(1 << 20)
+        try:
+            rc.put(1, name, data)
+        except (OSError, IOError):
+            continue
+        acked[name] = data
+    v.start_osd(victim)
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        try:
+            rc.refresh_map()
+            if rc.status()["n_up"] == N_OSDS:
+                break
+        except (OSError, IOError):
+            pass
+        time.sleep(0.5)
+    for i in range(3):                    # lane alive post-revival
+        name = f"k9c{i}"
+        data = os.urandom(1 << 20)
+        rc.put(1, name, data)
+        acked[name] = data
+    for name, data in acked.items():
+        got = None
+        for _ in range(20):
+            try:
+                got = rc.get(1, name)
+                break
+            except (OSError, IOError):
+                time.sleep(0.5)
+        assert got == data, f"acked write {name} lost after kill9"
+
+
+def test_flip_bit_in_ring_rejected_like_socket(live_cluster):
+    """A bit flipped INSIDE the shm ring record must be rejected by
+    the daemon's verify scan (connection drop), and the op must
+    complete correctly via the resend machinery — corrupt bytes are
+    never stored."""
+    d, v, rc = live_cluster
+    data = os.urandom(1 << 20)
+    fired0 = faults.fire_counts().get("wire.flip_bit", 0)
+    faults.arm("wire.flip_bit", mode="always", count=1,
+               match={"site": "shm_ring"})
+    try:
+        rc.put(1, "ringflip", data)
+    finally:
+        faults.disarm("wire.flip_bit")
+    assert faults.fire_counts().get("wire.flip_bit", 0) == fired0 + 1
+    assert rc.get(1, "ringflip") == data
+
+
+def test_malformed_shm_attach_is_refused_not_fatal(live_cluster):
+    """A garbage MSG_SHM_ATTACH blob (non-dict, bad size type) gets
+    the designed ok=False refusal — the connection survives and
+    keeps serving, it is never torn down with a traceback."""
+    from ceph_tpu.msg import encoding, wire
+    from ceph_tpu.msg.queue import Envelope
+    d, v, rc = live_cluster
+    conn = rc._stream_conn(0)
+    try:
+        for blob in ([1, 2, 3],                       # non-dict
+                     {"path": None, "size": None},    # bad types
+                     {"size": 4096}):                 # missing path
+            wire.send_frame(conn.sock, Envelope(
+                wire.MSG_SHM_ATTACH, 0, -1, encoding.dumps(blob)),
+                session_key=conn.key, src=conn.entity, dst=conn.peer)
+            env = wire.recv_frame(conn.sock, session_key=conn.key)
+            assert env.type == wire.MSG_REPLY
+            assert encoding.loads(bytes(env.payload)) == {"ok": False}
+        # same connection still serves ordinary requests
+        assert "osd" in conn.call({"cmd": "status"})
+    finally:
+        conn.close()
+
+
+def test_ring_disabled_pure_socket_fallback(live_cluster):
+    # the option is read at stream-pool creation: a FRESH client
+    # handle proves the pure-socket lane (the shared fixture client's
+    # pools legitimately keep their negotiated rings)
+    from ceph_tpu.client.remote import RemoteCluster
+    from ceph_tpu.common.options import config
+    d, v, rc = live_cluster
+    config().set("wire_shm_ring_kib", 0)
+    rc2 = RemoteCluster(d)
+    try:
+        c0 = perf("wire.zero").dump().get("shm_frames", 0)
+        data = os.urandom(1 << 20)
+        rc2.aio_put(1, "nosh", data).get_return_value()
+        assert rc2.get(1, "nosh") == data
+        assert perf("wire.zero").dump().get("shm_frames", 0) == c0
+    finally:
+        rc2.close()
+        config().clear("wire_shm_ring_kib")
+
+
+# ----------------------------------------------------------- CI smoke ---
+
+@pytest.mark.smoke
+def test_check_wire_smoke():
+    """scripts/check_wire.py end to end (the check_async pattern):
+    one crc pass per byte via the scan-counting hook, shm negotiation
+    on a vstart pair, TCP fallback with the ring disabled."""
+    import importlib.util
+    import pathlib
+    path = pathlib.Path(__file__).resolve().parents[1] / \
+        "scripts" / "check_wire.py"
+    spec = importlib.util.spec_from_file_location("check_wire",
+                                                  str(path))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    assert mod.main() == 0
