@@ -291,5 +291,143 @@ class TraceGapRule(Rule):
         return out
 
 
+_PERF_FACTORIES = frozenset(("perf", "_perf"))
+_PC_MUTATORS = frozenset(("inc", "set", "tinc", "hinc"))
+_HISTORY_MODULE = "mgr/metrics_history.py"
+
+
+def _perf_group_of(node: ast.AST) -> Optional[str]:
+    """The constant group name when ``node`` is ``perf("g")`` /
+    ``_perf("g")``, else None."""
+    if isinstance(node, ast.Call) and \
+            _send_name(node) in _PERF_FACTORIES and node.args and \
+            isinstance(node.args[0], ast.Constant) and \
+            isinstance(node.args[0].value, str):
+        return node.args[0].value
+    return None
+
+
+class RateCounterMonotonicRule(Rule):
+    rule_id = "CTL702"
+    name = "rate-counter-not-monotonic"
+    description = ("a perf counter the metrics-history rate layer "
+                   "queries (mgr/metrics_history.py RATE_COUNTERS) "
+                   "must be MONOTONIC at its declaration site: only "
+                   "``.inc()`` may ever touch it — a ``.set()`` "
+                   "retype feeds a gauge into the delta pipeline and "
+                   "every derived rate is silently garbage; each "
+                   "listed counter also needs at least one inc site "
+                   "(the declaration), or the history ring records "
+                   "nothing")
+
+    # ----------------------------------------------------- contract --
+    def _rate_pairs(self) -> Tuple[List[Tuple[str, str]],
+                                   Optional[ParsedModule], int]:
+        """The (group, key) pairs of the RATE_COUNTERS literal in
+        mgr/metrics_history.py, plus the module and the literal's
+        line (anchor for missing-inc findings)."""
+        for mod in self.program.modules.values():
+            if not mod.relpath.replace("\\", "/") \
+                    .endswith(_HISTORY_MODULE):
+                continue
+            for node in ast.walk(mod.tree):
+                if not (isinstance(node, ast.Assign) and
+                        len(node.targets) == 1 and
+                        isinstance(node.targets[0], ast.Name) and
+                        node.targets[0].id == "RATE_COUNTERS"):
+                    continue
+                pairs: List[Tuple[str, str]] = []
+                if isinstance(node.value, (ast.Tuple, ast.List)):
+                    for el in node.value.elts:
+                        if isinstance(el, (ast.Tuple, ast.List)) and \
+                                len(el.elts) == 2 and all(
+                                    isinstance(c, ast.Constant) and
+                                    isinstance(c.value, str)
+                                    for c in el.elts):
+                            pairs.append((el.elts[0].value,
+                                          el.elts[1].value))
+                return pairs, mod, node.lineno
+        return [], None, 0
+
+    # ------------------------------------------------------- bindings --
+    @staticmethod
+    def _attr_groups(mods: Iterable[ParsedModule]) -> Dict[str, str]:
+        """``self.X = _perf("g")`` sites across the tree: attribute
+        name -> group (the class-attr receiver shape, e.g. daemon.py
+        ``self._pc_io = _perf("osd.io")``)."""
+        out: Dict[str, str] = {}
+        for mod in mods:
+            for node in ast.walk(mod.tree):
+                if isinstance(node, ast.Assign) and \
+                        len(node.targets) == 1 and \
+                        isinstance(node.targets[0], ast.Attribute):
+                    g = _perf_group_of(node.value)
+                    if g is not None:
+                        out[node.targets[0].attr] = g
+        return out
+
+    def finish(self) -> Iterable[Finding]:
+        pairs, hist_mod, decl_line = self._rate_pairs()
+        if not pairs:
+            return ()
+        rate_set = set(pairs)
+        mods = [m for m in self.program.lint_modules()]
+        attr_groups = self._attr_groups(mods)
+        inc_seen: Set[Tuple[str, str]] = set()
+        out: List[Finding] = []
+        for mod in mods:
+            for fn, _cls in astutil.walk_functions(mod.tree):
+                local: Dict[str, str] = {}
+                for node in ast.walk(fn):
+                    if isinstance(node, ast.Assign) and \
+                            len(node.targets) == 1 and \
+                            isinstance(node.targets[0], ast.Name):
+                        g = _perf_group_of(node.value)
+                        if g is not None:
+                            local[node.targets[0].id] = g
+                for node in ast.walk(fn):
+                    if not (isinstance(node, ast.Call) and
+                            isinstance(node.func, ast.Attribute) and
+                            node.func.attr in _PC_MUTATORS and
+                            node.args and
+                            isinstance(node.args[0], ast.Constant) and
+                            isinstance(node.args[0].value, str)):
+                        continue
+                    recv = node.func.value
+                    group = _perf_group_of(recv)
+                    if group is None and isinstance(recv, ast.Name):
+                        group = local.get(recv.id)
+                    if group is None and \
+                            isinstance(recv, ast.Attribute):
+                        group = attr_groups.get(recv.attr)
+                    if group is None:
+                        continue
+                    key = node.args[0].value
+                    if (group, key) not in rate_set:
+                        continue
+                    if node.func.attr == "inc":
+                        inc_seen.add((group, key))
+                    else:
+                        out.append(self.finding(
+                            mod, node.lineno,
+                            f"history rate counter "
+                            f"{group}.{key} updated via "
+                            f".{node.func.attr}() — RATE_COUNTERS "
+                            f"entries must be monotonic (inc-only); "
+                            f"a gauge in the delta pipeline yields "
+                            f"garbage rates silently"))
+        if hist_mod is not None:
+            for group, key in pairs:
+                if (group, key) not in inc_seen:
+                    out.append(self.finding(
+                        hist_mod, decl_line,
+                        f"RATE_COUNTERS lists {group}.{key} but no "
+                        f".inc() declaration site exists in the "
+                        f"tree — the history/rate layer would query "
+                        f"a counter nothing increments"))
+        return out
+
+
 def register(reg) -> None:
     reg.add("CTL701", TraceGapRule)
+    reg.add("CTL702", RateCounterMonotonicRule)

@@ -856,7 +856,8 @@ class MonDaemon:
                 cmd in self.MUTATIONS + ("report_slow_ops", "health",
                                          "report_store_health",
                                          "report_perf",
-                                         "cluster_stats")
+                                         "cluster_stats",
+                                         "balancer_eval")
                 and self.quorum.leader != self.rank):
             # slow-op/perf rollup state is leader-local (transient
             # health + stats, not a quorum decree): reports AND their
@@ -928,12 +929,48 @@ class MonDaemon:
                 return {"ok": True}
             if cmd == "cluster_stats":
                 # the aggregated cluster view (`ceph -s` io lines,
-                # `ceph df`, `ceph osd df`, and the cluster
-                # Prometheus scrape text when {"metrics": True})
+                # `ceph df`, `ceph osd df`, the cluster Prometheus
+                # scrape text when {"metrics": True}), plus the
+                # ClusterScope sub-queries: {"history": {...}} range-
+                # queries the leader's metrics-history rings (`ceph
+                # telemetry history`) and {"heat": {...}} merges the
+                # per-OSD PG heat tables (`ceph pg heat`)
                 cs = self.mon.cluster_stats
+                hq = req.get("history")
+                if hq is not None:
+                    return cs.history.query(
+                        str(hq.get("counter", "osd.io.wr_ops")),
+                        daemon=hq.get("daemon"),
+                        since=hq.get("since"),
+                        until=hq.get("until"))
+                heat_q = req.get("heat")
+                if heat_q is not None:
+                    pool = heat_q.get("pool")
+                    top = heat_q.get("top")
+                    return {
+                        "pgs": cs.pg_heat(
+                            pool=None if pool is None else int(pool),
+                            top=None if top is None else int(top)),
+                        "osds": cs.osd_heat(),
+                    }
                 out = cs.dump()
                 if bool(req.get("metrics", False)):
                     out["prometheus"] = cs.render_prometheus()
+                return out
+            if cmd == "balancer_eval":
+                # ClusterScope balancer ADVISOR: score the current
+                # mapping from heat x utilization history and propose
+                # upmap moves as a REPORT — dry-run only, nothing here
+                # may touch the osdmap (asserted: epoch unchanged)
+                from ..mgr.balancer_advisor import evaluate
+                om = self.mon.osdmap
+                epoch0 = om.epoch
+                out = evaluate(
+                    om, self.mon.cluster_stats,
+                    max_moves=int(req.get("max_moves", 8)),
+                    pool=req.get("pool"))
+                assert om.epoch == epoch0, \
+                    "balancer advisor mutated the osdmap"
                 return out
             if cmd == "health":
                 # PG_DEGRADED needs the batched mapper (a compile in
@@ -1364,6 +1401,13 @@ class OSDDaemon:
         # of these into per-OSD/per-pool io rates for `ceph -s`
         self._pc_io = _perf("osd.io")
         self._perf_reported = 0.0     # last report_perf wall time
+        # per-PG client heat (pool HitSet role), counted at the same
+        # _account_io chokepoint as the osd.io counters so the mon's
+        # heat<->osd.io agreement check holds; wall clock on this tier
+        from .pg_heat import PGHeatTracker
+        from .osd_service import _heat_half_life
+        self.heat = PGHeatTracker(half_life=_heat_half_life(),
+                                  clock=time.time)
         # recovery/backfill reservations (the reference's AsyncReserver
         # pair + osd_max_backfills): LOCAL = this OSD driving a PG's
         # recovery as primary, REMOTE = this OSD receiving a recovery/
@@ -1782,6 +1826,7 @@ class OSDDaemon:
         cmd = req["cmd"]
         coll = req.get("coll")
         pool = int(coll[0]) if coll else -1
+        pg = int(coll[1]) if coll is not None and len(coll) > 1 else -1
         if cmd in self._WR_CMDS:
             nbytes = len(req.get("data") or b"")
             self._pc_io.inc("wr_ops")
@@ -1789,6 +1834,8 @@ class OSDDaemon:
             if pool >= 0:
                 self._pc_io.inc(f"pool.{pool}.wr_ops")
                 self._pc_io.inc(f"pool.{pool}.wr_bytes", nbytes)
+                if pg >= 0:
+                    self.heat.record(pool, pg, "wr", nbytes=nbytes)
         elif cmd in self._RD_CMDS:
             nbytes = len(reply) if isinstance(
                 reply, (bytes, bytearray, memoryview)) else 0
@@ -1797,10 +1844,14 @@ class OSDDaemon:
             if pool >= 0:
                 self._pc_io.inc(f"pool.{pool}.rd_ops")
                 self._pc_io.inc(f"pool.{pool}.rd_bytes", nbytes)
+                if pg >= 0:
+                    self.heat.record(pool, pg, "rd", nbytes=nbytes)
         elif cmd in ("delete_shard", "delete_object"):
             self._pc_io.inc("wr_ops")
             if pool >= 0:
                 self._pc_io.inc(f"pool.{pool}.wr_ops")
+                if pg >= 0:
+                    self.heat.record(pool, pg, "wr")
 
     def _handle_inner(self, entity: str, req: Dict[str, Any]) -> Any:
         cmd = req["cmd"]
@@ -2872,7 +2923,12 @@ class OSDDaemon:
         now = time.time()
         if now - self._perf_reported < 1.0:
             return        # cheap cadence floor under fast heartbeats
-        report = {"perf": _perf().dump_typed(),
+        # heat BEFORE perf: _account_io bumps osd.io first and the
+        # heat ledger second, so snapshotting in this order keeps
+        # heat <= osd.io at every instant — the mon's agreement
+        # assert depends on it
+        heat = self.heat.dump()
+        report = {"perf": _perf().dump_typed(), "heat": heat,
                   "util": self._store_util(), "ts": now}
         try:
             self.mon_client().call({"cmd": "report_perf",

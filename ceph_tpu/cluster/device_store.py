@@ -40,6 +40,26 @@ faults.declare("device.staging_drop",
                "pressure/invalidation racing the read path; dirty "
                "entries are never dropped (they are the only copy)")
 
+# process-wide HBM staging occupancy (summed across every cache in
+# the process: per-OSD caches + the client-side one), exported as
+# perf("hbm") GAUGES — the ClusterScope staging-pressure signal next
+# to the jit compile counters
+_hbm_entries = 0
+_hbm_bytes = 0
+
+
+def _hbm_account(d_entries: int, d_bytes: int) -> None:
+    global _hbm_entries, _hbm_bytes
+    _hbm_entries = max(0, _hbm_entries + d_entries)
+    _hbm_bytes = max(0, _hbm_bytes + d_bytes)
+    try:
+        from ..common.perf_counters import perf as _perf
+        pc = _perf("hbm")
+        pc.set("staged_entries", _hbm_entries)
+        pc.set("staged_bytes", _hbm_bytes)
+    except Exception:
+        pass
+
 
 @dataclass(frozen=True)
 class ShardRef:
@@ -337,7 +357,10 @@ class DeviceShardCache:
             csum: Optional[int]) -> None:
         """Stage a shard ref; ``csum=None`` marks it dirty (staged
         flush mode — the device copy is authoritative until flush)."""
+        prev = self._entries.get(key)
         self._entries[key] = _Entry(ref, csum, int(ref.size))
+        _hbm_account(0 if prev is not None else 1,
+                     int(ref.size) - (prev.nbytes if prev else 0))
         from ..parallel import data_plane
         if data_plane.enabled():
             dp = data_plane.plane()
@@ -349,8 +372,10 @@ class DeviceShardCache:
                     int(ref.size))
 
     def evict(self, key: ShardKey) -> None:
-        if self._entries.pop(key, None) is not None:
+        e = self._entries.pop(key, None)
+        if e is not None:
             self.invalidations += 1
+            _hbm_account(-1, -e.nbytes)
 
     def evict_object(self, pool_id: int, pg: int, name: str) -> None:
         """Drop every staged shard of one object (overwrite/delete
@@ -361,6 +386,9 @@ class DeviceShardCache:
             self.evict(k)
 
     def clear(self) -> None:
+        if self._entries:
+            _hbm_account(-len(self._entries),
+                         -sum(e.nbytes for e in self._entries.values()))
         self._entries.clear()
 
     # ------------------------------------------------------------- reads --

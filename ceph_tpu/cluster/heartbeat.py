@@ -108,22 +108,37 @@ class HeartbeatMonitor:
                 "objects": objects, "pools": pools}
 
     def _report_telemetry(self) -> None:
-        """ClusterStats rollup, sim tier: per-OSD store utilization
-        plus (once, under the client entity — one process is one perf
-        domain) the process perf dump, mirroring what daemonized OSDs
+        """ClusterStats rollup, sim tier: per-OSD store utilization,
+        per-OSD PG heat tables, and per-OSD ``osd.io`` counters
+        SYNTHESIZED from the heat ledger's raw totals (one process is
+        one perf domain, so real per-OSD counters don't exist here —
+        deriving them from the same ledger makes the heat↔osd.io
+        agreement exact by construction and feeds the metrics-history
+        rate pipeline per OSD).  The process perf dump still ships
+        once under the client entity, mirroring what daemonized OSDs
         ship on their wire heartbeats."""
         import time as _time
+        from ..common.perf_counters import COUNTER
         from ..common.perf_counters import perf as _perf
         now = _time.time()
         rescan = (self.ticks % self.UTIL_SCAN_TICKS == 1)
+        services = getattr(self.sim, "services", None) or []
         for o in self.sim.osds:
             if not o.alive or not self._reaches(o.id, "mon"):
                 continue
             if rescan or o.id not in self._util_cache:
                 self._util_cache[o.id] = self._scan_util(o)
-            self.mon.record_daemon_perf(
-                f"osd.{o.id}",
-                {"util": self._util_cache[o.id], "ts": now})
+            report = {"util": self._util_cache[o.id], "ts": now}
+            svc = services[o.id] if o.id < len(services) else None
+            heat = getattr(svc, "heat", None)
+            if heat is not None:
+                # decay runs on the TICK clock: deterministic per seed
+                heat.advance(float(self.ticks))
+                report["heat"] = heat.dump()
+                report["perf"] = {
+                    "osd.io": {k: (COUNTER, v)
+                               for k, v in heat.totals().items()}}
+            self.mon.record_daemon_perf(f"osd.{o.id}", report)
         self.mon.record_daemon_perf(
             "client", {"perf": _perf().dump_typed(), "ts": now})
 

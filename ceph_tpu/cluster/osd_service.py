@@ -38,6 +38,15 @@ faults.declare("msg.drop_op",
 from ..msg.dispatcher import BatchingDispatcher
 from ..msg.queue import Envelope, MessageQueue, QueueClosed, QueueFull
 from ..msg.scheduler import CLASS_CLIENT, CLASS_RECOVERY, MClockScheduler
+from .pg_heat import PGHeatTracker
+
+
+def _heat_half_life() -> float:
+    try:
+        from ..common.options import config
+        return float(config().get("pg_heat_half_life"))
+    except Exception:
+        return 60.0
 
 MSG_OSD_OP = 0x10
 
@@ -69,6 +78,10 @@ class OSDService:
         # test hook: seconds to sleep inside _execute (models a stalled
         # device dispatch; drives the SLOW_OPS acceptance path)
         self.inject_execute_delay = 0.0
+        # per-PG client-io heat (pool HitSet role).  Manual clock: the
+        # heartbeat advances it to its tick count, so decay is
+        # seed-deterministic on the sim tick clock
+        self.heat = PGHeatTracker(half_life=_heat_half_life())
         self.dispatcher = BatchingDispatcher(
             self.in_q, self._handle, linger=0.0,
             name=f"osd.{osd.id}").start()
@@ -118,17 +131,48 @@ class OSDService:
             # daemon-side dispatch stage span, linked under the
             # submitting op's trace context (carried on the op dict —
             # the in-process half of trace propagation); the nested
-            # device.dispatch child covers the store/device access
+            # device.dispatch child covers the store/device access.
+            # service = the EXECUTING entity (this OSD), not the
+            # process-wide default "client" the sim tier used to stamp
             with _trace.linked_span(
                     "osd.dispatch", op.get("tctx"),
+                    service=f"osd.{self.osd.id}",
                     osd=self.osd.id, kind=op["kind"]):
                 with _trace.child_span("device.dispatch",
+                                       service=f"osd.{self.osd.id}",
                                        osd=self.osd.id):
-                    return self._execute_inner(op)
+                    out = self._execute_inner(op)
+            self._record_heat(op, out)
+            return out
         finally:
             # device-dispatch latency distribution (the encode/store
             # stage averages hide; acceptance histogram family)
             self._pc.hinc("dispatch_s", time.perf_counter() - t0)
+
+    def _record_heat(self, op: Dict[str, Any], result: Any) -> None:
+        """Count a completed CLIENT op against its PG's heat ledger —
+        recovery traffic is placement churn, not client load, so it
+        stays out (matching what ``osd.io`` counts on the daemon
+        tier)."""
+        if op.get("klass", CLASS_CLIENT) != CLASS_CLIENT:
+            return
+        key = op.get("key")
+        if key is None:                    # bulk *_many ride recovery
+            return
+        kind = op["kind"]
+        pool, pg = int(key[0]), int(key[1])
+        if kind in ("put", "put_dev"):
+            data = op.get("data")
+            nbytes = (len(data) if data is not None
+                      else int(getattr(op.get("_obj"), "nbytes", 0)
+                               or 0))
+            self.heat.record(pool, pg, "wr", nbytes=nbytes)
+        elif kind in ("get", "get_dev"):
+            self.heat.record(pool, pg, "rd",
+                             nbytes=int(getattr(result, "nbytes", 0)
+                                        or 0))
+        elif kind == "delete":
+            self.heat.record(pool, pg, "wr")
 
     def _execute_inner(self, op: Dict[str, Any]):
         kind = op["kind"]

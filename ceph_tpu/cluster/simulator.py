@@ -1667,11 +1667,23 @@ class ClusterSim:
                          prev_head, e.version)
 
     # ----------------------------------------------------------- failure --
+    def _lose_memory(self, osd: int) -> None:
+        """Process death drops in-memory state: the PG heat table
+        dies with the process, so the synthesized per-OSD counters
+        restart from zero — the mon's history layer must see that as
+        a counted RESET, never a negative rate."""
+        services = getattr(self, "services", None) or []
+        svc = services[osd] if osd < len(services) else None
+        heat = getattr(svc, "heat", None)
+        if heat is not None:
+            heat.reset()
+
     def kill_osd(self, osd: int) -> None:
         """Thrasher-style kill (qa/tasks/ceph_manager.py kill_osd): process
         death — store contents are lost to the cluster."""
         self.osds[osd].crash()
         self.osds[osd].alive = False
+        self._lose_memory(osd)
         self.osdmap.mark_down(osd)
 
     def fail_osd(self, osd: int) -> None:
@@ -1679,6 +1691,7 @@ class ClusterSim:
         heartbeat/failure-report pipeline exists to detect."""
         self.osds[osd].crash()
         self.osds[osd].alive = False
+        self._lose_memory(osd)
 
     def out_osd(self, osd: int) -> None:
         self.osdmap.mark_out(osd)

@@ -406,6 +406,23 @@ _TABLE: Tuple[Option, ...] = (
            "default dmClock LIMIT for a per-tenant client class "
            "(reference osd_mclock_scheduler_client_lim); 0 = "
            "unlimited", min=0.0),
+    Option("metrics_history_samples", TYPE_INT, 64,
+           "per-level ring bound of the leader mon's metrics history "
+           "(mgr/metrics_history.py, the mgr MetricCollector / PGMap "
+           "delta-history role): level 0 keeps this many raw "
+           "report_perf deliveries per reporter before log2 "
+           "downsampling folds the oldest pairs upward", min=2),
+    Option("metrics_history_levels", TYPE_INT, 6,
+           "log2 downsampling levels of the metrics history: level i "
+           "holds samples whose window fuses 2^i raw deliveries, so "
+           "retained wall coverage grows ~2^levels x samples while "
+           "memory stays levels x samples entries per reporter",
+           min=1),
+    Option("pg_heat_half_life", TYPE_FLOAT, 60.0,
+           "exponential-decay half life of the per-PG client-io heat "
+           "ledgers (cluster/pg_heat.py, the pool HitSet role): "
+           "seconds on the daemon tier, heartbeat TICKS on the sim "
+           "tier's deterministic clock", min=0.001),
 )
 
 _config: Optional[Options] = None

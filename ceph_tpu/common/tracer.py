@@ -231,7 +231,8 @@ class Tracer:
 
     def _make_span(self, name: str,
                    child_of: Optional[Iterable[int]],
-                   tags: Dict[str, Any]) -> Span:
+                   tags: Dict[str, Any],
+                   service: Optional[str] = None) -> Span:
         parent = self._current()
         if parent is not None:
             tid, pid = parent.trace_id, parent.span_id
@@ -244,25 +245,35 @@ class Tracer:
         return Span(trace_id=tid, span_id=_rng.getrandbits(48),
                     parent_id=pid, name=name,
                     start=time.perf_counter(), ts=time.time(),
-                    service=_service["name"], tags=dict(tags))
+                    service=service or _service["name"],
+                    tags=dict(tags))
 
     def start_span(self, name: str,
-                   child_of: Optional[Iterable[int]] = None, **tags):
+                   child_of: Optional[Iterable[int]] = None,
+                   service: Optional[str] = None, **tags):
         """Root span, child of the active span on this thread
         (child_span semantics, src/common/tracer.h:10-30), or child
         of a REMOTE parent via ``child_of=(trace_id, span_id)``.
+        ``service`` overrides the process entity for this span — the
+        sim tier's attribution fix: one process hosts MANY logical
+        entities (client, every osd.N, the mon), and a span must name
+        the entity that EXECUTED the stage, not whoever owns the
+        process (which is always "client" in-process).
         Disarmed: returns a shared null context manager."""
         if "on" not in _armed:
             return _NULL_CM
-        return _SpanCM(self, self._make_span(name, child_of, tags))
+        return _SpanCM(self, self._make_span(name, child_of, tags,
+                                             service))
 
-    def child_span(self, name: str, **tags):
+    def child_span(self, name: str, service: Optional[str] = None,
+                   **tags):
         """A span ONLY when a parent is active on this thread (stage
         sites deep in daemons — an untraced op must not spawn orphan
         root spans at every stage it passes)."""
         if "on" not in _armed or self._current() is None:
             return _NULL_CM
-        return _SpanCM(self, self._make_span(name, None, tags))
+        return _SpanCM(self, self._make_span(name, None, tags,
+                                             service))
 
     # ----------------------------------------------- manual open/finish --
     def span_open(self, name: str,
@@ -512,7 +523,7 @@ def pin_trace(trace_id) -> None:
         t.pin_trace(int(trace_id))
 
 
-def child_span(name: str, **tags):
+def child_span(name: str, service: Optional[str] = None, **tags):
     """Module-level stage-span fast path: one dict-miss when
     disarmed, null when no parent is active (see Tracer.child_span).
     Deep fire sites (scheduler dequeue, store barriers, device
@@ -522,26 +533,33 @@ def child_span(name: str, **tags):
     t = _tracer
     if t is None:
         return _NULL_CM
-    return t.child_span(name, **tags)
+    return t.child_span(name, service=service, **tags)
 
 
-def start_span(name: str, child_of=None, **tags):
+def start_span(name: str, child_of=None,
+               service: Optional[str] = None, **tags):
     """Module-level span fast path: the disarmed case is one
     dict-miss with no singleton lock (fire sites run per op)."""
     if "on" not in _armed:
         return _NULL_CM
-    return tracer().start_span(name, child_of=child_of, **tags)
+    return tracer().start_span(name, child_of=child_of,
+                               service=service, **tags)
 
 
-def linked_span(name: str, child_of, **tags):
+def linked_span(name: str, child_of,
+                service: Optional[str] = None, **tags):
     """Open a span ONLY when a remote trace context arrived (or a
     local parent is active): the daemon-side rule — an op that was
-    never stamped must not litter the buffer with orphan roots."""
+    never stamped must not litter the buffer with orphan roots.
+    ``service`` attributes the span to the EXECUTING logical entity
+    (sim-tier daemons share one process whose default entity is
+    "client")."""
     if "on" not in _armed:
         return _NULL_CM
     if child_of:
-        return tracer().start_span(name, child_of=child_of, **tags)
-    return child_span(name, **tags)
+        return tracer().start_span(name, child_of=child_of,
+                                   service=service, **tags)
+    return child_span(name, service=service, **tags)
 
 
 def _buffer_bound() -> int:

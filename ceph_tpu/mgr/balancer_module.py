@@ -18,6 +18,13 @@ class BalancerModule(MgrModule):
         self.last_result = calc_pg_upmaps(self.get("osd_map"), **kw)
         return self.last_result
 
+    def eval(self, cluster_stats, **kw) -> dict:
+        """Dry-run advisor (`ceph balancer eval`): score the current
+        mapping from heat x utilization and return proposed moves as
+        a report — calc_pg_upmaps MUTATES the map, this never does."""
+        from .balancer_advisor import evaluate
+        return evaluate(self.get("osd_map"), cluster_stats, **kw)
+
     def serve_tick(self) -> None:
         self.optimize()
 

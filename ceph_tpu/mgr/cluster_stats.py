@@ -39,6 +39,9 @@ from typing import Any, Dict, Iterable, List, Optional, Tuple
 
 from ..common.perf_counters import (COUNTER, GAUGE, HISTOGRAM,
                                     TIME_AVG)
+from ..common.perf_counters import perf as _perf
+from ..cluster.pg_heat import merge_heat, osd_heat_rollup
+from .metrics_history import RATE_COUNTERS, MetricsHistory
 
 QUANTILES = (0.5, 0.99, 0.999)
 STALE_S = 600.0          # reporter aging (the SLOW_OPS window)
@@ -122,6 +125,15 @@ class ClusterStats:
         # daemon -> computed {key: rate/s}
         self._rates: Dict[str, Dict[str, float]] = {}
         self.reports_ingested = 0
+        # ClusterScope: bounded per-reporter delivery rings (the
+        # mgr MetricCollector / PGMap-history role)
+        self.history = MetricsHistory(stale_s=self.stale_s)
+        # daemon -> latest PGHeatTracker.dump() (pool-HitSet role)
+        self._heat: Dict[str, Dict[str, Any]] = {}
+        # monotonic-counter resets observed across reporters (a
+        # daemon restart zeroes its counters; the rate layer clamps
+        # the negative delta and counts it here + stats.counter_resets)
+        self.counter_resets = 0
 
     # ------------------------------------------------------------ ingest --
     @staticmethod
@@ -141,6 +153,7 @@ class ClusterStats:
         ts = float(report.get("ts") or time.time())
         perf = report.get("perf") or {}
         util = report.get("util") or {}
+        heat = report.get("heat")
         with self._lock:
             self.reports_ingested += 1
             prev = self._prev_io.get(daemon)
@@ -148,6 +161,14 @@ class ClusterStats:
             if prev is not None:
                 pts, pflat = prev
                 dt = ts - pts
+                # counter-reset robustness: a restarted daemon's
+                # monotonic counters went backwards — the rate clamps
+                # to zero (max() below) and the reset is COUNTED, so
+                # a restart reads as "reset, rate 0", not garbage
+                if any(v < pflat.get(k, 0.0)
+                       for k, v in flat.items() if k in pflat):
+                    self.counter_resets += 1
+                    _perf("stats").inc("counter_resets")
                 if dt > 0:
                     self._rates[daemon] = {
                         k: max(0.0, (v - pflat.get(k, 0.0)) / dt)
@@ -155,6 +176,12 @@ class ClusterStats:
             self._prev_io[daemon] = (ts, flat)
             self._latest[daemon] = {"ts": ts, "perf": perf,
                                     "util": util}
+            if heat:
+                self._heat[daemon] = heat
+        # retain the delivery in the history ring (its own lock; the
+        # ring does its own per-reporter reset detection so history
+        # rate series clamp identically)
+        self.history.record(daemon, ts, perf)
 
     def _live(self) -> Dict[str, Dict[str, Any]]:
         """Latest reports younger than the staleness window (caller
@@ -226,6 +253,46 @@ class ClusterStats:
                                 if not k.startswith("pool.")}
                             for d, r in sorted(rates.items())}}
 
+    # -------------------------------------------------------------- heat --
+    def _live_heat(self) -> Dict[str, Dict[str, Any]]:
+        """Heat dumps of non-stale reporters (caller holds no lock)."""
+        with self._lock:
+            live = set(self._live())
+            return {d: h for d, h in self._heat.items() if d in live}
+
+    def pg_heat(self, pool: Optional[int] = None,
+                top: Optional[int] = None) -> List[Dict[str, Any]]:
+        """`ceph pg heat [--pool P] [--top N]`: per-PG client-io heat
+        rows merged across every reporting OSD, hottest first."""
+        return merge_heat(self._live_heat(), pool=pool, top=top)
+
+    def osd_heat(self, check: bool = True) -> Dict[str, Any]:
+        """Per-OSD heat rollup.  ``check`` asserts the raw totals
+        agree with the same daemon's reported ``osd.io`` counters —
+        heat and io counters are incremented at the SAME call sites,
+        so a mismatch means an attribution bug, and the rollup says
+        so rather than letting the two surfaces silently diverge.
+        (>= because the io counters may have advanced between the
+        heat snapshot and the perf dump inside one report.)"""
+        rollup = osd_heat_rollup(self._live_heat())
+        if check:
+            with self._lock:
+                live = self._live()
+            for daemon, row in rollup.items():
+                io = (live.get(daemon) or {}).get("perf") or {}
+                flat = self._flat_io(io)
+                if not flat:
+                    continue
+                for f in ("rd_ops", "wr_ops", "rd_bytes", "wr_bytes"):
+                    got, want = row.get(f"tot_{f}", 0.0), \
+                        flat.get(f, 0.0)
+                    if got > want + 0.5:
+                        raise AssertionError(
+                            f"{daemon}: heat rollup {f}={got} "
+                            f"exceeds osd.io counter {want} — "
+                            f"per-PG attribution double-counted")
+        return rollup
+
     # ---------------------------------------------------------- df views --
     def osd_df(self) -> List[Dict[str, Any]]:
         """Per-OSD utilization rows (`ceph osd df`) — OSD reporters
@@ -245,7 +312,13 @@ class ClusterStats:
                 "bytes_total": total,
                 "utilization": round(used / total, 6)
                 if total else 0.0,
-                "objects": int(u.get("objects") or 0)})
+                "objects": int(u.get("objects") or 0),
+                # recent-rate trend columns off the history rings
+                # (the `ceph osd df` sparkline; "-" until 2 samples)
+                "wr_trend": self.history.sparkline(
+                    daemon, "osd.io.wr_ops"),
+                "rd_trend": self.history.sparkline(
+                    daemon, "osd.io.rd_ops")})
         return rows
 
     def df(self) -> Dict[str, Any]:
@@ -277,10 +350,12 @@ class ClusterStats:
     def dump(self) -> Dict[str, Any]:
         return {"daemons": self.daemons(),
                 "reports_ingested": self.reports_ingested,
+                "counter_resets": self.counter_resets,
                 "quantiles": self.merged_quantiles(),
                 "io": self.io_rates(),
                 "df": self.df(),
-                "osd_df": self.osd_df()}
+                "osd_df": self.osd_df(),
+                "history": self.history.dump()}
 
     # -------------------------------------------------------- prometheus --
     @staticmethod
@@ -396,6 +471,36 @@ class ClusterStats:
         lines.append("# TYPE ceph_cluster_io_rate gauge")
         for k, v in sorted(io["cluster"].items()):
             lines.append(f'ceph_cluster_io_rate{{metric="{k}"}} {v}')
+        # short/long window rates off the history rings: the latest
+        # interval vs the whole retained window, per daemon per
+        # headline counter (reset intervals clamp to zero inside)
+        hist = self.history
+        rate_lines: List[str] = []
+        for daemon in hist.reporters():
+            for group, key in RATE_COUNTERS:
+                counter = f"{group}.{key}"
+                short = hist.window_rate(daemon, counter, window=2)
+                long = hist.window_rate(daemon, counter,
+                                        window=1 << 30)
+                for win, v in (("short", short), ("long", long)):
+                    if v is not None:
+                        rate_lines.append(
+                            f'ceph_history_rate{{ceph_daemon='
+                            f'"{_esc(daemon)}",counter='
+                            f'"{_esc(counter)}",window="{win}"}} '
+                            f'{v}')
+        if rate_lines:
+            lines.append("# HELP ceph_history_rate windowed counter "
+                         "rates from the metrics-history rings "
+                         "(reset-clamped)")
+            lines.append("# TYPE ceph_history_rate gauge")
+            lines.extend(rate_lines)
+        # cumulative reset count (alerting on restart storms)
+        lines.append("# HELP ceph_cluster_counter_resets monotonic "
+                     "counter resets observed (daemon restarts)")
+        lines.append("# TYPE ceph_cluster_counter_resets counter")
+        lines.append(f"ceph_cluster_counter_resets "
+                     f"{self.counter_resets}")
         return "\n".join(lines) + "\n"
 
     def reset(self) -> None:
@@ -403,4 +508,7 @@ class ClusterStats:
             self._latest.clear()
             self._prev_io.clear()
             self._rates.clear()
+            self._heat.clear()
             self.reports_ingested = 0
+            self.counter_resets = 0
+        self.history.reset()

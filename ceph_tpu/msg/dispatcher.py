@@ -111,7 +111,10 @@ class ShardFanout:
                 "want": len(shard_payloads), "got": 0, "failed": False,
                 "event": threading.Event()}
         self._pc.inc("ops_submitted")
+        # service = the fanning-out entity (this primary), not the
+        # process-wide default — sim-tier spans must name who ran them
         with _trace.linked_span("msg.fanout", tctx,
+                                service=self.entity,
                                 shards=len(shard_payloads)):
             for shard, (q, payload) in enumerate(
                     zip(self.shard_queues, shard_payloads)):

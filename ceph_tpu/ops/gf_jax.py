@@ -96,7 +96,12 @@ def matrix_to_device(A: np.ndarray) -> jax.Array:
         _seen_matrices.move_to_end(key)
         while len(_seen_matrices) > _MATRIX_CACHE_SIZE:
             _seen_matrices.popitem(last=False)
-    out = _bitmatrix_device(key[0], *A.shape)
+    from ..common.jit_profile import compile_event, signature_of
+    # compile_event is a no-op on cache hit; a first-seen matrix gets
+    # a jit.compile child span + jit.compiles counters (the cost the
+    # triggering op's flame trace must be able to explain)
+    with compile_event("ec.gf_jax", signature_of(A), compiled):
+        out = _bitmatrix_device(key[0], *A.shape)
     _mark_active("dispatched_device", component="ec.gf_jax",
                  compiled=compiled)
     return out

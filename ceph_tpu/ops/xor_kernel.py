@@ -32,6 +32,7 @@ exact whatever the platform endianness.
 from __future__ import annotations
 
 import functools
+import threading
 
 import jax
 import jax.numpy as jnp
@@ -146,12 +147,36 @@ def xor_matmul_w32(masks, words) -> jax.Array:
         pad = (-W) % tile
         if pad:
             w3 = jnp.pad(w3, ((0, 0), (0, 0), (0, pad)))
-        out = _xor_matmul_pallas(m3, w3, per_batch, tile)
+        with _compile_cm(True, per_batch, m3.shape, (B, C, W + pad)):
+            out = _xor_matmul_pallas(m3, w3, per_batch, tile)
         if pad:
             out = out[..., :W]
     else:
-        out = _xor_matmul_xla(m3, w3, per_batch)
+        with _compile_cm(False, per_batch, m3.shape, (B, C, W)):
+            out = _xor_matmul_xla(m3, w3, per_batch)
     return out.reshape(lead + (R, W))
+
+
+# the jitted contractions above are shape-keyed: a first-seen
+# (backend, per_batch, masks-shape, words-shape) tuple means XLA
+# compiles a fresh executable on this dispatch — tag it with a
+# jit.compile child span + jit.compiles counters so the triggering
+# op's flame trace can explain the stall (same role as
+# gf_jax.matrix_to_device's content-keyed tag)
+_seen_shapes: set = set()
+_seen_lock = threading.Lock()
+
+
+def _compile_cm(pallas: bool, per_batch: bool, mshape, wshape):
+    key = (pallas, per_batch, tuple(mshape), tuple(wshape))
+    with _seen_lock:
+        compiled = key not in _seen_shapes
+        _seen_shapes.add(key)
+    from ..common.jit_profile import compile_event
+    sig = (f"{'pallas' if pallas else 'xla'}:"
+           f"{'x'.join(str(d) for d in mshape)}@"
+           f"{'x'.join(str(d) for d in wshape)}")
+    return compile_event("ec.xor_kernel", sig, compiled)
 
 
 def xor_matmul(masks, planes) -> jax.Array:

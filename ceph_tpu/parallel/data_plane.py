@@ -143,11 +143,14 @@ class ShardedDataPlane:
                             .astype(jnp.int64)), SHARD_AXIS)
                 return out, rows
 
+            from ..common.jit_profile import wrap as _jit_wrap
             mspec = P(SHARD_AXIS) if per_batch else P()
-            step = self._steps[key] = jax.jit(shard_map(
-                local, mesh=self.mesh,
-                in_specs=(mspec, P(SHARD_AXIS)),
-                out_specs=(P(SHARD_AXIS), P())))
+            step = self._steps[key] = _jit_wrap(
+                jax.jit(shard_map(
+                    local, mesh=self.mesh,
+                    in_specs=(mspec, P(SHARD_AXIS)),
+                    out_specs=(P(SHARD_AXIS), P()))),
+                "data_plane.step", f"per_batch={per_batch}")
         return step
 
     def _collective_step(self, per_batch: bool):
@@ -182,11 +185,14 @@ class ShardedDataPlane:
                                           tiled=True)
                 return full, rows
 
+            from ..common.jit_profile import wrap as _jit_wrap
             mspec = P(SHARD_AXIS) if per_batch else P()
-            step = self._steps[key] = jax.jit(shard_map(
-                local, mesh=self.mesh,
-                in_specs=(mspec, P(SHARD_AXIS)),
-                out_specs=(P(), P()), check_rep=False))
+            step = self._steps[key] = _jit_wrap(
+                jax.jit(shard_map(
+                    local, mesh=self.mesh,
+                    in_specs=(mspec, P(SHARD_AXIS)),
+                    out_specs=(P(), P()), check_rep=False)),
+                "data_plane.collective", f"per_batch={per_batch}")
         return step
 
     def _ppermute_step(self, shift: int):
@@ -208,10 +214,13 @@ class ShardedDataPlane:
             def local(x):
                 return jax.lax.ppermute(x, SHARD_AXIS, perm=perm)
 
-            step = self._steps[key] = jax.jit(shard_map(
-                local, mesh=self.mesh,
-                in_specs=(P(SHARD_AXIS),),
-                out_specs=P(SHARD_AXIS)))
+            from ..common.jit_profile import wrap as _jit_wrap
+            step = self._steps[key] = _jit_wrap(
+                jax.jit(shard_map(
+                    local, mesh=self.mesh,
+                    in_specs=(P(SHARD_AXIS),),
+                    out_specs=P(SHARD_AXIS))),
+                "data_plane.ppermute", f"shift={shift}")
         return step
 
     def ppermute_shift(self, arr, shift: int = 1):

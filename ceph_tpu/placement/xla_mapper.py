@@ -952,15 +952,20 @@ class XlaMapper:
                 with jax.default_matmul_precision("highest"):
                     return inner(xs, weights)
 
+            from ..common.jit_profile import wrap as _jit_wrap
+            sig = f"rule{ruleno}:max{result_max}"
             if mesh is None:
-                self._jitted[key] = jax.jit(fn)
+                self._jitted[key] = _jit_wrap(
+                    jax.jit(fn), "crush.mapper", sig)
             else:
                 from jax.sharding import NamedSharding, PartitionSpec as P
                 axis = mesh.axis_names[0]
                 batch = NamedSharding(mesh, P(axis))
                 repl = NamedSharding(mesh, P())
-                self._jitted[key] = jax.jit(
-                    fn, in_shardings=(batch, repl), out_shardings=batch)
+                self._jitted[key] = _jit_wrap(
+                    jax.jit(fn, in_shardings=(batch, repl),
+                            out_shardings=batch),
+                    "crush.mapper", f"{sig}:sharded")
         return self._jitted[key]
 
 

@@ -265,15 +265,96 @@ def cmd_df(rc, out) -> int:
 def cmd_osd_df(rc, out) -> int:
     """`ceph osd df` — per-OSD utilization from the ClusterStats
     aggregator (allocator-backed used/total bytes each daemon ships
-    on its heartbeat)."""
+    on its heartbeat), with recent write/read rate sparklines off
+    the mon's metrics-history rings."""
     rows = rc.mon_call({"cmd": "cluster_stats"}).get("osd_df") or []
-    out.write("NAME  OBJECTS  USED  TOTAL  %USE\n")
+    out.write("NAME  OBJECTS  USED  TOTAL  %USE  WR  RD\n")
     for r in rows:
         out.write(f"{r['daemon']}  {r['objects']}  "
                   f"{r['bytes_used']}  {r['bytes_total']}  "
-                  f"{100.0 * r['utilization']:.2f}\n")
+                  f"{100.0 * r['utilization']:.2f}  "
+                  f"{r.get('wr_trend', '-')}  "
+                  f"{r.get('rd_trend', '-')}\n")
     if not rows:
         out.write("(no daemon reports yet)\n")
+    return 0
+
+
+def cmd_telemetry_history(rc, counter: str, daemon: Optional[str],
+                          out, as_json: bool = False) -> int:
+    """`ceph telemetry history <counter> [--daemon osd.N]` — range-
+    query the leader mon's metrics-history rings: retained samples +
+    reset-clamped rates per reporter."""
+    r = rc.mon_call({"cmd": "cluster_stats",
+                     "history": {"counter": counter,
+                                 "daemon": daemon}})
+    if as_json:
+        out.write(json.dumps(r, indent=2, sort_keys=True) + "\n")
+        return 0
+    series = r.get("series") or {}
+    if not series:
+        out.write(f"(no history for counter {counter!r})\n")
+        return 1
+    out.write(f"counter {counter} (cluster resets: "
+              f"{r.get('counter_resets', 0)})\n")
+    for name, s in sorted(series.items()):
+        out.write(f"  {name}: {len(s['samples'])} samples, "
+                  f"{s['resets']} resets\n")
+        for (ts, v), (_rts, rate) in zip(s["samples"][1:],
+                                         s["rates"]):
+            out.write(f"    {ts:.3f}  {v:.0f}  ({rate:.3f}/s)\n")
+    return 0
+
+
+def cmd_pg_heat(rc, pool: Optional[int], top: Optional[int],
+                out, as_json: bool = False) -> int:
+    """`ceph pg heat [--pool P] [--top N]` — decayed per-PG client-io
+    heat merged across the reporting OSDs, hottest first, plus the
+    per-OSD rollup (asserted consistent with the osd.io counters)."""
+    r = rc.mon_call({"cmd": "cluster_stats",
+                     "heat": {"pool": pool, "top": top}})
+    if as_json:
+        out.write(json.dumps(r, indent=2, sort_keys=True) + "\n")
+        return 0
+    pgs = r.get("pgs") or []
+    if not pgs:
+        out.write("(no heat reported yet)\n")
+        return 1
+    out.write("PGID  HEAT  RD_OPS  WR_OPS  RD_B  WR_B  OSDS\n")
+    for row in pgs:
+        out.write(f"{row['pgid']}  {row['heat']:.3f}  "
+                  f"{row['rd_ops']:.1f}  {row['wr_ops']:.1f}  "
+                  f"{row['rd_bytes']:.0f}  {row['wr_bytes']:.0f}  "
+                  f"{','.join(row['osds'])}\n")
+    return 0
+
+
+def cmd_balancer_eval(rc, max_moves: int, pool: Optional[int],
+                      out, as_json: bool = False) -> int:
+    """`ceph balancer eval` / `ceph balancer propose [--json]` — the
+    dry-run advisor: imbalance score from heat x utilization and the
+    proposed upmap moves, as a REPORT (nothing is actuated)."""
+    r = rc.mon_call({"cmd": "balancer_eval", "max_moves": max_moves,
+                     "pool": pool})
+    if as_json:
+        out.write(json.dumps(r, indent=2, sort_keys=True) + "\n")
+        return 0
+    out.write(f"current imbalance score: {r['score_before']:.6f} "
+              f"(epoch {r['epoch']}, {r['pgs_considered']} hot "
+              f"pgs)\n")
+    props = r.get("proposals") or []
+    if not props:
+        out.write("no improving moves found (dry run; map "
+                  "unchanged)\n")
+        return 0
+    out.write(f"proposed score: {r['score_after']:.6f} with "
+              f"{len(props)} move(s):\n")
+    for p in props:
+        out.write(f"  pg {p['pgid']}: osd.{p['from']} -> "
+                  f"osd.{p['to']} (heat {p['heat']:.3f}, score -> "
+                  f"{p['score_after']:.6f})\n")
+    out.write("dry run only — apply is not implemented in this "
+              "release\n")
     return 0
 
 
@@ -441,7 +522,11 @@ def main(argv: Optional[List[str]] = None,
                          "osd tier add|remove BASE CACHE | "
                          "osd tier agent BASE [TARGET] | "
                          "osd df | trace OP_ID [--json] | "
-                         "pg dump POOL | df | scrub POOL | "
+                         "pg dump POOL | pg heat [--pool=P --top=N] "
+                         "| telemetry history COUNTER "
+                         "[--daemon=osd.N] | "
+                         "balancer eval|propose [--json] | "
+                         "df | scrub POOL | "
                          "daemon NAME dump_ops_in_flight|"
                          "dump_historic_ops|dump_historic_slow_ops|"
                          "perf dump|fault_injection [...]|"
@@ -507,6 +592,44 @@ def main(argv: Optional[List[str]] = None,
         except (RuntimeError, ValueError, OSError) as e:
             out.write(f"Error: {e}\n")
             return 1
+    if ns.words[0] in ("telemetry", "balancer") or \
+            ns.words[:2] == ["pg", "heat"]:
+        # ClusterScope observability verbs: their flags ride `extra`
+        # (use --flag=value forms; argparse scrambles split pairs)
+        if ns.dir is None:
+            ap.error(f"--dir is required for `{ns.words[0]}`")
+        sub = argparse.ArgumentParser(prog=f"ceph {ns.words[0]}")
+        sub.add_argument("--daemon", default=None)
+        sub.add_argument("--pool", type=int, default=None)
+        sub.add_argument("--top", type=int, default=None)
+        sub.add_argument("--max-moves", type=int, default=8,
+                         dest="max_moves")
+        sub.add_argument("--json", action="store_true",
+                         dest="as_json")
+        sub.add_argument("rest", nargs="*")
+        fl = sub.parse_args(ns.words[1:] + extra)
+        rc = _client(ns.dir)
+        try:
+            if ns.words[0] == "telemetry":
+                if fl.rest[:1] != ["history"] or len(fl.rest) < 2:
+                    ap.error("telemetry history COUNTER "
+                             "[--daemon=osd.N] [--json]")
+                return cmd_telemetry_history(rc, fl.rest[1],
+                                             fl.daemon, out,
+                                             fl.as_json)
+            if ns.words[0] == "balancer":
+                if fl.rest[:1] not in (["eval"], ["propose"]):
+                    ap.error("balancer eval|propose "
+                             "[--max-moves=N] [--pool=P] [--json]")
+                return cmd_balancer_eval(
+                    rc, fl.max_moves, fl.pool, out,
+                    fl.as_json or fl.rest[0] == "propose")
+            return cmd_pg_heat(rc, fl.pool, fl.top, out, fl.as_json)
+        except (RuntimeError, ValueError, OSError) as e:
+            out.write(f"Error: {e}\n")
+            return 1
+        finally:
+            rc.close()
     if extra:
         ap.error(f"unrecognized arguments: {' '.join(extra)}")
     if ns.dir is None:

@@ -1,0 +1,129 @@
+#!/usr/bin/env python3
+"""Compare the newest two BENCH_r*.json snapshots and fail on regression.
+
+Each BENCH_r*.json is a driver snapshot ``{n, cmd, rc, tail, parsed}``
+where ``parsed`` is the headline JSON line bench.py prints
+(``{"metric", "unit", "value", "vs_baseline", "extras": {...}}``).
+This script diffs the named headline metrics between the newest two
+snapshots and exits nonzero when any of them regressed by more than
+the threshold (default 30%).  Higher is better for every metric in
+the headline set (they are all throughput/rate numbers).
+
+Usage:
+    python scripts/bench_compare.py [--dir REPO] [--threshold 0.30]
+
+Exit codes: 0 ok / nothing to compare with <2 files, 1 regression,
+2 malformed snapshots.
+"""
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+
+# Headline metrics ("metric" itself plus dotted paths into "extras").
+# Only metrics present in BOTH snapshots are compared — a metric that
+# first appears in the newer run is new coverage, not a regression.
+HEADLINE = (
+    "ec_encode_rs8_3_gbps",
+    "extras.ec_decode_rs8_3_gbps",
+    "extras.crush_mappings_per_s",
+    "extras.cluster_system.put_gbps",
+    "extras.cluster_system.degraded_get_gbps",
+)
+
+
+def _load_parsed(path: str):
+    with open(path, "r", encoding="utf-8") as fh:
+        snap = json.load(fh)
+    parsed = snap.get("parsed")
+    if isinstance(parsed, dict) and "metric" in parsed:
+        return parsed
+    # fall back to scraping the tail for the headline JSON line
+    for line in reversed((snap.get("tail") or "").splitlines()):
+        line = line.strip()
+        if line.startswith("{") and '"metric"' in line:
+            try:
+                return json.loads(line)
+            except ValueError:
+                continue
+    return None
+
+
+def _lookup(parsed: dict, name: str):
+    """Resolve a headline name against a parsed snapshot: the bare
+    metric name matches ``parsed["metric"]``; dotted ``extras.*``
+    paths walk into the extras tree."""
+    if not name.startswith("extras."):
+        if parsed.get("metric") == name:
+            return parsed.get("value")
+        return None
+    node = parsed.get("extras") or {}
+    for part in name.split(".")[1:]:
+        if not isinstance(node, dict) or part not in node:
+            return None
+        node = node[part]
+    return node if isinstance(node, (int, float)) else None
+
+
+def compare(old: dict, new: dict, threshold: float):
+    """Return (rows, regressions) comparing headline metrics."""
+    rows, regressions = [], []
+    for name in HEADLINE:
+        a, b = _lookup(old, name), _lookup(new, name)
+        if a is None or b is None or not a:
+            continue
+        delta = (b - a) / abs(a)
+        rows.append((name, a, b, delta))
+        if delta < -threshold:
+            regressions.append((name, a, b, delta))
+    return rows, regressions
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--dir", default=os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), ".."))
+    ap.add_argument("--threshold", type=float, default=0.30,
+                    help="fractional drop that counts as a regression")
+    ns = ap.parse_args(argv)
+
+    files = glob.glob(os.path.join(ns.dir, "BENCH_r*.json"))
+    # newest two by run number (BENCH_r05 > BENCH_r04), not mtime —
+    # a checkout touches every mtime
+    files.sort(key=lambda p: int(
+        re.search(r"BENCH_r(\d+)", p).group(1)))
+    if len(files) < 2:
+        print(f"bench_compare: {len(files)} snapshot(s), nothing to "
+              "compare")
+        return 0
+    old_p, new_p = files[-2], files[-1]
+    old, new = _load_parsed(old_p), _load_parsed(new_p)
+    if old is None or new is None:
+        print(f"bench_compare: malformed snapshot "
+              f"({old_p if old is None else new_p})", file=sys.stderr)
+        return 2
+
+    rows, regressions = compare(old, new, ns.threshold)
+    print(f"bench_compare: {os.path.basename(old_p)} -> "
+          f"{os.path.basename(new_p)}  (threshold "
+          f"{ns.threshold:.0%})")
+    for name, a, b, delta in rows:
+        flag = "  REGRESSED" if delta < -ns.threshold else ""
+        print(f"  {name:44s} {a:12.3f} -> {b:12.3f}  "
+              f"{delta:+7.1%}{flag}")
+    if not rows:
+        print("  (no shared headline metrics)")
+    if regressions:
+        print(f"bench_compare: {len(regressions)} headline metric(s) "
+              f"regressed >{ns.threshold:.0%}", file=sys.stderr)
+        return 1
+    print("bench_compare: OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

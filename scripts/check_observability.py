@@ -225,10 +225,143 @@ def main() -> int:
         return _fail(f"disarmed trace sites cost {dt:.2f}s per 100k "
                      f"(want << 1s)")
 
+    # ---- ClusterScope (ISSUE 16): history, heat, compile spans ----
+    import glob
+    import math
+    import subprocess
+
+    # 7) telemetry history range query: writes between two heartbeat
+    # deliveries become a per-daemon counter series whose derived
+    # rates are finite, non-negative, and somewhere positive
+    for i in range(6):
+        client.put(1, f"hist-{i}", b"h" * 4096)
+    time.sleep(0.02)             # distinct report timestamps
+    hb.tick()
+    hq = cs.history.query("osd.io.wr_ops")
+    if not hq.get("series"):
+        return _fail("telemetry history: query returned no series")
+    n_samples = 0
+    any_pos = False
+    for daemon, ser in hq["series"].items():
+        if len(ser["samples"]) < 2:
+            continue
+        n_samples = max(n_samples, len(ser["samples"]))
+        vals = [v for _, v in ser["samples"]]
+        if ser.get("resets", 0) == 0 and vals != sorted(vals):
+            return _fail(f"history[{daemon}]: non-monotonic counter "
+                         f"series without a counted reset: {vals}")
+        for _ts, r in ser["rates"]:
+            if not (r >= 0.0) or math.isinf(r) or math.isnan(r):
+                return _fail(f"history[{daemon}]: insane rate {r}")
+            any_pos = any_pos or r > 0.0
+    if n_samples < 2:
+        return _fail("telemetry history: no daemon retained >= 2 "
+                     "samples")
+    if not any_pos:
+        return _fail("telemetry history: writes landed but every "
+                     "derived rate is zero")
+
+    # 8) a forced cold compile inside a traced op must surface as a
+    # `jit.compile` child span in that op's assembled trace — and the
+    # executing-daemon spans must carry their OWN service identity
+    from ceph_tpu.cluster.osdmap import POOL_ERASURE
+    from ceph_tpu.ops import gf_jax
+    sim.create_ec_profile("obsec", {"plugin": "jax", "k": "2",
+                                    "m": "1"})
+    sim.osdmap.add_pool(PGPool(id=2, name="ecobs", type=POOL_ERASURE,
+                               size=3, pg_num=8, crush_rule=0,
+                               erasure_code_profile="obsec"))
+    import copy
+    client.osdmap = copy.deepcopy(sim.osdmap)   # resync client view
+    from ceph_tpu.ops import xor_kernel
+    with gf_jax._seen_lock:      # force the encode matrix cold
+        gf_jax._seen_matrices.clear()
+    gf_jax._bitmatrix_device.cache_clear()
+    with xor_kernel._seen_lock:  # and the masked-XOR executable
+        xor_kernel._seen_shapes.clear()
+    config().set("op_tracker_complaint_time", 0.0001)
+    try:
+        client.put(2, "coldpoke", b"c" * 8192)
+    finally:
+        config().clear("op_tracker_complaint_time")
+    slow = tracker().dump_historic_slow_ops()
+    crec = next((op for op in slow["ops"]
+                 if op.get("obj") == "coldpoke"), None)
+    if crec is None or not crec.get("trace_id"):
+        return _fail("cold-compile op missing from the slow ring or "
+                     "carries no trace_id")
+    cspans = tracing.tracer().spans_for(crec["trace_id"])
+    jit_spans = [s for s in cspans if s["name"] == "jit.compile"]
+    if not jit_spans:
+        return _fail(f"cold-compile trace has no jit.compile span "
+                     f"({sorted({s['name'] for s in cspans})})")
+    comps = {s.get("tags", {}).get("component") for s in jit_spans}
+    if not any(str(c).startswith("ec.") for c in comps):
+        return _fail(f"jit.compile span not attributed to an EC "
+                     f"component: {sorted(map(str, comps))}")
+    osd_svcs = {s.get("service") for s in cspans
+                if s["name"] in ("osd.dispatch", "device.dispatch")}
+    if not any(str(s).startswith("osd.") for s in osd_svcs):
+        return _fail(f"executor spans carry no osd.N service "
+                     f"identity: {sorted(map(str, osd_svcs))}")
+
+    # 9) balancer advisor: on a skewed heat fixture the proposed
+    # mapping must re-score strictly better — and stay a DRY RUN
+    from ceph_tpu.mgr import balancer_advisor
+    for _ in range(40):
+        client.put(1, "hotspot", b"H" * 8192)
+    time.sleep(0.01)
+    hb.tick()
+    heat_rows = cs.pg_heat(top=5)
+    if not heat_rows or heat_rows[0]["heat"] <= 0:
+        return _fail(f"pg heat: no hot rows after skewed traffic "
+                     f"({heat_rows})")
+    if heat_rows[0]["tot_wr_ops"] < 40:
+        return _fail(f"pg heat: hottest row only "
+                     f"{heat_rows[0]['tot_wr_ops']} writes — the "
+                     f"hotspot PG is not on top")
+    epoch0 = sim.osdmap.epoch
+    upmaps0 = (dict(sim.osdmap.pg_upmap),
+               dict(sim.osdmap.pg_upmap_items))
+    rep = balancer_advisor.evaluate(sim.osdmap, cs, max_moves=8)
+    if sim.osdmap.epoch != epoch0 or \
+            (dict(sim.osdmap.pg_upmap),
+             dict(sim.osdmap.pg_upmap_items)) != upmaps0:
+        return _fail("balancer advisor ACTUATED (osdmap changed on a "
+                     "dry run)")
+    if rep["score_before"] <= 0:
+        return _fail(f"advisor: zero imbalance on a skewed fixture "
+                     f"({rep})")
+    if not rep["proposals"]:
+        return _fail(f"advisor proposed no moves on a skewed fixture "
+                     f"(score {rep['score_before']})")
+    if not rep["score_after"] < rep["score_before"]:
+        return _fail(f"advisor score did not improve: "
+                     f"{rep['score_before']} -> {rep['score_after']}")
+
+    # 10) bench regression gate rides the smoke path whenever two
+    # driver snapshots exist to diff
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    benches = glob.glob(os.path.join(repo, "BENCH_r*.json"))
+    bench_note = "no BENCH snapshots"
+    if len(benches) >= 2:
+        rcmp = subprocess.run(
+            [sys.executable,
+             os.path.join(repo, "scripts", "bench_compare.py")]
+        ).returncode
+        if rcmp != 0:
+            return _fail(f"bench_compare exited {rcmp} (headline "
+                         f"metric regression)")
+        bench_note = f"bench_compare OK over {len(benches)} snapshots"
+
     print(f"OK: {len(smoke)} tracked ops, per-stage histograms live, "
           f"/metrics scrapeable ({len(text)} bytes), cluster scrape "
           f"{len(ctext)} bytes ({len(cs.daemons())} daemons), slow "
-          f"trace {tree['spans']} spans, disarmed 100k in {dt:.3f}s")
+          f"trace {tree['spans']} spans, disarmed 100k in {dt:.3f}s, "
+          f"history {n_samples} samples, {len(jit_spans)} jit.compile "
+          f"span(s), advisor {rep['score_before']} -> "
+          f"{rep['score_after']} in {rep['moves']} moves, "
+          f"{bench_note}")
     return 0
 
 

@@ -1,0 +1,173 @@
+"""Balancer dry-run advisor (ISSUE 16): heat x utilization scoring and
+upmap proposals as a REPORT — `ceph balancer eval` never actuates.
+
+Pinned contracts:
+
+  * on a skewed heat fixture the advisor proposes moves whose
+    FROM-SCRATCH re-score is strictly lower than the current score;
+  * the osdmap is never mutated (epoch, upmap tables bit-identical);
+  * proposals respect CRUSH failure domains (a move never collapses
+    two replicas onto one host) and never target an OSD already in
+    the PG's up set;
+  * empty heat -> score 0, no proposals (nothing to advise on).
+"""
+import pytest
+
+from ceph_tpu.cluster.balancer import osd_ancestors, rule_failure_domain
+from ceph_tpu.cluster.osdmap import OSDMap, PGPool, POOL_REPLICATED
+from ceph_tpu.mgr.balancer_advisor import evaluate, imbalance_score
+from ceph_tpu.placement.builder import build_flat_cluster
+from ceph_tpu.placement.crush_map import (RULE_CHOOSELEAF_FIRSTN,
+                                          RULE_EMIT, RULE_TAKE, Rule)
+
+
+class FakeCS:
+    """The two ClusterStats surfaces the advisor reads."""
+
+    def __init__(self, heat_rows, df_rows):
+        self._heat = heat_rows
+        self._df = df_rows
+
+    def pg_heat(self, pool=None, top=None):
+        rows = [r for r in self._heat
+                if pool is None or r["pool"] == pool]
+        return rows[:top] if top else rows
+
+    def osd_df(self):
+        return self._df
+
+
+def make_map(n_hosts=4, osds_per_host=2, pg_num=16, seed=3):
+    cmap, root = build_flat_cluster(n_hosts=n_hosts,
+                                    osds_per_host=osds_per_host,
+                                    seed=seed)
+    cmap.add_rule(Rule(steps=[(RULE_TAKE, root, 0),
+                              (RULE_CHOOSELEAF_FIRSTN, 0, 1),
+                              (RULE_EMIT, 0, 0)]))
+    om = OSDMap(cmap)
+    om.mark_all_in_up()
+    om.add_pool(PGPool(id=1, name="rep", type=POOL_REPLICATED, size=3,
+                       pg_num=pg_num, crush_rule=0))
+    return om
+
+
+def skewed_cs(om, hot_osd=0, pool=1, base=1.0, hot=80.0):
+    """Heat rows where every PG touching ``hot_osd`` burns hot."""
+    p = om.pools[pool]
+    rows = []
+    for pg in range(p.pg_num):
+        up, _, _, _ = om.pg_to_up_acting_osds(pool, pg)
+        h = hot if hot_osd in up else base
+        rows.append({"pgid": f"{pool}.{pg}", "pool": pool, "heat": h,
+                     "wr_ops": h, "rd_ops": 0.0,
+                     "wr_bytes": 0.0, "rd_bytes": 0.0})
+    df = [{"daemon": f"osd.{o}", "utilization": 0.1}
+          for o in range(om.max_osd)]
+    return FakeCS(rows, df)
+
+
+def frozen_state(om):
+    return (om.epoch, dict(om.pg_upmap), dict(om.pg_upmap_items))
+
+
+# ------------------------------------------------------------ scoring --
+
+def test_imbalance_score_zero_when_proportional():
+    shares = {0: 0.5, 1: 0.25, 2: 0.25}
+    assert imbalance_score({0: 10.0, 1: 5.0, 2: 5.0}, shares) == 0.0
+    assert imbalance_score({}, shares) == 0.0
+    assert imbalance_score({0: 0.0, 1: 0.0}, {0: 0.5, 1: 0.5}) == 0.0
+
+
+def test_imbalance_score_grows_with_skew():
+    shares = {0: 0.5, 1: 0.5}
+    mild = imbalance_score({0: 12.0, 1: 8.0}, shares)
+    harsh = imbalance_score({0: 19.0, 1: 1.0}, shares)
+    assert 0 < mild < harsh
+
+
+# ---------------------------------------------------------- proposals --
+
+def test_skewed_fixture_yields_strictly_better_dry_run():
+    om = make_map()
+    cs = skewed_cs(om)
+    before = frozen_state(om)
+    rep = evaluate(om, cs, max_moves=8)
+    assert frozen_state(om) == before, "advisor mutated the osdmap"
+    assert rep["epoch"] == om.epoch
+    assert rep["score_before"] > 0
+    assert rep["proposals"], "no moves proposed on a skewed fixture"
+    assert rep["score_after"] < rep["score_before"]
+    assert rep["moves"] == len(rep["proposals"])
+    for p in rep["proposals"]:
+        assert p["from"] != p["to"]
+        assert p["heat"] > 0
+
+
+def test_proposals_respect_failure_domains_and_up_sets():
+    om = make_map()
+    cs = skewed_cs(om)
+    rep = evaluate(om, cs, max_moves=8)
+    assert rep["proposals"]
+    p1 = om.pools[1]
+    dom = osd_ancestors(om.crush,
+                        rule_failure_domain(om.crush, p1.crush_rule))
+    for p in rep["proposals"]:
+        pid, pg = (int(x) for x in p["pgid"].split("."))
+        up, _, _, _ = om.pg_to_up_acting_osds(pid, pg)
+        assert p["from"] in up
+        assert p["to"] not in up
+        # the post-move membership keeps one replica per failure domain
+        moved = [p["to"] if o == p["from"] else o for o in up]
+        doms = [int(dom[o]) for o in moved if 0 <= o < len(dom)]
+        assert len(doms) == len(set(doms)), \
+            f"move {p} collapses failure domains {doms}"
+
+
+def test_empty_heat_is_a_noop_report():
+    om = make_map()
+    cs = FakeCS([], [{"daemon": f"osd.{o}", "utilization": 0.0}
+                     for o in range(om.max_osd)])
+    rep = evaluate(om, cs)
+    assert rep["score_before"] == 0.0
+    assert rep["score_after"] == 0.0
+    assert rep["proposals"] == []
+    assert rep["pgs_considered"] == 0
+
+
+def test_pool_filter_restricts_consideration():
+    om = make_map()
+    om.add_pool(PGPool(id=2, name="other", type=POOL_REPLICATED,
+                       size=3, pg_num=8, crush_rule=0))
+    cs = skewed_cs(om, pool=1)
+    rep = evaluate(om, cs, pool=2)
+    assert rep["pgs_considered"] == 0      # pool 1 heat filtered out
+    rep = evaluate(om, cs, pool=1)
+    assert rep["pgs_considered"] == om.pools[1].pg_num
+
+
+def test_already_upmapped_pgs_are_skipped():
+    om = make_map()
+    cs = skewed_cs(om)
+    rep = evaluate(om, cs, max_moves=8)
+    assert rep["proposals"]
+    # pin every proposed PG with an existing upmap entry: the advisor
+    # must not re-propose them (accepting a plan is a separate verb,
+    # and double-proposing an applied move would thrash)
+    for p in rep["proposals"]:
+        pid, pg = (int(x) for x in p["pgid"].split("."))
+        om.pg_upmap_items[(pid, pg)] = [(p["from"], p["to"])]
+    rep2 = evaluate(om, cs, max_moves=8)
+    hit = {p["pgid"] for p in rep["proposals"]} & \
+        {p["pgid"] for p in rep2["proposals"]}
+    assert not hit, f"re-proposed already-upmapped PGs {hit}"
+
+
+def test_max_moves_bounds_the_plan():
+    om = make_map()
+    cs = skewed_cs(om)
+    rep = evaluate(om, cs, max_moves=1)
+    assert len(rep["proposals"]) <= 1
+    rep0 = evaluate(om, cs, max_moves=0)
+    assert rep0["proposals"] == []
+    assert rep0["score_after"] == rep0["score_before"]

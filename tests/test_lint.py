@@ -886,6 +886,86 @@ def test_ctl701_incrementally_built_dict_is_a_gap(tmp_path):
     assert [f.line for f in res.findings] == [4], res.findings
 
 
+def test_ctl702_set_on_rate_counter_all_receiver_shapes(tmp_path):
+    """CTL702: a `.set()` on a RATE_COUNTERS pair is flagged through
+    every receiver shape the tree uses (direct `_perf("g")` call,
+    `self.X = _perf("g")` attr), while inc-only use and unlisted
+    keys stay clean."""
+    write(tmp_path, "mgr/metrics_history.py", """\
+        RATE_COUNTERS = (
+            ("osd.io", "wr_ops"),
+            ("jit", "compiles"),
+        )
+        """)
+    write(tmp_path, "daemon.py", """\
+        from perf_counters import perf as _perf
+
+        class OSD:
+            def __init__(self):
+                self._pc_io = _perf("osd.io")
+
+            def on_write(self):
+                self._pc_io.inc("wr_ops")
+
+            def load_stats(self, n):
+                self._pc_io.set("wr_ops", n)       # gauge retype
+
+        def restore(v):
+            _perf("jit").set("compiles", v)        # gauge retype
+
+        def on_compile():
+            pc = _perf("jit")
+            pc.inc("compiles")
+
+        def depth_gauge(d):
+            _perf("osd.io").set("queue_depth", d)  # key not listed
+        """)
+    res = lint(tmp_path, select=["CTL702"])
+    assert rules_of(res) == ["CTL702", "CTL702"], res.findings
+    hits = {(f.path, f.line) for f in res.findings}
+    assert hits == {("daemon.py", 11), ("daemon.py", 14)}, hits
+    assert all("monotonic (inc-only)" in f.msg for f in res.findings)
+
+
+def test_ctl702_listed_counter_without_inc_site(tmp_path):
+    """A RATE_COUNTERS entry nothing increments is a finding anchored
+    at the declaration — the history ring would query a counter that
+    never moves."""
+    write(tmp_path, "mgr/metrics_history.py", """\
+        RATE_COUNTERS = (
+            ("osd.io", "wr_ops"),
+            ("jit", "compiles"),
+        )
+        """)
+    write(tmp_path, "osd.py", """\
+        from perf_counters import perf
+
+        def on_write():
+            perf("osd.io").inc("wr_ops")
+        """)
+    res = lint(tmp_path, select=["CTL702"])
+    assert [(f.path, f.line) for f in res.findings] == \
+        [("mgr/metrics_history.py", 1)], res.findings
+    assert "jit.compiles" in res.findings[0].msg
+    assert "no .inc() declaration site" in res.findings[0].msg
+
+
+def test_ctl702_noqa_and_inc_only_tree_clean(tmp_path):
+    write(tmp_path, "mgr/metrics_history.py", """\
+        RATE_COUNTERS = (("osd.io", "wr_ops"),)
+        """)
+    write(tmp_path, "osd.py", """\
+        from perf_counters import perf
+
+        def on_write():
+            perf("osd.io").inc("wr_ops")
+
+        def restore(v):
+            perf("osd.io").set("wr_ops", v)  # noqa: CTL702
+        """)
+    assert not lint(tmp_path, select=["CTL702"]).findings
+
+
 def test_ctl120_recovery_named_helper_without_own_loop(tmp_path):
     """A recovery-NAMED helper whose blocking send is straight-line
     (no loop of its own) is still one RTT per iteration of the

@@ -1,0 +1,171 @@
+"""MetricsHistory (ISSUE 16): the leader mon's bounded time-series
+ring — log2 downsampling, rate derivation, reset clamping, reporter
+aging.
+
+The pinned properties:
+
+  * downsampling CONSERVES COUNTER SUMS — the ring keeps the newer
+    sample of each folded pair, and cumulative counters telescope, so
+    the total delta across the retained series equals the raw delta
+    over the same window, at every fill level (property test over
+    seeds);
+  * a counter that goes BACKWARDS (daemon restart) is a counted reset
+    and clamps to rate 0.0 — never a negative or garbage rate;
+  * reporters age out of queries after ``stale_s`` (600 s default);
+  * retention stays bounded at samples x levels entries per reporter.
+"""
+import random
+
+import pytest
+
+from ceph_tpu.common.perf_counters import COUNTER, GAUGE
+from ceph_tpu.mgr.metrics_history import (HISTORY_GROUPS, RATE_COUNTERS,
+                                          MetricsHistory, _Ring)
+
+
+def _report(wr_ops, wr_bytes=0.0, compiles=0.0):
+    """Nested perf payload the aggregator hands to record()."""
+    return {
+        "osd.io": {"wr_ops": (COUNTER, float(wr_ops)),
+                   "wr_bytes": (COUNTER, float(wr_bytes)),
+                   "queue_depth": (GAUGE, 3.0)},     # never retained
+        "jit": {"compiles": (COUNTER, float(compiles))},
+        "op_tracker": {"ops": (COUNTER, 99.0)},      # group not listed
+    }
+
+
+# ------------------------------------------------------------ flatten --
+
+def test_flatten_keeps_only_history_group_counters():
+    flat = MetricsHistory.flatten(_report(7, wr_bytes=512, compiles=2))
+    assert flat == {"osd.io.wr_ops": 7.0, "osd.io.wr_bytes": 512.0,
+                    "jit.compiles": 2.0}
+    # gauges and unlisted groups never enter the delta pipeline
+    assert "osd.io.queue_depth" not in flat
+    assert "op_tracker.ops" not in flat
+
+
+def test_rate_counters_all_live_in_history_groups():
+    """The CTL702 contract's precondition: every headline rate pair
+    names a retained group (else the lint guards a dead surface)."""
+    for group, _key in RATE_COUNTERS:
+        assert group in HISTORY_GROUPS
+
+
+# ---------------------------------------------- downsampling property --
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3])
+def test_downsampling_conserves_counter_sums(seed):
+    """Push far more deliveries than the raw ring holds; at EVERY
+    point the retained series' summed deltas must equal newest -
+    oldest retained value (telescoping survives every fold), and the
+    retained values must stay a monotone subsequence of the input."""
+    r = random.Random(seed)
+    ring = _Ring(samples=4, n_levels=3)
+    total = 0.0
+    for i in range(200):
+        total += r.uniform(0, 10)
+        ring.push(float(i), {"osd.io.wr_ops": total})
+        series = ring.series()
+        ts = [t for t, _ in series]
+        vals = [f["osd.io.wr_ops"] for _, f in series]
+        assert ts == sorted(ts)
+        assert vals == sorted(vals), "cumulative counter went backwards"
+        deltas = [b - a for a, b in zip(vals, vals[1:])]
+        assert sum(deltas) == pytest.approx(vals[-1] - vals[0])
+        # the newest raw sample always survives (it carries the total)
+        assert vals[-1] == pytest.approx(total)
+        assert ring.sample_count() <= 4 * 3
+
+
+def test_ring_bound_and_deepest_level_drops():
+    ring = _Ring(samples=2, n_levels=2)
+    for i in range(100):
+        ring.push(float(i), {"c": float(i)})
+    assert ring.sample_count() <= 4
+    # the deepest level plainly drops its oldest: coverage is bounded,
+    # newest still present
+    assert ring.series()[-1][1]["c"] == 99.0
+
+
+# --------------------------------------------------- rates and resets --
+
+def test_rates_derive_from_deltas():
+    h = MetricsHistory(samples=8, levels=2)
+    for i, v in enumerate([0, 10, 30, 30]):
+        h.record("osd.0", 100.0 + 2 * i, _report(v))
+    q = h.query("osd.io.wr_ops", now=110.0)
+    s = q["series"]["osd.0"]
+    assert [v for _, v in s["samples"]] == [0.0, 10.0, 30.0, 30.0]
+    assert [r for _, r in s["rates"]] == [5.0, 10.0, 0.0]
+    assert s["resets"] == 0 and q["counter_resets"] == 0
+
+
+def test_counter_reset_clamps_and_counts():
+    """A restart zeroes the daemon's counters: the backward sample is
+    a counted reset, and its interval rate clamps to exactly 0.0."""
+    h = MetricsHistory(samples=8, levels=2)
+    assert h.record("osd.1", 100.0, _report(50, wr_bytes=4096)) == 0
+    assert h.record("osd.1", 102.0, _report(80, wr_bytes=8192)) == 0
+    # restart: BOTH retained counters go backwards in one delivery
+    n = h.record("osd.1", 104.0, _report(3, wr_bytes=128))
+    assert n == 2
+    h.record("osd.1", 106.0, _report(13, wr_bytes=256))
+    q = h.query("osd.io.wr_ops", now=106.0)
+    s = q["series"]["osd.1"]
+    assert [r for _, r in s["rates"]] == [15.0, 0.0, 5.0]
+    assert all(r >= 0.0 for _, r in s["rates"])
+    # one reset EVENT (per delivery), surfaced per-ring and globally
+    assert s["resets"] == 1
+    assert q["counter_resets"] == 1
+    assert h.dump()["reporters"]["osd.1"]["resets"] == 1
+
+
+def test_window_rate_short_vs_long():
+    h = MetricsHistory(samples=8, levels=2)
+    for i, v in enumerate([0, 100, 110]):
+        h.record("osd.2", 100.0 + 10 * i, _report(v))
+    assert h.window_rate("osd.2", "osd.io.wr_ops", window=2) == 1.0
+    assert h.window_rate("osd.2", "osd.io.wr_ops", window=8) == 5.5
+    assert h.window_rate("osd.2", "nope", window=2) is None
+
+
+def test_sparkline_shapes():
+    h = MetricsHistory(samples=16, levels=2)
+    assert h.sparkline("osd.3", "osd.io.wr_ops") == "-"
+    for i, v in enumerate([0, 0, 100, 100]):
+        h.record("osd.3", 100.0 + i, _report(v))
+    line = h.sparkline("osd.3", "osd.io.wr_ops")
+    assert len(line) == 3
+    assert line[0] == "▁" and line[2] == "▁" and line[1] == "█"
+
+
+# ------------------------------------------------------ reporter aging --
+
+def test_reporters_age_out_after_stale_window():
+    """600 s without a delivery drops the reporter from queries — a
+    dead daemon must not pin week-old series into the CLI."""
+    h = MetricsHistory(samples=8, levels=2, stale_s=600.0)
+    h.record("osd.4", 1000.0, _report(5))
+    h.record("osd.4", 1010.0, _report(9))
+    h.record("osd.5", 1500.0, _report(2))
+    h.record("osd.5", 1510.0, _report(4))
+    q = h.query("osd.io.wr_ops", now=1599.0)
+    assert set(q["series"]) == {"osd.4", "osd.5"}
+    # osd.4's newest delivery (1010) ages past 600 s; osd.5 survives
+    q = h.query("osd.io.wr_ops", now=1611.0)
+    assert set(q["series"]) == {"osd.5"}
+    assert h.reporters() == ["osd.5"]
+
+
+def test_query_daemon_filter_and_time_range():
+    h = MetricsHistory(samples=8, levels=2)
+    for d in ("osd.6", "osd.7"):
+        for i in range(4):
+            h.record(d, 100.0 + i, _report(i * 10))
+    q = h.query("osd.io.wr_ops", daemon="osd.6", now=104.0)
+    assert set(q["series"]) == {"osd.6"}
+    q = h.query("osd.io.wr_ops", daemon="osd.6",
+                since=101.0, until=102.0, now=104.0)
+    assert [ts for ts, _ in q["series"]["osd.6"]["samples"]] == \
+        [101.0, 102.0]

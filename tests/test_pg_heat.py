@@ -1,0 +1,125 @@
+"""PGHeatTracker (ISSUE 16): per-PG client-io heat with exponential
+decay — the pool-HitSet role feeding `ceph pg heat` and the balancer
+advisor.
+
+Pinned contracts:
+
+  * decay on the SIM TICK clock is seed-deterministic: the same op
+    sequence and tick schedule produce bit-identical heat tables;
+  * raw ``tot_*`` ledgers never decay (the agrees-with-osd.io series);
+  * the mon-side merge sums per-OSD tables per PG, filters by pool,
+    sorts hottest-first;
+  * the per-OSD rollup's totals equal the sum of its PG entries.
+"""
+import random
+
+import pytest
+
+from ceph_tpu.cluster.pg_heat import (PGHeatTracker, merge_heat,
+                                      osd_heat_rollup)
+
+
+def _drive(tracker, seed, n=200):
+    """A seeded op schedule interleaved with tick advances."""
+    r = random.Random(seed)
+    for i in range(n):
+        pool = r.choice((1, 2))
+        pg = r.randrange(8)
+        if r.random() < 0.6:
+            tracker.record(pool, pg, "wr", nbytes=r.randrange(1 << 16))
+        else:
+            tracker.record(pool, pg, "rd", nbytes=r.randrange(1 << 16))
+        if i % 7 == 0:
+            tracker.advance(float(i) / 3.0)
+
+
+@pytest.mark.parametrize("seed", [0, 3, 11])
+def test_decay_is_seed_deterministic_on_tick_clock(seed):
+    a = PGHeatTracker(half_life=5.0)
+    b = PGHeatTracker(half_life=5.0)
+    _drive(a, seed)
+    _drive(b, seed)
+    assert a.dump() == b.dump()
+    assert a.totals() == b.totals()
+    c = PGHeatTracker(half_life=5.0)
+    _drive(c, seed + 1)
+    assert c.dump() != a.dump()
+
+
+def test_half_life_halves_decayed_not_totals():
+    t = PGHeatTracker(half_life=4.0)
+    for _ in range(10):
+        t.record(1, 0, "wr", nbytes=100)
+    t.advance(4.0)                       # exactly one half-life
+    ent = t.dump()["pgs"]["1.0"]
+    assert ent["wr_ops"] == pytest.approx(5.0)
+    assert ent["wr_bytes"] == pytest.approx(500.0)
+    # the raw ledger is monotonic — never decayed
+    assert ent["tot_wr_ops"] == 10.0
+    assert ent["tot_wr_bytes"] == 1000.0
+    t.advance(8.0)
+    ent = t.dump()["pgs"]["1.0"]
+    assert ent["wr_ops"] == pytest.approx(2.5)
+    assert ent["tot_wr_ops"] == 10.0
+
+
+def test_clock_standstill_means_no_decay():
+    t = PGHeatTracker(half_life=0.001)   # brutal half-life, no clock
+    t.record(2, 5, "rd", nbytes=64)
+    ent = t.dump()["pgs"]["2.5"]
+    assert ent["rd_ops"] == 1.0          # time never moved
+
+
+def test_injected_clock_is_used():
+    now = [100.0]
+    t = PGHeatTracker(half_life=2.0, clock=lambda: now[0])
+    t.record(1, 1, "wr")
+    now[0] = 102.0
+    assert t.dump()["pgs"]["1.1"]["wr_ops"] == pytest.approx(0.5)
+
+
+# ------------------------------------------------------- mon merging --
+
+def _dumps():
+    """Two OSDs sharing pg 1.0; osd.1 alone serves pool 2."""
+    a = PGHeatTracker(half_life=10.0)
+    b = PGHeatTracker(half_life=10.0)
+    for _ in range(6):
+        a.record(1, 0, "wr", nbytes=1000)
+    a.record(1, 1, "rd", nbytes=500)
+    for _ in range(4):
+        b.record(1, 0, "wr", nbytes=1000)
+    b.record(2, 0, "rd", nbytes=4 << 20)
+    return {"osd.0": a.dump(), "osd.1": b.dump()}
+
+
+def test_merge_sums_across_osds_and_sorts_hottest_first():
+    rows = merge_heat(_dumps())
+    assert [r["pgid"] for r in rows][:1] == ["1.0"]
+    top = rows[0]
+    # 6 writes counted by osd.0 + 4 by osd.1 = the PG's cluster load
+    assert top["wr_ops"] == pytest.approx(10.0)
+    assert top["tot_wr_bytes"] == pytest.approx(10000.0)
+    assert sorted(top["osds"]) == ["osd.0", "osd.1"]
+    heats = [r["heat"] for r in rows]
+    assert heats == sorted(heats, reverse=True)
+    # the byte term: 4 MiB of reads weighs like one op
+    pg20 = next(r for r in rows if r["pgid"] == "2.0")
+    assert pg20["heat"] == pytest.approx(2.0)
+
+
+def test_merge_pool_filter_and_top():
+    rows = merge_heat(_dumps(), pool=1)
+    assert {r["pool"] for r in rows} == {1}
+    rows = merge_heat(_dumps(), top=1)
+    assert len(rows) == 1 and rows[0]["pgid"] == "1.0"
+
+
+def test_osd_rollup_totals_match_pg_sum():
+    dumps = _dumps()
+    roll = osd_heat_rollup(dumps)
+    assert set(roll) == {"osd.0", "osd.1"}
+    for reporter, d in dumps.items():
+        want = sum(e["tot_wr_ops"] for e in d["pgs"].values())
+        assert roll[reporter]["tot_wr_ops"] == pytest.approx(want)
+    assert roll["osd.0"]["heat"] > 0

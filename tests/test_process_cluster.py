@@ -690,3 +690,81 @@ def test_lrc_wire_recovery_rebuilds_with_sub_k_plan(tmp_path):
         rc.close()
     finally:
         v.stop()
+
+
+def test_kill9_reboot_keeps_history_rates_sane(tmp_path, monkeypatch):
+    """ISSUE 16 satellite: a SIGKILLed-and-rebooted OSD restarts its
+    in-process perf counters from zero, so its next report_perf
+    delivery goes BACKWARDS.  The mon's metrics-history layer must
+    count that as a reset and clamp the interval to rate 0.0 — the
+    `ceph telemetry history` wire series stays consistent, never a
+    negative rate, across the reboot."""
+    d = str(tmp_path / "cluster")
+    build_cluster_dir(d, n_osds=3, osds_per_host=1, fsync=False)
+    v = Vstart(d)
+    v.start(3, hb_interval=0.25)
+    try:
+        rc = _client(d)
+
+        def hist():
+            return rc.mon_call({"cmd": "cluster_stats",
+                                "history": {"counter":
+                                            "osd.io.wr_ops"}})
+
+        for i in range(16):
+            assert rc.put(1, f"h{i}", b"x" * 4096) >= 1
+
+        # wr_ops is a PRIMARY-side counter, so only OSDs that primary
+        # a written PG ever report it — demand two such reporters,
+        # each with a real multi-sample series
+        def two_reporters_sampled():
+            q = hist()
+            live = [s for s in q["series"].values()
+                    if len(s["samples"]) >= 2
+                    and s["samples"][-1][1] > 0]
+            return len(live) >= 2
+        wait_for_state(two_reporters_sampled,
+                       desc="multi-sample history on two OSDs")
+        q0 = hist()
+        victim = max(q0["series"],
+                     key=lambda k: q0["series"][k]["samples"][-1][1])
+        vid = int(victim.split(".")[1])
+        assert q0["series"][victim]["resets"] == 0
+
+        v.kill9(victim)
+        assert not v.alive(victim)
+        v.start_osd(vid, hb_interval=0.25)
+        wait_for_state(lambda: rc.status()["n_up"] >= 3,
+                       desc="rebooted OSD back up")
+
+        # fresh counters start at zero; keep writing NEW names until
+        # the rebooted primary counts one (fewer than its pre-kill
+        # total, so the delivery goes backwards) and the mon counts
+        # the reset.  One put per poll keeps the budget bounded.
+        n_extra = [0]
+
+        def reset_counted():
+            rc.refresh_map()
+            rc.put(1, f"r{n_extra[0]}", b"y" * 4096)
+            n_extra[0] += 1
+            q = hist()
+            s = q["series"].get(victim)
+            return bool(s) and s["resets"] >= 1 and \
+                q["counter_resets"] >= 1
+        wait_for_state(reset_counted, polls=60,
+                       desc="reboot counted as reset")
+
+        q = hist()
+        s = q["series"][victim]
+        rates = [r for _, r in s["rates"]]
+        assert rates, "no rates derived across the reboot"
+        assert all(r >= 0.0 for r in rates), \
+            f"negative rate across reboot: {rates}"
+        # the daemon filter narrows the wire reply to the victim
+        qf = rc.mon_call({"cmd": "cluster_stats",
+                          "history": {"counter": "osd.io.wr_ops",
+                                      "daemon": victim}})
+        assert set(qf["series"]) == {victim}
+        rc.close()
+    finally:
+        v.stop()
