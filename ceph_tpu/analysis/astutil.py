@@ -38,6 +38,15 @@ TRACE_COMBINATORS = {
     "jax.lax.associative_scan",
 }
 PARTIAL_NAMES = {"functools.partial", "partial"}
+# shard_map wraps its FIRST argument as a per-device traced body; the
+# remaining arguments are mesh/spec pytrees, never callables — so only
+# args[0] joins the hot set (treated like a combinator target, not a
+# directly-jitted root: the body's params are per-shard views)
+SHARD_MAP_NAMES = {
+    "jax.experimental.shard_map.shard_map",
+    "jax.experimental.shard_map",
+    "jax.shard_map",
+}
 
 
 def import_aliases(tree: ast.AST) -> Dict[str, str]:
@@ -439,6 +448,11 @@ def _program_hot(program) -> HotInfo:
                     for fn in g.resolve_ref(mod, None, a):
                         if not g.mod_of[fn].evidence:
                             info.hot.add(fn)
+            elif cn in SHARD_MAP_NAMES:
+                for a in node.args[:1]:
+                    for fn in g.resolve_ref(mod, None, a):
+                        if not g.mod_of[fn].evidence:
+                            info.hot.add(fn)
 
     work = list(info.hot)
     while work:
@@ -517,6 +531,12 @@ def hot_functions(mod) -> HotInfo:
                         mark_direct(fn, node)
         elif cn in TRACE_COMBINATORS:
             for a in node.args:
+                base = dotted(a)
+                if base:
+                    for fn in funcs.get(_tail(base), ()):
+                        info.hot.add(fn)
+        elif cn in SHARD_MAP_NAMES:
+            for a in node.args[:1]:
                 base = dotted(a)
                 if base:
                     for fn in funcs.get(_tail(base), ()):

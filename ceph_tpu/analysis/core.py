@@ -42,7 +42,7 @@ class Finding:
 # `# noqa: E402` must NOT blanket-suppress CTL rules)
 _NOQA_RE = re.compile(
     r"#\s*noqa\b(?P<colon>\s*:\s*(?P<codes>[^#]*))?", re.IGNORECASE)
-_NOQA_CODE_RE = re.compile(r"[A-Za-z]{1,4}\d{3}")
+_NOQA_CODE_RE = re.compile(r"[A-Za-z]{1,4}\d{3,4}")
 
 
 class ParsedModule:

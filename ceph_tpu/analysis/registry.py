@@ -106,12 +106,12 @@ class RuleRegistry:
         # register(reg), mirroring the EC plugin seam
         from . import (rules_admin, rules_concurrency, rules_dtype,
                        rules_faults, rules_jax, rules_perfconfig,
-                       rules_protocol, rules_serving, rules_trace,
-                       rules_wire)
+                       rules_protocol, rules_serving, rules_shard,
+                       rules_trace, rules_wire)
         for mod in (rules_jax, rules_dtype, rules_concurrency,
                     rules_perfconfig, rules_admin, rules_faults,
                     rules_trace, rules_protocol, rules_serving,
-                    rules_wire):
+                    rules_wire, rules_shard):
             mod.register(self)
 
 

@@ -32,7 +32,7 @@ from __future__ import annotations
 import ast
 from typing import Dict, Iterable, List, Optional, Set, Tuple
 
-from . import astutil
+from . import astutil, shardspec
 from .core import Finding, ParsedModule, Rule
 
 
@@ -120,13 +120,19 @@ class FireInJitRule(Rule):
     def check_module(self, mod: ParsedModule) -> Iterable[Finding]:
         if mod.evidence:
             return ()
-        info = astutil.hot_functions(mod)
-        if not info.hot:
+        # the ShardCheck DeviceContext and astutil.hot_functions slice
+        # the SAME whole-program reachability set (computed once per
+        # run); CTL602 reads it through the shared context so the
+        # jit/shard_map families cannot disagree on what is traced
+        hot = shardspec.device_context(mod.program).hot_in(mod) \
+            if mod.program is not None else \
+            astutil.hot_functions(mod).hot
+        if not hot:
             return ()
         aliases = astutil.aliases_of(mod)
         out: List[Finding] = []
         seen: Set[int] = set()               # nested-hot dedup
-        for fn in info.hot:
+        for fn in hot:
             for node in ast.walk(fn):
                 if isinstance(node, ast.Call) and \
                         isinstance(node.func, ast.Attribute) and \

@@ -37,7 +37,7 @@ import ast
 import re
 from typing import Iterable, List, Set, Tuple
 
-from . import astutil
+from . import astutil, shardspec
 from .core import Finding, ParsedModule, Rule
 
 # method calls that force a device->host readback on an array
@@ -161,7 +161,8 @@ _BLOCKING_ATTRS = {
 # engine's workers, NOT in callback context
 _DEFER_ATTRS = {"submit"}
 # registration sites whose callable argument becomes callback-context
-_CB_REG_ATTRS = {"set_complete_callback", "add_done_callback"}
+# (cb= / set_complete_callback / add_done_callback) are collected by
+# the shared ShardCheck DeviceContext in analysis/shardspec.py
 
 
 class CallbackBlockingRule(Rule):
@@ -172,11 +173,6 @@ class CallbackBlockingRule(Rule):
                    "functions run on stream reader threads) — "
                    "whole-program: the callback may be registered "
                    "in one module and block in another")
-
-    def __init__(self) -> None:
-        super().__init__()
-        # root callable -> (origin name, ParsedModule, enclosing cls)
-        self.roots: dict = {}
 
     @staticmethod
     def _own_calls(fn: ast.AST) -> List[ast.Call]:
@@ -203,50 +199,21 @@ class CallbackBlockingRule(Rule):
         visit(fn)
         return out
 
-    def check_module(self, mod: ParsedModule) -> Iterable[Finding]:
-        """Collect callback ROOTS only; reachability and reporting
-        run once, whole-program, in finish()."""
-        if mod.evidence:
-            return ()
-        graph = astutil.program_graph(mod.program)
-
-        def note(v: ast.AST, cls) -> None:
-            if isinstance(v, ast.Lambda):
-                self.roots.setdefault(
-                    v, ("<lambda callback>", mod, cls))
-            else:
-                for fn in graph.resolve_ref(mod, cls, v):
-                    tmod = graph.mod_of[fn]
-                    if not tmod.evidence:
-                        self.roots.setdefault(
-                            fn, (fn.name, tmod, graph.cls_of[fn]))
-
-        def visit(node: ast.AST, cls) -> None:
-            for ch in ast.iter_child_nodes(node):
-                ncls = ch.name if isinstance(ch, ast.ClassDef) else cls
-                if isinstance(ch, ast.Call):
-                    for kw in ch.keywords:
-                        if kw.arg == "cb":
-                            note(kw.value, cls)
-                    if isinstance(ch.func, ast.Attribute) and \
-                            ch.func.attr in _CB_REG_ATTRS and ch.args:
-                        note(ch.args[0], cls)
-                visit(ch, ncls)
-
-        visit(mod.tree, None)
-        return ()
-
     def finish(self) -> Iterable[Finding]:
-        if not self.roots:
+        # callback ROOT collection lives on the shared ShardCheck
+        # DeviceContext (analysis/shardspec.py) — one tree walk feeds
+        # this rule AND the CTL10xx shard_map site collection, so the
+        # reachability families share a single per-run computation
+        roots = shardspec.device_context(self.program).callback_roots
+        if not roots:
             return ()
         graph = astutil.program_graph(self.program)
         # callback-context reachability over the resolved
         # cross-module graph, own-frame calls only (deferred
         # arguments escape callback context by design)
-        origin = {fn: name for fn, (name, _m, _c) in
-                  self.roots.items()}
-        ctx = {fn: (m, c) for fn, (_n, m, c) in self.roots.items()}
-        work = list(self.roots)
+        origin = {fn: name for fn, (name, _m, _c) in roots.items()}
+        ctx = {fn: (m, c) for fn, (_n, m, c) in roots.items()}
+        work = list(roots)
         while work:
             fn = work.pop()
             mod, cls = ctx[fn]

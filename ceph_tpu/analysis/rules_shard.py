@@ -1,0 +1,653 @@
+"""CTL10xx — ShardCheck: static SPMD/mesh-axis verification.
+
+Every collective in a ``shard_map`` body is pinned to a mesh axis by a
+string name, every spec position promises a layout, and nothing checks
+either until the program runs on a real multi-device mesh — CI's
+forced-CPU single-device mesh traces the broken program fine.  These
+rules interpret the ShardCheck abstract domain
+(analysis/shardspec.py, riding the PR-12 ``ProgramGraph``) and close
+that gap statically, the way CTL8xx closed the wire-protocol contract:
+
+  CTL1001  collective-axis closure — every axis name a collective
+           reachable from a shard_map body uses (across modules) must
+           be bound by that site's mesh; misspelled/unbound = error,
+           and hardcoded axis string literals outside parallel/mesh.py
+           are flagged (import the shared constants)
+  CTL1002  trace-time side effects — host-state mutation (perf counter
+           incs, self attr/dict mutation, appends to captured host
+           lists, logging/print) in jit/shard_map-reachable code runs
+           ONCE at trace time and silently lies thereafter
+  CTL1003  per-device host sync — ``jax.device_get``, ``int(x)``/
+           ``float(x)`` tracer casts, ``.addressable_shards`` /
+           ``.devices()`` introspection inside shard_map-reachable
+           code (the np.*/.item()/.block_until_ready() forms are
+           CTL101's, which covers shard bodies through the same
+           shared hot set)
+  CTL1004  spec discipline — in_specs arity matches the wrapped
+           function's parameters, out_specs arity matches its
+           returns, and every PartitionSpec axis exists in the mesh
+           bound at that call site
+  CTL1005  unreduced accounting — a shard_map body returning a
+           reduction through a replicated out_spec with no psum-class
+           collective reads one device's partial as the cluster total
+           (the bug PR 4's psum accounting exists to prevent); plus
+           literal ppermute permutations must not repeat a source or
+           destination
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from . import astutil, shardspec
+from .core import Finding, ParsedModule, Rule
+
+
+def _sorted_reach(ctx, site) -> List[ast.AST]:
+    return sorted(
+        site.reach,
+        key=lambda f: (ctx.mod_of(f, site).relpath,
+                       getattr(f, "lineno", 0)))
+
+
+class AxisClosureRule(Rule):
+    rule_id = "CTL1001"
+    name = "shard-axis-closure"
+    description = ("collective axis name reachable from a shard_map "
+                   "body is not bound by that site's mesh (misspelled "
+                   "axes detonate only on a real multi-device mesh), "
+                   "or a hardcoded axis string bypasses the shared "
+                   "constants in parallel/mesh.py")
+
+    def finish(self) -> Iterable[Finding]:
+        ctx = shardspec.device_context(self.program)
+        out: List[Finding] = []
+        emitted: Set[Tuple[str, int, str]] = set()
+
+        def emit(mod, line: int, msg: str) -> None:
+            key = (mod.relpath, line, msg)
+            if key not in emitted:
+                emitted.add(key)
+                out.append(self.finding(mod, line, msg))
+
+        for site in ctx.sites:
+            # mesh not statically resolvable (self.mesh): bound =
+            # the axes the site's own specs use plus the BLESSED
+            # vocabulary from parallel/mesh.py — a misspelled name
+            # pinned as a constant elsewhere must still be unbound
+            bound = site.mesh_axes if site.mesh_axes is not None \
+                else frozenset(site.spec_axes()
+                               | ctx.mesh_axis_values)
+            for fn in _sorted_reach(ctx, site):
+                mod = ctx.mod_of(fn, site)
+                if mod.evidence:
+                    continue
+                aliases = astutil.aliases_of(mod)
+                env = shardspec.fn_env(fn) \
+                    if not isinstance(fn, ast.Lambda) else {}
+                fname = getattr(fn, "name", "<lambda>")
+                for call in ast.walk(fn):
+                    if not isinstance(call, ast.Call):
+                        continue
+                    cn = astutil.resolve(call.func, aliases)
+                    idx = shardspec.COLLECTIVES.get(cn or "")
+                    if idx is None:
+                        continue
+                    tail = cn.rsplit(".", 1)[-1]
+                    for anode in shardspec.collective_axis_nodes(
+                            call, idx):
+                        val = ctx.resolve_axis(mod, env, anode)
+                        lit = isinstance(anode, ast.Constant)
+                        if lit and not shardspec.is_mesh_module(
+                                mod.relpath):
+                            emit(mod, anode.lineno,
+                                 f"hardcoded axis string {val!r} in "
+                                 f"lax.{tail}() inside {fname}() — "
+                                 f"import the shared axis constants "
+                                 f"from parallel/mesh.py so the 2-D "
+                                 f"mesh rename is one edit")
+                        if val is None:
+                            continue      # runtime axis: stay quiet
+                        if val not in bound:
+                            emit(mod, call.lineno,
+                                 f"collective axis {val!r} in "
+                                 f"lax.{tail}() inside {fname}() is "
+                                 f"not bound by the mesh at shard_map "
+                                 f"site {site.where()} — bound axes: "
+                                 f"{sorted(bound)}")
+            # hardcoded axis literals inside the spec pytrees
+            for spec in (site.in_specs, site.out_specs):
+                if spec is None or shardspec.is_mesh_module(
+                        site.mod.relpath):
+                    continue
+                for val, node, lit in spec.axis_nodes:
+                    if lit:
+                        emit(site.mod, node.lineno,
+                             f"hardcoded axis string {val!r} in a "
+                             f"PartitionSpec at shard_map site "
+                             f"{site.where()} — import the shared "
+                             f"axis constants from parallel/mesh.py")
+        return out
+
+
+# mutating container/profiling verbs whose receiver is host state
+_MUTATORS = {
+    "append", "extend", "insert", "add", "update", "setdefault",
+    "pop", "popitem", "remove", "discard", "clear", "write", "put",
+}
+# perf-counter verbs: host state even through a local handle
+_COUNTER_MUTATORS = {"inc", "tinc", "hinc"}
+_LOG_ATTRS = {"debug", "info", "warning", "warn", "error",
+              "exception", "critical", "log"}
+_LOG_RECV = {"logger", "log", "_log", "_logger", "LOG"}
+
+
+def _own_nodes(fn: ast.AST) -> Iterable[ast.AST]:
+    """Nodes executed in ``fn``'s own frame — nested def/lambda bodies
+    excluded (they are hot in their own right only if reached)."""
+    work = list(ast.iter_child_nodes(fn))
+    while work:
+        n = work.pop()
+        yield n
+        if not isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda)):
+            work.extend(ast.iter_child_nodes(n))
+
+
+def _local_names(fn: ast.AST) -> Set[str]:
+    """Names bound inside ``fn`` (params, assignments, loop/with
+    targets, comprehensions) MINUS global/nonlocal declarations —
+    mutation of anything else escapes the trace."""
+    out: Set[str] = set()
+    a = fn.args
+    for p in a.posonlyargs + a.args + a.kwonlyargs:
+        out.add(p.arg)
+    if a.vararg:
+        out.add(a.vararg.arg)
+    if a.kwarg:
+        out.add(a.kwarg.arg)
+    escaped: Set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, (ast.Global, ast.Nonlocal)):
+            escaped.update(node.names)
+            continue
+        tgts: List[ast.AST] = []
+        if isinstance(node, ast.Assign):
+            tgts = list(node.targets)
+        elif isinstance(node, (ast.AnnAssign, ast.AugAssign,
+                               ast.For, ast.AsyncFor)):
+            tgts = [node.target]
+        elif isinstance(node, ast.NamedExpr):
+            tgts = [node.target]
+        elif isinstance(node, (ast.withitem,)):
+            if node.optional_vars is not None:
+                tgts = [node.optional_vars]
+        elif isinstance(node, ast.comprehension):
+            tgts = [node.target]
+        elif isinstance(node, (ast.FunctionDef,
+                               ast.AsyncFunctionDef)) and node is not fn:
+            out.add(node.name)
+        for t in tgts:
+            # only a bare Name (possibly inside tuple/list
+            # destructuring) BINDS — `counts["k"] = 1` mutates the
+            # existing object and must not make `counts` look local
+            work2 = [t]
+            while work2:
+                n = work2.pop()
+                if isinstance(n, ast.Name):
+                    out.add(n.id)
+                elif isinstance(n, (ast.Tuple, ast.List)):
+                    work2.extend(n.elts)
+                elif isinstance(n, ast.Starred):
+                    work2.append(n.value)
+    return out - escaped
+
+
+def _root_name(node: ast.AST) -> Optional[str]:
+    """The leftmost Name of an attribute/subscript chain."""
+    while isinstance(node, (ast.Attribute, ast.Subscript)):
+        node = node.value
+    return node.id if isinstance(node, ast.Name) else None
+
+
+def _is_at_set(call: ast.Call) -> bool:
+    """``x.at[idx].set(...)`` — JAX's functional update, NOT host
+    mutation."""
+    f = call.func
+    return (isinstance(f, ast.Attribute)
+            and f.attr in ("set", "add", "multiply", "divide",
+                           "min", "max", "apply", "get")
+            and isinstance(f.value, ast.Subscript)
+            and isinstance(f.value.value, ast.Attribute)
+            and f.value.value.attr == "at")
+
+
+class TraceTimeEffectRule(Rule):
+    rule_id = "CTL1002"
+    name = "shard-trace-time-effect"
+    description = ("host-state mutation (perf counter inc, self "
+                   "attr/dict mutation, append to a captured list, "
+                   "logging/print) in jit/shard_map-reachable code — "
+                   "it runs ONCE at trace time, so every count and "
+                   "log after the first call silently lies")
+
+    def check_module(self, mod: ParsedModule) -> Iterable[Finding]:
+        if mod.evidence:
+            return ()
+        ctx = shardspec.device_context(mod.program)
+        hot = ctx.hot_in(mod)
+        if not hot:
+            return ()
+        aliases = astutil.aliases_of(mod)
+        out: List[Finding] = []
+        seen: Set[Tuple[int, str]] = set()
+
+        def emit(line: int, msg: str) -> None:
+            if (line, msg) not in seen:
+                seen.add((line, msg))
+                out.append(self.finding(mod, line, msg))
+
+        for fn in hot:
+            fname = getattr(fn, "name", "<fn>")
+            local = _local_names(fn)
+
+            def host_chain(node: ast.AST) -> Optional[str]:
+                """Dotted text when the chain roots in host state."""
+                root = _root_name(node)
+                if root is None:
+                    return None
+                if root in ("self", "cls") or root not in local:
+                    return astutil.dotted(node) or root
+                return None
+
+            for node in _own_nodes(fn):
+                if isinstance(node, (ast.Assign, ast.AugAssign,
+                                     ast.AnnAssign)):
+                    tgts = node.targets \
+                        if isinstance(node, ast.Assign) \
+                        else [node.target]
+                    for t in tgts:
+                        if not isinstance(t, (ast.Attribute,
+                                              ast.Subscript)):
+                            continue
+                        chain = host_chain(t)
+                        if chain:
+                            emit(node.lineno,
+                                 f"mutation of host state "
+                                 f"'{chain}' in jit-reachable "
+                                 f"{fname}() happens once at trace "
+                                 f"time, not per call — hoist it out "
+                                 f"of the traced path or carry the "
+                                 f"value through the computation")
+                    continue
+                if not isinstance(node, ast.Call):
+                    continue
+                if _is_at_set(node):
+                    continue
+                f = node.func
+                if isinstance(f, ast.Name) and f.id == "print":
+                    emit(node.lineno,
+                         f"print() in jit-reachable {fname}() runs "
+                         f"once at trace time — use jax.debug.print "
+                         f"for per-call output")
+                    continue
+                if isinstance(f, ast.Attribute):
+                    recv = f.value
+                    if f.attr in _COUNTER_MUTATORS:
+                        emit(node.lineno,
+                             f".{f.attr}() perf-counter write in "
+                             f"jit-reachable {fname}() counts the "
+                             f"trace, not the calls — move it to the "
+                             f"dispatch boundary")
+                        continue
+                    if f.attr in _MUTATORS:
+                        chain = host_chain(recv)
+                        if chain:
+                            emit(node.lineno,
+                                 f".{f.attr}() on captured host "
+                                 f"state '{chain}' in jit-reachable "
+                                 f"{fname}() mutates once at trace "
+                                 f"time — every later call silently "
+                                 f"skips it")
+                        continue
+                    if f.attr in _LOG_ATTRS:
+                        rn = astutil.resolve(recv, aliases)
+                        root = _root_name(recv)
+                        if (rn and rn.split(".")[0] == "logging") or \
+                                root in _LOG_RECV:
+                            emit(node.lineno,
+                                 f"logging call in jit-reachable "
+                                 f"{fname}() fires once at trace "
+                                 f"time — use jax.debug.print or log "
+                                 f"at the dispatch boundary")
+        return out
+
+
+_STATIC_CAST_ATTRS = {"shape", "ndim", "size", "dtype", "itemsize"}
+_DEVICE_SYNC_CALLS = {"jax.device_get", "jax.device_put"}
+_DEVICE_INTROSPECT_ATTRS = {"addressable_shards", "global_shards",
+                            "addressable_data", "devices"}
+
+
+def _static_cast_arg(node: ast.AST,
+                     env: Dict[str, ast.AST]) -> bool:
+    """``int(x.shape[0])`` / ``int(len(xs))`` are trace-time statics;
+    only a cast of an actual array value forces a device sync.
+    Expands local single assignments so ``lead = x.shape[:-2];
+    int(np.prod(lead))`` resolves as static too."""
+    seen: Set[str] = set()
+    work: List[ast.AST] = [node]
+    while work:
+        e = work.pop()
+        if isinstance(e, ast.Constant):
+            return True
+        for n in ast.walk(e):
+            if isinstance(n, ast.Attribute) and \
+                    n.attr in _STATIC_CAST_ATTRS:
+                return True
+            if isinstance(n, ast.Call) and \
+                    isinstance(n.func, ast.Name) and \
+                    n.func.id == "len":
+                return True
+            if isinstance(n, ast.Name) and n.id in env \
+                    and n.id not in seen:
+                seen.add(n.id)
+                work.append(env[n.id])
+    return False
+
+
+class ShardHostSyncRule(Rule):
+    rule_id = "CTL1003"
+    name = "shard-per-device-sync"
+    description = ("per-device host sync (device_get, int(x)/float(x) "
+                   "tracer cast, .addressable_shards/.devices() "
+                   "introspection) inside shard_map-reachable code — "
+                   "each device round-trips to the host per step")
+
+    def check_module(self, mod: ParsedModule) -> Iterable[Finding]:
+        if mod.evidence:
+            return ()
+        ctx = shardspec.device_context(mod.program)
+        here = [(fn, sites) for fn, sites in ctx.shard_fns.items()
+                if ctx.mod_of(fn, sites[0]) is mod]
+        if not here:
+            return ()
+        aliases = astutil.aliases_of(mod)
+        out: List[Finding] = []
+        seen: Set[Tuple[int, str]] = set()
+
+        def emit(line: int, msg: str) -> None:
+            if (line, msg) not in seen:
+                seen.add((line, msg))
+                out.append(self.finding(mod, line, msg))
+
+        for fn, sites in sorted(
+                here, key=lambda p: getattr(p[0], "lineno", 0)):
+            fname = getattr(fn, "name", "<lambda>")
+            env = shardspec.fn_env(fn) \
+                if not isinstance(fn, ast.Lambda) else {}
+            site = min(sites, key=lambda s: (s.mod.relpath, s.lineno))
+            ctx_txt = (f"shard_map-reachable {fname}() (from site "
+                       f"{site.where()})")
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Attribute) and \
+                        node.attr in _DEVICE_INTROSPECT_ATTRS:
+                    emit(node.lineno,
+                         f".{node.attr} inside {ctx_txt} "
+                         f"introspects per-device placement on the "
+                         f"host — hoist it out of the traced body")
+                    continue
+                if not isinstance(node, ast.Call):
+                    continue
+                cn = astutil.resolve(node.func, aliases)
+                if cn in _DEVICE_SYNC_CALLS:
+                    emit(node.lineno,
+                         f"{cn}() inside {ctx_txt} forces a "
+                         f"per-device host round trip every step")
+                    continue
+                if isinstance(node.func, ast.Name) and \
+                        node.func.id in ("int", "float", "bool") \
+                        and len(node.args) == 1 and \
+                        not _static_cast_arg(node.args[0], env):
+                    emit(node.lineno,
+                         f"{node.func.id}() cast of a traced value "
+                         f"inside {ctx_txt} blocks on device->host "
+                         f"transfer (ConcretizationTypeError on an "
+                         f"abstract tracer) — keep it an array")
+        return out
+
+
+def _body_arity(fn: ast.AST) -> Optional[int]:
+    """Positional parameter count of a shard_map body; None when
+    *args makes the arity open."""
+    if isinstance(fn, ast.Lambda):
+        a = fn.args
+    elif isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        a = fn.args
+    else:
+        return None
+    if a.vararg is not None:
+        return None
+    params = [p.arg for p in a.posonlyargs + a.args]
+    if params and params[0] in ("self", "cls"):
+        params = params[1:]
+    return len(params)
+
+
+def _return_arity(fn: ast.AST) -> Optional[int]:
+    """Consistent tuple-arity of ``fn``'s own returns, else None."""
+    counts: Set[int] = set()
+    for node in _own_nodes(fn):
+        if isinstance(node, ast.Return) and node.value is not None:
+            v = node.value
+            counts.add(len(v.elts)
+                       if isinstance(v, ast.Tuple) else 1)
+    if len(counts) == 1:
+        return counts.pop()
+    return None
+
+
+class SpecDisciplineRule(Rule):
+    rule_id = "CTL1004"
+    name = "shard-spec-discipline"
+    description = ("shard_map spec discipline: in_specs arity must "
+                   "match the wrapped function's parameters, "
+                   "out_specs arity its returns, and every "
+                   "PartitionSpec axis must exist in the mesh bound "
+                   "at that call site")
+
+    def finish(self) -> Iterable[Finding]:
+        ctx = shardspec.device_context(self.program)
+        out: List[Finding] = []
+        for site in ctx.sites:
+            body = next((b for b in site.bodies
+                         if isinstance(b, (ast.FunctionDef,
+                                           ast.AsyncFunctionDef,
+                                           ast.Lambda))), None)
+            bname = getattr(body, "name", "<lambda>") \
+                if body is not None else "<unresolved>"
+            if body is not None and site.in_specs is not None \
+                    and site.in_specs.count is not None:
+                arity = _body_arity(body)
+                if arity is not None and arity != site.in_specs.count:
+                    out.append(self.finding(
+                        site.mod, site.lineno,
+                        f"in_specs carries {site.in_specs.count} "
+                        f"spec(s) but shard_map body {bname}() takes "
+                        f"{arity} positional argument(s) at site "
+                        f"{site.where()} — the pytree mismatch "
+                        f"surfaces as a confusing runtime error"))
+            if body is not None and not isinstance(body, ast.Lambda) \
+                    and site.out_specs is not None \
+                    and site.out_specs.count is not None:
+                rarity = _return_arity(body)
+                if rarity is not None and \
+                        rarity != site.out_specs.count:
+                    out.append(self.finding(
+                        site.mod, site.lineno,
+                        f"out_specs carries {site.out_specs.count} "
+                        f"spec(s) but shard_map body {bname}() "
+                        f"returns {rarity} value(s) at site "
+                        f"{site.where()}"))
+            bound = site.mesh_axes if site.mesh_axes is not None \
+                else (frozenset(ctx.mesh_axis_values)
+                      if ctx.mesh_axis_values else None)
+            if bound is None:
+                continue
+            for label, spec in (("in_specs", site.in_specs),
+                                ("out_specs", site.out_specs)):
+                if spec is None:
+                    continue
+                for val, node, _lit in spec.axis_nodes:
+                    if val not in bound:
+                        out.append(self.finding(
+                            site.mod, node.lineno,
+                            f"PartitionSpec axis {val!r} in {label} "
+                            f"at shard_map site {site.where()} does "
+                            f"not exist in the mesh bound there — "
+                            f"known axes: {sorted(bound)}"))
+        return out
+
+
+_REDUCTIONS = {"sum", "mean", "max", "min", "prod", "count_nonzero",
+               "nansum", "nanmean", "average", "any", "all"}
+_COLLECTIVE_TAILS = {cn.rsplit(".", 1)[-1]
+                     for cn in shardspec.COLLECTIVES}
+
+
+def _call_names(expr: ast.AST, env: Dict[str, ast.AST],
+                aliases: Dict[str, str]) -> Set[str]:
+    """Resolved callee names in ``expr``, expanded through local
+    single assignments (sees through ``rows = psum(...)``)."""
+    names: Set[str] = set()
+    seen: Set[str] = set()
+    work: List[ast.AST] = [expr]
+    while work:
+        e = work.pop()
+        for n in ast.walk(e):
+            if isinstance(n, ast.Call):
+                cn = astutil.resolve(n.func, aliases)
+                if cn:
+                    names.add(cn)
+            elif isinstance(n, ast.Name) and n.id in env \
+                    and n.id not in seen:
+                seen.add(n.id)
+                work.append(env[n.id])
+    return names
+
+
+def _perm_pairs(node: ast.AST) -> Optional[List[Tuple[int, int]]]:
+    """Literal ppermute permutation pairs, else None."""
+    if not isinstance(node, (ast.List, ast.Tuple)):
+        return None
+    pairs: List[Tuple[int, int]] = []
+    for e in node.elts:
+        if not (isinstance(e, (ast.Tuple, ast.List))
+                and len(e.elts) == 2
+                and all(isinstance(x, ast.Constant)
+                        and isinstance(x.value, int)
+                        for x in e.elts)):
+            return None
+        pairs.append((e.elts[0].value, e.elts[1].value))
+    return pairs
+
+
+class UnreducedAccountingRule(Rule):
+    rule_id = "CTL1005"
+    name = "shard-unreduced-accounting"
+    description = ("shard_map body returns a reduction through a "
+                   "replicated out_spec with no psum-class collective "
+                   "— one device's partial reads as the cluster "
+                   "total; also flags literal ppermute permutations "
+                   "with duplicate sources/destinations")
+
+    def finish(self) -> Iterable[Finding]:
+        ctx = shardspec.device_context(self.program)
+        out: List[Finding] = []
+        emitted: Set[Tuple[str, int, str]] = set()
+
+        def emit(mod, line: int, msg: str) -> None:
+            key = (mod.relpath, line, msg)
+            if key not in emitted:
+                emitted.add(key)
+                out.append(self.finding(mod, line, msg))
+
+        for site in ctx.sites:
+            spec = site.out_specs
+            body = next((b for b in site.bodies
+                         if isinstance(b, (ast.FunctionDef,
+                                           ast.AsyncFunctionDef))),
+                        None)
+            if spec is None or spec.count is None or body is None:
+                continue
+            bmod = ctx.mod_of(body, site)
+            if bmod.evidence:
+                continue
+            aliases = astutil.aliases_of(bmod)
+            env = shardspec.fn_env(body)
+            for ret in _own_nodes(body):
+                if not isinstance(ret, ast.Return) or \
+                        ret.value is None:
+                    continue
+                elems = ret.value.elts \
+                    if isinstance(ret.value, ast.Tuple) \
+                    else [ret.value]
+                if len(elems) != spec.count:
+                    continue               # CTL1004's department
+                for i, e in enumerate(elems):
+                    if spec.elems[i].empty is not True:
+                        continue           # sharded or unknown spec
+                    names = _call_names(e, env, aliases)
+                    tails = {n.rsplit(".", 1)[-1] for n in names}
+                    if tails & _COLLECTIVE_TAILS:
+                        continue
+                    if tails & _REDUCTIONS:
+                        emit(bmod, ret.lineno,
+                             f"shard_map body {body.name}() returns "
+                             f"a per-shard reduction through "
+                             f"replicated out_spec position {i} at "
+                             f"site {site.where()} with no lax.psum "
+                             f"over the mesh axis — each device's "
+                             f"partial reads as the cluster total")
+        # literal ppermute permutation validity, tree-wide
+        for mod in self.program.lint_modules():
+            aliases = astutil.aliases_of(mod)
+            for fn, _cls in astutil.walk_functions(mod.tree):
+                env = shardspec.fn_env(fn)
+                for call in ast.walk(fn):
+                    if not isinstance(call, ast.Call):
+                        continue
+                    if astutil.resolve(call.func, aliases) != \
+                            "jax.lax.ppermute":
+                        continue
+                    pnode = call.args[2] if len(call.args) > 2 \
+                        else None
+                    for kw in call.keywords:
+                        if kw.arg == "perm":
+                            pnode = kw.value
+                    if isinstance(pnode, ast.Name) and \
+                            pnode.id in env:
+                        pnode = env[pnode.id]
+                    pairs = _perm_pairs(pnode) \
+                        if pnode is not None else None
+                    if pairs is None:
+                        continue
+                    srcs = [s for s, _ in pairs]
+                    dsts = [d for _, d in pairs]
+                    if len(set(srcs)) != len(srcs) or \
+                            len(set(dsts)) != len(dsts):
+                        emit(mod, call.lineno,
+                             f"ppermute permutation in {fn.name}() "
+                             f"repeats a source or destination — "
+                             f"a permutation must be a bijection or "
+                             f"shards are silently dropped/"
+                             f"overwritten")
+        return out
+
+
+def register(reg) -> None:
+    reg.add(AxisClosureRule.rule_id, AxisClosureRule)
+    reg.add(TraceTimeEffectRule.rule_id, TraceTimeEffectRule)
+    reg.add(ShardHostSyncRule.rule_id, ShardHostSyncRule)
+    reg.add(SpecDisciplineRule.rule_id, SpecDisciplineRule)
+    reg.add(UnreducedAccountingRule.rule_id, UnreducedAccountingRule)

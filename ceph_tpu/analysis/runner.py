@@ -162,6 +162,10 @@ def main(argv: Optional[Sequence[str]] = None, out=None) -> int:
                          "package's location)")
     ap.add_argument("--json", action="store_true",
                     help="machine-readable output")
+    ap.add_argument("--sarif", action="store_true",
+                    help="SARIF 2.1.0 output (the GitHub "
+                         "code-scanning schema) — CI uploads it so "
+                         "findings annotate the diff inline")
     ap.add_argument("--check", action="store_true",
                     help="exit 1 when unsuppressed findings OR stale "
                          "baseline entries exist (the CI gate)")
@@ -232,7 +236,9 @@ def main(argv: Optional[Sequence[str]] = None, out=None) -> int:
         out.write(f"lint error: {e}\n")
         return 2
 
-    if ns.json:
+    if ns.sarif:
+        out.write(json.dumps(_sarif(res), indent=2) + "\n")
+    elif ns.json:
         out.write(json.dumps({
             "root": root,
             "count": len(res.findings),
@@ -262,6 +268,53 @@ def main(argv: Optional[Sequence[str]] = None, out=None) -> int:
         # anything and silently shrinks the gate — remove it
         return 1
     return 0
+
+
+def _sarif(res: Result) -> dict:
+    """SARIF 2.1.0 document (the subset GitHub code scanning
+    ingests): one run, the registered rules as tool metadata, every
+    unsuppressed finding as an ``error`` result and every baselined
+    finding as a ``note`` (visible but non-blocking — mirroring the
+    --check gate).  Paths stay repo-relative via SRCROOT so the
+    upload action can anchor them to the checkout."""
+    from .. import __version__
+    described = RuleRegistry.instance().describe()
+
+    def result(f: Finding, level: str) -> dict:
+        return {
+            "ruleId": f.rule,
+            "level": level,
+            "message": {"text": f.msg},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {"uri": f.path,
+                                         "uriBaseId": "SRCROOT"},
+                    "region": {"startLine": max(1, f.line)},
+                },
+            }],
+        }
+
+    return {
+        "$schema": "https://json.schemastore.org/sarif-2.1.0.json",
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {
+                "name": "cephtpu-lint",
+                "version": __version__,
+                "informationUri":
+                    "https://example.invalid/cephtpu-lint",
+                "rules": [{
+                    "id": rid,
+                    "name": meta["name"],
+                    "shortDescription": {"text": meta["description"]},
+                } for rid, meta in described.items()],
+            }},
+            "originalUriBaseIds": {"SRCROOT": {"uri": "file:///"}},
+            "results":
+                [result(f, "error") for f in res.findings] +
+                [result(f, "note") for f in res.baselined],
+        }],
+    }
 
 
 def _dump_graph(root: str, ns, out) -> int:

@@ -1,0 +1,528 @@
+"""ShardCheck abstract domain — the static SPMD/mesh-axis model the
+CTL10xx rules (analysis/rules_shard.py) interpret.
+
+JAX pins every collective to a mesh axis by a *string name* that
+nothing checks until runtime on a real multi-device host: a misspelled
+``lax.psum(x, "shrad")`` traces fine on the forced-CPU CI mesh and
+detonates only at multi-host scale.  This module builds, once per lint
+run, the whole-program facts those checks need:
+
+  * **axis constants** — module-level ``NAME_AXIS = "str"`` bindings
+    tree-wide, with the ``parallel/mesh.py`` set blessed as the shared
+    vocabulary (CTL1001's "no hardcoded axis strings" rule);
+  * **shard_map sites** — every ``shard_map(body, mesh=..., in_specs=,
+    out_specs=)`` call with the body function(s) resolved (innermost
+    enclosing scope first, then the PR-12 ``ProgramGraph``), the mesh
+    axis tuple when statically resolvable (inline ``Mesh(...)``, a
+    name bound to one, or an in-tree factory returning one), and both
+    spec pytrees parsed into per-position :class:`SpecElem` facts;
+  * **per-site reachability** — the transitive closure of each body
+    over the resolved cross-module call graph (the set CTL1001/CTL1003
+    walk);
+  * **device context** — the jit/shard_map-reachable ("hot") set,
+    shared VERBATIM with CTL1xx/CTL6xx via ``astutil._program_hot``
+    (shard_map bodies join it there), plus the messenger-callback
+    roots CTL110 consumes — so every rule family agrees on one
+    reachability computation per run.
+
+Everything is cached on ``Program._cache['device_ctx']``; rules call
+:func:`device_context` and share the single instance.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, FrozenSet, Iterable, List, Optional, Set, \
+    Tuple
+
+from . import astutil
+from .astutil import SHARD_MAP_NAMES  # noqa: F401  (re-export)
+
+# canonical (post-alias) collective spellings -> positional index of
+# the axis-name argument (keyword forms checked by name)
+COLLECTIVES: Dict[str, int] = {
+    "jax.lax.psum": 1,
+    "jax.lax.pmean": 1,
+    "jax.lax.pmax": 1,
+    "jax.lax.pmin": 1,
+    "jax.lax.psum_scatter": 1,
+    "jax.lax.ppermute": 1,
+    "jax.lax.pshuffle": 1,
+    "jax.lax.all_gather": 1,
+    "jax.lax.all_to_all": 1,
+    "jax.lax.axis_index": 0,
+    "jax.lax.axis_size": 0,
+}
+_AXIS_KWARGS = ("axis_name", "axis_names", "axis_index_groups_axis")
+
+PSPEC_NAMES = {
+    "jax.sharding.PartitionSpec",
+    "jax.experimental.PartitionSpec",
+    "jax.interpreters.pxla.PartitionSpec",
+}
+MESH_CTORS = {
+    "jax.sharding.Mesh",
+    "jax.experimental.maps.Mesh",
+    "jax.make_mesh",
+}
+
+
+def is_mesh_module(relpath: str) -> bool:
+    """The shared-axis-vocabulary module(s): ``parallel/mesh.py`` (or
+    any ``mesh.py``) may define axis strings; everyone else imports."""
+    return relpath.replace("\\", "/").rsplit("/", 1)[-1] == "mesh.py"
+
+
+# --------------------------------------------------------------- specs ----
+
+class SpecElem:
+    """One positional element of an in_specs/out_specs pytree.
+
+    ``axes``     — resolved axis-name strings mentioned by the element
+    ``axis_nodes`` — (value, node, is_literal) per resolved axis
+    ``empty``    — True: definitely ``P()`` (fully replicated);
+                   False: definitely carries at least one axis;
+                   None: unknown / conditional (stay quiet)
+    """
+
+    def __init__(self) -> None:
+        self.axes: Set[str] = set()
+        self.axis_nodes: List[Tuple[str, ast.AST, bool]] = []
+        self.empty: Optional[bool] = None
+
+    def merge(self, other: "SpecElem") -> "SpecElem":
+        out = SpecElem()
+        out.axes = self.axes | other.axes
+        out.axis_nodes = self.axis_nodes + other.axis_nodes
+        out.empty = self.empty if self.empty == other.empty else None
+        return out
+
+
+class SpecInfo:
+    """A parsed in_specs/out_specs expression: positional arity (when
+    the pytree is a literal tuple/list) plus per-position facts."""
+
+    def __init__(self) -> None:
+        self.count: Optional[int] = None
+        self.elems: List[SpecElem] = []
+
+    @property
+    def axes(self) -> Set[str]:
+        out: Set[str] = set()
+        for e in self.elems:
+            out |= e.axes
+        return out
+
+    @property
+    def axis_nodes(self) -> List[Tuple[str, ast.AST, bool]]:
+        out: List[Tuple[str, ast.AST, bool]] = []
+        for e in self.elems:
+            out.extend(e.axis_nodes)
+        return out
+
+
+# ----------------------------------------------------- name environments --
+
+def fn_env(fn: ast.AST) -> Dict[str, ast.AST]:
+    """name -> last simple ``name = expr`` assignment inside ``fn``
+    (the single-assignment expansion CTL1004/CTL1005 use to see
+    through ``mspec = P(SHARD_AXIS) if per_batch else P()``)."""
+    env: Dict[str, ast.AST] = {}
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name):
+            env[node.targets[0].id] = node.value
+    return env
+
+
+def mod_env(mod) -> Dict[str, ast.AST]:
+    """Module-level simple assignments (``MESH = Mesh(...)``)."""
+    cached = mod._cache.get("shard_mod_env")
+    if cached is not None:
+        return cached
+    env: Dict[str, ast.AST] = {}
+    for node in mod.tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name):
+            env[node.targets[0].id] = node.value
+    mod._cache["shard_mod_env"] = env
+    return env
+
+
+# --------------------------------------------------------------- context --
+
+class ShardSite:
+    """One statically-collected ``shard_map(...)`` call."""
+
+    def __init__(self, mod, call: ast.Call, enclosing: str,
+                 bodies: List[ast.AST],
+                 mesh_axes: Optional[FrozenSet[str]],
+                 in_specs: Optional[SpecInfo],
+                 out_specs: Optional[SpecInfo],
+                 reach: Set[ast.AST]) -> None:
+        self.mod = mod
+        self.call = call
+        self.lineno = call.lineno
+        self.enclosing = enclosing
+        self.bodies = bodies          # FunctionDef / Lambda nodes
+        self.mesh_axes = mesh_axes    # None: not statically resolvable
+        self.in_specs = in_specs
+        self.out_specs = out_specs
+        self.reach = reach            # bodies + transitive callees
+
+    def spec_axes(self) -> Set[str]:
+        out: Set[str] = set()
+        for s in (self.in_specs, self.out_specs):
+            if s is not None:
+                out |= s.axes
+        return out
+
+    def where(self) -> str:
+        return f"{self.enclosing}() ({self.mod.relpath})"
+
+
+class DeviceContext:
+    """The once-per-run shared reachability + SPMD facts (see module
+    docstring).  CTL602, CTL110 and every CTL10xx rule read this; the
+    jit/shard_map-hot set is the SAME object ``astutil.hot_functions``
+    slices, so the families cannot disagree."""
+
+    def __init__(self, program) -> None:
+        self.program = program
+        self.graph = astutil.program_graph(program)
+        hot = astutil._program_hot(program)
+        self.hot: Set[ast.AST] = hot.hot
+        self.direct = hot.direct
+        # (dotted module, NAME) -> value for NAME_AXIS = "str"
+        self.axis_consts: Dict[Tuple[str, str], str] = {}
+        self.mesh_axis_values: Set[str] = set()   # blessed vocabulary
+        self.axis_values: Set[str] = set()        # every known value
+        self.sites: List[ShardSite] = []
+        # root callable -> (origin name, ParsedModule, enclosing cls)
+        self.callback_roots: Dict[ast.AST, tuple] = {}
+        self._reach_cache: Dict[ast.AST, Set[ast.AST]] = {}
+        for mod in program.modules.values():
+            self._collect_axis_consts(mod)
+        for mod in program.modules.values():
+            if not mod.evidence:
+                self._scan_module(mod)
+        # fn -> shard_map sites whose bodies reach it
+        self.shard_fns: Dict[ast.AST, List[ShardSite]] = {}
+        for site in self.sites:
+            for fn in site.reach:
+                self.shard_fns.setdefault(fn, []).append(site)
+
+    # ------------------------------------------------------- hot slices --
+    def hot_in(self, mod) -> Set[ast.AST]:
+        """Hot functions OF one module — the per-module slice CTL602
+        (and CTL101/102 via ``hot_functions``) key off; same
+        underlying whole-program set, computed once."""
+        return astutil.hot_functions(mod).hot
+
+    def mod_of(self, fn: ast.AST, site: Optional[ShardSite] = None):
+        """Owning module of a reached callable; a Lambda body is not
+        in the graph index and belongs to its site's module."""
+        mod = self.graph.mod_of.get(fn)
+        if mod is None and site is not None:
+            return site.mod
+        return mod
+
+    # -------------------------------------------------- axis constants --
+    def _collect_axis_consts(self, mod) -> None:
+        dn = astutil.module_dotted(mod.relpath)
+        for node in mod.tree.body:
+            if not (isinstance(node, ast.Assign)
+                    and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)):
+                continue
+            name = node.targets[0].id
+            if not (name.isupper() and name.endswith("_AXIS")):
+                continue
+            if isinstance(node.value, ast.Constant) and \
+                    isinstance(node.value.value, str):
+                val = node.value.value
+                self.axis_consts[(dn, name)] = val
+                self.axis_values.add(val)
+                if is_mesh_module(mod.relpath):
+                    self.mesh_axis_values.add(val)
+
+    def resolve_axis(self, mod, env: Dict[str, ast.AST],
+                     node: ast.AST,
+                     _seen: Optional[Set[str]] = None
+                     ) -> Optional[str]:
+        """Static value of an axis-name expression: a string literal,
+        a module-level ``*_AXIS`` constant (same module or imported),
+        or a local name bound to one."""
+        seen = _seen if _seen is not None else set()
+        if isinstance(node, ast.Constant):
+            return node.value if isinstance(node.value, str) else None
+        d = astutil.dotted(node)
+        if d is None:
+            return None
+        parts = d.split(".")
+        if len(parts) == 1:
+            name = parts[0]
+            if name in env and name not in seen:
+                seen.add(name)
+                return self.resolve_axis(mod, env, env[name], seen)
+            dn = astutil.module_dotted(mod.relpath)
+            if (dn, name) in self.axis_consts:
+                return self.axis_consts[(dn, name)]
+            tgt = astutil.program_aliases_of(mod).get(name)
+            if tgt and "." in tgt:
+                mn, _, cname = tgt.rpartition(".")
+                return self.axis_consts.get((mn, cname))
+            return None
+        head = astutil.program_aliases_of(mod).get(parts[0])
+        if head:
+            mn = ".".join([head] + parts[1:-1])
+            return self.axis_consts.get((mn, parts[-1]))
+        return None
+
+    # ------------------------------------------------------ mesh axes --
+    def _mesh_axes(self, mod, env: Dict[str, ast.AST], node: ast.AST,
+                   depth: int = 3) -> Optional[FrozenSet[str]]:
+        """The axis-name tuple a mesh expression binds, when statically
+        resolvable; None (check against the spec/constant vocabulary
+        instead) for runtime meshes like ``self.mesh``."""
+        if depth <= 0 or node is None:
+            return None
+        if isinstance(node, ast.Name) and node.id in env:
+            nenv = dict(env)
+            nenv.pop(node.id)              # break self-reference
+            return self._mesh_axes(mod, nenv, env[node.id], depth - 1)
+        if not isinstance(node, ast.Call):
+            return None
+        aliases = astutil.aliases_of(mod)
+        cn = astutil.resolve(node.func, aliases)
+        if cn in MESH_CTORS:
+            ax = None
+            if len(node.args) >= 2:
+                ax = node.args[1]
+            for kw in node.keywords:
+                if kw.arg == "axis_names":
+                    ax = kw.value
+            if ax is None:
+                return None
+            elts = ax.elts if isinstance(ax, (ast.Tuple, ast.List)) \
+                else [ax]
+            vals: Set[str] = set()
+            for e in elts:
+                v = self.resolve_axis(mod, env, e)
+                if v is None:
+                    return None
+                vals.add(v)
+            return frozenset(vals)
+        # in-tree factory returning a Mesh (parallel.mesh.make_mesh)
+        for fac in self.graph.resolve_call(mod, None, node,
+                                           precise=True):
+            fmod = self.graph.mod_of[fac]
+            fenv = {**mod_env(fmod), **fn_env(fac)}
+            for ret in ast.walk(fac):
+                if isinstance(ret, ast.Return) and \
+                        ret.value is not None:
+                    got = self._mesh_axes(fmod, fenv, ret.value,
+                                          depth - 1)
+                    if got is not None:
+                        return got
+        return None
+
+    # ---------------------------------------------------------- specs --
+    def parse_spec_elem(self, mod, env: Dict[str, ast.AST],
+                        node: ast.AST,
+                        _seen: Optional[Set[str]] = None) -> SpecElem:
+        seen = _seen if _seen is not None else set()
+        elem = SpecElem()
+        if node is None or (isinstance(node, ast.Constant)
+                            and node.value is None):
+            elem.empty = True
+            return elem
+        if isinstance(node, ast.Name) and node.id in env \
+                and node.id not in seen:
+            seen.add(node.id)
+            return self.parse_spec_elem(mod, env, env[node.id], seen)
+        if isinstance(node, ast.IfExp):
+            a = self.parse_spec_elem(mod, env, node.body, seen)
+            b = self.parse_spec_elem(mod, env, node.orelse, seen)
+            return a.merge(b)
+        if isinstance(node, ast.Call):
+            cn = astutil.resolve(node.func, astutil.aliases_of(mod))
+            if cn in PSPEC_NAMES:
+                unresolved = False
+                for arg in node.args:
+                    items = arg.elts \
+                        if isinstance(arg, (ast.Tuple, ast.List)) \
+                        else [arg]
+                    for item in items:
+                        if isinstance(item, ast.Constant) and \
+                                item.value is None:
+                            continue
+                        v = self.resolve_axis(mod, env, item)
+                        if v is None:
+                            unresolved = True
+                            continue
+                        lit = isinstance(item, ast.Constant)
+                        elem.axes.add(v)
+                        elem.axis_nodes.append((v, item, lit))
+                if elem.axes:
+                    elem.empty = False
+                elif not unresolved:
+                    elem.empty = True
+                return elem
+        return elem                      # unknown expression
+
+    def parse_specs(self, mod, env: Dict[str, ast.AST],
+                    node: Optional[ast.AST]) -> Optional[SpecInfo]:
+        if node is None:
+            return None
+        info = SpecInfo()
+        if isinstance(node, ast.Name) and node.id in env:
+            nenv = dict(env)
+            nenv.pop(node.id)
+            return self.parse_specs(mod, nenv, env[node.id])
+        if isinstance(node, (ast.Tuple, ast.List)):
+            info.count = len(node.elts)
+            for e in node.elts:
+                info.elems.append(self.parse_spec_elem(mod, env, e))
+            return info
+        elem = self.parse_spec_elem(mod, env, node)
+        if elem.axes or elem.empty is not None or \
+                isinstance(node, (ast.Call, ast.IfExp, ast.Constant)):
+            info.count = 1
+            info.elems = [elem]
+        return info
+
+    # ---------------------------------------------------------- sites --
+    def _resolve_bodies(self, mod, cls: Optional[str],
+                        stack: List[ast.AST],
+                        arg: ast.AST) -> List[ast.AST]:
+        if isinstance(arg, ast.Lambda):
+            return [arg]
+        # innermost enclosing scope first: the data_plane idiom is a
+        # nested `def local(...)` right next to its shard_map call,
+        # and four same-named locals per module make the graph's
+        # module-local index too coarse here
+        if isinstance(arg, ast.Name):
+            for encl in reversed(stack):
+                hits = [n for n in ast.walk(encl)
+                        if isinstance(n, (ast.FunctionDef,
+                                          ast.AsyncFunctionDef))
+                        and n.name == arg.id and n is not encl]
+                if hits:
+                    return hits
+        return self.graph.resolve_ref(mod, cls, arg)
+
+    def _site_reach(self, mod, cls: Optional[str],
+                    bodies: List[ast.AST]) -> Set[ast.AST]:
+        reach: Set[ast.AST] = set()
+        roots: List[ast.AST] = []
+        for b in bodies:
+            reach.add(b)
+            if b in self.graph.mod_of:
+                roots.append(b)
+            else:                         # Lambda: resolve its calls
+                for call in ast.walk(b):
+                    if isinstance(call, ast.Call):
+                        roots.extend(self.graph.resolve_call(
+                            mod, cls, call))
+        for b in roots:
+            if b in self._reach_cache:
+                reach |= self._reach_cache[b]
+                continue
+            sub = {b} | self.graph.reachable([b])
+            self._reach_cache[b] = sub
+            reach |= sub
+        return reach
+
+    def _scan_module(self, mod) -> None:
+        aliases = astutil.aliases_of(mod)
+        menv = mod_env(mod)
+        graph = self.graph
+
+        def note_cb(v: ast.AST, cls) -> None:
+            """CTL110 messenger-callback root (migrated here so the
+            reachability families share one collection pass)."""
+            if isinstance(v, ast.Lambda):
+                self.callback_roots.setdefault(
+                    v, ("<lambda callback>", mod, cls))
+            else:
+                for fn in graph.resolve_ref(mod, cls, v):
+                    tmod = graph.mod_of[fn]
+                    if not tmod.evidence:
+                        self.callback_roots.setdefault(
+                            fn, (fn.name, tmod, graph.cls_of[fn]))
+
+        def note_site(call: ast.Call, cls,
+                      stack: List[ast.AST]) -> None:
+            mesh_e = call.args[1] if len(call.args) > 1 else None
+            in_e = call.args[2] if len(call.args) > 2 else None
+            out_e = call.args[3] if len(call.args) > 3 else None
+            for kw in call.keywords:
+                if kw.arg == "mesh":
+                    mesh_e = kw.value
+                elif kw.arg == "in_specs":
+                    in_e = kw.value
+                elif kw.arg == "out_specs":
+                    out_e = kw.value
+            env = dict(menv)
+            if stack:
+                env.update(fn_env(stack[-1]))
+            bodies = self._resolve_bodies(
+                mod, cls, stack, call.args[0]) if call.args else []
+            self.sites.append(ShardSite(
+                mod, call,
+                stack[-1].name if stack else "<module>",
+                bodies,
+                self._mesh_axes(mod, env, mesh_e)
+                if mesh_e is not None else None,
+                self.parse_specs(mod, env, in_e),
+                self.parse_specs(mod, env, out_e),
+                self._site_reach(mod, cls, bodies)))
+
+        def visit(node: ast.AST, cls,
+                  stack: List[ast.AST]) -> None:
+            for ch in ast.iter_child_nodes(node):
+                ncls = ch.name if isinstance(ch, ast.ClassDef) else cls
+                nstack = stack + [ch] if isinstance(
+                    ch, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    else stack
+                if isinstance(ch, ast.Call):
+                    if astutil.resolve(ch.func, aliases) \
+                            in SHARD_MAP_NAMES and ch.args:
+                        note_site(ch, cls, stack)
+                    for kw in ch.keywords:
+                        if kw.arg == "cb":
+                            note_cb(kw.value, cls)
+                    if isinstance(ch.func, ast.Attribute) and \
+                            ch.func.attr in ("set_complete_callback",
+                                             "add_done_callback") \
+                            and ch.args:
+                        note_cb(ch.args[0], cls)
+                visit(ch, ncls, nstack)
+
+        visit(mod.tree, None, [])
+
+
+def device_context(program) -> DeviceContext:
+    """The per-run shared context (built once, cached on Program)."""
+    ctx = program._cache.get("device_ctx")
+    if ctx is None:
+        ctx = program._cache["device_ctx"] = DeviceContext(program)
+    return ctx
+
+
+def collective_axis_nodes(call: ast.Call,
+                          idx: int) -> Iterable[ast.AST]:
+    """The axis-name argument expression(s) of a collective call —
+    positional by ``idx`` or by keyword; tuple axis args flattened."""
+    nodes: List[ast.AST] = []
+    if len(call.args) > idx:
+        nodes.append(call.args[idx])
+    for kw in call.keywords:
+        if kw.arg in _AXIS_KWARGS:
+            nodes.append(kw.value)
+    for n in nodes:
+        if isinstance(n, (ast.Tuple, ast.List)):
+            for e in n.elts:
+                yield e
+        else:
+            yield n

@@ -24,32 +24,66 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 # this flag — the wrapped-to-int32 trace would stick in the step cache
 jax.config.update("jax_enable_x64", True)
 
+# The shared axis-name vocabulary.  Every collective and PartitionSpec
+# in the tree MUST name axes through these constants (CTL1001 flags
+# hardcoded strings): the 2-D (stripe, shard) mesh rename then touches
+# exactly this block.  SHARD_AXIS is today's 1-D stripe/PG batch axis;
+# STRIPE_AXIS is the second axis the ROADMAP-item-1 refactor adds
+# (intra-stripe parallelism / multi-process outer axis).
 SHARD_AXIS = "shard"
+STRIPE_AXIS = "stripe"
+MESH_AXES: Tuple[str, str] = (STRIPE_AXIS, SHARD_AXIS)
+
+
+def _pick_devices(n_devices: Optional[int],
+                  devices: Optional[Sequence]) -> Sequence:
+    """Resolve the device list, falling back to the CPU backend's
+    virtual devices when the default backend has fewer than
+    n_devices (the dry-run path on a 1-chip host with
+    --xla_force_host_platform_device_count set)."""
+    if devices is not None:
+        return devices
+    devices = jax.devices()
+    if n_devices is not None and len(devices) < n_devices:
+        try:
+            cpus = jax.devices("cpu")
+            if len(cpus) >= n_devices:
+                devices = cpus
+        except RuntimeError:
+            pass
+    if n_devices is not None:
+        if len(devices) < n_devices:
+            raise ValueError(
+                f"need {n_devices} devices, have {len(devices)}")
+        devices = devices[:n_devices]
+    return devices
 
 
 def make_mesh(n_devices: Optional[int] = None,
               devices: Optional[Sequence] = None) -> Mesh:
-    """1-D mesh over the stripe/PG batch axis.
+    """1-D mesh over the stripe/PG batch axis (CPU fallback per
+    ``_pick_devices``)."""
+    return Mesh(np.asarray(_pick_devices(n_devices, devices)),
+                (SHARD_AXIS,))
 
-    Falls back to the CPU backend's virtual devices when the default
-    backend has fewer than n_devices (the dry-run path on a 1-chip host
-    with --xla_force_host_platform_device_count set).
-    """
-    if devices is None:
-        devices = jax.devices()
-        if n_devices is not None and len(devices) < n_devices:
-            try:
-                cpus = jax.devices("cpu")
-                if len(cpus) >= n_devices:
-                    devices = cpus
-            except RuntimeError:
-                pass
-        if n_devices is not None:
-            if len(devices) < n_devices:
-                raise ValueError(
-                    f"need {n_devices} devices, have {len(devices)}")
-            devices = devices[:n_devices]
-    return Mesh(np.asarray(devices), (SHARD_AXIS,))
+
+def make_mesh_2d(n_stripe: int, n_shard: int,
+                 devices: Optional[Sequence] = None) -> Mesh:
+    """Named 2-D (stripe, shard) mesh — the target shape of the
+    ROADMAP-item-1 data-plane refactor.  ``n_stripe`` is the outer
+    (future multi-process) axis, ``n_shard`` the per-host batch axis;
+    the device list is reshaped row-major so shard neighbors stay
+    ICI-adjacent.  Usable today at (1, n) as a drop-in for the 1-D
+    mesh everywhere a ``lane_shardings``-style leading-axis annotation
+    is all the consumer needs."""
+    total = n_stripe * n_shard
+    devs = _pick_devices(total, devices)
+    if len(devs) < total:
+        raise ValueError(
+            f"need {total} devices for a ({n_stripe}, {n_shard}) "
+            f"mesh, have {len(devs)}")
+    grid = np.asarray(list(devs)[:total]).reshape(n_stripe, n_shard)
+    return Mesh(grid, MESH_AXES)
 
 
 def batch_sharding(mesh: Mesh) -> NamedSharding:
@@ -59,6 +93,17 @@ def batch_sharding(mesh: Mesh) -> NamedSharding:
 
 def replicated_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
+
+
+def lane_shardings(mesh: Mesh) -> Tuple[NamedSharding, NamedSharding]:
+    """(batch, replicated) sharding pair for a data-plane lane, keyed
+    off the mesh's OWN axis names — works for the 1-D (shard,) mesh
+    today and the 2-D (stripe, shard) mesh after the rename, and keeps
+    consumers (placement mappers, serving lanes) free of axis-name
+    strings entirely.  The batch annotation splits the leading array
+    axis over the mesh's leading axis."""
+    return (NamedSharding(mesh, P(mesh.axis_names[0])),
+            NamedSharding(mesh, P()))
 
 
 _STEP_CACHE: dict = {}

@@ -1012,10 +1012,8 @@ class FastMapper:
             if mesh is None:
                 self._jitted[key] = jax.jit(fn)
             else:
-                from jax.sharding import NamedSharding, PartitionSpec as P
-                axis = mesh.axis_names[0]
-                batch = NamedSharding(mesh, P(axis))
-                repl = NamedSharding(mesh, P())
+                from ..parallel.mesh import lane_shardings
+                batch, repl = lane_shardings(mesh)
                 self._jitted[key] = jax.jit(
                     fn, in_shardings=(batch, repl),
                     out_shardings=(batch, batch))

@@ -958,10 +958,8 @@ class XlaMapper:
                 self._jitted[key] = _jit_wrap(
                     jax.jit(fn), "crush.mapper", sig)
             else:
-                from jax.sharding import NamedSharding, PartitionSpec as P
-                axis = mesh.axis_names[0]
-                batch = NamedSharding(mesh, P(axis))
-                repl = NamedSharding(mesh, P())
+                from ..parallel.mesh import lane_shardings
+                batch, repl = lane_shardings(mesh)
                 self._jitted[key] = _jit_wrap(
                     jax.jit(fn, in_shardings=(batch, repl),
                             out_shardings=batch),

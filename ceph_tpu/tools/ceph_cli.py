@@ -531,8 +531,8 @@ def main(argv: Optional[List[str]] = None,
                          "dump_historic_ops|dump_historic_slow_ops|"
                          "perf dump|fault_injection [...]|"
                          "store_fsck [repair] | "
-                         "lint [--check|--json|--rule CTL###|"
-                         "--graph module.fn|...] | "
+                         "lint [--check|--json|--sarif|"
+                         "--rule CTL###|--graph module.fn|...] | "
                          "thrash [--seed N --cycles K --netsplit "
                          "--powercycle --json] | "
                          "serve [--seed N --chaos --starve --json] | "

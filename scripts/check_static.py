@@ -114,6 +114,35 @@ _SEEDS = (
         from ceph_tpu.common import faults
         faults.declare("twice.over", "second site")
         """, ("CTL804",)),
+    # ShardCheck seeds: an unbound collective axis (CTL1001) and a
+    # per-shard reduction returned through a replicated out_spec with
+    # no psum (CTL1005) — the two SPMD bugs that trace fine on the
+    # forced-CPU CI mesh and detonate only on a real multi-device host
+    ("parallel/mesh.py", """
+        SHARD_AXIS = "shard"
+        """, ()),
+    ("parallel/plane.py", """
+        import jax
+        import jax.numpy as jnp
+        from jax.experimental.shard_map import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        from .mesh import SHARD_AXIS
+
+        def _body(x):
+            total = jnp.sum(x)
+            moved = jax.lax.ppermute(
+                x, SHRAD_AXIS, perm=[(0, 1), (1, 0)])
+            return moved, total
+
+        SHRAD_AXIS = "shrad"
+
+        def build(mesh):
+            return jax.jit(shard_map(
+                _body, mesh=mesh,
+                in_specs=(P(SHARD_AXIS),),
+                out_specs=(P(SHARD_AXIS), P())))
+        """, ("CTL1001", "CTL1005")),
 )
 
 

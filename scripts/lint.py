@@ -10,6 +10,9 @@ Thin wrapper over ceph_tpu.analysis.runner (also surfaced as
                                              # stale baseline entry
     python scripts/lint.py --json            # machine-readable (shape
                                              # documented in runner.py)
+    python scripts/lint.py --sarif           # SARIF 2.1.0 for GitHub
+                                             # code scanning (inline
+                                             # diff annotations in CI)
     python scripts/lint.py --select CTL3     # one rule family
     python scripts/lint.py --rule CTL8       # same, triage spelling
     python scripts/lint.py --graph daemon._recover_pg

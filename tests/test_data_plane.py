@@ -255,3 +255,40 @@ def test_check_multichip_smoke():
     cluster_sharded section shape)."""
     import scripts.check_multichip as chk
     assert chk.main() == 0
+
+
+def test_make_mesh_2d_and_lane_shardings():
+    """2-D mesh prep (ROADMAP item 1): make_mesh_2d reshapes the
+    device list into the shared (STRIPE, SHARD) axis vocabulary, a
+    (1, n) mesh is a drop-in for today's 1-D lane, and lane_shardings
+    keys off the mesh's own axis names so consumers carry no axis
+    strings."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    from ceph_tpu.parallel.mesh import (
+        MESH_AXES, SHARD_AXIS, STRIPE_AXIS, lane_shardings, make_mesh,
+        make_mesh_2d)
+
+    assert MESH_AXES == (STRIPE_AXIS, SHARD_AXIS)
+    n = len(jax.devices())
+    assert n >= 2, "conftest forces a multi-device CPU host"
+
+    mesh2d = make_mesh_2d(1, n)
+    assert mesh2d.axis_names == MESH_AXES
+    assert mesh2d.devices.shape == (1, n)
+    assert mesh2d.shape[SHARD_AXIS] == n
+
+    # row-major reshape: shard neighbors stay adjacent in device order
+    assert list(mesh2d.devices[0]) == list(jax.devices()[:n])
+
+    # lane_shardings works identically for the 1-D and 2-D meshes —
+    # batch splits the mesh's leading axis, the twin is replicated
+    for mesh, lead in ((make_mesh(n), SHARD_AXIS),
+                       (mesh2d, STRIPE_AXIS)):
+        batch, repl = lane_shardings(mesh)
+        assert batch.spec == P(lead)
+        assert repl.spec == P()
+
+    with pytest.raises(ValueError):
+        make_mesh_2d(n + 1, n + 1)

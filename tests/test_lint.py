@@ -1257,9 +1257,11 @@ def test_write_baseline_select_preserves_other_families(tmp_path):
 def test_registry_mirrors_plugin_contract():
     reg = RuleRegistry.instance()
     ids = reg.names()
-    # one rule family minimum per invariant class, CTL1xx..CTL8xx
+    # one rule family minimum per invariant class, CTL1xx..CTL9xx
+    # plus the CTL10xx ShardCheck family ("CTL100" prefix — a bare
+    # "CTL10" would also match the CTL10x rules)
     for family in ("CTL1", "CTL2", "CTL3", "CTL4", "CTL5", "CTL6",
-                   "CTL7", "CTL8"):
+                   "CTL7", "CTL8", "CTL9", "CTL100"):
         assert any(r.startswith(family) for r in ids), family
     with pytest.raises(LintError, match="already registered"):
         reg.add("CTL301", type(reg.factory("CTL301")))
@@ -1519,6 +1521,365 @@ def test_ctl130_real_tree_hot_path_is_view_clean():
                      select=["CTL130"])
     assert not res.findings, "\n".join(
         f.render() for f in res.findings)
+
+
+# ------------------------- CTL10xx: ShardCheck (SPMD/mesh axes) ---
+
+def test_ctl1001_unbound_axis_across_modules(tmp_path):
+    """The headline ShardCheck case: the collective lives in a
+    DIFFERENT module than the shard_map site, its axis name resolves
+    through an import, and the statically-resolved mesh does not bind
+    it.  CI's single-device CPU mesh traces this fine; a real mesh
+    raises NameError deep inside pjit."""
+    write(tmp_path, "parallel/__init__.py", "")
+    write(tmp_path, "parallel/mesh.py", """\
+        SHARD_AXIS = "shard"
+        STRIPE_AXIS = "stripe"
+        """)
+    write(tmp_path, "parallel/body.py", """\
+        import jax
+        from .mesh import SHARD_AXIS, STRIPE_AXIS
+
+        def count(x):
+            return jax.lax.psum(x, STRIPE_AXIS)   # mesh is 1-D!
+
+        def total(x):
+            return jax.lax.psum(x, SHARD_AXIS)
+        """)
+    write(tmp_path, "parallel/plane.py", """\
+        import jax
+        import numpy as np
+        from jax.experimental.shard_map import shard_map
+        from jax.sharding import Mesh, PartitionSpec as P
+        from .mesh import SHARD_AXIS
+        from .body import count, total
+
+        MESH = Mesh(np.array(jax.devices()), (SHARD_AXIS,))
+
+        def build():
+            bad = shard_map(count, mesh=MESH,
+                            in_specs=(P(SHARD_AXIS),),
+                            out_specs=P(SHARD_AXIS))
+            good = shard_map(total, mesh=MESH,
+                             in_specs=(P(SHARD_AXIS),),
+                             out_specs=P(SHARD_AXIS))
+            return bad, good
+        """)
+    res = lint(tmp_path, select=["CTL1001"])
+    assert [(f.path, f.rule) for f in res.findings] == \
+        [("parallel/body.py", "CTL1001")], res.findings
+    msg = res.findings[0].msg
+    assert "'stripe'" in msg and "not bound" in msg
+    assert "'shard'" in msg        # the bound axes are named
+
+
+def test_ctl1001_hardcoded_literal_and_noqa(tmp_path):
+    """Axis string literals outside parallel/mesh.py are flagged even
+    when they happen to spell a real axis — the 2-D mesh rename must
+    be a one-edit change — and a 4-digit ``# noqa: CTL1001``
+    suppresses."""
+    write(tmp_path, "parallel/__init__.py", "")
+    write(tmp_path, "parallel/mesh.py", 'SHARD_AXIS = "shard"\n')
+    write(tmp_path, "parallel/plane.py", """\
+        import jax
+        from jax.experimental.shard_map import shard_map
+        from jax.sharding import PartitionSpec as P
+        from .mesh import SHARD_AXIS
+
+        def bad(x):
+            return jax.lax.psum(x, "shard")
+
+        def justified(x):
+            return jax.lax.psum(x, "shard")  # noqa: CTL1001 — perf A/B
+
+        def build(mesh):
+            a = shard_map(bad, mesh=mesh,
+                          in_specs=(P(SHARD_AXIS),),
+                          out_specs=P(SHARD_AXIS))
+            b = shard_map(justified, mesh=mesh,
+                          in_specs=(P(SHARD_AXIS),),
+                          out_specs=P(SHARD_AXIS))
+            return a, b
+        """)
+    res = lint(tmp_path, select=["CTL1001"])
+    assert len(res.findings) == 1, res.findings
+    assert "hardcoded axis string 'shard'" in res.findings[0].msg
+    assert len(res.noqa) == 1, "4-digit noqa code must parse"
+
+
+def test_ctl1002_trace_time_mutation_positive_and_negative(tmp_path):
+    """Host-state mutation reachable from jit: self attrs, captured
+    dicts/lists, perf-counter .inc(), print().  Local containers and
+    ``x.at[i].set()`` functional updates stay clean, as does the same
+    code when it is not jit-reachable."""
+    write(tmp_path, "mod.py", """\
+        import jax
+
+        COUNTS = {}
+        EVENTS = []
+
+        class Plane:
+            def step(self, x):
+                self.calls = 1                # trace-time attr write
+                COUNTS["step"] = 1            # captured dict write
+                EVENTS.append(x)              # captured list append
+                print("step")                 # trace-time print
+                return x
+
+            def cold(self, x):
+                self.calls = 0                # not jit-reachable
+                return x
+
+        @jax.jit
+        def f(x, pc):
+            pc.inc("calls")                   # counter lies per-trace
+            local = []
+            local.append(x)                   # local: fine
+            y = x.at[0].set(1.0)              # functional: fine
+            p = Plane()
+            return p.step(y)
+        """)
+    res = lint(tmp_path, select=["CTL1002"])
+    lines = sorted(f.line for f in res.findings)
+    assert lines == [8, 9, 10, 11, 20], res.findings
+    msgs = " | ".join(f.msg for f in res.findings)
+    assert "trace" in msgs
+    assert ".inc()" in msgs and "print()" in msgs
+
+
+def test_ctl1002_trace_time_counter_demonstrably_miscounts(tmp_path):
+    """The lie CTL1002 exists to catch, shown at runtime: a host
+    counter incremented inside a jitted function counts TRACES, not
+    calls — three invocations, one increment — and the static rule
+    flags exactly that shape."""
+    import jax
+    import jax.numpy as jnp
+
+    counts = {"calls": 0}
+
+    @jax.jit
+    def step(x):
+        counts["calls"] += 1
+        return x + 1
+
+    for _ in range(3):
+        step(jnp.ones((2,))).block_until_ready()
+    assert counts["calls"] == 1, \
+        "the trace-time increment ran once for three calls"
+
+    write(tmp_path, "mod.py", """\
+        import jax
+
+        COUNTS = {"calls": 0}
+
+        @jax.jit
+        def step(x):
+            COUNTS["calls"] += 1
+            return x + 1
+        """)
+    res = lint(tmp_path, select=["CTL1002"])
+    assert rules_of(res) == ["CTL1002"], res.findings
+    assert "trace" in res.findings[0].msg
+
+
+def test_ctl1003_per_device_sync_through_helper(tmp_path):
+    """Tracer casts and device_get in shard_map-reachable code —
+    including across a module boundary — are per-device host round
+    trips; shape-derived casts and non-reachable host code stay
+    clean."""
+    write(tmp_path, "parallel/__init__.py", "")
+    write(tmp_path, "parallel/mesh.py", 'SHARD_AXIS = "shard"\n')
+    write(tmp_path, "parallel/helper.py", """\
+        import jax
+
+        def pull(x):
+            return jax.device_get(x)          # reached from body()
+
+        def fine(x):
+            return int(x.shape[0])            # static shape math
+
+        def host_entry(x):
+            return jax.device_get(x)          # never shard-reached
+        """)
+    write(tmp_path, "parallel/plane.py", """\
+        import jax
+        from jax.experimental.shard_map import shard_map
+        from jax.sharding import PartitionSpec as P
+        from .mesh import SHARD_AXIS
+        from .helper import pull, fine
+
+        def body(x):
+            n = int(x)                        # tracer cast
+            y = fine(x)
+            return pull(y)
+
+        def build(mesh):
+            return shard_map(body, mesh=mesh,
+                             in_specs=(P(SHARD_AXIS),),
+                             out_specs=P(SHARD_AXIS))
+        """)
+    res = lint(tmp_path, select=["CTL1003"])
+    assert sorted((f.path, f.line) for f in res.findings) == \
+        [("parallel/helper.py", 4), ("parallel/plane.py", 8)], \
+        res.findings
+    msgs = " | ".join(f.msg for f in res.findings)
+    assert "jax.device_get" in msgs and "int() cast" in msgs
+    assert "shard_map-reachable" in msgs
+
+
+def test_ctl1004_spec_arity_and_unknown_axis(tmp_path):
+    """in_specs arity vs parameters, out_specs arity vs returns, and
+    a PartitionSpec axis the resolved mesh does not carry."""
+    write(tmp_path, "parallel/__init__.py", "")
+    write(tmp_path, "parallel/mesh.py", """\
+        SHARD_AXIS = "shard"
+        STRIPE_AXIS = "stripe"
+        """)
+    write(tmp_path, "parallel/plane.py", """\
+        import jax
+        import numpy as np
+        from jax.experimental.shard_map import shard_map
+        from jax.sharding import Mesh, PartitionSpec as P
+        from .mesh import SHARD_AXIS, STRIPE_AXIS
+
+        MESH = Mesh(np.array(jax.devices()), (SHARD_AXIS,))
+
+        def body(a, b):
+            return a + b
+
+        def arity():
+            return shard_map(body, mesh=MESH,
+                             in_specs=(P(SHARD_AXIS),),
+                             out_specs=P(SHARD_AXIS))
+
+        def badaxis():
+            return shard_map(body, mesh=MESH,
+                             in_specs=(P(SHARD_AXIS),
+                                       P(STRIPE_AXIS)),
+                             out_specs=P(SHARD_AXIS))
+
+        def outarity():
+            return shard_map(body, mesh=MESH,
+                             in_specs=(P(SHARD_AXIS), P()),
+                             out_specs=(P(SHARD_AXIS), P()))
+
+        def clean():
+            return shard_map(body, mesh=MESH,
+                             in_specs=(P(SHARD_AXIS), P()),
+                             out_specs=P(SHARD_AXIS))
+        """)
+    res = lint(tmp_path, select=["CTL1004"])
+    msgs = sorted(f.msg for f in res.findings)
+    assert len(msgs) == 3, res.findings
+    assert any("in_specs carries 1 spec(s)" in m and
+               "takes 2 positional" in m for m in msgs)
+    assert any("PartitionSpec axis 'stripe'" in m and
+               "does not exist" in m for m in msgs)
+    assert any("out_specs carries 2 spec(s)" in m and
+               "returns 1 value(s)" in m for m in msgs)
+
+
+def test_ctl1005_unreduced_total_and_bad_ppermute(tmp_path):
+    """A per-shard jnp.sum() returned through a replicated out_spec
+    with no psum reads one device's partial as the cluster total; the
+    psum'd twin is clean.  A literal ppermute permutation repeating a
+    source is flagged wherever it sits."""
+    write(tmp_path, "parallel/__init__.py", "")
+    write(tmp_path, "parallel/mesh.py", 'SHARD_AXIS = "shard"\n')
+    write(tmp_path, "parallel/plane.py", """\
+        import jax
+        import jax.numpy as jnp
+        from jax.experimental.shard_map import shard_map
+        from jax.sharding import PartitionSpec as P
+        from .mesh import SHARD_AXIS
+
+        def bad(x):
+            rows = jnp.sum(x)
+            return x, rows
+
+        def good(x):
+            rows = jax.lax.psum(jnp.sum(x), SHARD_AXIS)
+            return x, rows
+
+        def build(mesh):
+            a = shard_map(bad, mesh=mesh,
+                          in_specs=(P(SHARD_AXIS),),
+                          out_specs=(P(SHARD_AXIS), P()))
+            b = shard_map(good, mesh=mesh,
+                          in_specs=(P(SHARD_AXIS),),
+                          out_specs=(P(SHARD_AXIS), P()))
+            return a, b
+
+        def shifty(x):
+            perm = [(0, 1), (0, 2)]
+            return jax.lax.ppermute(x, SHARD_AXIS, perm=perm)
+        """)
+    res = lint(tmp_path, select=["CTL1005"])
+    assert sorted((f.path, f.line) for f in res.findings) == \
+        [("parallel/plane.py", 9), ("parallel/plane.py", 26)], \
+        res.findings
+    msgs = " | ".join(f.msg for f in res.findings)
+    assert "cluster total" in msgs and "bijection" in msgs
+
+
+def test_misspelled_axis_in_real_data_plane_is_caught(tmp_path):
+    """Acceptance: deliberately misspell a collective axis name in a
+    copy of the REAL parallel/data_plane.py and `ceph lint` reports it
+    statically — the failure mode that otherwise only a multi-device
+    TPU host would surface."""
+    import io as _io
+    real = (REPO / "ceph_tpu" / "parallel" /
+            "data_plane.py").read_text()
+    assert ", SHARD_AXIS)" in real
+    broken = real.replace(", SHARD_AXIS)", ", 'shrad')", 1)
+    write(tmp_path, "parallel/data_plane.py", broken)
+    write(tmp_path, "parallel/mesh.py",
+          (REPO / "ceph_tpu" / "parallel" / "mesh.py").read_text())
+    res = lint(tmp_path, select=["CTL1001"])
+    assert res.findings, "misspelled axis must be caught"
+    assert all(f.path == "parallel/data_plane.py"
+               for f in res.findings), res.findings
+    assert any("'shrad'" in f.msg and "not bound" in f.msg
+               for f in res.findings), res.findings
+
+    # and through the operator CLI: `ceph lint` passes the flags
+    # straight to the runner, so the same check gates interactively
+    from ceph_tpu.tools.ceph_cli import main as ceph_main
+    buf = _io.StringIO()
+    rc = ceph_main(["lint", ".", "--root", str(tmp_path),
+                    "--select", "CTL1001", "--baseline", "none",
+                    "--check"], out=buf)
+    assert rc == 1
+    assert "shrad" in buf.getvalue()
+
+
+def test_cli_sarif_output(tmp_path):
+    """--sarif emits the GitHub code-scanning subset of SARIF 2.1.0:
+    tool metadata with every registered rule, error-level results with
+    repo-relative locations."""
+    import io as _io
+    write(tmp_path, "cluster/svc.py", """\
+        import threading
+        L = threading.Lock()
+        """)
+    buf = _io.StringIO()
+    rc = runner.main(["--root", str(tmp_path), "--sarif",
+                      "--select", "CTL302", "--baseline", "none",
+                      "."], out=buf)
+    assert rc == 0
+    doc = json.loads(buf.getvalue())
+    assert doc["version"] == "2.1.0"
+    run = doc["runs"][0]
+    assert run["tool"]["driver"]["name"] == "cephtpu-lint"
+    rule_ids = {r["id"] for r in run["tool"]["driver"]["rules"]}
+    assert {"CTL302", "CTL1001", "CTL1005"} <= rule_ids
+    result = run["results"][0]
+    assert result["ruleId"] == "CTL302"
+    assert result["level"] == "error"
+    loc = result["locations"][0]["physicalLocation"]
+    assert loc["artifactLocation"]["uri"] == "cluster/svc.py"
+    assert loc["artifactLocation"]["uriBaseId"] == "SRCROOT"
+    assert loc["region"]["startLine"] == 2
 
 
 # ----------------------------------------------- the tier-1 gate ---
