@@ -1657,6 +1657,69 @@ def bench_s3_serving(seed=0, n_osds=4, shards=8, clients_scale=4.0,
     }
 
 
+def bench_multisite(n_objects=64, obj_kib=128, shards=8, workers=4,
+                    seed=0):
+    """GeoSync catch-up (ROADMAP item 5): seed a sharded bucket in
+    zone A, then measure a cold zone-B catch-up twice — serialized
+    (no engine: shards drain one after another) and pipelined (the
+    shared AioEngine fetch/applies shards concurrently) — reporting
+    catch-up GB/s, the pipelined/serialized decomposition, and the
+    replication-lag p99 read from the agent's merged histograms."""
+    from ceph_tpu.common.perf_counters import perf as _gperf
+    from ceph_tpu.cluster.dr_drill import _SimZone
+    from ceph_tpu.mgr.cluster_stats import merge_histograms, quantile
+    from ceph_tpu.rgw.sync import BucketSyncAgent, make_sync_engine
+    rng = np.random.default_rng(seed)
+    payload = [rng.integers(0, 256, size=obj_kib << 10,
+                            dtype=np.uint8).tobytes()
+               for _ in range(4)]
+    total_bytes = n_objects * (obj_kib << 10)
+
+    def catch_up(engine, dst_name):
+        za, zb = _SimZone("a"), _SimZone(dst_name)
+        try:
+            b = za.gw.create_bucket("geo", num_shards=shards)
+            for i in range(n_objects):
+                b.put_object(f"k{i:04d}", payload[i % len(payload)])
+            _gperf(f"geosync.a.{dst_name}").reset()
+            ag = BucketSyncAgent(za.gw, zb.gw, "geo",
+                                 zone=dst_name, src_zone="a",
+                                 engine=engine)
+            t0 = time.perf_counter()
+            applied = ag.sync()
+            dt = time.perf_counter() - t0
+            if applied["puts"] != n_objects or ag.last_errors:
+                raise RuntimeError(
+                    f"catch-up incomplete: {applied} "
+                    f"{ag.last_errors[:3]}")
+            return dt, ag.lag_dump()
+        finally:
+            za.close()
+            zb.close()
+
+    serial_s, _ = catch_up(None, "bser")
+    engine = make_sync_engine(workers)
+    try:
+        piped_s, lag = catch_up(engine, "bpipe")
+    finally:
+        engine.close()
+    merged = merge_histograms([lag]) if lag else {}
+    p99 = quantile(merged, 0.99) if merged else None
+    return {
+        "n_objects": n_objects,
+        "obj_kib": obj_kib,
+        "index_shards": shards,
+        "engine_workers": workers,
+        "catchup_gbps": round(total_bytes / piped_s / 1e9, 4),
+        "replication_lag_p99_s": p99,
+        "decomposition": {
+            "serialized_s": round(serial_s, 4),
+            "pipelined_s": round(piped_s, 4),
+            "pipeline_speedup": round(serial_s / piped_s, 3),
+        },
+    }
+
+
 def main():
     out = {"metric": "ec_encode_rs8_3_gbps", "unit": "GB/s"}
     extras = {}
@@ -1769,6 +1832,12 @@ def main():
         extras["s3_serving"] = bench_s3_serving()
     except Exception as e:
         print(f"# s3 serving bench failed: {e}", file=sys.stderr)
+    try:
+        import gc
+        gc.collect()
+        extras["multisite"] = bench_multisite()
+    except Exception as e:
+        print(f"# multisite bench failed: {e}", file=sys.stderr)
     out["extras"] = extras
     print(json.dumps(out))
 

@@ -26,6 +26,14 @@ either burns the compiled path or bakes one outcome in at trace time.
           CrashDev recorder, so the crash-state enumeration silently
           proves nothing about them (exactly the bug class that
           invalidates the power-loss harness)
+  CTL605  a sync-agent apply path that persists a replication marker
+          (advance/commit/save × marker/applied/position/cursor/
+          state) while an async submission's completion is still
+          unresolved — the acked-then-lost ordering bug: a crash
+          between the marker write and the apply's completion makes
+          the peer skip an entry it never actually applied.  Marker
+          calls resolve through the PR-12 whole-program graph, so a
+          one-hop wrapper around the persist helper is still caught
 """
 from __future__ import annotations
 
@@ -298,8 +306,131 @@ class StoreBypassRule(Rule):
         return out
 
 
+# the replication-agent layer: modules under rgw/ plus any module
+# whose name says it is a sync/replication agent — the only place a
+# "persisted marker" means "the peer will never resend this entry"
+_SYNC_DIRS = ("rgw",)
+
+# a call persists a replication marker when its name pairs a commit
+# verb with a marker noun (_advance_applied, _save_state,
+# commit_marker, update_position, ...)
+_MARKER_VERBS = ("advance", "commit", "persist", "save", "update",
+                 "bump", "store")
+_MARKER_NOUNS = ("marker", "applied", "position", "cursor", "state")
+
+# completion-resolving calls: any of these settles outstanding async
+# submissions (the AioCompletion surface + concurrent.futures')
+_RESOLVERS = ("result", "wait_for_complete", "wait", "gather",
+              "as_completed")
+
+
+def _is_marker_name(name: Optional[str]) -> bool:
+    if not name:
+        return False
+    n = name.lower()
+    return any(v in n for v in _MARKER_VERBS) and \
+        any(s in n for s in _MARKER_NOUNS)
+
+
+class MarkerBeforeCompletionRule(Rule):
+    rule_id = "CTL605"
+    name = "marker-advanced-before-completion"
+    description = ("sync-agent apply path persists a replication "
+                   "marker while an async submission's completion is "
+                   "unresolved — a crash between the marker write and "
+                   "the apply's completion loses the entry forever "
+                   "(the acked-then-lost ordering bug)")
+
+    def _marker_call(self, mod: ParsedModule, cls: Optional[str],
+                     call: ast.Call) -> Optional[str]:
+        """The marker-persist name this call reaches, or None.  Direct
+        name match first; otherwise resolve one wrapper hop through
+        the whole-program graph (a helper whose own name is bland but
+        which calls the persist helper is the same commit point)."""
+        f = call.func
+        direct = f.attr if isinstance(f, ast.Attribute) else (
+            f.id if isinstance(f, ast.Name) else None)
+        if _is_marker_name(direct):
+            return direct
+        if mod.program is None:
+            return None
+        graph = astutil.program_graph(mod.program)
+        for fn in graph.resolve_call(mod, cls, call, precise=True):
+            if _is_marker_name(getattr(fn, "name", None)):
+                return fn.name
+            for callee in graph.callees(fn):
+                if _is_marker_name(getattr(callee, "name", None)):
+                    return f"{fn.name} -> {callee.name}"
+        return None
+
+    def check_module(self, mod: ParsedModule) -> Iterable[Finding]:
+        if mod.evidence:
+            return ()
+        rp = mod.relpath.replace("\\", "/")
+        parts = rp.split("/")
+        if not (any(p in _SYNC_DIRS for p in parts[:-1]) or
+                "sync" in parts[-1]):
+            return ()
+        out: List[Finding] = []
+        for fn, cls in astutil.walk_functions(mod.tree):
+            out.extend(self._check_fn(mod, cls, fn))
+        return out
+
+    def _check_fn(self, mod: ParsedModule, cls: Optional[str],
+                  fn: ast.AST) -> Iterable[Finding]:
+        """Linearize the function's calls by source line and simulate:
+        a ``.submit(...)`` opens a pending completion, any resolver
+        call settles ALL pending (gathers are batch-shaped), and a
+        marker persist while something is pending is the finding.
+        Statement order approximates control flow — exactly right for
+        the submit -> persist -> gather loop shape the bug takes."""
+        events: List[Tuple[int, str, Optional[str]]] = []
+        plain: List[ast.Call] = []
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            attr = f.attr if isinstance(f, ast.Attribute) else (
+                f.id if isinstance(f, ast.Name) else None)
+            if attr == "submit" or (attr or "").startswith("aio_"):
+                events.append((node.lineno, "submit", attr))
+                continue
+            if attr in _RESOLVERS:
+                events.append((node.lineno, "resolve", attr))
+                continue
+            plain.append(node)
+        if any(k == "submit" for _, k, _ in events):
+            # only a function that actually opens completions can
+            # order a marker ahead of one — graph-resolve its other
+            # calls; everything else skips the whole-program walk
+            for node in plain:
+                name = self._marker_call(mod, cls, node)
+                if name is not None:
+                    events.append((node.lineno, "marker", name))
+        events.sort()
+        pending = 0
+        out: List[Finding] = []
+        for lineno, kind, name in events:
+            if kind == "submit":
+                pending += 1
+            elif kind == "resolve":
+                pending = 0
+            elif kind == "marker" and pending:
+                out.append(self.finding(
+                    mod, lineno,
+                    f"{name}() persists a replication marker while "
+                    f"{pending} async submission(s) are still "
+                    f"unresolved — a crash here acks an entry whose "
+                    f"apply never completed (peer will skip it "
+                    f"forever); gather/.result() the completions "
+                    f"first, then advance the marker"))
+        return out
+
+
 def register(reg) -> None:
     reg.add(UndeclaredFireRule.rule_id, UndeclaredFireRule)
     reg.add(FireInJitRule.rule_id, FireInJitRule)
     reg.add(SwallowedIOErrorRule.rule_id, SwallowedIOErrorRule)
     reg.add(StoreBypassRule.rule_id, StoreBypassRule)
+    reg.add(MarkerBeforeCompletionRule.rule_id,
+            MarkerBeforeCompletionRule)

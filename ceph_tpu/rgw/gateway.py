@@ -38,9 +38,21 @@ import time
 import zlib
 from typing import Dict, List, Optional, Tuple
 
+from ..common import faults
 from ..common.backoff import ExpBackoff
 
 _BUCKETS_OID = "rgw.buckets"
+
+# Declared next to its fire site (Bucket._log_op): the seeded
+# lost-replication fault the DR drill's falsifiability leg arms — the
+# data/index write lands but the bilog entry is silently dropped, so
+# multisite sync never learns about the op.  A gate that stays green
+# with this armed proves nothing.
+faults.declare(
+    "rgw.bilog_lost_entry",
+    "drop one bucket-index-log append (the data/index write lands, "
+    "the bilog entry never does) — the lost-replication seed the DR "
+    "convergence gate must turn red on; ctx: bucket, key, shard")
 
 
 class RGWError(IOError):
@@ -81,6 +93,52 @@ def _read_json(ioctx, oid: str, default, what: str):
     raise RGWError(f"{what} {oid!r} unreadable after retries: {last}")
 
 
+# -------------------------------------------------- sync bookkeeping --
+# The marker/zone object schema is shared between the gateway (drain
+# gating on trim/retire/delete) and rgw/sync.py (the agents that own
+# the markers), so it lives here next to the bilog naming it governs.
+
+def zones_oid(bucket: str) -> str:
+    return f"rgw.zones.{bucket}"
+
+
+def sync_state_oid(bucket: str, zone: str) -> str:
+    return f"rgw.sync.{bucket}.{zone}"
+
+
+def read_sync_state(ioctx, bucket: str, zone: str):
+    """One zone's persisted sync cursor: {"gen": g, "shards":
+    {"<shard>": last_applied_seq}}.  Absent -> None (never synced);
+    the pre-generation format (a bare int: shard 0's position) reads
+    as a gen-0 single-shard cursor, so old pools resume, not restart.
+    Transient read errors retry/raise via _read_json — fabricating
+    "never synced" from a flake would re-replay a whole generation."""
+    raw = _read_json(ioctx, sync_state_oid(bucket, zone), None,
+                     "sync state")
+    if raw is None:
+        return None
+    if isinstance(raw, (int, float)):
+        return {"gen": 0, "shards": {"0": int(raw)}}
+    return {"gen": int(raw.get("gen", 0)),
+            "shards": {str(k): int(v)
+                       for k, v in raw.get("shards", {}).items()}}
+
+
+def zone_drained_past(state, gen: int, ends: List[int]) -> bool:
+    """Has this zone consumed EVERY entry of bilog generation ``gen``
+    (per-shard end seqs ``ends``)?  A later generation implies the
+    cutover already drained this one; an earlier one (or no state at
+    all) means entries this zone has not replicated still live here."""
+    if state is None:
+        return False
+    zgen = int(state.get("gen", 0))
+    if zgen != gen:
+        return zgen > gen
+    shards = state.get("shards", {})
+    return all(int(shards.get(str(s), -1)) >= e
+               for s, e in enumerate(ends))
+
+
 class Bucket:
     # how long a handle trusts its cached shard layout before
     # re-reading the bucket directory record: the window in which a
@@ -106,11 +164,18 @@ class Bucket:
         now = time.monotonic()
         if self._layout_cache is None or \
                 now - self._layout_ts > self._LAYOUT_TTL_S:
-            ent = self.gw._read_buckets().get(self.name) or {}
-            self._layout_cache = {
-                "num_shards": int(ent.get("num_shards", 1)),
-                "index_gen": int(ent.get("index_gen", 0))}
-            self._layout_ts = now
+            return self._refresh_layout()
+        return self._layout_cache
+
+    def _refresh_layout(self) -> Dict[str, int]:
+        """Drop the TTL cache and re-read the bucket record NOW —
+        the ECANCELED-refresh a real RGW client does when an index
+        op lands on a resharded-away generation."""
+        ent = self.gw._read_buckets().get(self.name) or {}
+        self._layout_cache = {
+            "num_shards": int(ent.get("num_shards", 1)),
+            "index_gen": int(ent.get("index_gen", 0))}
+        self._layout_ts = time.monotonic()
         return self._layout_cache
 
     def num_shards(self) -> int:
@@ -124,33 +189,55 @@ class Bucket:
         lo = layout or self._layout()
         return zlib.crc32(key.encode()) % lo["num_shards"]
 
-    def bilog_for_shard(self, shard: int):
-        """Per-shard bucket index log (the RGW bilog-per-shard role):
-        every put/delete lands in its key's shard log.  Shard 0 keeps
-        the legacy un-suffixed name so multisite sync (rgw/sync.py)
-        replays single-shard buckets unchanged."""
-        j = self._bilogs.get(shard)
+    def bilog_for_shard(self, shard: int, gen: Optional[int] = None):
+        """Per-(generation, shard) bucket index log (the RGW
+        bilog-per-shard role, generation-split like cls_rgw's
+        bilog layout after reshard): every put/delete lands in its
+        key's shard log OF THE CURRENT GENERATION.  A reshard starts
+        a fresh set of logs (new gen) instead of interleaving two
+        shard mappings in one stream — the old generation's logs stay
+        put, end-marked, until every peer zone drains them.
+        Generation 0 keeps the legacy un-suffixed/`.N` names so
+        pre-generation pools replay unchanged."""
+        if gen is None:
+            gen = self._layout()["index_gen"]
+        j = self._bilogs.get((gen, shard))
         if j is None:
             from ..fs.journaler import Journaler
-            suffix = "" if shard == 0 else f".{shard}"
-            j = self._bilogs[shard] = Journaler(
+            if gen == 0:
+                suffix = "" if shard == 0 else f".{shard}"
+            else:
+                suffix = f".g{gen}.{shard}"
+            j = self._bilogs[(gen, shard)] = Journaler(
                 self.gw.ioctx, f"rgw.bilog.{self.name}{suffix}")
         return j
 
     @property
     def bilog(self):
-        """Shard 0's bilog — the whole log for single-shard buckets
-        (what rgw/sync.py replays; resharded buckets need a
-        full-sync restart, as the reference's bilog reshard does)."""
-        return self.bilog_for_shard(0)
+        """Generation 0's shard-0 bilog — the whole log for legacy
+        single-shard buckets (kept for pre-generation callers;
+        rgw/sync.py walks every (gen, shard) log itself)."""
+        return self.bilog_for_shard(0, gen=0)
 
-    def _log_op(self, op: str, key: str, shard: int) -> None:
+    def _log_op(self, op: str, key: str, shard: int,
+                gen: Optional[int] = None, **extra) -> None:
+        """Append one bilog entry: {op, key, mtime} plus per-op extras
+        (etag/size on puts; origin on sync applies, so the reverse
+        agent can suppress the echo instead of ping-ponging writes).
+        ``gen`` pins the log to the caller's layout snapshot — the
+        shard NUMBER and the log GENERATION must come from the same
+        layout or a TTL refresh mid-op could cross the streams."""
+        if faults.fire("rgw.bilog_lost_entry", bucket=self.name,
+                       key=key, shard=shard) is not None:
+            return                     # the entry is silently LOST
         # reload the journal header first: another live handle of this
         # bucket may have appended since ours cached its sequence — a
         # stale seq would duplicate and sync would drop the entry
-        j = self.bilog_for_shard(shard)
+        j = self.bilog_for_shard(shard, gen=gen)
         j._load_header()
-        j.append(json.dumps({"op": op, "key": key}).encode())
+        ent = {"op": op, "key": key, "mtime": time.time()}
+        ent.update(extra)
+        j.append(json.dumps(ent).encode())
 
     # ------------------------------------------------------------- index --
     def _index_shard_oid(self, shard: int,
@@ -248,7 +335,8 @@ class Bucket:
         # replay finds no object and skips — never a visible object
         # that multisite would silently miss
         with self.gw._index_lock(self.name, shard):
-            self._log_op("put", key, shard)
+            self._log_op("put", key, shard, gen=lo["index_gen"],
+                         etag=etag, size=len(data))
             self.gw.ioctx.write_full(self._data_oid(key, gen), data)
             idx = self._read_index_shard(shard, layout=lo)
             old = idx.get(key)
@@ -259,6 +347,65 @@ class Bucket:
         if old:
             self.gw.gc_enqueue(self._version_oids(key, old))
         return etag
+
+    def apply_put(self, key: str, data: bytes,
+                  metadata: Optional[Dict[str, str]], mtime: float,
+                  origin: str) -> Optional[str]:
+        """Sync-agent apply of a replicated put — put_object with the
+        three cross-zone differences: the index entry keeps the
+        SOURCE's mtime (last-writer-wins across zones compares source
+        timestamps, not apply times), the bilog entry carries the
+        ORIGIN zone (the reverse-direction agent suppresses the echo
+        instead of ping-ponging the write back), and a strictly NEWER
+        local entry wins (the post-failover overwrite case).  Returns
+        the ETag, or None when the local entry won."""
+        import secrets as _secrets
+        etag = hashlib.md5(data).hexdigest()
+        gen = _secrets.token_hex(4)
+        lo = dict(self._layout())
+        shard = self._shard_for_key(key, lo)
+        with self.gw._index_lock(self.name, shard):
+            idx = self._read_index_shard(shard, layout=lo)
+            old = idx.get(key)
+            if old and float(old.get("mtime", 0.0)) > mtime:
+                return None            # local write is newer: keep it
+            self._log_op("put", key, shard, gen=lo["index_gen"],
+                         etag=etag, size=len(data), mtime=mtime,
+                         origin=origin)
+            self.gw.ioctx.write_full(self._data_oid(key, gen), data)
+            idx[key] = {"size": len(data), "etag": etag, "gen": gen,
+                        "mtime": mtime, "meta": metadata or {}}
+            self._write_index_shard(shard, idx, layout=lo)
+        if old:
+            self.gw.gc_enqueue(self._version_oids(key, old))
+        return etag
+
+    def apply_delete(self, key: str, mtime: float,
+                     origin: str) -> bool:
+        """Sync-agent apply of a replicated delete (same LWW/origin
+        contract as apply_put).  Returns False when there was nothing
+        to delete or a newer local entry won."""
+        lo = dict(self._layout())
+        shard = self._shard_for_key(key, lo)
+        with self.gw._index_lock(self.name, shard):
+            idx = self._read_index_shard(shard, layout=lo)
+            ent = idx.get(key)
+            if ent is None or float(ent.get("mtime", 0.0)) > mtime:
+                return False
+            self._log_op("delete", key, shard, gen=lo["index_gen"],
+                         mtime=mtime, origin=origin)
+            del idx[key]
+            self._write_index_shard(shard, idx, layout=lo)
+        mp = ent.get("mp")
+        if mp:
+            self.gw.gc_enqueue(self._version_oids(key, ent))
+            return True
+        try:
+            self.gw.ioctx.remove(self._data_oid(key,
+                                                ent.get("gen", "")))
+        except Exception:
+            pass
+        return True
 
     def _version_oids(self, key: str, ent: dict) -> List[str]:
         """Every data oid one index-entry version owns."""
@@ -272,6 +419,16 @@ class Bucket:
         lo = dict(self._layout())
         ent = self._read_index_shard(
             self._shard_for_key(key, lo), layout=lo).get(key)
+        if ent is None:
+            # a miss through a TTL-stale handle reads a resharded-away
+            # generation's (removed) index shard — refresh and retry
+            # once before declaring absence, like the reference
+            # client's ECANCELED + layout-refresh loop
+            lo2 = dict(self._refresh_layout())
+            if lo2 != lo:
+                ent = self._read_index_shard(
+                    self._shard_for_key(key, lo2),
+                    layout=lo2).get(key)
         if ent is None:
             raise RGWError(f"NoSuchKey: {key}")
         mp = ent.get("mp")
@@ -296,6 +453,12 @@ class Bucket:
         ent = self._read_index_shard(
             self._shard_for_key(key, lo), layout=lo).get(key)
         if ent is None:
+            lo2 = dict(self._refresh_layout())
+            if lo2 != lo:
+                ent = self._read_index_shard(
+                    self._shard_for_key(key, lo2),
+                    layout=lo2).get(key)
+        if ent is None:
             raise RGWError(f"NoSuchKey: {key}")
         return dict(ent)
 
@@ -309,7 +472,8 @@ class Bucket:
             ent = idx[key]
             # index entry first, then data: a crash leaves an orphan
             # data object (GC-able), never a dangling index entry
-            self._log_op("delete", key, shard)   # log-ahead, like put
+            self._log_op("delete", key, shard,   # log-ahead, like put
+                         gen=lo["index_gen"])
             del idx[key]
             self._write_index_shard(shard, idx, layout=lo)
         mp = ent.get("mp")
@@ -394,7 +558,8 @@ class Bucket:
         lo = dict(self._layout())
         shard = self._shard_for_key(key, lo)
         with self.gw._index_lock(self.name, shard):
-            self._log_op("put", key, shard)
+            self._log_op("put", key, shard, gen=lo["index_gen"],
+                         etag=etag, size=size)
             idx = self._read_index_shard(shard, layout=lo)
             old = idx.get(key)
             idx[key] = {"size": size, "etag": etag,
@@ -615,6 +780,16 @@ class RGWGateway:
                 shards[nb._shard_for_key(key)][key] = e
             for s, idx in enumerate(shards):
                 nb._write_index_shard(s, idx)
+            # END-MARK the outgoing generation's bilogs: under the
+            # shard locks no writer can append, so each log's current
+            # tail seq is its final entry.  The cutover record is
+            # what lets a sync agent DRAIN the old generation to
+            # these ends and switch — instead of a full-sync restart
+            ends = []
+            for s in range(old_layout["num_shards"]):
+                j = b.bilog_for_shard(s, gen=old_layout["index_gen"])
+                j._load_header()
+                ends.append(j.seq - 1)
             # commit the layout AFTER the new shards exist: a crash
             # mid-copy leaves the old generation authoritative
             d = self._read_buckets()
@@ -623,6 +798,10 @@ class RGWGateway:
                 int(prev.get("max_shards",
                              old_layout["num_shards"])),
                 int(num_shards))
+            new_layout["log_gens"] = list(prev.get("log_gens", [])) + [
+                {"gen": old_layout["index_gen"],
+                 "num_shards": old_layout["num_shards"],
+                 "ends": ends}]
             d[name] = dict(prev, **new_layout)
             self._write_buckets(d)
             # old generation -> gone (absent old-gen reads were never
@@ -666,37 +845,127 @@ class RGWGateway:
     def list_buckets(self) -> List[str]:
         return sorted(self._read_buckets())
 
-    def delete_bucket(self, name: str) -> None:
+    # --------------------------------------------- bilog retirement --
+    # Old-generation bilogs are the ONLY copy of ops a peer zone has
+    # not replicated yet: removing one before every registered zone
+    # drained past its end markers is the lost-replication bug class.
+    # Trim/retire is therefore drain-gated everywhere — the sync
+    # agents call retire_drained_bilogs() after their passes, and
+    # delete_bucket refuses while undrained entries remain.
+
+    def _remove_bilog(self, b: Bucket, gen: int, shard: int) -> None:
+        j = b.bilog_for_shard(shard, gen=gen)
+        j._load_header()
+        for idx_no in range(j.first, j.active + 1):
+            try:
+                self.ioctx.remove(j._obj_oid(idx_no))
+            except Exception:
+                pass
+        try:
+            self.ioctx.remove(j._header_oid())
+        except Exception:
+            pass
+
+    def _gen_drained(self, name: str, gen: int, ends: List[int],
+                     zones: Optional[List[str]] = None) -> bool:
+        """True when every registered peer zone's sync cursor is past
+        generation ``gen``'s end markers (no zones -> vacuously
+        drained: nothing replicates this bucket)."""
+        if zones is None:
+            zones = _read_json(self.ioctx, zones_oid(name), [],
+                               "zone set")
+        return all(zone_drained_past(
+            read_sync_state(self.ioctx, name, z), gen, ends)
+            for z in zones)
+
+    def retire_drained_bilogs(self, name: str) -> int:
+        """Remove retired-generation bilogs every registered zone has
+        drained past (and drop them from the bucket record's gen
+        history); returns generations retired.  Undrained generations
+        stay — they are replayable history, not garbage."""
+        d = self._read_buckets()
+        ent = d.get(name)
+        if ent is None or not ent.get("log_gens"):
+            return 0
+        zones = _read_json(self.ioctx, zones_oid(name), [],
+                           "zone set")
+        b = Bucket(self, name,
+                   layout={"num_shards": int(ent.get("num_shards", 1)),
+                           "index_gen": int(ent.get("index_gen", 0))})
+        keep, retired = [], 0
+        for h in ent["log_gens"]:
+            g = int(h["gen"])
+            ends = [int(e) for e in h["ends"]]
+            if self._gen_drained(name, g, ends, zones):
+                for s in range(int(h["num_shards"])):
+                    self._remove_bilog(b, g, s)
+                retired += 1
+            else:
+                keep.append(h)
+        if retired:
+            d = self._read_buckets()
+            cur = d.get(name)
+            if cur is not None:
+                cur["log_gens"] = keep
+                self._write_buckets(d)
+        return retired
+
+    def delete_bucket(self, name: str, force: bool = False) -> None:
         d = self._read_buckets()
         if name not in d:
             raise RGWError(f"NoSuchBucket: {name}")
+        ent = d[name]
         b = self.bucket(name)
         if b._read_index():
             raise RGWError(f"BucketNotEmpty: {name}")
+        cur_gen = int(ent.get("index_gen", 0))
+        cur_n = int(ent.get("num_shards", 1))
+        zones = _read_json(self.ioctx, zones_oid(name), [],
+                           "zone set")
+        # every generation's logs, with the ACTIVE one end-marked at
+        # its current tails (the bucket is empty, so its remaining
+        # entries are the deletes peers still need to replicate)
+        gens = [(int(h["gen"]), int(h["num_shards"]),
+                 [int(e) for e in h["ends"]])
+                for h in ent.get("log_gens", [])]
+        cur_ends = []
+        for s in range(cur_n):
+            j = b.bilog_for_shard(s, gen=cur_gen)
+            j._load_header()
+            cur_ends.append(j.seq - 1)
+        gens.append((cur_gen, cur_n, cur_ends))
+        if zones and not force:
+            for g, _n, ends in gens:
+                if not self._gen_drained(name, g, ends, zones):
+                    raise RGWError(
+                        f"BucketNotDrained: {name} bilog gen {g} has "
+                        f"entries no peer zone has synced yet — pump "
+                        f"sync first, or force=True to accept the "
+                        f"lost replication")
         for s in range(b.num_shards()):
             try:
                 self.ioctx.remove(b._index_shard_oid(s))
             except Exception:
                 pass
-        # drop every shard's bilog chain + header so a recreated
-        # bucket starts with fresh logs (sync position objects are
-        # per-zone and owned by their agents).  Sweep to the
-        # HIGH-WATER shard count: bilogs are keyed by shard number
-        # and a shrink reshard leaves the higher shards' logs behind
-        max_shards = max(int(d[name].get("max_shards",
-                                         b.num_shards())),
-                         b.num_shards())
+        for g, n, _ends in gens:
+            for s in range(n):
+                self._remove_bilog(b, g, s)
+        # legacy sweep to the HIGH-WATER shard count: pre-generation
+        # pools left shrink-reshard bilogs under plain gen-0 names
+        max_shards = max(int(ent.get("max_shards", cur_n)), cur_n)
         for s in range(max_shards):
-            j = b.bilog_for_shard(s)
-            for idx_no in range(j.first, j.active + 1):
-                try:
-                    self.ioctx.remove(j._obj_oid(idx_no))
-                except Exception:
-                    pass
+            self._remove_bilog(b, 0, s)
+        # sync bookkeeping goes with the bucket (the drain gate above
+        # already proved the markers were consumed or force waived)
+        for z in zones:
             try:
-                self.ioctx.remove(j._header_oid())
+                self.ioctx.remove(sync_state_oid(name, z))
             except Exception:
                 pass
+        try:
+            self.ioctx.remove(zones_oid(name))
+        except Exception:
+            pass
         del d[name]
         self._write_buckets(d)
         self._drop_index_locks(name)

@@ -714,6 +714,14 @@ def serve_main(argv: Optional[Sequence[str]] = None,
     import argparse
     import sys
     out = out or sys.stdout
+    argv = list(argv or [])
+    if "--dr" in argv:
+        # `ceph serve --dr`: the two-zone disaster-recovery drill
+        # (sever -> failover -> heal -> convergence gate) — same
+        # serving-shaped workload, different harness
+        from ..cluster.dr_drill import drill_main
+        argv.remove("--dr")
+        return drill_main(argv, out=out)
     ap = argparse.ArgumentParser(
         prog="ceph serve",
         description="multi-tenant S3 serving workload with an "

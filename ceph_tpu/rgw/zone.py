@@ -28,7 +28,7 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
 from .gateway import RGWGateway
-from .sync import BucketSyncAgent
+from .sync import BucketSyncAgent, make_sync_engine
 
 
 class RealmError(RuntimeError):
@@ -239,10 +239,26 @@ class PeriodSync:
     bucket (the rgw data-sync fan-out shape, with sync.py's bilog
     agents as the data plane)."""
 
-    def __init__(self, realm: Realm, gateways: Dict[str, RGWGateway]):
+    def __init__(self, realm: Realm, gateways: Dict[str, RGWGateway],
+                 engine_workers: int = 4):
         self.realm = realm
         self.gateways = gateways
         self._agents: Dict[tuple, BucketSyncAgent] = {}
+        # one shared fetch/apply pipeline for every agent: shard
+        # drains across buckets AND zone pairs run concurrently,
+        # FIFO-ordered only within one (bucket, zone, gen, shard)
+        self._engine = None
+        self._engine_workers = int(engine_workers)
+
+    def engine(self):
+        if self._engine is None and self._engine_workers > 0:
+            self._engine = make_sync_engine(self._engine_workers)
+        return self._engine
+
+    def close(self) -> None:
+        if self._engine is not None:
+            self._engine.close()
+            self._engine = None
 
     def _pairs(self) -> List[tuple]:
         period = self.realm.current_period()
@@ -269,7 +285,9 @@ class PeriodSync:
                 agent = self._agents.get(key)
                 if agent is None:
                     agent = BucketSyncAgent(src_gw, dst_gw, bucket,
-                                            zone=dst_zone)
+                                            zone=dst_zone,
+                                            src_zone=src_zone,
+                                            engine=self.engine())
                     self._agents[key] = agent
                 applied[key] = agent.sync()
         return applied

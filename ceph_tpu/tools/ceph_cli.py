@@ -536,6 +536,8 @@ def main(argv: Optional[List[str]] = None,
                          "thrash [--seed N --cycles K --netsplit "
                          "--powercycle --json] | "
                          "serve [--seed N --chaos --starve --json] | "
+                         "serve --dr [--seed N --chaos "
+                         "--lose-bilog --json] | "
                          "rgw POOL bucket reshard|limit ...")
     ns, extra = ap.parse_known_args(argv)
     if ns.words[0] == "lint":
@@ -554,7 +556,9 @@ def main(argv: Optional[List[str]] = None,
         # serving surface (`ceph serve [--chaos --starve --json]`):
         # the multi-tenant S3 workload with the enforced SLO/QoS
         # gate — builds its own vstart cluster, exits nonzero on
-        # any per-tenant breach (rgw/serving.py)
+        # any per-tenant breach (rgw/serving.py).  `serve --dr`
+        # routes to the two-zone disaster-recovery drill
+        # (cluster/dr_drill.py) and exits with its convergence gate
         from ..rgw.serving import serve_main
         return serve_main(ns.words[1:] + extra, out=out)
     if ns.words[0] == "rgw":

@@ -1,0 +1,85 @@
+"""DR drill — two-zone sever/failover/heal with a hard gate.
+
+ISSUE 18 (c): the drill converges on seeds 0-1 (sim tier) and under
+the composed kill+powercycle chaos inside zone A (live tier), the
+gate is provably falsifiable (one seeded lost-bilog entry turns it
+red), and the seeded workload schedule is same-seed deterministic.
+The smoke marker rides scripts/check_dr.py so CI covers the script
+path without a separate job.
+"""
+import io
+
+import pytest
+
+from ceph_tpu.cluster.dr_drill import (DrillConfig, drill_main,
+                                       run_drill)
+from ceph_tpu.common import faults
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    yield
+    faults.reset()
+
+
+@pytest.mark.parametrize("seed", [0, 1])
+def test_dr_drill_green(seed):
+    """Sever -> failover -> heal -> converge, gated HARD: every acked
+    ETag readable in both zones, zero double-applies, zero full-sync
+    restarts, lag p99 read from merged histograms, the sever
+    provably bit, and the mid-catch-up reshard cut a generation."""
+    r = run_drill(DrillConfig(seed=seed))
+    assert r["ok"], r["failures"]
+    assert r["converged"] and r["sever_verified"] and r["resharded"]
+    assert r["lag_samples"] > 0 and r["lag_p99_s"] is not None
+    assert sum(a["double_applies"] for a in r["agents"].values()) == 0
+    assert sum(a["full_syncs"] for a in r["agents"].values()) == 0
+    assert sum(a["gen_cutovers"] for a in r["agents"].values()) >= 1
+
+
+def test_dr_drill_schedule_deterministic():
+    """Same seed, same drill: the workload schedule digest (every
+    (phase, zone, op, key, size) tuple) reproduces exactly."""
+    cfg = dict(seed=5, phase_ops=12, keys=8, reshard_to=0)
+    a = run_drill(DrillConfig(**cfg))
+    b = run_drill(DrillConfig(**cfg))
+    assert a["schedule_digest"] == b["schedule_digest"]
+    assert a["ok"] and b["ok"]
+    # and a different seed actually yields a different schedule
+    c = run_drill(DrillConfig(seed=6, phase_ops=12, keys=8,
+                              reshard_to=0))
+    assert c["schedule_digest"] != a["schedule_digest"]
+
+
+def test_dr_drill_falsifiable_on_lost_bilog():
+    """One acked write whose bilog append is seeded away MUST turn
+    the convergence gate red (exit nonzero, naming the lost key) —
+    a gate that cannot fail proves nothing."""
+    buf = io.StringIO()
+    rc = drill_main(["--seed", "0", "--lose-bilog"], out=buf)
+    assert rc != 0
+    assert "lost-canary" in buf.getvalue()
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", [0, 1])
+def test_dr_drill_chaos_live_zone(seed):
+    """The composed soak: zone A runs live OSD daemons and eats a
+    kill9 AND a powercycle (power_loss + torn WAL + reboot) during
+    cross-zone catch-up; the same hard gate must still hold.  Slow
+    tier (live daemons, ~14 s/seed) like the thrasher soaks."""
+    r = run_drill(DrillConfig(seed=seed, chaos=True))
+    assert r["ok"], r["failures"]
+    assert len(r["chaos"]) == 2, r["chaos"]
+    assert {k for k, _ in r["chaos"]} == {"kill", "powercycle"}
+    assert sum(a["double_applies"] for a in r["agents"].values()) == 0
+
+
+@pytest.mark.smoke
+def test_check_dr_smoke():
+    """The CI smoke (scripts/check_dr.py riding pytest): the cheap
+    determinism leg here; the green/falsifiable legs run as the
+    dedicated tests above (the script builds its own zones when run
+    standalone)."""
+    import scripts.check_dr as cd
+    assert cd._check_determinism() == 0

@@ -1,0 +1,252 @@
+"""GeoSync — per-shard bilog replication, generation cutover, drains.
+
+ISSUE 18 tentpole coverage: reshard mid-catch-up is a SYNCED cutover
+(zero full-sync restarts, asserted structurally), a crashed agent
+resumes from its persisted per-(gen, shard) markers, trim/retire and
+delete_bucket are drain-gated on every registered peer zone, reverse
+agents suppress origin echoes instead of ping-ponging writes, and
+cross-zone conflicts resolve last-writer-wins on SOURCE mtime.
+Reference roles: src/rgw/driver/rados/rgw_sync.cc / rgw_data_sync.cc
+(bilog incremental sync, sync markers, reshard generations).
+"""
+import time
+
+import pytest
+
+from ceph_tpu.client.rados import Rados
+from ceph_tpu.cluster.monitor import Monitor
+from ceph_tpu.common import faults
+from ceph_tpu.rgw import RGWError, RGWGateway
+from ceph_tpu.rgw.sync import BucketSyncAgent
+from tests.test_snaps import make_sim
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    yield
+    faults.reset()
+
+
+def _gw(sim):
+    return RGWGateway(Rados(sim, Monitor(sim.osdmap)).connect()
+                      .open_ioctx("rep"))
+
+
+def _zones():
+    return _gw(make_sim()), _gw(make_sim())
+
+
+def _keys(b, **kw):
+    return [c["key"] for c in
+            b.list_objects(max_keys=1000, **kw)["contents"]]
+
+
+# ------------------------------------------------- reshard cutover --
+
+def test_reshard_mid_sync_synced_cutover_no_full_sync():
+    """Live writes + reshard between sync passes: the peer converges
+    through the generation cutover — old-gen shards drained to their
+    end markers, then the new-gen shards — with ZERO full-sync
+    restarts and identical listings."""
+    gw_a, gw_b = _zones()
+    a = gw_a.create_bucket("hot", num_shards=2)
+    for i in range(8):
+        a.put_object(f"k{i:02d}", f"v{i}".encode() * 40)
+    agent = BucketSyncAgent(gw_a, gw_b, "hot", zone="b",
+                            src_zone="a")
+    assert agent.sync() == {"puts": 8, "deletes": 0}
+    # live writes continue, then the bucket reshards, then MORE
+    # writes land in the new generation before the next pass
+    for i in range(8, 12):
+        a.put_object(f"k{i:02d}", f"v{i}".encode() * 40)
+    a.delete_object("k00")
+    gw_a.reshard_bucket("hot", 6)
+    for i in range(12, 16):
+        gw_a.bucket("hot").put_object(f"k{i:02d}",
+                                      f"v{i}".encode() * 40)
+    s = agent.sync()
+    assert s == {"puts": 8, "deletes": 1}
+    assert agent.stats["gen_cutovers"] == 1
+    assert agent.stats["full_syncs"] == 0
+    assert agent.stats["double_applies"] == 0
+    assert _keys(gw_b.bucket("hot")) == _keys(gw_a.bucket("hot"))
+    # steady state: nothing replays, the cutover is durable
+    assert agent.sync() == {"puts": 0, "deletes": 0}
+
+
+def test_fresh_agent_resumes_from_persisted_markers():
+    """A crash is a dropped agent: a FRESH instance picks up from the
+    durable cursor — applying only the unseen suffix, across a
+    reshard boundary, with no full-sync restart and no double
+    applies."""
+    gw_a, gw_b = _zones()
+    a = gw_a.create_bucket("wal", num_shards=2)
+    for i in range(6):
+        a.put_object(f"k{i:02d}", b"x" * 64)
+    ag1 = BucketSyncAgent(gw_a, gw_b, "wal", zone="b", src_zone="a")
+    assert ag1.sync()["puts"] == 6
+    # "crash": ag1 is gone; more writes + a reshard happen meanwhile
+    gw_a.reshard_bucket("wal", 4)
+    b_new = gw_a.bucket("wal")
+    for i in range(6, 10):
+        b_new.put_object(f"k{i:02d}", b"y" * 64)
+    ag2 = BucketSyncAgent(gw_a, gw_b, "wal", zone="b", src_zone="a")
+    s = ag2.sync()
+    assert s == {"puts": 4, "deletes": 0}          # suffix only
+    assert ag2.stats["full_syncs"] == 0
+    assert ag2.stats["double_applies"] == 0
+    assert _keys(gw_b.bucket("wal")) == _keys(gw_a.bucket("wal"))
+
+
+def test_partition_mid_drain_resumes_where_severed():
+    """The wire drops mid-shard-drain (net.partition severing after a
+    few entries): progress up to the sever is durable, the pass
+    reports the error with markers unmoved past it, and a fresh agent
+    finishes the remainder — at-most-once throughout."""
+    gw_a, gw_b = _zones()
+    a = gw_a.create_bucket("cut", num_shards=1)
+    for i in range(10):
+        a.put_object(f"k{i:02d}", b"z" * 32)
+    calls = {"n": 0}
+
+    def sever_after_4(ctx):
+        # only the cross-zone lane: the sim's own heartbeat/dispatch
+        # traffic consults the same faultpoint and must keep flowing
+        if ctx.get("src") != "zone.a" or ctx.get("dst") != "zone.b":
+            return False
+        calls["n"] += 1
+        return calls["n"] > 4
+    faults.arm("net.partition", mode="predicate",
+               predicate=sever_after_4)
+    ag1 = BucketSyncAgent(gw_a, gw_b, "cut", zone="b", src_zone="a")
+    s = ag1.sync()
+    assert 0 < s["puts"] < 10
+    assert ag1.last_errors and "severed" in ag1.last_errors[0]
+    faults.disarm("net.partition")
+    ag2 = BucketSyncAgent(gw_a, gw_b, "cut", zone="b", src_zone="a")
+    s2 = ag2.sync()
+    assert s["puts"] + s2["puts"] == 10
+    assert ag2.stats["double_applies"] == 0
+    assert ag2.stats["full_syncs"] == 0
+    assert _keys(gw_b.bucket("cut")) == _keys(a)
+
+
+# ------------------------------------------------ drain-gated trim --
+
+def test_old_generation_bilogs_retire_only_after_drain():
+    """Reshard leaves the outgoing generation's bilogs in place until
+    every registered zone drained past their end markers; the sync
+    pass itself then retires them."""
+    gw_a, gw_b = _zones()
+    a = gw_a.create_bucket("gen", num_shards=2)
+    for i in range(6):
+        a.put_object(f"k{i}", b"d" * 16)
+    agent = BucketSyncAgent(gw_a, gw_b, "gen", zone="b",
+                            src_zone="a")     # registers zone b
+    gw_a.reshard_bucket("gen", 4)
+    ent = gw_a._read_buckets()["gen"]
+    assert [h["gen"] for h in ent["log_gens"]] == [0]
+    assert len(ent["log_gens"][0]["ends"]) == 2
+    # zone b has drained nothing: retirement must refuse
+    assert gw_a.retire_drained_bilogs("gen") == 0
+    assert gw_a._read_buckets()["gen"]["log_gens"]
+    # the drain pass retires the generation as part of trim
+    agent.sync()
+    assert gw_a._read_buckets()["gen"].get("log_gens") == []
+
+
+def test_delete_bucket_refuses_until_peers_drain():
+    """delete_bucket with a registered, behind peer zone raises
+    BucketNotDrained (premature trim is the lost-replication bug
+    class); force=True overrides; a drained bucket deletes clean."""
+    gw_a, gw_b = _zones()
+    a = gw_a.create_bucket("doomed", num_shards=2)
+    agent = BucketSyncAgent(gw_a, gw_b, "doomed", zone="b",
+                            src_zone="a")
+    a.put_object("k0", b"v")
+    a.put_object("k1", b"v")
+    a.delete_object("k0")
+    a.delete_object("k1")
+    with pytest.raises(RGWError, match="BucketNotDrained"):
+        gw_a.delete_bucket("doomed")
+    agent.sync()                       # zone b drains to the tails
+    gw_a.delete_bucket("doomed")       # now clean, no force
+    assert "doomed" not in gw_a.list_buckets()
+
+
+def test_delete_bucket_force_overrides_drain_gate():
+    gw_a, gw_b = _zones()
+    a = gw_a.create_bucket("forced")
+    BucketSyncAgent(gw_a, gw_b, "forced", zone="b", src_zone="a")
+    a.put_object("k", b"v")
+    a.delete_object("k")
+    with pytest.raises(RGWError, match="BucketNotDrained"):
+        gw_a.delete_bucket("forced")
+    gw_a.delete_bucket("forced", force=True)
+    assert "forced" not in gw_a.list_buckets()
+
+
+# ------------------------------------------- bidirectional zones --
+
+def test_echo_suppression_no_ping_pong():
+    """A->B applies log with the ORIGIN zone; the reverse agent skips
+    those entries instead of bouncing the write back forever."""
+    gw_a, gw_b = _zones()
+    a = gw_a.create_bucket("both", num_shards=2)
+    a.put_object("seed", b"from-a")
+    ab = BucketSyncAgent(gw_a, gw_b, "both", zone="b", src_zone="a")
+    assert ab.sync()["puts"] == 1
+    ba = BucketSyncAgent(gw_b, gw_a, "both", zone="a", src_zone="b")
+    for _ in range(3):                 # steady-state ping-pong check
+        assert ba.sync() == {"puts": 0, "deletes": 0}
+        assert ab.sync() == {"puts": 0, "deletes": 0}
+    assert ba.stats["origin_skips"] >= 1
+    assert ab.stats["double_applies"] == 0
+    assert ba.stats["double_applies"] == 0
+    assert gw_a.bucket("both").get_object("seed")[0] == b"from-a"
+    assert gw_b.bucket("both").get_object("seed")[0] == b"from-a"
+
+
+def test_conflict_resolves_last_writer_wins_on_source_mtime():
+    """Divergent writes to one key during a partition converge to the
+    LATER source write in BOTH zones after heal."""
+    gw_a, gw_b = _zones()
+    a = gw_a.create_bucket("clash")
+    a.put_object("k", b"first")
+    ab = BucketSyncAgent(gw_a, gw_b, "clash", zone="b", src_zone="a")
+    ab.sync()
+    b = gw_b.bucket("clash")
+    # partition: both sides write independently, B strictly later
+    a.put_object("k", b"a-side")
+    time.sleep(0.02)
+    b.put_object("k", b"b-side-wins")
+    ba = BucketSyncAgent(gw_b, gw_a, "clash", zone="a", src_zone="b")
+    for _ in range(2):                 # heal: pump both directions
+        ab.sync()
+        ba.sync()
+    assert gw_a.bucket("clash").get_object("k")[0] == b"b-side-wins"
+    assert gw_b.bucket("clash").get_object("k")[0] == b"b-side-wins"
+    assert ab.stats["conflict_skips"] >= 1   # a-side lost the race
+
+
+# ------------------------------------------------- seeded faults --
+
+def test_lost_bilog_entry_never_replicates():
+    """The falsifiability seed: one acked write whose bilog append is
+    dropped is invisible to replication — the peer converges WITHOUT
+    it (exactly what the DR gate must turn red on)."""
+    gw_a, gw_b = _zones()
+    a = gw_a.create_bucket("holes")
+    a.put_object("kept", b"logged")
+    faults.arm("rgw.bilog_lost_entry", mode="always", count=1)
+    a.put_object("lost", b"acked but never logged")
+    faults.disarm("rgw.bilog_lost_entry")
+    assert faults.fire_counts()["rgw.bilog_lost_entry"] == 1
+    agent = BucketSyncAgent(gw_a, gw_b, "holes", zone="b",
+                            src_zone="a")
+    assert agent.sync() == {"puts": 1, "deletes": 0}
+    b = gw_b.bucket("holes")
+    assert b.get_object("kept")[0] == b"logged"
+    with pytest.raises(RGWError, match="NoSuchKey"):
+        b.get_object("lost")           # acked on A, absent on B
+    assert a.get_object("lost")[0].startswith(b"acked")

@@ -596,6 +596,90 @@ def test_ctl604_noqa_suppresses(tmp_path):
     assert not lint(tmp_path, select=["CTL604"]).findings
 
 
+def test_ctl605_marker_before_completion(tmp_path):
+    """ISSUE 18: a sync agent that persists its replication marker
+    while an async apply is still in flight acks an entry the crash
+    may lose forever — the gather must come first."""
+    write(tmp_path, "rgw/agent.py", """\
+        def _save_state(ioctx, state):
+            ioctx.write_full("rgw.sync.b.z", state)
+
+        def bad_pump(self, engine, shards):
+            comps = []
+            for s in shards:
+                comps.append(engine.submit(self.apply, key=s))
+            _save_state(self.ioctx, self.state)   # apply in flight
+            for c in comps:
+                c.result()
+
+        def good_pump(self, engine, shards):
+            comps = []
+            for s in shards:
+                comps.append(engine.submit(self.apply, key=s))
+            for c in comps:
+                c.result()
+            _save_state(self.ioctx, self.state)   # after the gather
+        """)
+    res = lint(tmp_path, select=["CTL605"])
+    assert rules_of(res) == ["CTL605"]
+    assert res.findings[0].line == 8
+    assert "unresolved" in res.findings[0].msg
+
+
+def test_ctl605_resolves_wrapper_through_program_graph(tmp_path):
+    """A bland-named wrapper around the persist helper is the same
+    commit point: the whole-program graph resolves one hop."""
+    write(tmp_path, "rgw/agent.py", """\
+        from rgw.markers import checkpoint
+
+        def pump(self, engine, shards):
+            for s in shards:
+                engine.submit(self.apply, key=s)
+            checkpoint(self)              # wraps the marker persist
+        """)
+    write(tmp_path, "rgw/markers.py", """\
+        def checkpoint(agent):
+            _commit_marker(agent)
+
+        def _commit_marker(agent):
+            agent.ioctx.write_full(agent.oid, agent.state)
+        """)
+    res = lint(tmp_path, select=["CTL605"])
+    assert rules_of(res) == ["CTL605"]
+    assert "checkpoint" in res.findings[0].msg
+
+
+def test_ctl605_scoped_and_clean_without_submit(tmp_path):
+    """No pending submission -> no finding; and modules outside the
+    rgw//sync scope keep their conventions."""
+    write(tmp_path, "rgw/agent.py", """\
+        def _advance_applied(self, seq):
+            self.ioctx.write_full(self.oid, seq)
+
+        def apply_entry(self, ent):
+            self.dst.apply_put(ent)
+            self._advance_applied(ent["seq"])   # after the apply
+        """)
+    assert not lint(tmp_path, select=["CTL605"]).findings
+    write(tmp_path, "cluster/batch.py", """\
+        def flush(self, engine, items):
+            for it in items:
+                engine.submit(self.push, key=it)
+            self.save_state()                 # out of CTL605 scope
+        """)
+    assert not lint(tmp_path, select=["CTL605"]).findings
+
+
+def test_ctl605_noqa_suppresses(tmp_path):
+    write(tmp_path, "rgw/agent.py", """\
+        def pump(self, engine, shards):
+            for s in shards:
+                engine.submit(self.apply, key=s)
+            self._save_state(self.state)  # noqa: CTL605 -- replays dedup
+        """)
+    assert not lint(tmp_path, select=["CTL605"]).findings
+
+
 # ------------------------------ CTL7xx: trace-context propagation ---
 
 def test_ctl701_raw_send_without_trace_context(tmp_path):

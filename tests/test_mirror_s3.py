@@ -303,10 +303,13 @@ def test_rgw_multisite_bucket_sync():
     from ceph_tpu.rgw import RGWError
     with pytest.raises(RGWError):
         b.get_object("doomed.txt")
-    # a fresh agent resumes from the durable position
-    assert BucketSyncAgent(gw_a, gw_b, "assets",
-                           zone="zone-b").sync() == \
-        {"puts": 0, "deletes": 0}
+    # a fresh agent resumes from the durable position, and the
+    # at-most-once ledger stayed clean throughout (ISSUE 18)
+    ag2 = BucketSyncAgent(gw_a, gw_b, "assets", zone="zone-b")
+    assert ag2.sync() == {"puts": 0, "deletes": 0}
+    for a_ in (agent, ag2):
+        assert a_.stats["double_applies"] == 0
+        assert a_.stats["full_syncs"] == 0
 
 
 def test_sigv4_replay_window():
