@@ -678,6 +678,87 @@ def _cluster_system_phases(sim, k, m, obj_bytes, batch_n, rounds):
     return out
 
 
+def bench_plane_2d(k=4, m=2, W=1 << 12, batch_n=64, iters=8):
+    """1-D vs 2-D data-plane layout on the same dispatch mix: the
+    replicated-mask EC encode (put hot loop) and the collective
+    rebuild (recovery hot loop) through ``ShardedDataPlane``, first on
+    the flat shard ring, then on the row-major (stripe, shard) mesh
+    (``parallel_data_plane_stripes=2``).  Reports throughput per
+    layout plus the 2-D mesh's per-axis all-gather row counters —
+    evidence that the rebuild really runs the two-level gather (SHARD
+    columns then STRIPE rows) rather than one flat ring hop.  Results
+    are bit-identical across layouts by construction (asserted in
+    dryrun_multichip); this measures cost, not correctness.  Needs
+    >= 4 devices for a non-degenerate 2x(n/2) grid."""
+    import jax
+    n_dev = len(jax.devices())
+    if n_dev < 4 or n_dev % 2:
+        return {"skipped": f"{n_dev} device(s): need an even count "
+                           f">= 4 for a 2-row mesh"}
+    from ceph_tpu.common.options import config
+    from ceph_tpu.common.perf_counters import perf
+    from ceph_tpu.ops import gf, xor_kernel
+
+    masks = xor_kernel.masks_to_device(
+        gf.gf8_bitmatrix(gf.vandermonde_parity(k, m)))
+    rng = np.random.default_rng(3)
+    words = rng.integers(0, 2 ** 31, (batch_n, 8 * k, W // 8),
+                         dtype=np.uint32)
+    rmasks = np.broadcast_to(
+        np.asarray(gf.gf8_bitmatrix(gf.vandermonde_parity(k, m)),
+                   dtype=np.int32),
+        (batch_n,) + gf.gf8_bitmatrix(
+            gf.vandermonde_parity(k, m)).shape).copy()
+    total = 4 * words.size * iters
+
+    def drive(stripes):
+        from ceph_tpu.parallel import data_plane as dpmod
+        config().set("parallel_data_plane", True)
+        if stripes:
+            config().set("parallel_data_plane_stripes", stripes)
+        try:
+            perf("dataplane").reset()
+            dp = dpmod.plane()
+            if dp is None:
+                return None
+            # warm both executables off the clock
+            jax.block_until_ready(dp.xor_matmul_w32(masks, words))
+            jax.block_until_ready(dp.rebuild_collective(rmasks, words))
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                jax.block_until_ready(dp.xor_matmul_w32(masks, words))
+            t_enc = time.perf_counter() - t0
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                jax.block_until_ready(
+                    dp.rebuild_collective(rmasks, words))
+            t_reb = time.perf_counter() - t0
+            d = perf("dataplane").dump()
+            return {
+                "mesh_shape": list(dp.mesh.devices.shape),
+                "encode_gbps": round(total / max(t_enc, 1e-9) / 1e9,
+                                     3),
+                "rebuild_gbps": round(total / max(t_reb, 1e-9) / 1e9,
+                                      3),
+                "psum_rows": d.get("psum_rows", 0),
+                "allgather_rows": d.get("allgather_rows", 0),
+                "allgather_rows_stripe":
+                    d.get("allgather_rows_stripe", 0),
+                "allgather_rows_shard":
+                    d.get("allgather_rows_shard", 0),
+            }
+        finally:
+            config().clear("parallel_data_plane")
+            if stripes:
+                config().clear("parallel_data_plane_stripes")
+
+    flat = drive(0)
+    grid = drive(2)
+    if flat is None or grid is None:
+        return {"skipped": "data plane unavailable on this host"}
+    return {"n_devices": n_dev, "flat_1d": flat, "grid_2d": grid}
+
+
 def bench_cluster_sharded(k=4, m=2, obj_bytes=4 << 20, batch_n=16,
                           n_osds=16, pg_num=32):
     """The FULL cluster step sharded across the ambient device mesh
@@ -1826,6 +1907,12 @@ def main():
         extras["cluster_sharded"] = bench_cluster_sharded()
     except Exception as e:
         print(f"# cluster sharded bench failed: {e}", file=sys.stderr)
+    try:
+        import gc
+        gc.collect()
+        extras["plane_2d"] = bench_plane_2d()
+    except Exception as e:
+        print(f"# plane 2d bench failed: {e}", file=sys.stderr)
     try:
         import gc
         gc.collect()

@@ -33,6 +33,12 @@ that gap statically, the way CTL8xx closed the wire-protocol contract:
            (the bug PR 4's psum accounting exists to prevent); plus
            literal ppermute permutations must not repeat a source or
            destination
+  CTL1006  process-rank divergence — ``jax.process_index()`` /
+           ``jax.process_count()`` inside jit/shard_map-reachable
+           code is a trace-time constant, so per-process branching
+           traces a DIFFERENT program on each host (the classic
+           multi-host deadlock); rank reads belong in host code
+           (parallel.multihost)
 """
 from __future__ import annotations
 
@@ -645,9 +651,61 @@ class UnreducedAccountingRule(Rule):
         return out
 
 
+_PROCESS_RANK_CALLS = {"jax.process_index", "jax.process_count",
+                       "jax.distributed.initialize"}
+
+
+class ProcessRankDivergenceRule(Rule):
+    rule_id = "CTL1006"
+    name = "shard-process-rank-divergence"
+    description = ("jax.process_index()/process_count() inside "
+                   "jit/shard_map-reachable code — the rank is a "
+                   "trace-time Python int, so per-process branching "
+                   "bakes a DIFFERENT program into each host's "
+                   "executable and the SPMD fleet deadlocks or "
+                   "silently diverges at the first collective; read "
+                   "the rank host-side via parallel.multihost")
+
+    def check_module(self, mod: ParsedModule) -> Iterable[Finding]:
+        if mod.evidence:
+            return ()
+        ctx = shardspec.device_context(mod.program)
+        hot = ctx.hot_in(mod)
+        if not hot:
+            return ()
+        aliases = astutil.aliases_of(mod)
+        out: List[Finding] = []
+        seen: Set[Tuple[int, str]] = set()
+
+        def emit(line: int, msg: str) -> None:
+            if (line, msg) not in seen:
+                seen.add((line, msg))
+                out.append(self.finding(mod, line, msg))
+
+        for fn in hot:
+            fname = getattr(fn, "name", "<fn>")
+            for node in _own_nodes(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                cn = astutil.resolve(node.func, aliases)
+                if cn in _PROCESS_RANK_CALLS:
+                    what = cn.rsplit(".", 1)[-1]
+                    emit(node.lineno,
+                         f"{cn}() in jit-reachable {fname}() is a "
+                         f"trace-time constant — each process traces "
+                         f"a different program and the SPMD "
+                         f"collectives deadlock or diverge; hoist "
+                         f"the {what} read to host code "
+                         f"(parallel.multihost.{what}) and pass the "
+                         f"result in as data")
+        return out
+
+
 def register(reg) -> None:
     reg.add(AxisClosureRule.rule_id, AxisClosureRule)
     reg.add(TraceTimeEffectRule.rule_id, TraceTimeEffectRule)
     reg.add(ShardHostSyncRule.rule_id, ShardHostSyncRule)
     reg.add(SpecDisciplineRule.rule_id, SpecDisciplineRule)
     reg.add(UnreducedAccountingRule.rule_id, UnreducedAccountingRule)
+    reg.add(ProcessRankDivergenceRule.rule_id,
+            ProcessRankDivergenceRule)

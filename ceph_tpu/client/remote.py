@@ -248,6 +248,24 @@ class RemoteCluster:
                     self._backoff.sleep(attempt)
         raise IOError(f"mon unreachable ({last})")
 
+    def report_plane_perf(self) -> None:
+        """Ship this client's process perf dump (the data-plane chip
+        counters live HERE — the plane runs client-side) to the mon's
+        ClusterStats, tagged with the multihost host label, so
+        `ceph -s` / `cluster_stats["mesh"]` show the plane against
+        live daemons.  Under the multi-process plane each rank's
+        client reports under its own host label and the mgr's
+        mesh_rollup sums the (host, chip) cells; single-process it is
+        one reporter owning every cell.  Attribution stays the
+        AUTHENTICATED wire entity — the label only tags the row."""
+        import time as _time
+        from ..common.perf_counters import perf as _perf
+        from ..parallel.multihost import host_label
+        self.mon_call({"cmd": "report_perf",
+                       "report": {"perf": _perf().dump_typed(),
+                                  "ts": _time.time(),
+                                  "host": host_label()}})
+
     # ---------------------------------------------------------------- map --
     def refresh_map(self) -> None:
         blob = self.mon_call({"cmd": "get_map"})
@@ -954,8 +972,16 @@ class RemoteCluster:
             # encode/transmit concurrently across streams instead of
             # one blocking RTT per shard
             fan: List[Tuple[int, int, object]] = []
-            for shard in range(n):
-                tgt = up[shard] if shard < len(up) else ITEM_NONE
+            # submission order: on a multi-host plane the sub-writes
+            # interleave round-robin across the targets' hosts so
+            # every host's dispatch queue fills from the first
+            # submit; single-host it is the identity order (today's
+            # fan-out, byte for byte)
+            targets = [up[s] if s < len(up) else ITEM_NONE
+                       for s in range(n)]
+            from ..parallel.multihost import stripe_order
+            for shard in stripe_order(targets):
+                tgt = targets[shard]
                 if tgt == ITEM_NONE or acked.get(shard) == tgt:
                     continue
                 fan.append((shard, tgt, self.aio.call_async(tgt, {
@@ -1932,7 +1958,7 @@ class RemoteCluster:
         # submit-all-then-gather on the async streams; put_shard is a
         # replay-stamped mutation, so the one fresh-stream resubmit
         # after a stream death applies at most once
-        push_fan = []
+        pending_push = []
         for rec in records:
             up_r, holdings_r = rec["up"], rec["holdings"]
             for shard, data in rec["shards"].items():
@@ -1942,13 +1968,20 @@ class RemoteCluster:
                 oid = f"{shard}:{rec['name']}"
                 if oid in holdings_r.get(tgt, set()):
                     continue
-                push_fan.append(
-                    (rec, shard, tgt, oid,
-                     self.aio.call_async(tgt, {
-                         "cmd": "put_shard", "coll": rec["coll"],
-                         "oid": oid, "data": data,
-                         "attrs": rec["attrs"],
-                         "klass": "background_recovery"})))
+                pending_push.append((rec, shard, tgt, oid, data))
+        # multi-host plane: interleave push submission across target
+        # hosts (identity order on a single host — see stripe_order)
+        from ..parallel.multihost import stripe_order
+        push_fan = []
+        for i in stripe_order([p[2] for p in pending_push]):
+            rec, shard, tgt, oid, data = pending_push[i]
+            push_fan.append(
+                (rec, shard, tgt, oid,
+                 self.aio.call_async(tgt, {
+                     "cmd": "put_shard", "coll": rec["coll"],
+                     "oid": oid, "data": data,
+                     "attrs": rec["attrs"],
+                     "klass": "background_recovery"})))
         for (rec, shard, tgt, oid, _c), (_r, err) in zip(
                 push_fan,
                 self.aio.gather([c for *_ign, c in push_fan])):

@@ -139,8 +139,17 @@ class HeartbeatMonitor:
                     "osd.io": {k: (COUNTER, v)
                                for k, v in heat.totals().items()}}
             self.mon.record_daemon_perf(f"osd.{o.id}", report)
+        # the process perf dump carries the data-plane chip counters;
+        # under the multi-process plane each rank reports as its own
+        # client daemon tagged with its host label, so the mgr's
+        # mesh_rollup sees per-(host, chip) cells instead of two
+        # ranks overwriting one "client" row
+        from ..parallel import multihost as _mh
+        label = _mh.host_label()
+        entity = "client" if not _mh.is_active() else f"client.{label}"
         self.mon.record_daemon_perf(
-            "client", {"perf": _perf().dump_typed(), "ts": now})
+            entity, {"perf": _perf().dump_typed(), "ts": now,
+                     "host": label})
 
     def tick(self) -> List[int]:
         """One heartbeat round; returns OSDs newly marked down."""

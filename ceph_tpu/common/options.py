@@ -304,6 +304,27 @@ _TABLE: Tuple[Option, ...] = (
            "mesh size for the sharded data plane (0 = every visible "
            "device); values above the visible device count disable "
            "the plane rather than fail mid-dispatch", min=0),
+    Option("parallel_data_plane_stripes", TYPE_INT, 0,
+           "stripe-row count of the MeshPlane2D (stripe, shard) 2-D "
+           "mesh (parallel/mesh.py make_mesh_2d): 0/1 = the legacy "
+           "1-D stripe-batch mesh; >= 2 reshapes the device list "
+           "row-major into (stripes, devices/stripes) so the k+m "
+           "shard dimension shards over the columns too; a count "
+           "that does not divide the device count disables the "
+           "plane rather than fail mid-dispatch", min=0),
+    Option("multihost_coordinator", TYPE_STR, "",
+           "jax.distributed coordinator address (host:port) for the "
+           "multi-process MeshPlane2D ('' = single-process fallback, "
+           "every data-plane path byte-identical to today's; env "
+           "CEPH_TPU_COORDINATOR overrides)"),
+    Option("multihost_processes", TYPE_INT, 0,
+           "process count of the multi-process plane (0/1 = single-"
+           "process fallback; env CEPH_TPU_NUM_PROCESSES overrides)",
+           min=0),
+    Option("multihost_process_id", TYPE_INT, -1,
+           "this process's id in the multi-process plane (-1 = "
+           "unset/fallback; env CEPH_TPU_PROCESS_ID overrides)",
+           min=-1),
     Option("osd_max_backfills", TYPE_INT, 1,
            "recovery/backfill reservations an OSD grants concurrently "
            "per role (local primary-side + remote replica-side, the "

@@ -33,6 +33,7 @@ pin week-old rates into `ceph -s` forever).
 from __future__ import annotations
 
 import math
+import re
 import threading
 import time
 from typing import Any, Dict, Iterable, List, Optional, Tuple
@@ -175,7 +176,8 @@ class ClusterStats:
                         for k, v in flat.items()}
             self._prev_io[daemon] = (ts, flat)
             self._latest[daemon] = {"ts": ts, "perf": perf,
-                                    "util": util}
+                                    "util": util,
+                                    "host": report.get("host")}
             if heat:
                 self._heat[daemon] = heat
         # retain the delivery in the history ring (its own lock; the
@@ -346,6 +348,62 @@ class ClusterStats:
                 "total_objects": total_objects,
                 "pools": dict(sorted(pools.items()))}
 
+    # -------------------------------------------------------- mesh plane --
+    _CHIP_KEY = re.compile(r"^(r(\d+)c(\d+)|shard(\d+))\.(.+)$")
+
+    def mesh_rollup(self) -> Dict[str, Any]:
+        """Per-(host, chip) data-plane counter rollup — the MeshPlane2D
+        cluster view.  Each reporter's ``dataplane`` perf group is
+        scanned for per-chip keys and attributed to the host label its
+        report carried (``host0`` when absent — single-process plane).
+        A reporter writing BOTH the 2-D coordinate keys and the 1-D
+        ``shard<i>`` aliases contributes the coordinate namespace only
+        (the alias is the same value under another name — summing both
+        would double-count); ``totals`` sums every (host, chip) cell,
+        so a 2-process plane's totals equal the single-process run's
+        (per-cell accounting is locality-gated at the source)."""
+        with self._lock:
+            live = self._live()
+        hosts: Dict[str, Dict[str, Dict[str, float]]] = {}
+        totals: Dict[str, float] = {}
+        rows = cols = 0
+        for daemon, rep in live.items():
+            grp = (rep["perf"] or {}).get("dataplane") or {}
+            chips: Dict[str, Dict[str, float]] = {}
+            coords = False
+            for key, tv in grp.items():
+                m = self._CHIP_KEY.match(key)
+                if not m or tv[0] != COUNTER:
+                    continue
+                if m.group(2) is not None:
+                    coords = True
+            for key, tv in grp.items():
+                m = self._CHIP_KEY.match(key)
+                if not m or tv[0] != COUNTER \
+                        or not isinstance(tv[1], (int, float)):
+                    continue
+                is_coord = m.group(2) is not None
+                if coords != is_coord:
+                    continue          # skip the alias namespace
+                if is_coord:
+                    rows = max(rows, int(m.group(2)) + 1)
+                    cols = max(cols, int(m.group(3)) + 1)
+                chips.setdefault(m.group(1), {})[m.group(5)] = \
+                    float(tv[1])
+            if not chips:
+                continue
+            host = str(rep.get("host") or "host0")
+            hrow = hosts.setdefault(host, {})
+            for chip, counters in chips.items():
+                cell = hrow.setdefault(chip, {})
+                for k, v in counters.items():
+                    cell[k] = cell.get(k, 0.0) + v
+                    totals[k] = totals.get(k, 0.0) + v
+        n_chips = sum(len(h) for h in hosts.values())
+        return {"hosts": hosts, "totals": totals,
+                "n_hosts": len(hosts), "n_chips": n_chips,
+                "shape": [rows, cols] if rows else None}
+
     # ------------------------------------------------------------- dump --
     def dump(self) -> Dict[str, Any]:
         return {"daemons": self.daemons(),
@@ -355,6 +413,7 @@ class ClusterStats:
                 "io": self.io_rates(),
                 "df": self.df(),
                 "osd_df": self.osd_df(),
+                "mesh": self.mesh_rollup(),
                 "history": self.history.dump()}
 
     # -------------------------------------------------------- prometheus --

@@ -1,16 +1,38 @@
 """ShardedDataPlane — the multi-chip execution tier for the CLUSTER
-hot loops.
+hot loops (MeshPlane2D: 1-D stripe mesh or 2-D (stripe, shard) mesh).
 
 `parallel/mesh.py` shards the raw kernels; this module shards the
 *system*: the batched put encode, the degraded-get / recovery decode
 (signature-grouped masked-XOR), and the million-PG remap sweep all
-dispatch over a 1-D device mesh on the stripe/PG batch axis, with
-XLA-inserted ICI collectives carrying the cluster-wide accounting
-(the psum the byte counters ride).  This is the reference's scale-out
-— messenger fan-out across OSD processes plus the ParallelPGMapper
-thread pool (src/osd/OSDMapMapping.h:18, SURVEY §2.4) — collapsed
-into shardings, in the spirit of DrJAX's sharded-map primitives
-(arxiv 2403.07128) and batched-XOR EC pipelines (arxiv 2108.02692).
+dispatch over a device mesh, with XLA-inserted ICI collectives
+carrying the cluster-wide accounting (the psum the byte counters
+ride).  This is the reference's scale-out — messenger fan-out across
+OSD processes plus the ParallelPGMapper thread pool
+(src/osd/OSDMapMapping.h:18, SURVEY §2.4) — collapsed into shardings,
+in the spirit of DrJAX's sharded-map primitives (arxiv 2403.07128)
+and batched-XOR EC pipelines (arxiv 2108.02692).
+
+Mesh layouts (``parallel_data_plane_stripes``):
+
+  * 1-D ``(shard,)`` (default, the legacy plane): the stripe/PG batch
+    axis splits over every chip; masks replicate; collectives psum /
+    all-gather over SHARD_AXIS.
+  * 2-D ``(stripe, shard)`` (stripes >= 2, or one stripe row per host
+    under the multi-process plane — parallel/multihost.py): the batch
+    splits over the STRIPE rows while the k+m output-shard dimension
+    (the masked-XOR contraction's R rows) splits over the SHARD
+    columns — per-chip shard ownership matches the OSD→chip affinity
+    the per-chip counters already track.  The EC contract rides
+    per-axis collectives: the row counter psums along STRIPE_AXIS
+    (per stripe row), rebuilt shards all-gather along SHARD_AXIS
+    (assembling k+m per stripe row) then along STRIPE_AXIS (landing
+    chip-to-chip on every target OSD's affine chip), and
+    ``ppermute_shift`` runs the flat ring over BOTH axes row-major —
+    the same block rotation the 1-D ring gave, now a true 2-D
+    collective.  Results are bit-identical across layouts: the
+    contraction is pure AND/XOR, axis splits change layout, never
+    values, and padding rows/columns are zeros sliced off before
+    anyone reads them.
 
 Wiring (all behind the ``parallel_data_plane`` option, default off —
 the single-device path is untouched when disabled):
@@ -96,6 +118,28 @@ class ShardedDataPlane:
         self.mesh = mesh
         self.n_shards = int(mesh.size)
         self._pc = _perf("dataplane")
+        # MeshPlane2D shape facts: (rows, cols) of the device grid.
+        # A 1-axis mesh is the legacy 1-D plane; a 2-axis mesh is the
+        # (stripe, shard) plane — even at (1, n), so the dispatch
+        # specs and counter namespaces are exercised identically on
+        # single-row layouts.
+        self.is_2d = len(mesh.axis_names) == 2
+        if self.is_2d:
+            self.n_rows, self.n_cols = (int(mesh.devices.shape[0]),
+                                        int(mesh.devices.shape[1]))
+        else:
+            self.n_rows, self.n_cols = 1, self.n_shards
+        # flat mesh positions whose device THIS process owns: under
+        # the multi-process plane every process runs the same SPMD
+        # dispatch, so host-side per-chip accounting must cover only
+        # the local cells or the cluster rollup double-counts (each
+        # host's counters sum to its own chips; the mgr mesh_rollup
+        # reassembles the cluster view).  Single-process: all cells.
+        from .multihost import process_index as _pidx
+        me = _pidx()
+        self._local_cells = frozenset(
+            i for i, d in enumerate(mesh.devices.flat)
+            if getattr(d, "process_index", 0) == me)
         # (per_batch, mesh) -> jitted sharded step
         self._steps: Dict[Tuple, object] = {}
         # the latest dispatch's cross-shard psum scalar, UNREAD: the
@@ -109,6 +153,22 @@ class ShardedDataPlane:
         an OSD's staged shards and sub-writes.  A stable modulo keyed
         on the OSD id, so the partition survives map churn."""
         return int(osd_id) % self.n_shards
+
+    def coords_of(self, flat: int) -> Tuple[int, int]:
+        """Flat mesh position -> (stripe_row, shard_col), row-major —
+        the 2-D counter coordinate of a chip (a 1-D mesh is row 0)."""
+        return divmod(int(flat), self.n_cols)
+
+    def _prefixes(self, flat: int) -> Tuple[str, ...]:
+        """Counter key prefixes for one chip: the coordinate key
+        ``r<row>c<col>`` on the 2-D mesh plus the 1-D ``shard<flat>``
+        alias existing dashboards/tests key on (satellite: the alias
+        is ALWAYS written, so a layout change never orphans a
+        dashboard)."""
+        if self.is_2d:
+            r, c = self.coords_of(flat)
+            return (f"shard{flat}", f"r{r}c{c}")
+        return (f"shard{flat}",)
 
     # ------------------------------------------------------------- dispatch --
     def _step(self, per_batch: bool):
@@ -125,8 +185,17 @@ class ShardedDataPlane:
         jit around the XLA fallback graph would silently swap the
         flagship kernel for the slow path on exactly the hardware
         the mesh targets.  (CPU runs the XLA fallback either way,
-        keeping the bit-identity tests meaningful.)"""
-        from .mesh import SHARD_AXIS, mesh_cache_key
+        keeping the bit-identity tests meaningful.)
+
+        2-D mesh: the batch splits over STRIPE rows while the mask
+        rows — the k+m output-shard dimension — split over SHARD
+        columns, so each cell contracts its stripe block against its
+        own output shards (per-chip shard ownership).  The row
+        counter psums along STRIPE_AXIS only: the count is the padded
+        batch total, identical to the 1-D plane's value, and every
+        shard column computes the same scalar by construction
+        (check_rep can't prove that, hence check_rep=False)."""
+        from .mesh import SHARD_AXIS, STRIPE_AXIS, mesh_cache_key
         key = (per_batch,) + mesh_cache_key(self.mesh)
         step = self._steps.get(key)
         if step is None:
@@ -135,6 +204,28 @@ class ShardedDataPlane:
             from jax.experimental.shard_map import shard_map
             from jax.sharding import PartitionSpec as P
             from ..ops import xor_kernel
+            from ..common.jit_profile import wrap as _jit_wrap
+            if self.is_2d:
+                def local(masks, words):
+                    out = xor_kernel.xor_matmul_w32(masks, words)
+                    rows = jax.lax.psum(
+                        jnp.sum(jnp.ones((words.shape[0],), jnp.int32)
+                                .astype(jnp.int64)), STRIPE_AXIS)
+                    return out, rows
+
+                # per-batch masks [B, R, C]: B over stripe rows, R
+                # (the k+m shards) over shard columns; replicated
+                # masks [R, C]: R over shard columns
+                mspec = P(STRIPE_AXIS, SHARD_AXIS) if per_batch \
+                    else P(SHARD_AXIS)
+                step = self._steps[key] = _jit_wrap(
+                    jax.jit(shard_map(
+                        local, mesh=self.mesh,
+                        in_specs=(mspec, P(STRIPE_AXIS)),
+                        out_specs=(P(STRIPE_AXIS, SHARD_AXIS), P()),
+                        check_rep=False)),
+                    "data_plane.step2d", f"per_batch={per_batch}")
+                return step
 
             def local(masks, words):
                 out = xor_kernel.xor_matmul_w32(masks, words)
@@ -143,7 +234,6 @@ class ShardedDataPlane:
                             .astype(jnp.int64)), SHARD_AXIS)
                 return out, rows
 
-            from ..common.jit_profile import wrap as _jit_wrap
             mspec = P(SHARD_AXIS) if per_batch else P()
             step = self._steps[key] = _jit_wrap(
                 jax.jit(shard_map(
@@ -165,8 +255,14 @@ class ShardedDataPlane:
         out_specs P() with check_rep=False: a tiled all_gather leaves
         the value identical on every mesh position by construction;
         shard_map cannot prove that, so the replication is asserted
-        by the bit-identity tests instead."""
-        from .mesh import SHARD_AXIS, mesh_cache_key
+        by the bit-identity tests instead.
+
+        2-D mesh: TWO per-axis gathers — first along SHARD_AXIS on
+        the output-shard axis (each stripe row assembles its full k+m
+        from the columns that own them), then along STRIPE_AXIS tiled
+        on the batch axis (every rebuilt stripe lands chip-to-chip on
+        every row, hence on each target OSD's affine chip)."""
+        from .mesh import SHARD_AXIS, STRIPE_AXIS, mesh_cache_key
         key = ("collective", per_batch) + mesh_cache_key(self.mesh)
         step = self._steps.get(key)
         if step is None:
@@ -175,6 +271,29 @@ class ShardedDataPlane:
             from jax.experimental.shard_map import shard_map
             from jax.sharding import PartitionSpec as P
             from ..ops import xor_kernel
+            from ..common.jit_profile import wrap as _jit_wrap
+            if self.is_2d:
+                def local(masks, words):
+                    out = xor_kernel.xor_matmul_w32(masks, words)
+                    rows = jax.lax.psum(
+                        jnp.sum(jnp.ones((words.shape[0],), jnp.int32)
+                                .astype(jnp.int64)), STRIPE_AXIS)
+                    full = jax.lax.all_gather(out, SHARD_AXIS, axis=1,
+                                              tiled=True)
+                    full = jax.lax.all_gather(full, STRIPE_AXIS,
+                                              axis=0, tiled=True)
+                    return full, rows
+
+                mspec = P(STRIPE_AXIS, SHARD_AXIS) if per_batch \
+                    else P(SHARD_AXIS)
+                step = self._steps[key] = _jit_wrap(
+                    jax.jit(shard_map(
+                        local, mesh=self.mesh,
+                        in_specs=(mspec, P(STRIPE_AXIS)),
+                        out_specs=(P(), P()), check_rep=False)),
+                    "data_plane.collective2d",
+                    f"per_batch={per_batch}")
+                return step
 
             def local(masks, words):
                 out = xor_kernel.xor_matmul_w32(masks, words)
@@ -185,7 +304,6 @@ class ShardedDataPlane:
                                           tiled=True)
                 return full, rows
 
-            from ..common.jit_profile import wrap as _jit_wrap
             mspec = P(SHARD_AXIS) if per_batch else P()
             step = self._steps[key] = _jit_wrap(
                 jax.jit(shard_map(
@@ -199,9 +317,14 @@ class ShardedDataPlane:
         """Jitted ring ppermute: each chip's stripe block moves
         ``shift`` positions around the ICI ring — the pairwise
         shard-landing primitive (a rebuilt block computed on chip i
-        delivered to the chip owning its target OSD), and the
-        building block the 2-D (stripe, shard) mesh plan composes."""
-        from .mesh import SHARD_AXIS, mesh_cache_key
+        delivered to the chip owning its target OSD).
+
+        2-D mesh: the ring runs over BOTH axes — the axis-name tuple
+        linearizes the (stripe, shard) grid row-major, so the perm's
+        flat indices rotate blocks across stripe-row boundaries
+        exactly like the flat 1-D ring did (a true 2-D collective:
+        the boundary hops cross the stripe axis chip-to-chip)."""
+        from .mesh import MESH_AXES, SHARD_AXIS, mesh_cache_key
         key = ("ppermute", shift) + mesh_cache_key(self.mesh)
         step = self._steps.get(key)
         if step is None:
@@ -210,16 +333,19 @@ class ShardedDataPlane:
             from jax.sharding import PartitionSpec as P
             n = self.n_shards
             perm = [(i, (i + shift) % n) for i in range(n)]
+            axes = tuple(MESH_AXES) if self.is_2d else SHARD_AXIS
+            lanes = P(tuple(MESH_AXES)) if self.is_2d \
+                else P(SHARD_AXIS)
 
             def local(x):
-                return jax.lax.ppermute(x, SHARD_AXIS, perm=perm)
+                return jax.lax.ppermute(x, axes, perm=perm)
 
             from ..common.jit_profile import wrap as _jit_wrap
             step = self._steps[key] = _jit_wrap(
                 jax.jit(shard_map(
                     local, mesh=self.mesh,
-                    in_specs=(P(SHARD_AXIS),),
-                    out_specs=P(SHARD_AXIS))),
+                    in_specs=(lanes,),
+                    out_specs=lanes)),
                 "data_plane.ppermute", f"shift={shift}")
         return step
 
@@ -227,79 +353,64 @@ class ShardedDataPlane:
         """Rotate a batch-sharded array ``shift`` mesh positions along
         the ring (block-granular: each chip's whole slice moves).  The
         leading axis must be a mesh multiple."""
-        import jax
-        from .mesh import batch_sharding
+        from jax.sharding import PartitionSpec as P
+        from .mesh import MESH_AXES, SHARD_AXIS
         if int(arr.shape[0]) % self.n_shards:
             raise ValueError(
                 f"ppermute batch {arr.shape[0]} not a multiple of "
                 f"{self.n_shards} mesh positions")
-        arr = jax.device_put(arr, batch_sharding(self.mesh))
+        # flat row-major split over ALL axes, matching the flat-ring
+        # perm's linearization of the (stripe, shard) grid
+        spec = P(tuple(MESH_AXES)) if self.is_2d else P(SHARD_AXIS)
+        arr = self._commit(arr, spec)
         out = self._ppermute_step(int(shift) % self.n_shards)(arr)
         self._pc.inc("ppermute_rows", int(arr.shape[0]))
-        return out
+        return self._canonical(out) if self.is_2d else out
 
-    def rebuild_collective(self, masks, words, kind: str = "recover"):
-        """The device-resident recovery dispatch: identical operands
-        and bit-identical result to :meth:`xor_matmul_w32`, but the
-        rebuilt rows land on EVERY chip via an in-graph tiled
-        all-gather — a recovered shard's new home reads its bytes
-        from its own chip's copy of the gathered buffer instead of a
-        per-shard host round trip.  Padding rows (zero masks, zero
-        words) gather as zeros and are sliced off."""
+    # ------------------------------------------------------------- packing --
+    def _commit(self, arr, spec):
+        """Scatter an operand onto the mesh under ``spec``.  Single
+        process: a plain device_put (operands arrive committed to
+        whatever placement the producing dispatch left them with and
+        pjit refuses a silent layout change).  Multi-process plane:
+        every process holds the SAME host value (SPMD dispatch), so
+        the global array is assembled per-shard via
+        make_array_from_callback — device_put cannot address another
+        host's devices."""
         import jax
+        from jax.sharding import NamedSharding
+        sh = NamedSharding(self.mesh, spec)
+        from .multihost import is_active
+        if is_active():
+            host = np.asarray(arr)
+            return jax.make_array_from_callback(
+                host.shape, sh, lambda idx: host[idx])
+        return jax.device_put(arr, sh)
+
+    def _canonical(self, out):
+        """Re-commit a 2-D dispatch result as replicated before it
+        leaves the plane.  Trimming the padded (stripe, shard) output
+        leaves a device-order-permuted GSPMD sharding behind; a later
+        unrelated jit that takes such a committed array as an operand
+        (e.g. the device_store assemble gather) partitions against the
+        permuted order and returns wrong bytes.  One explicit
+        device_put pins the public contract: plane results read the
+        same from any consumer, sharded or not."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        return jax.device_put(out, NamedSharding(self.mesh, P()))
+
+    def _prepare(self, masks, words):
+        """Shared operand packing for the sharded dispatches:
+        validate, flatten the leading axes, pad to mesh multiples
+        (zero inputs AND zero masks produce zero outputs, sliced off
+        before return), and commit to the layout's shardings.  1-D:
+        the batch pads to the mesh size.  2-D: the batch pads to the
+        STRIPE row count and the mask rows — the k+m output shards —
+        pad to the SHARD column count."""
         import jax.numpy as jnp
-        words = jnp.asarray(words, jnp.int32)
-        masks = jnp.asarray(masks, jnp.int32)
-        lead = words.shape[:-2]
-        C, W = words.shape[-2:]
-        per_batch = masks.ndim > 2
-        if per_batch and masks.shape[:-2] != lead:
-            raise ValueError(
-                f"mask batch {masks.shape[:-2]} != data batch {lead}")
-        R = masks.shape[-2]
-        B = int(np.prod(lead)) if lead else 1
-        w3 = words.reshape(B, C, W)
-        m3 = masks.reshape(B, R, masks.shape[-1]) if per_batch \
-            else masks
-        pad = (-B) % self.n_shards
-        if pad:
-            w3 = jnp.pad(w3, ((0, pad), (0, 0), (0, 0)))
-            if per_batch:
-                m3 = jnp.pad(m3, ((0, pad), (0, 0), (0, 0)))
-        from .mesh import batch_sharding, replicated_sharding
-        w3 = jax.device_put(w3, batch_sharding(self.mesh))
-        m3 = jax.device_put(m3, batch_sharding(self.mesh) if per_batch
-                            else replicated_sharding(self.mesh))
-        out, rows = self._collective_step(per_batch)(m3, w3)
-        self.last_psum = rows
-        self.account(kind, B, 4 * C * W, padded_rows=B + pad)
-        self._pc.inc("allgather_rows", B + pad)
-        out = out[:B] if pad else out
-        return out.reshape(lead + (R, W)) if lead else \
-            out.reshape(R, W)
-
-    def account_landed(self, target_osd: int, rows: int,
-                       row_bytes: int) -> None:
-        """One rebuilt shard landed chip-to-chip on ``target_osd``'s
-        affine chip (the delivery half of rebuild_collective)."""
-        chip = self.chip_of(target_osd)
-        self._pc.inc(f"shard{chip}.recover_landed")
-        self._pc.inc(f"shard{chip}.recover_landed_bytes",
-                     rows * row_bytes)
-
-    def xor_matmul_w32(self, masks, words, kind: str = "encode"):
-        """Drop-in for ``ops.xor_kernel.xor_matmul_w32``, sharded on
-        the leading (stripe) axis.  masks [R, C] (replicated) or
-        [..., R, C] matching ``words``'s leading axes (per-stripe
-        signatures, sharded); words [..., C, W] int32 -> [..., R, W].
-
-        The batch pads with zero rows to a mesh multiple (zero inputs
-        AND zero masks produce zero outputs, sliced off before
-        return), so arbitrary batch sizes reuse the same executable
-        family and the result is bit-identical to the single-device
-        kernel.
-        """
-        import jax.numpy as jnp
+        from jax.sharding import PartitionSpec as P
+        from .mesh import SHARD_AXIS, STRIPE_AXIS
         words = jnp.asarray(words, jnp.int32)
         masks = jnp.asarray(masks, jnp.int32)
         lead = words.shape[:-2]
@@ -317,27 +428,91 @@ class ShardedDataPlane:
         w3 = words.reshape(B, C, W)
         m3 = masks.reshape(B, R, masks.shape[-1]) if per_batch \
             else masks
-        pad = (-B) % self.n_shards
-        if pad:
-            w3 = jnp.pad(w3, ((0, pad), (0, 0), (0, 0)))
+        bpad = (-B) % (self.n_rows if self.is_2d else self.n_shards)
+        rpad = ((-R) % self.n_cols) if self.is_2d else 0
+        if bpad:
+            w3 = jnp.pad(w3, ((0, bpad), (0, 0), (0, 0)))
             if per_batch:
-                m3 = jnp.pad(m3, ((0, pad), (0, 0), (0, 0)))
-        # explicit reshard: operands arrive committed to whatever
-        # placement the producing dispatch left them with (a staged
-        # buffer, a gather output) and pjit refuses a silent layout
-        # change — device_put scatters the batch across the mesh
-        import jax
-        from .mesh import batch_sharding, replicated_sharding
-        w3 = jax.device_put(w3, batch_sharding(self.mesh))
-        m3 = jax.device_put(m3, batch_sharding(self.mesh) if per_batch
-                            else replicated_sharding(self.mesh))
+                m3 = jnp.pad(m3, ((0, bpad), (0, 0), (0, 0)))
+        if rpad:
+            m3 = jnp.pad(m3, ((0, 0), (0, rpad), (0, 0)) if per_batch
+                         else ((0, rpad), (0, 0)))
+        if self.is_2d:
+            wspec = P(STRIPE_AXIS)
+            mspec = P(STRIPE_AXIS, SHARD_AXIS) if per_batch \
+                else P(SHARD_AXIS)
+        else:
+            wspec = P(SHARD_AXIS)
+            mspec = P(SHARD_AXIS) if per_batch else P()
+        return (self._commit(m3, mspec), self._commit(w3, wspec),
+                lead, per_batch, B, R, W, C, bpad, rpad)
+
+    def rebuild_collective(self, masks, words, kind: str = "recover"):
+        """The device-resident recovery dispatch: identical operands
+        and bit-identical result to :meth:`xor_matmul_w32`, but the
+        rebuilt rows land on EVERY chip via in-graph tiled
+        all-gathers — a recovered shard's new home reads its bytes
+        from its own chip's copy of the gathered buffer instead of a
+        per-shard host round trip.  On the 2-D mesh the gather runs
+        per axis (SHARD columns assemble each stripe row's k+m, then
+        STRIPE rows land every rebuilt stripe everywhere) and the
+        per-axis row counters record both legs.  Padding rows (zero
+        masks, zero words) gather as zeros and are sliced off."""
+        (m3, w3, lead, per_batch, B, R, W, C,
+         bpad, rpad) = self._prepare(masks, words)
+        out, rows = self._collective_step(per_batch)(m3, w3)
+        self.last_psum = rows
+        self.account(kind, B, 4 * C * W, padded_rows=B + bpad)
+        self._pc.inc("allgather_rows", B + bpad)
+        if self.is_2d:
+            self._pc.inc("allgather_rows_stripe", B + bpad)
+            self._pc.inc("allgather_rows_shard", R + rpad)
+        out = out[:B]
+        if rpad:
+            out = out[:, :R]
+        if self.is_2d:
+            out = self._canonical(out)
+        return out.reshape(lead + (R, W)) if lead else \
+            out.reshape(R, W)
+
+    def account_landed(self, target_osd: int, rows: int,
+                       row_bytes: int) -> None:
+        """One rebuilt shard landed chip-to-chip on ``target_osd``'s
+        affine chip (the delivery half of rebuild_collective)."""
+        chip = self.chip_of(target_osd)
+        if chip not in self._local_cells:
+            return
+        for pfx in self._prefixes(chip):
+            self._pc.inc(f"{pfx}.recover_landed")
+            self._pc.inc(f"{pfx}.recover_landed_bytes",
+                         rows * row_bytes)
+
+    def xor_matmul_w32(self, masks, words, kind: str = "encode"):
+        """Drop-in for ``ops.xor_kernel.xor_matmul_w32``, sharded over
+        the mesh.  masks [R, C] (replicated across stripe rows, R
+        sharded over shard columns on the 2-D mesh) or [..., R, C]
+        matching ``words``'s leading axes (per-stripe signatures);
+        words [..., C, W] int32 -> [..., R, W].
+
+        Padding (batch to a stripe-row multiple, mask rows to a
+        shard-column multiple on the 2-D mesh) is zeros in / zeros
+        out, sliced off before return, so arbitrary shapes reuse the
+        same executable family and the result is bit-identical to the
+        single-device kernel — and across mesh layouts.
+        """
+        (m3, w3, lead, per_batch, B, R, W, C,
+         bpad, rpad) = self._prepare(masks, words)
         out, rows = self._step(per_batch)(m3, w3)
         # keep the psum ON DEVICE: reading it here would host-sync
-        # every dispatch (its value is deterministically B+pad, which
+        # every dispatch (its value is deterministically B+bpad, which
         # the counter records; psum_probe() verifies the collective)
         self.last_psum = rows
-        self.account(kind, B, 4 * C * W, padded_rows=B + pad)
-        out = out[:B] if pad else out
+        self.account(kind, B, 4 * C * W, padded_rows=B + bpad)
+        out = out[:B]
+        if rpad:
+            out = out[:, :R]
+        if self.is_2d:
+            out = self._canonical(out)
         return out.reshape(lead + (R, W)) if lead else \
             out.reshape(R, W)
 
@@ -350,45 +525,76 @@ class ShardedDataPlane:
     # ----------------------------------------------------------- accounting --
     def account(self, kind: str, rows: int, row_bytes: int,
                 padded_rows: Optional[int] = None) -> None:
-        """Per-chip accounting for one sharded dispatch: the leading
-        axis splits contiguously across the mesh, so chip i's REAL
-        row count is derivable host-side; ``psum_rows`` records the
-        padded total the in-graph collective reduces to (value known
-        host-side — reading the device scalar per dispatch would
-        host-sync the hot loop; see psum_probe)."""
+        """Per-chip accounting for one sharded dispatch, mesh-shape
+        aware: the batch splits contiguously over the mesh (1-D) or
+        over STRIPE rows (2-D stripe dispatches — every shard column
+        in a row then reads the row's full stripe block to contract
+        its own k+m slice, so per-chip ``*_bytes`` counts bytes
+        touched per chip, which over-counts a stripe row vs the 1-D
+        total by design).  Map sweeps split flat on any layout (see
+        ``lane_shardings``).  Only cells whose device THIS process
+        owns are incremented — under SPMD every process runs this
+        call, and the mgr rollup sums hosts.  ``psum_rows`` records
+        the padded total the in-graph collective reduces to (value
+        known host-side — reading the device scalar per dispatch
+        would host-sync the hot loop; see psum_probe)."""
         pc = self._pc
         pc.inc("dispatches")
         pc.inc(f"{kind}_dispatches")
         if padded_rows is not None:
             pc.inc("psum_rows", padded_rows)
         total = padded_rows if padded_rows is not None else rows
-        per = -(-total // self.n_shards)
         unit = "lanes" if kind == "map" else "stripes"
-        for i in range(self.n_shards):
-            real = max(0, min(per, rows - i * per))
-            if real:
-                pc.inc(f"shard{i}.{kind}_{unit}", real)
-                pc.inc(f"shard{i}.{kind}_bytes", real * row_bytes)
+        if self.is_2d and kind != "map":
+            per = -(-total // self.n_rows)
+            for r in range(self.n_rows):
+                real = max(0, min(per, rows - r * per))
+                if real <= 0:
+                    continue
+                for c in range(self.n_cols):
+                    flat = r * self.n_cols + c
+                    if flat not in self._local_cells:
+                        continue
+                    for pfx in self._prefixes(flat):
+                        pc.inc(f"{pfx}.{kind}_{unit}", real)
+                        pc.inc(f"{pfx}.{kind}_bytes",
+                               real * row_bytes)
+        else:
+            per = -(-total // self.n_shards)
+            for i in range(self.n_shards):
+                real = max(0, min(per, rows - i * per))
+                if real > 0 and i in self._local_cells:
+                    for pfx in self._prefixes(i):
+                        pc.inc(f"{pfx}.{kind}_{unit}", real)
+                        pc.inc(f"{pfx}.{kind}_bytes",
+                               real * row_bytes)
         _mark_active("dispatched_mesh", kind=kind,
                      shards=self.n_shards, rows=rows)
 
     def account_subwrite(self, target_osd: int) -> None:
         """One EC sub-write headed to ``target_osd``: counted on its
         affine chip (the fan-out half of the per-chip staging view)."""
-        self._pc.inc(f"shard{self.chip_of(target_osd)}.subwrites")
+        chip = self.chip_of(target_osd)
+        if chip not in self._local_cells:
+            return
+        for pfx in self._prefixes(chip):
+            self._pc.inc(f"{pfx}.subwrites")
 
     def account_staged(self, osd_or_shard: int, nbytes: int) -> None:
         """One shard staged into an HBM partition, attributed by
         OSD-shard -> chip affinity."""
         chip = self.chip_of(osd_or_shard)
-        self._pc.inc(f"shard{chip}.staged_entries")
-        self._pc.inc(f"shard{chip}.staged_bytes", int(nbytes))
+        if chip not in self._local_cells:
+            return
+        for pfx in self._prefixes(chip):
+            self._pc.inc(f"{pfx}.staged_entries")
+            self._pc.inc(f"{pfx}.staged_bytes", int(nbytes))
 
     def stats(self) -> Dict:
         return self._pc.dump()
 
 
-_planes: Dict[int, ShardedDataPlane] = {}
+_planes: Dict[Tuple[int, int], ShardedDataPlane] = {}
 _planes_lock = threading.Lock()
 # resolved-plane cache: plane() runs on per-shard hot paths (staging
 # accounting), so the mesh-size option walk + jax.devices() must not
@@ -409,19 +615,31 @@ def _invalidate_resolution(_name=None, _value=None) -> None:
 def plane() -> Optional[ShardedDataPlane]:
     """The process-wide data plane, or None when the option is off or
     fewer than two devices exist (single-device hosts fall through to
-    the plain path — there is nothing to shard)."""
+    the plain path — there is nothing to shard).
+
+    Layout resolution (MeshPlane2D): ``parallel_data_plane_stripes``
+    >= 2 reshapes the device list row-major into a (stripes, n //
+    stripes) 2-D mesh; 0/1 keeps the legacy 1-D mesh — UNLESS the
+    multi-process plane is active, in which case the stripe axis
+    defaults to one row per host so every process's local devices
+    form one shard row.  A stripe count that does not divide the
+    device count disables the plane (plain-path fallback) rather than
+    failing the caller mid-put."""
     global _resolved, _resolved_valid, _observing_devices
     if not enabled():
         return None
     if _resolved_valid:
         return _resolved
     if not _observing_devices:
-        try:
-            config().observe("parallel_data_plane_devices",
-                             _invalidate_resolution)
-            _observing_devices = True
-        except OptionError:
-            pass
+        obs = 0
+        for opt in ("parallel_data_plane_devices",
+                    "parallel_data_plane_stripes"):
+            try:
+                config().observe(opt, _invalidate_resolution)
+                obs += 1
+            except OptionError:
+                pass
+        _observing_devices = obs == 2
     gen = _resolve_gen
     try:
         import jax
@@ -433,15 +651,32 @@ def plane() -> Optional[ShardedDataPlane]:
         want = int(config().get("parallel_data_plane_devices"))
     except OptionError:
         pass
+    stripes = 0
+    try:
+        stripes = int(config().get("parallel_data_plane_stripes"))
+    except OptionError:
+        pass
+    from .multihost import is_active, process_count
+    if stripes <= 1 and is_active():
+        stripes = process_count()
     n = want or n_avail
     if n < 2 or n_avail < n:
         p = None
+    elif stripes >= 2 and n % stripes:
+        p = None
     else:
+        key = (n, stripes if stripes >= 2 else 0)
         with _planes_lock:
-            p = _planes.get(n)
+            p = _planes.get(key)
             if p is None:
-                from .mesh import make_mesh
-                p = _planes[n] = ShardedDataPlane(make_mesh(n))
+                import jax as _jax
+                from .mesh import make_mesh, make_mesh_2d
+                if stripes >= 2:
+                    mesh = make_mesh_2d(stripes, n // stripes,
+                                        devices=_jax.devices()[:n])
+                else:
+                    mesh = make_mesh(n)
+                p = _planes[key] = ShardedDataPlane(mesh)
     if gen == _resolve_gen:
         # publish only if no invalidation raced the resolution (a
         # mid-compute option change would otherwise be masked by a

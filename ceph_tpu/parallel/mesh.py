@@ -67,15 +67,31 @@ def make_mesh(n_devices: Optional[int] = None,
                 (SHARD_AXIS,))
 
 
-def make_mesh_2d(n_stripe: int, n_shard: int,
+def make_mesh_2d(n_stripe: int, n_shard: Optional[int] = None,
                  devices: Optional[Sequence] = None) -> Mesh:
-    """Named 2-D (stripe, shard) mesh — the target shape of the
-    ROADMAP-item-1 data-plane refactor.  ``n_stripe`` is the outer
-    (future multi-process) axis, ``n_shard`` the per-host batch axis;
-    the device list is reshaped row-major so shard neighbors stay
-    ICI-adjacent.  Usable today at (1, n) as a drop-in for the 1-D
-    mesh everywhere a ``lane_shardings``-style leading-axis annotation
-    is all the consumer needs."""
+    """Named 2-D (stripe, shard) mesh — the MeshPlane2D data-plane
+    shape.  ``n_stripe`` is the outer (multi-process) axis, ``n_shard``
+    the per-host shard-column axis; the device list is reshaped
+    row-major so shard neighbors stay ICI-adjacent.  A (1, n) mesh is
+    a drop-in for the 1-D mesh everywhere a ``lane_shardings``-style
+    leading-axis annotation is all the consumer needs.
+
+    ``n_shard=None`` infers the column count from the available
+    devices, with a clear divisibility error instead of a reshape
+    traceback (the forced-CPU dry run hits this first)."""
+    if n_stripe < 1:
+        raise ValueError(f"n_stripe must be >= 1, got {n_stripe}")
+    if n_shard is None:
+        devs = list(devices) if devices is not None \
+            else list(jax.devices())
+        if len(devs) % n_stripe:
+            raise ValueError(
+                f"cannot split {len(devs)} device(s) into {n_stripe} "
+                f"stripe row(s): {len(devs)} % {n_stripe} != 0 — pick "
+                f"a stripe count that divides the device count, or "
+                f"pass n_shard explicitly")
+        n_shard = len(devs) // n_stripe
+        devices = devs
     total = n_stripe * n_shard
     devs = _pick_devices(total, devices)
     if len(devs) < total:
@@ -98,11 +114,15 @@ def replicated_sharding(mesh: Mesh) -> NamedSharding:
 def lane_shardings(mesh: Mesh) -> Tuple[NamedSharding, NamedSharding]:
     """(batch, replicated) sharding pair for a data-plane lane, keyed
     off the mesh's OWN axis names — works for the 1-D (shard,) mesh
-    today and the 2-D (stripe, shard) mesh after the rename, and keeps
-    consumers (placement mappers, serving lanes) free of axis-name
-    strings entirely.  The batch annotation splits the leading array
-    axis over the mesh's leading axis."""
-    return (NamedSharding(mesh, P(mesh.axis_names[0])),
+    and the 2-D (stripe, shard) mesh alike, and keeps consumers
+    (placement mappers, serving lanes) free of axis-name strings
+    entirely.  The batch annotation splits the leading array axis over
+    ALL mesh axes, row-major (one lane block per flat mesh position),
+    so a (r, c) mesh splits a sweep r*c ways exactly like the flat
+    device list did — map sweeps stay bit-identical across layouts."""
+    lead = mesh.axis_names[0] if len(mesh.axis_names) == 1 \
+        else tuple(mesh.axis_names)
+    return (NamedSharding(mesh, P(lead)),
             NamedSharding(mesh, P()))
 
 

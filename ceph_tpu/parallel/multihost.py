@@ -1,0 +1,203 @@
+"""Multi-process data-plane boot — the MeshPlane2D scale-out half.
+
+One process per host joins a ``jax.distributed`` fleet and the data
+plane's mesh spans every host's devices: the STRIPE axis gets one row
+per process (by default), the SHARD axis stays each host's local
+chip row, and every sharded dispatch in ``data_plane`` runs SPMD
+across the fleet — the same jitted step, the same bytes, with the
+cross-host hops riding the collectives the 2-D mesh already names.
+
+Boot handshake: process 0 serves the coordinator at
+``multihost_coordinator`` (host:port); every process calls
+:func:`ensure_initialized` with its rank before FIRST touching a jax
+backend (the CPU fleet needs the gloo collectives flag set before
+backend init).  Configuration comes from the options registry with
+environment overrides for launchers::
+
+    CEPH_TPU_COORDINATOR   overrides multihost_coordinator
+    CEPH_TPU_NUM_PROCESSES overrides multihost_processes
+    CEPH_TPU_PROCESS_ID    overrides multihost_process_id
+
+Fallback rule (load-bearing): with no coordinator configured —
+the default — :func:`ensure_initialized` is a no-op returning False,
+``process_index()/process_count()`` report (0, 1), and every existing
+single-process path is byte-for-byte unchanged.  Tests pin this.
+
+Host-side rank reads MUST come through :func:`process_index` /
+:func:`process_count` — never ``jax.process_index()`` inside traced
+code, where per-process branching diverges the SPMD program (lint
+rule CTL1006 flags exactly that).
+"""
+from __future__ import annotations
+
+import os
+import threading
+from typing import List, Optional, Sequence, Tuple
+
+from ..common.options import OptionError, config
+
+_lock = threading.Lock()
+_initialized = False   # ensure_initialized ran (either outcome)
+_active = False        # jax.distributed actually connected
+
+ENV_COORDINATOR = "CEPH_TPU_COORDINATOR"
+ENV_NUM_PROCESSES = "CEPH_TPU_NUM_PROCESSES"
+ENV_PROCESS_ID = "CEPH_TPU_PROCESS_ID"
+
+
+def _spec() -> Tuple[str, int, int]:
+    """Resolve (coordinator, num_processes, process_id) — env wins
+    over the options registry so fleet launchers need no config
+    plumbing; '' / 0 / -1 mean unset."""
+    coord, procs, pid = "", 0, -1
+    cfg = config()
+    try:
+        coord = str(cfg.get("multihost_coordinator") or "")
+    except OptionError:
+        pass
+    try:
+        procs = int(cfg.get("multihost_processes") or 0)
+    except OptionError:
+        pass
+    try:
+        pid = int(cfg.get("multihost_process_id"))
+    except OptionError:
+        pass
+    coord = os.environ.get(ENV_COORDINATOR, coord)
+    if os.environ.get(ENV_NUM_PROCESSES):
+        procs = int(os.environ[ENV_NUM_PROCESSES])
+    if os.environ.get(ENV_PROCESS_ID) is not None \
+            and os.environ.get(ENV_PROCESS_ID, "") != "":
+        pid = int(os.environ[ENV_PROCESS_ID])
+    return coord, procs, pid
+
+
+def ensure_initialized() -> bool:
+    """Join the fleet if a coordinator is configured; no-op fallback
+    otherwise.  Idempotent; returns whether the multi-process plane
+    is active.  Must run before the first jax backend touch on CPU
+    fleets (the gloo cross-process collectives flag binds at backend
+    init)."""
+    global _initialized, _active
+    with _lock:
+        if _initialized:
+            return _active
+        coord, procs, pid = _spec()
+        if not coord or procs < 2 or pid < 0:
+            _initialized = True
+            return False
+        import jax
+        try:
+            # CPU fleets need a cross-process collectives backend;
+            # harmless on TPU where ICI/DCN collectives are native
+            jax.config.update("jax_cpu_collectives_implementation",
+                              "gloo")
+        except Exception:
+            pass
+        jax.distributed.initialize(coordinator_address=coord,
+                                   num_processes=procs,
+                                   process_id=pid)
+        _initialized = True
+        _active = True
+    # the plane's layout depends on the fleet shape — drop any plane
+    # resolved before the fleet came up (lazy import: data_plane
+    # imports US at plane construction)
+    from . import data_plane
+    data_plane._invalidate_resolution()
+    return True
+
+
+def is_active() -> bool:
+    """Whether this process is part of a live multi-process plane."""
+    return _active
+
+
+def process_index() -> int:
+    """This process's rank — THE blessed host-side read (0 when
+    single-process).  Never call ``jax.process_index()`` from
+    jit/shard_map-reachable code (CTL1006)."""
+    if not _active:
+        return 0
+    import jax
+    return int(jax.process_index())
+
+
+def process_count() -> int:
+    """Fleet size (1 when single-process)."""
+    if not _active:
+        return 1
+    import jax
+    return int(jax.process_count())
+
+
+def host_label(idx: Optional[int] = None) -> str:
+    """Stable per-host daemon label for the cluster_stats rollup
+    (``host<rank>`` — rank is the identity the coordinator
+    assigned, so the label survives restarts with the same spec)."""
+    return f"host{process_index() if idx is None else int(idx)}"
+
+
+def global_mesh_2d(n_stripe: Optional[int] = None):
+    """The fleet-wide (stripe, shard) mesh: all processes' devices,
+    one stripe row per process by default — each host's local chips
+    form one shard row, so SHARD-axis collectives stay on-host (ICI)
+    and only STRIPE-axis legs cross hosts.  Works single-process too
+    (one row spanning the local devices)."""
+    from .mesh import make_mesh_2d
+    import jax
+    rows = n_stripe or process_count()
+    return make_mesh_2d(rows, devices=jax.devices())
+
+
+def host_of_chip(mesh, flat: int) -> int:
+    """Which process owns flat mesh position ``flat`` (0 for every
+    position on a single-process mesh)."""
+    dev = list(mesh.devices.flat)[int(flat)]
+    return int(getattr(dev, "process_index", 0))
+
+
+def stripe_order(targets: Sequence, host_of=None) -> List[int]:
+    """Submission order for a cross-host shard fan-out: indices into
+    ``targets`` interleaved round-robin across hosts, so every host's
+    dispatch queue fills from the first submit instead of draining
+    host 0's shards before host 1 sees traffic.  Single-host (or no
+    host resolver): identity order — the fan-out is byte-for-byte
+    today's.  ``host_of`` maps a target to its host rank; default
+    uses the target's affine chip on the resolved plane."""
+    idxs = list(range(len(targets)))
+    if not _active:
+        return idxs
+    if host_of is None:
+        from .data_plane import plane
+        p = plane()
+        if p is None:
+            return idxs
+
+        def host_of(t):  # noqa: F811 — deliberate default binding
+            return host_of_chip(p.mesh, p.chip_of(int(t)))
+    buckets: dict = {}
+    for i in idxs:
+        buckets.setdefault(int(host_of(targets[i])), []).append(i)
+    if len(buckets) < 2:
+        return idxs
+    order: List[int] = []
+    queues = [buckets[h] for h in sorted(buckets)]
+    while any(queues):
+        for q in queues:
+            if q:
+                order.append(q.pop(0))
+    return order
+
+
+def shutdown() -> None:
+    """Leave the fleet (test teardown); safe when inactive."""
+    global _initialized, _active
+    with _lock:
+        if _active:
+            import jax
+            try:
+                jax.distributed.shutdown()
+            except Exception:
+                pass
+        _initialized = False
+        _active = False

@@ -1053,6 +1053,11 @@ class FastMapper:
         chunk cap) — consumers that keep working on device (remap
         diffs, recovery planning) skip the multi-MB host transfer
         entirely, and benchmarks can meter compute vs readback.
+
+        With ``mesh`` the chunk cap scales by ``mesh.size`` and the
+        lanes shard over every mesh axis row-major (lane_shardings) —
+        the sweep is layout-agnostic across the 1-D ring and the 2-D
+        (stripe, shard) plane.
         """
         if ruleno < 0 or ruleno >= self.cmap.max_rules or \
                 self.cmap.rules[ruleno] is None:

@@ -1050,6 +1050,10 @@ class XlaMapper:
 
         With ``mesh``, the x axis is sharded across the device mesh (the
         multi-chip ParallelPGMapper); N is padded to the mesh size.
+        Mesh-shape agnostic: ``lane_shardings`` splits the batch over
+        EVERY mesh axis row-major, so the 1-D shard ring and the 2-D
+        (stripe, shard) plane run the same sweep bit-identically
+        (asserted by dryrun_multichip's 2-D section).
 
         Dispatch: the level-synchronous FastMapper handles supported
         rules (with incomplete lanes recomputed bit-exactly host-side);

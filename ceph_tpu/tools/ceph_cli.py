@@ -72,12 +72,24 @@ def cmd_status(rc, out) -> int:
     # the PGMap io line, from the mon's ClusterStats aggregator
     # (counter deltas across the daemons' heartbeat perf reports)
     try:
-        io = rc.mon_call({"cmd": "cluster_stats"})["io"]["cluster"]
+        cs = rc.mon_call({"cmd": "cluster_stats"})
+        io = cs["io"]["cluster"]
         out.write("  io:\n")
         out.write(f"    client: {io.get('rd_bytes', 0.0) / 2**20:.1f}"
                   f" MiB/s rd, {io.get('wr_bytes', 0.0) / 2**20:.1f}"
                   f" MiB/s wr, {io.get('rd_ops', 0.0):.0f} op/s rd, "
                   f"{io.get('wr_ops', 0.0):.0f} op/s wr\n")
+        # the MeshPlane2D line: the mgr rollup's (host, chip) view —
+        # a two-host plane reads as ONE cluster here
+        mesh = cs.get("mesh") or {}
+        if mesh.get("n_chips"):
+            shape = mesh.get("shape")
+            grid = f", ({shape[0]}, {shape[1]}) mesh" if shape else ""
+            stripes = int(mesh.get("totals", {}).get("put_stripes",
+                                                     0))
+            out.write(f"  plane: {mesh['n_hosts']} host(s), "
+                      f"{mesh['n_chips']} chip(s){grid}, "
+                      f"{stripes} put stripes\n")
     except Exception:
         pass
     return 0

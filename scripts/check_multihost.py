@@ -1,0 +1,270 @@
+#!/usr/bin/env python
+"""Multi-host smoke check — the MeshPlane2D scale-out boot, verified.
+
+Three layers of evidence, cheapest first:
+
+  * fallback: with no coordinator configured ``ensure_initialized``
+    is a no-op, rank reads report (0, 1), and ``stripe_order`` is the
+    identity — the single-process plane is byte-for-byte untouched,
+  * single-process 2-D reference: the (stripe, shard) mesh runs the
+    encode + collective-rebuild dispatches bit-identically to the
+    unsharded kernel and writes one counter cell per mesh position,
+  * the REAL fleet: two ``jax.distributed`` processes (gloo CPU
+    collectives, 4 forced devices each) boot one global 2x4 mesh,
+    run the SAME dispatches, and must produce the same bytes while
+    each rank accounts ONLY its own row — the parent sums the two
+    ranks' per-(host, chip) cells through the mgr's
+    ``ClusterStats.mesh_rollup`` and requires the totals to equal the
+    single-process run's.
+
+Runs on CPU (no accelerator needed):
+
+    python scripts/check_multihost.py            # full check
+    python scripts/check_multihost.py --quick    # skip the fleet pair
+
+Also wired as a fast pytest test (tests/test_multihost.py, `smoke`
+marker) so CI covers it without a separate job.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+_CHILD_DEVICES = 4          # per-process forced CPU devices
+_PARENT_DEVICES = 2 * _CHILD_DEVICES
+
+if "--child" not in sys.argv and \
+        "xla_force_host_platform_device_count" not in \
+        os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "") +
+        f" --xla_force_host_platform_device_count="
+        f"{_PARENT_DEVICES}").strip()
+
+
+def _fail(msg: str) -> int:
+    print(f"FAIL: {msg}", file=sys.stderr)
+    return 1
+
+
+def _dispatch_payload():
+    """The shared dispatch mix every layer runs: one replicated-mask
+    encode + one collective rebuild over fixed operands, hashed.
+    Deterministic, so the single-process reference and both fleet
+    ranks must produce identical digests."""
+    import hashlib
+
+    import numpy as np
+
+    from ceph_tpu.ops import gf, xor_kernel
+    from ceph_tpu.parallel import data_plane as dpmod
+
+    rng = np.random.default_rng(17)
+    k, m, W8 = 4, 2, 16
+    words = rng.integers(0, 2 ** 31, (6, 8 * k, W8), dtype=np.uint32)
+    bitm = gf.gf8_bitmatrix(gf.vandermonde_parity(k, m))
+    masks = xor_kernel.masks_to_device(bitm)
+    dp = dpmod.plane()
+    if dp is None:
+        return None
+    enc = np.asarray(dp.xor_matmul_w32(masks, words, kind="put"))
+    reb = np.asarray(dp.rebuild_collective(masks, words,
+                                           kind="recover"))
+    # bit-identity against the unsharded kernel, locally
+    ref = np.asarray(xor_kernel.xor_matmul_w32(masks, words))
+    if not (np.array_equal(enc, ref) and np.array_equal(reb, ref)):
+        raise AssertionError("plane dispatch diverged from the "
+                             "single-device kernel")
+    return {
+        "mesh_shape": list(dp.mesh.devices.shape),
+        "sha_encode": hashlib.sha256(enc.tobytes()).hexdigest(),
+        "sha_rebuild": hashlib.sha256(reb.tobytes()).hexdigest(),
+        "cells": sorted(f"r{f // dp.n_cols}c{f % dp.n_cols}"
+                        for f in sorted(dp._local_cells)),
+    }
+
+
+def _child(rank: int, port: int) -> int:
+    """One fleet process: join via jax.distributed, resolve the
+    global 2-D plane, run the dispatch mix, report counters."""
+    os.environ["CEPH_TPU_COORDINATOR"] = f"127.0.0.1:{port}"
+    os.environ["CEPH_TPU_NUM_PROCESSES"] = "2"
+    os.environ["CEPH_TPU_PROCESS_ID"] = str(rank)
+    os.environ["XLA_FLAGS"] = (
+        f"--xla_force_host_platform_device_count={_CHILD_DEVICES}")
+
+    from ceph_tpu.common.options import config
+    from ceph_tpu.common.perf_counters import perf
+    from ceph_tpu.parallel import multihost
+
+    if not multihost.ensure_initialized():
+        return _fail(f"child {rank}: fleet did not initialize")
+    import jax
+    config().set("parallel_data_plane", True)
+    perf("dataplane").reset()
+    payload = _dispatch_payload()
+    if payload is None:
+        return _fail(f"child {rank}: no plane resolved")
+    payload.update({
+        "rank": multihost.process_index(),
+        "nprocs": multihost.process_count(),
+        "host": multihost.host_label(),
+        "global_devices": len(jax.devices()),
+        "local_devices": len(jax.local_devices()),
+        "perf": {"dataplane": perf("dataplane").dump_typed()},
+    })
+    print("CHILD " + json.dumps(payload), flush=True)
+    multihost.shutdown()
+    return 0
+
+
+def _run_pair(ref) -> int:
+    """Spawn the two-process fleet and check its collective story."""
+    import socket
+    import subprocess
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    env = {k: v for k, v in os.environ.items()
+           if k != "XLA_FLAGS" and not k.startswith("CEPH_TPU_")}
+    procs = [subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__),
+         "--child", str(rank), str(port)],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        text=True, env=env, cwd=_REPO) for rank in (0, 1)]
+    outs = []
+    for p in procs:
+        try:
+            out, err = p.communicate(timeout=240)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            return _fail("fleet pair timed out")
+        if p.returncode != 0:
+            return _fail(f"fleet child exited {p.returncode}: "
+                         f"{err[-800:]}")
+        outs.append(out)
+    reports = []
+    for out in outs:
+        lines = [ln for ln in out.splitlines()
+                 if ln.startswith("CHILD ")]
+        if not lines:
+            return _fail(f"child produced no report: {out[-400:]}")
+        reports.append(json.loads(lines[-1][len("CHILD "):]))
+    reports.sort(key=lambda r: r["rank"])
+
+    for r in reports:
+        if r["nprocs"] != 2 or r["global_devices"] != _PARENT_DEVICES \
+                or r["local_devices"] != _CHILD_DEVICES:
+            return _fail(f"rank {r['rank']}: fleet shape wrong: {r}")
+        if r["mesh_shape"] != [2, _CHILD_DEVICES]:
+            return _fail(f"rank {r['rank']}: global mesh "
+                         f"{r['mesh_shape']}, want "
+                         f"[2, {_CHILD_DEVICES}]")
+        if (r["sha_encode"], r["sha_rebuild"]) != \
+                (ref["sha_encode"], ref["sha_rebuild"]):
+            return _fail(f"rank {r['rank']}: fleet dispatch bytes "
+                         f"diverged from single-process reference")
+    # locality gating: each rank owns exactly its stripe row
+    own0, own1 = (set(r["cells"]) for r in reports)
+    if own0 & own1 or len(own0 | own1) != _PARENT_DEVICES:
+        return _fail(f"per-rank cell ownership wrong: {own0} / {own1}")
+    if {r["host"] for r in reports} != {"host0", "host1"}:
+        return _fail("host labels wrong: "
+                     f"{[r['host'] for r in reports]}")
+
+    # mgr rollup: two ranks ingest as two daemons, totals must equal
+    # the single-process run (each cell incremented exactly once)
+    import time as _time
+
+    from ceph_tpu.mgr.cluster_stats import ClusterStats
+    stats = ClusterStats()
+    for r in reports:
+        stats.ingest(f"client.{r['host']}",
+                     {"perf": r["perf"], "ts": _time.time(),
+                      "host": r["host"]})
+    roll = stats.mesh_rollup()
+    if roll["n_hosts"] != 2 or roll["n_chips"] != _PARENT_DEVICES:
+        return _fail(f"mesh_rollup shape wrong: {roll['n_hosts']} "
+                     f"hosts, {roll['n_chips']} chips")
+    if roll["shape"] != [2, _CHILD_DEVICES]:
+        return _fail(f"mesh_rollup grid {roll['shape']}")
+    for key, want in ref["cell_totals"].items():
+        got = roll["totals"].get(key, 0.0)
+        if got != want:
+            return _fail(f"rollup totals[{key}] = {got}, "
+                         f"single-process run says {want}")
+    print(f"OK: 2-process fleet verified (global 2x{_CHILD_DEVICES} "
+          f"mesh, identical bytes, rollup totals match)")
+    return 0
+
+
+def main() -> int:
+    quick = "--quick" in sys.argv
+
+    from ceph_tpu.common.options import config
+    from ceph_tpu.common.perf_counters import perf
+    from ceph_tpu.parallel import multihost
+
+    # ---- fallback: no coordinator -> everything single-process ----
+    if multihost.ensure_initialized():
+        return _fail("ensure_initialized active without a "
+                     "coordinator configured")
+    if multihost.process_index() != 0 or \
+            multihost.process_count() != 1:
+        return _fail("inactive rank reads must be (0, 1)")
+    if multihost.stripe_order([5, 3, 8]) != [0, 1, 2]:
+        return _fail("inactive stripe_order must be the identity")
+
+    # ---- single-process 2-D reference -----------------------------
+    import jax
+    n_dev = len(jax.devices())
+    if n_dev < 4 or n_dev % 2:
+        return _fail(f"need an even device count >= 4, have {n_dev}")
+    config().set("parallel_data_plane", True)
+    config().set("parallel_data_plane_stripes", 2)
+    try:
+        perf("dataplane").reset()
+        ref = _dispatch_payload()
+        if ref is None:
+            return _fail("no 2-D plane resolved single-process")
+        if ref["mesh_shape"] != [2, n_dev // 2]:
+            return _fail(f"reference mesh {ref['mesh_shape']}")
+        if len(ref["cells"]) != n_dev:
+            return _fail("single-process plane must own every cell, "
+                         f"owns {ref['cells']}")
+        # totals per counter NAME summed over the r<r>c<c> cells —
+        # the same reduction mesh_rollup applies to the fleet's cells
+        import re
+        d = perf("dataplane").dump()
+        totals = {}
+        for k, v in d.items():
+            m = re.match(r"^r\d+c\d+\.(.+)$", k)
+            if m and v:
+                totals[m.group(1)] = totals.get(m.group(1), 0.0) + v
+        ref["cell_totals"] = totals
+        if not ref["cell_totals"]:
+            return _fail("no per-(row, col) counters accounted")
+    finally:
+        config().clear("parallel_data_plane")
+        config().clear("parallel_data_plane_stripes")
+
+    if quick:
+        print(f"OK: multihost fallback + single-process 2-D "
+              f"reference verified on {n_dev} devices (--quick: "
+              f"fleet pair skipped)")
+        return 0
+    return _run_pair(ref)
+
+
+if __name__ == "__main__":
+    if "--child" in sys.argv:
+        i = sys.argv.index("--child")
+        sys.exit(_child(int(sys.argv[i + 1]), int(sys.argv[i + 2])))
+    sys.exit(main())

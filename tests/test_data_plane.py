@@ -283,12 +283,22 @@ def test_make_mesh_2d_and_lane_shardings():
     assert list(mesh2d.devices[0]) == list(jax.devices()[:n])
 
     # lane_shardings works identically for the 1-D and 2-D meshes —
-    # batch splits the mesh's leading axis, the twin is replicated
+    # batch splits the leading array axis over ALL mesh axes
+    # row-major (a (r, c) mesh splits a sweep r*c ways exactly like
+    # the flat device list), the twin is replicated
     for mesh, lead in ((make_mesh(n), SHARD_AXIS),
-                       (mesh2d, STRIPE_AXIS)):
+                       (mesh2d, tuple(MESH_AXES))):
         batch, repl = lane_shardings(mesh)
         assert batch.spec == P(lead)
         assert repl.spec == P()
 
     with pytest.raises(ValueError):
         make_mesh_2d(n + 1, n + 1)
+
+    # device-count divisibility guard: inferring n_shard from a
+    # stripe count that does not divide the device pool is a clear
+    # error, not a reshape traceback
+    with pytest.raises(ValueError, match="stripe count that divides"):
+        make_mesh_2d(n + 1)
+    inferred = make_mesh_2d(1)
+    assert inferred.devices.shape == (1, n)

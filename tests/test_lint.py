@@ -1906,6 +1906,61 @@ def test_ctl1005_unreduced_total_and_bad_ppermute(tmp_path):
     assert "cluster total" in msgs and "bijection" in msgs
 
 
+def test_ctl1006_process_rank_in_traced_code(tmp_path):
+    """jax.process_index()/process_count() inside jit/shard_map-
+    reachable code traces a DIFFERENT program per host (the classic
+    multi-host divergence); the same read host-side — outside the
+    traced path — is the blessed pattern and stays clean, and a
+    ``# noqa: CTL1006`` suppresses."""
+    write(tmp_path, "parallel/__init__.py", "")
+    write(tmp_path, "parallel/mesh.py", 'SHARD_AXIS = "shard"\n')
+    write(tmp_path, "parallel/plane.py", """\
+        import jax
+        import jax.numpy as jnp
+        from jax.experimental.shard_map import shard_map
+        from jax.sharding import PartitionSpec as P
+        from .mesh import SHARD_AXIS
+
+        def bad(x):
+            if jax.process_index() == 0:
+                x = x + 1
+            return x
+
+        def justified(x):
+            r = jax.process_count()  # noqa: CTL1006 — debug build
+            return x * r
+
+        def good(x):
+            return jax.lax.psum(x, SHARD_AXIS)
+
+        def build(mesh):
+            a = shard_map(bad, mesh=mesh,
+                          in_specs=(P(SHARD_AXIS),),
+                          out_specs=P(SHARD_AXIS))
+            b = shard_map(justified, mesh=mesh,
+                          in_specs=(P(SHARD_AXIS),),
+                          out_specs=P(SHARD_AXIS))
+            c = shard_map(good, mesh=mesh,
+                          in_specs=(P(SHARD_AXIS),),
+                          out_specs=P(SHARD_AXIS))
+            return a, b, c
+
+        @jax.jit
+        def stepped(x):
+            return bad(x)
+
+        def host_side():
+            # rank reads OUTSIDE traced code are the blessed pattern
+            return jax.process_index(), jax.process_count()
+        """)
+    res = lint(tmp_path, select=["CTL1006"])
+    assert [(f.path, f.line) for f in res.findings] == \
+        [("parallel/plane.py", 8)], res.findings
+    assert "trace-time constant" in res.findings[0].msg
+    assert "parallel.multihost" in res.findings[0].msg
+    assert len(res.noqa) == 1, "noqa'd rank read must suppress"
+
+
 def test_misspelled_axis_in_real_data_plane_is_caught(tmp_path):
     """Acceptance: deliberately misspell a collective axis name in a
     copy of the REAL parallel/data_plane.py and `ceph lint` reports it
@@ -1914,8 +1969,9 @@ def test_misspelled_axis_in_real_data_plane_is_caught(tmp_path):
     import io as _io
     real = (REPO / "ceph_tpu" / "parallel" /
             "data_plane.py").read_text()
-    assert ", SHARD_AXIS)" in real
-    broken = real.replace(", SHARD_AXIS)", ", 'shrad')", 1)
+    assert "), SHARD_AXIS)" in real, \
+        "expected a psum(..., SHARD_AXIS) collective site"
+    broken = real.replace("), SHARD_AXIS)", "), 'shrad')", 1)
     write(tmp_path, "parallel/data_plane.py", broken)
     write(tmp_path, "parallel/mesh.py",
           (REPO / "ceph_tpu" / "parallel" / "mesh.py").read_text())

@@ -1,0 +1,128 @@
+"""MeshPlane2D scale-out boot (parallel/multihost.py).
+
+The load-bearing contract is the FALLBACK: with no coordinator
+configured every multihost entry point must collapse to the
+single-process behaviour byte-for-byte — ensure_initialized a no-op,
+rank reads (0, 1), fan-out ordering the identity — because every
+existing test and every single-host deployment runs through those
+paths with the module imported.  The real fleet (two jax.distributed
+processes over gloo CPU collectives) is exercised as subprocesses via
+scripts/check_multihost.py: global 2-D mesh construction, bit-identical
+dispatch bytes, and the per-(host, chip) counter rollup summing to the
+single-process totals.
+"""
+import numpy as np
+import pytest
+
+from ceph_tpu.common.options import config
+from ceph_tpu.parallel import multihost
+
+
+def test_fallback_is_noop():
+    """No coordinator configured (the default): ensure_initialized
+    declines, rank reads report the single-process identity, and the
+    plane-facing helpers keep today's semantics."""
+    assert multihost.ensure_initialized() is False
+    assert multihost.is_active() is False
+    assert multihost.process_index() == 0
+    assert multihost.process_count() == 1
+    assert multihost.host_label() == "host0"
+    assert multihost.host_label(3) == "host3"
+
+
+def test_fallback_stripe_order_is_identity():
+    """Single-process fan-outs MUST keep submission order — the
+    interleave only exists to balance cross-host queues."""
+    assert multihost.stripe_order([]) == []
+    assert multihost.stripe_order([9, 4, 7, 1]) == [0, 1, 2, 3]
+
+
+def test_stripe_order_interleaves_across_hosts(monkeypatch):
+    """Active fleet: targets interleave round-robin by owning host so
+    every host's queue fills from the first submit."""
+    monkeypatch.setattr(multihost, "_active", True)
+    hosts = {10: 0, 11: 0, 12: 1, 13: 1, 14: 0}
+    order = multihost.stripe_order([10, 11, 12, 13, 14],
+                                   host_of=lambda t: hosts[t])
+    assert order == [0, 2, 1, 3, 4]
+    # one host only -> identity even when active
+    assert multihost.stripe_order([10, 11],
+                                  host_of=lambda t: 0) == [0, 1]
+
+
+def test_global_mesh_2d_single_process():
+    """Single-process the global mesh degrades to one stripe row over
+    the local devices; an explicit row count reshapes them."""
+    import jax
+    n = len(jax.devices())
+    mesh = multihost.global_mesh_2d()
+    assert mesh.devices.shape == (1, n)
+    assert multihost.global_mesh_2d(2).devices.shape == (2, n // 2)
+    for flat in range(n):
+        assert multihost.host_of_chip(mesh, flat) == 0
+
+
+def test_disabled_mode_byte_identity():
+    """With multihost imported and initialized-inactive, the sharded
+    plane's dispatch still equals the single-device kernel bit for
+    bit (the fallback touches no data path)."""
+    from ceph_tpu.ops import gf, xor_kernel
+    from ceph_tpu.parallel import data_plane as dpmod
+    assert multihost.ensure_initialized() is False
+    rng = np.random.default_rng(5)
+    words = rng.integers(0, 2 ** 31, (3, 32, 16), dtype=np.uint32)
+    masks = xor_kernel.masks_to_device(
+        gf.gf8_bitmatrix(gf.vandermonde_parity(4, 2)))
+    config().set("parallel_data_plane", True)
+    try:
+        dp = dpmod.plane()
+        if dp is None:
+            pytest.skip("no multi-device plane on this host")
+        out = np.asarray(dp.xor_matmul_w32(masks, words))
+    finally:
+        config().clear("parallel_data_plane")
+    np.testing.assert_array_equal(
+        out, np.asarray(xor_kernel.xor_matmul_w32(masks, words)))
+
+
+def test_mesh_rollup_alias_dedup():
+    """A reporter writing BOTH coordinate keys and shard aliases
+    contributes the coordinate namespace only (summing both would
+    double-count); alias-only reporters (1-D plane) still roll up,
+    attributed to host0 with no grid shape."""
+    import time
+
+    from ceph_tpu.mgr.cluster_stats import ClusterStats
+    stats = ClusterStats()
+    grp = {"r0c0.put_stripes": ("counter", 5),
+           "r0c1.put_stripes": ("counter", 7),
+           "shard0.put_stripes": ("counter", 5),
+           "shard1.put_stripes": ("counter", 7),
+           "psum_rows": ("counter", 99)}
+    stats.ingest("client.host0", {"perf": {"dataplane": grp},
+                                  "ts": time.time(), "host": "host0"})
+    roll = stats.mesh_rollup()
+    assert roll["totals"] == {"put_stripes": 12.0}
+    assert roll["n_hosts"] == 1 and roll["n_chips"] == 2
+    assert roll["shape"] == [1, 2]
+    assert roll["hosts"]["host0"]["r0c1"]["put_stripes"] == 7.0
+
+    alias_only = ClusterStats()
+    alias_only.ingest(
+        "client", {"perf": {"dataplane":
+                            {"shard1.put_stripes": ("counter", 3)}},
+                   "ts": time.time()})
+    r2 = alias_only.mesh_rollup()
+    assert r2["hosts"]["host0"]["shard1"]["put_stripes"] == 3.0
+    assert r2["totals"] == {"put_stripes": 3.0}
+    assert r2["shape"] is None
+
+
+@pytest.mark.smoke
+def test_check_multihost_smoke():
+    """scripts/check_multihost.py passes against this tree: fallback
+    no-op, single-process 2-D reference, and the real 2-process
+    jax.distributed pair (global mesh, identical bytes, mesh_rollup
+    totals equal to the single-process run)."""
+    import scripts.check_multihost as chk
+    assert chk.main() == 0
