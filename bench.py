@@ -1429,7 +1429,12 @@ def bench_wire_zero(n_osds=2, mib=32, frame_kib=1024):
         """One vstart cluster, N measured client phases on it (same
         daemons ⇒ phase-to-phase comparisons dodge the cross-cluster
         scheduling noise this sandbox swings by 2x).  ``phases`` =
-        [(label, opts, csums_for_frame), ...]."""
+        [(label, opts, csums_for_frame), ...].  Each phase measures
+        the put sweep AND a get sweep over the objects it just wrote,
+        with the counter deltas split client/daemon so the REQUEST
+        and REPLY lanes price separately (RingReply: the reply lane's
+        send scan and reader copy must both read 0 when the reply
+        ring + trusted-csum fold are live)."""
         tmp = tempfile.mkdtemp(prefix="bench-zw-")
         d = os.path.join(tmp, "cluster")
         build_cluster_dir(d, n_osds=n_osds, osds_per_host=1,
@@ -1471,8 +1476,10 @@ def bench_wire_zero(n_osds=2, mib=32, frame_kib=1024):
                     n_frames = max(1, (mib << 20) // len(frame))
                     c0 = _wire_zero_counters(d, n_osds)
                     vals = []
+                    last_work = []
                     for _rep in range(3):   # median of 3 batches
                         work = reqs(n_frames)
+                        last_work = work
                         t0 = time.perf_counter()
                         comps = [aio.call_async(t, r)
                                  for t, r in work]
@@ -1495,12 +1502,69 @@ def bench_wire_zero(n_osds=2, mib=32, frame_kib=1024):
                         "trusted_csum_mib": round(
                             delta.get("trusted_csum_bytes", 0)
                             / 2**20, 1),
+                        # the counter that BACKS a passes/MiB of 0:
+                        # the bytes moved to the GF(2) matmul, they
+                        # did not silently go unverified
+                        "device_crc_mib": round(
+                            delta.get("device_crc_bytes", 0)
+                            / 2**20, 1),
                         "scan_sites": {
                             k[len("scan_"):-len("_bytes")]: round(
                                 delta[k] / nbytes, 2)
                             for k in delta
                             if k.startswith("scan_") and
                             k.endswith("_bytes") and delta[k]},
+                    }
+                    # ---- reply lane: read back the last batch ----
+                    # daemon vs client deltas split so the reply's
+                    # SEND scan (daemon, deleted by the trusted-csum
+                    # fold) and the reader COPY (client, deleted by
+                    # the reply ring) price independently
+                    from ceph_tpu.common import crcutil as _cu
+                    from ceph_tpu.common.perf_counters import \
+                        perf as _perf
+                    gets = [(t, {"cmd": "get_shard",
+                                 "coll": r["coll"],
+                                 "oid": r["oid"]})
+                            for t, r in last_work]
+                    aio.call(*gets[0])         # warm the read path
+                    g_d0 = _cu.wire_zero_counters(
+                        d, n_osds, include_local=False)
+                    g_c0 = _perf("wire.zero").dump()
+                    gvals = []
+                    for _rep in range(3):
+                        t0 = time.perf_counter()
+                        comps = [aio.call_async(t, r)
+                                 for t, r in gets]
+                        for rr, err in aio.gather(comps):
+                            if err is not None:
+                                raise err
+                        gvals.append(len(gets) * len(frame) /
+                                     (time.perf_counter() - t0))
+                    g_d1 = _cu.wire_zero_counters(
+                        d, n_osds, include_local=False)
+                    g_c1 = _perf("wire.zero").dump()
+                    dd = _counter_delta(g_d0, g_d1)
+                    dc = _counter_delta(g_c0, g_c1)
+                    gbytes = 3 * len(gets) * len(frame)
+                    results[label]["get"] = {
+                        "gbps": round(
+                            statistics.median(gvals) / 1e9, 3),
+                        "reply_send_passes_per_mib": round(
+                            (dd.get("scan_send_bytes", 0) +
+                             dd.get("scan_shm_send_bytes", 0))
+                            / gbytes, 2),
+                        "reply_copies_per_mib": round(
+                            dc.get("copy_bytes", 0) / gbytes, 2),
+                        "client_verify_passes_per_mib": round(
+                            dc.get("scan_verify_bytes", 0)
+                            / gbytes, 2),
+                        "via_reply_ring_mib": round(
+                            dc.get("shm_reply_bytes_served", 0)
+                            / 2**20, 1),
+                        "daemon_device_crc_mib": round(
+                            dd.get("device_crc_bytes", 0)
+                            / 2**20, 1),
                     }
                 finally:
                     aio.close()
@@ -1550,6 +1614,32 @@ def bench_wire_zero(n_osds=2, mib=32, frame_kib=1024):
     out["speedup_crc_mode_socket_only"] = round(
         out["after_socket"]["gbps"] / max(out["before"]["gbps"],
                                           1e-9), 2)
+    # device-resident daemon: daemons booted with wire_device_crc
+    # forced on, so the receive verify runs as the GF(2) matmul and
+    # the daemon's HOST passes/MiB reads 0 (counter-backed — the
+    # bytes show up in device_crc_bytes instead; on this CPU sandbox
+    # the matmul is slower than zlib, so only the small sweep runs it
+    # and only the counters, not the gbps, are the datapoint)
+    try:
+        dev = run_cluster(
+            {"CEPH_TPU_WIRE_DEVICE_CRC": "on"},
+            [("after_device",
+              dict(client_opts, wire_shm_ring_kib=16384,
+                   wire_device_crc="on"), cs)])
+        out["after_device"] = dev["after_device"]
+    except Exception as e:
+        print(f"# device-crc lane failed: {e}", file=sys.stderr)
+    # the reply-direction headline, lifted to the top level so
+    # bench_compare's smoke gate can key on it directly
+    out["reply"] = {
+        lane: {
+            "send_passes_per_mib":
+                out[lane]["get"]["reply_send_passes_per_mib"],
+            "copies_per_mib":
+                out[lane]["get"]["reply_copies_per_mib"],
+        }
+        for lane in ("before", "after", "after_socket")
+        if "get" in out.get(lane, {})}
     return out
 
 
@@ -1702,15 +1792,70 @@ def bench_crash_recovery(n_wal_batches=1500, batch_kib=8,
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def bench_ragged_fused(seed=0, n_objects=48, k=4, m=2,
+                       max_kib=1024, iters=3):
+    """Fused ragged kernel vs the padded rectangle on an S3Serve-shaped
+    MIXED-SIZE batch (zipf object sizes — the serving tier's honest
+    distribution): wall time for parity+crc through
+    ops/ragged_fused.encode (one traversal, descriptor-staged blocks)
+    vs encode_padded (rectangle matmul + separate host crc scans),
+    plus padding-bytes-avoided — the rectangle bytes the descriptor
+    layout never stages or multiplies."""
+    from ceph_tpu.ops import gf, ragged_fused
+    rng = np.random.default_rng(seed)
+    # zipf sizes in [1 byte, max_kib KiB]: a heavy head of small
+    # objects with a long large-object tail, like the serving keys
+    raw = rng.zipf(1.3, size=n_objects).astype(np.float64)
+    sizes = np.clip((raw * 1024).astype(np.int64), 1,
+                    max_kib << 10)
+    shards = [rng.integers(0, 256, size=(k, int(L)), dtype=np.uint8)
+              for L in sizes]
+    A = np.ascontiguousarray(gf.isa_rs_parity(k, m), np.uint8)
+    batch = ragged_fused.pack(shards)
+
+    def timed(fn):
+        fn()                               # compile/warm
+        vals = []
+        for _ in range(iters):
+            t0 = time.perf_counter()
+            fn()
+            vals.append(time.perf_counter() - t0)
+        return statistics.median(vals)
+
+    fused_s = timed(lambda: ragged_fused.encode(A, shards))
+    padded_s = timed(lambda: ragged_fused.encode_padded(A, shards))
+    res = ragged_fused.encode(A, shards)
+    ref = ragged_fused.encode_padded(A, shards)
+    identical = all(
+        np.array_equal(res.parity[i], ref.parity[i])
+        for i in range(len(shards)))
+    return {
+        "n_objects": n_objects,
+        "k": k, "m": m,
+        "size_min": int(sizes.min()),
+        "size_max": int(sizes.max()),
+        "fused_s": round(fused_s, 4),
+        "padded_s": round(padded_s, 4),
+        "fused_speedup": round(padded_s / max(fused_s, 1e-9), 2),
+        "padding_bytes_avoided": int(batch.padding_avoided(m)),
+        "rect_bytes": int(batch.rect_bytes(m)),
+        "fused_bytes": int(batch.fused_bytes(m)),
+        "bit_identical": identical,
+    }
+
+
 def bench_s3_serving(seed=0, n_osds=4, shards=8, clients_scale=4.0,
-                     ops_scale=3.0):
+                     ops_scale=3.0, sizes=None):
     """The millions-of-users serving headline (ROADMAP item 3):
     multi-tenant S3 workload over live daemons through the async
     wire core — zipfian keys, sharded bucket indexes, per-tenant
     dmClock QoS — reporting ops/s plus per-tenant p50/p99/p999 read
     from the mon's cluster histogram merge, with the SLO/QoS gate's
     verdict riding along (a red gate in a bench run is a datapoint,
-    not an exception)."""
+    not an exception).  ``--sizes zipf``: the mixed-size profile also
+    prices the fused ragged kernel against the padded rectangle on a
+    zipf batch shaped like this workload's object sizes
+    (bench_ragged_fused), reporting padding-bytes-avoided."""
     from ceph_tpu.rgw.serving import (ServeConfig, default_tenants,
                                       run_serve)
     tenants = default_tenants()
@@ -1720,7 +1865,7 @@ def bench_s3_serving(seed=0, n_osds=4, shards=8, clients_scale=4.0,
     cfg = ServeConfig(seed=seed, n_osds=n_osds, index_shards=shards,
                       tenants=tenants)
     r = run_serve(cfg)
-    return {
+    out = {
         "n_osds": n_osds,
         "index_shards": r["index_shards"],
         "clients": sum(t.clients for t in tenants),
@@ -1736,6 +1881,13 @@ def bench_s3_serving(seed=0, n_osds=4, shards=8, clients_scale=4.0,
         "slo_gate_ok": r["ok"],
         "breaches": r["breaches"],
     }
+    if sizes == "zipf":
+        try:
+            out["ragged_zipf"] = bench_ragged_fused(seed=seed)
+        except Exception as e:
+            print(f"# ragged fused profile failed: {e}",
+                  file=sys.stderr)
+    return out
 
 
 def bench_multisite(n_objects=64, obj_kib=128, shards=8, workers=4,
@@ -1861,6 +2013,15 @@ def main():
         gc.collect()
         extras["wire_zero"] = bench_wire_zero()
         extras["wire_zero"]["shm"] = bench_wire_shm()
+        # RingReply headline (ISSUE 20): the reply-direction lane
+        # decomposition + the device-resident daemon's host-scan zero
+        extras["wire_reply"] = {
+            "reply": extras["wire_zero"].get("reply", {}),
+            "daemon_device": {
+                k: extras["wire_zero"]["after_device"][k]
+                for k in ("scan_sites", "crc_passes_per_mib", "get")
+                if k in extras["wire_zero"].get("after_device", {})},
+        }
     except Exception as e:
         print(f"# wire zero bench failed: {e}", file=sys.stderr)
     if "cold_restart" not in extras.get("rebuild_osd", {}):
@@ -1916,7 +2077,10 @@ def main():
     try:
         import gc
         gc.collect()
-        extras["s3_serving"] = bench_s3_serving()
+        extras["s3_serving"] = bench_s3_serving(sizes="zipf")
+        if extras["s3_serving"].get("ragged_zipf"):
+            extras.setdefault("wire_reply", {})["ragged"] = \
+                extras["s3_serving"]["ragged_zipf"]
     except Exception as e:
         print(f"# s3 serving bench failed: {e}", file=sys.stderr)
     try:

@@ -1,4 +1,5 @@
-"""CTL1xx wire hot-path rules — CTL130: copy-introducing patterns.
+"""CTL1xx wire hot-path rules — CTL130: copy-introducing patterns;
+CTL131: reply-direction re-scans outside the combine chokepoint.
 
 ZeroWire (ISSUE 15) made the wire data path zero-copy end to end:
 payload buffers cross the client, the frames, the receive path and
@@ -161,5 +162,137 @@ class WireCopyRule(Rule):
         return out
 
 
+# ---------------------------------------------------------- CTL131 ---
+# RingReply (ISSUE 20) deleted the reply lane's send-side scan: a bulk
+# reply's sub-crcs are already TRUSTED (BlueStore blob csums adopted at
+# receive verify), so the frame crc is a crc32_combine fold, never a
+# rescan.  The regression class: a reply-building function that calls
+# zlib.crc32 / Csums.scan on payload bytes anyway — the silent
+# double-scan.  Folding functions (they call crc32_combine /
+# combine_series — the sanctioned chokepoint) are exempt; counted
+# fallbacks carry # noqa: CTL131 with justification.
+
+_COMBINE_CALLS = frozenset(("crc32_combine", "combine_series"))
+_SCAN_ATTRS = frozenset(("crc32", "scan"))
+
+
+def _references_reply(fn: ast.AST) -> bool:
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Attribute) and \
+                node.attr.startswith("MSG_REPLY"):
+            return True
+        if isinstance(node, ast.Name) and \
+                node.id.startswith("MSG_REPLY"):
+            return True
+    return False
+
+
+def _sends_frames(fn: ast.AST) -> bool:
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        name = f.attr if isinstance(f, ast.Attribute) else \
+            (f.id if isinstance(f, ast.Name) else "")
+        if name in ("prepare_frame", "put"):
+            return True
+    return False
+
+
+def _calls_combine(fn: ast.AST) -> bool:
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        name = f.attr if isinstance(f, ast.Attribute) else \
+            (f.id if isinstance(f, ast.Name) else "")
+        if name in _COMBINE_CALLS:
+            return True
+    return False
+
+
+def _rescan_patterns(fn: ast.AST) -> List[Tuple[int, str]]:
+    out: List[Tuple[int, str]] = []
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        if isinstance(f, ast.Attribute) and f.attr in _SCAN_ATTRS \
+                and node.args and _is_payload(node.args[0]):
+            what = "zlib.crc32" if f.attr == "crc32" else "Csums.scan"
+            out.append((node.lineno,
+                        f"{what}() re-scans payload bytes"))
+    return out
+
+
+class WireReplyRescanRule(Rule):
+    rule_id = "CTL131"
+    name = "reply-direction-rescan"
+    description = ("reply-direction send that re-scans payload bytes "
+                   "(zlib.crc32 / Csums.scan) outside the "
+                   "crc32_combine chokepoint — trusted sub-crcs from "
+                   "the store side table must FOLD into the frame "
+                   "crc, never trigger a second traversal "
+                   "(interprocedural over the whole-program graph)")
+
+    def __init__(self) -> None:
+        super().__init__()
+        self._roots: List[Tuple[ParsedModule, ast.AST]] = []
+        self._scope_mods: List[ParsedModule] = []
+
+    def check_module(self, mod: ParsedModule) -> Iterable[Finding]:
+        if mod.evidence:
+            return ()
+        rel = mod.relpath.replace("\\", "/")
+        dirs = rel.split("/")[:-1]
+        if "msg" in dirs or "cluster" in dirs:
+            self._scope_mods.append(mod)
+            for fn, _cls in astutil.walk_functions(mod.tree):
+                if _references_reply(fn) and _sends_frames(fn):
+                    self._roots.append((mod, fn))
+        return ()
+
+    def finish(self) -> Iterable[Finding]:
+        out: List[Finding] = []
+        seen: Set[Tuple[str, int]] = set()
+        owner = {}
+        for mod in self._scope_mods:
+            for fn, _cls in astutil.walk_functions(mod.tree):
+                owner[id(fn)] = (mod, fn)
+        graph = astutil.program_graph(self.program) \
+            if self.program is not None else None
+
+        def report(mod: ParsedModule, fn: ast.AST, line: int,
+                   msg: str, via: str) -> None:
+            key = (mod.relpath, line)
+            if key in seen or mod.suppressed(line, self.rule_id):
+                return
+            seen.add(key)
+            name = getattr(fn, "name", "?")
+            out.append(Finding(
+                self.rule_id, mod.relpath, line,
+                f"{msg} on the reply send path in '{name}'{via} — "
+                f"trusted csums fold via crc32_combine at the "
+                f"chokepoint; a rescan here is the double-scan the "
+                f"reply lane exists to delete"))
+
+        for mod, fn in self._roots:
+            targets = [(mod, fn)]
+            if graph is not None:
+                for g in graph.reachable([fn]):
+                    o = owner.get(id(g))
+                    if o is not None and g is not fn:
+                        targets.append(o)
+            for tmod, tfn in targets:
+                if _calls_combine(tfn):
+                    continue          # the sanctioned fold chokepoint
+                via = "" if tfn is fn else \
+                    f" (reached from '{getattr(fn, 'name', '?')}')"
+                for line, msg in _rescan_patterns(tfn):
+                    report(tmod, tfn, line, msg, via)
+        return out
+
+
 def register(reg) -> None:
     reg.add("CTL130", WireCopyRule)
+    reg.add("CTL131", WireReplyRescanRule)

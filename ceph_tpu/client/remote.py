@@ -458,13 +458,16 @@ class RemoteCluster:
         """Async form of osd_call: returns the AioCompletion."""
         return self.aio.call_async(osd, req)
 
-    def aio_put(self, pool_id: int, name: str, data: bytes):
+    def aio_put(self, pool_id: int, name: str, data: bytes,
+                csums=None):
         """Asynchronous put (librados aio_write_full): the op runs
         its submit -> encode -> fan-out -> gather-commits machine on
         the completion engine; same-object ops execute in submission
-        order (the librados write-ordering contract)."""
+        order (the librados write-ordering contract).  ``csums`` as
+        in :meth:`put` — precomputed trusted csums keep the client's
+        send path scan-free."""
         return self.aio.engine.submit(
-            lambda: self.put(pool_id, name, data),
+            lambda: self.put(pool_id, name, data, csums=csums),
             key=("obj", pool_id, name))
 
     def aio_get(self, pool_id: int, name: str):
@@ -851,13 +854,24 @@ class RemoteCluster:
         return stats
 
     # ----------------------------------------------------------------- IO --
-    def put(self, pool_id: int, name: str, data: bytes) -> int:
-        """Returns the number of shard/replica writes acknowledged."""
+    def put(self, pool_id: int, name: str, data: bytes,
+            csums=None) -> int:
+        """Returns the number of shard/replica writes acknowledged.
+
+        ``csums`` — optional precomputed :class:`crcutil.Csums` for
+        ``data`` (the staged-in-HBM shape: ``crc32_gf2.csums_for``
+        computes them on-device).  With them the client never
+        host-scans the payload — the wire layer folds the combined
+        crc into the frame/doorbell and the daemon's single verify
+        re-derives the trusted blob csums it stores and forwards to
+        replicas.  Replicated pools only; EC encode re-chunks the
+        bytes, so per-chunk csums come from the encode path instead."""
         return self._tracked("put", pool_id, name,
                              lambda: self._put_routed(pool_id, name,
-                                                      data))
+                                                      data, csums))
 
-    def _put_routed(self, pool_id: int, name: str, data: bytes) -> int:
+    def _put_routed(self, pool_id: int, name: str, data: bytes,
+                    csums=None) -> int:
         pool = self.osdmap.pools[pool_id]
         if pool.write_tier >= 0 and "@" not in name:
             # writeback cache routing (the Objecter consults the
@@ -868,12 +882,13 @@ class RemoteCluster:
             self._tier_reads[(pool_id, name)] = \
                 self._tier_reads.get((pool_id, name), 0) + 1
             return self._put_inner(pool.write_tier, name, data,
-                                   extra_attrs={"tier_dirty": b"1"})
-        return self._put_inner(pool_id, name, data)
+                                   extra_attrs={"tier_dirty": b"1"},
+                                   csums=csums)
+        return self._put_inner(pool_id, name, data, csums=csums)
 
     def _put_inner(self, pool_id: int, name: str, data: bytes,
-                   extra_attrs: Optional[Dict[str, bytes]] = None
-                   ) -> int:
+                   extra_attrs: Optional[Dict[str, bytes]] = None,
+                   csums=None) -> int:
         pool = self.osdmap.pools[pool_id]
         pg = self._pg_for(pool, name)
         up = self._up(pool, pg)
@@ -915,11 +930,16 @@ class RemoteCluster:
                 if stamp is None:
                     stamp = stamps[primary] = self._next_stamp(primary)
                 try:
-                    r = self.osd_call(primary, {
-                        "cmd": "put_object", "coll": coll,
-                        "oid": f"0:{name}", "data": data,
-                        "attrs": extra_attrs,
-                        "replicas": replicas, **stamp})
+                    req = {"cmd": "put_object", "coll": coll,
+                           "oid": f"0:{name}", "data": data,
+                           "attrs": extra_attrs,
+                           "replicas": replicas, **stamp}
+                    if csums is not None and \
+                            csums.length == len(data):
+                        # trusted client csums: the wire layer folds
+                        # the combined crc instead of re-scanning
+                        req["_csums"] = csums
+                    r = self.osd_call(primary, req)
                 except (OSError, IOError) as e:
                     last = e
                     if attempt < attempts - 1:   # no backoff on the

@@ -256,6 +256,17 @@ class AsyncObjecter:
                     shm_dir=shm_dir, shm_bytes=self.shm_bytes)
             return p
 
+    @property
+    def reply_wanted(self) -> bool:
+        """True when pools built by this objecter will ask daemons
+        for the shm REPLY ring (RingReply): requires a live shm lane
+        (secure mode zeroes ``shm_bytes`` — sealed payloads never
+        cross the plaintext mmap, in either direction) AND the
+        ``wire_reply_ring`` option.  The observability twin of the
+        gate each StreamPool latches at build time."""
+        from ..common import crcutil
+        return self.shm_bytes > 0 and crcutil.flag("wire_reply_ring")
+
     def drop_pool(self, osd: int) -> None:
         with self._lock:
             p = self._pools.pop(osd, None)

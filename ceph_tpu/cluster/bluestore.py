@@ -766,6 +766,38 @@ class BlueStore:
                    else min(offset + length, o.size))
             return self._read_onode(o, offset, end)
 
+    def read_with_csums(self, coll: Coll, oid: str):
+        """Full-object read PLUS the store-trusted sub-crcs:
+        -> (data, crcutil.Csums | None).
+
+        The reply-direction half of the one-pass handoff (RingReply):
+        csum-on-read just verified every stored block against the
+        blob csum array, so those csums are TRUSTED for the bytes
+        being returned — the daemon's reply path folds them into the
+        frame crc / ring doorbell via crc32_combine and sends with
+        ZERO additional scans.  Only the simple write_full shape
+        qualifies (one uncompressed blob storing the logical bytes
+        verbatim, one extent covering [0, size)): overwrite histories
+        and compressed blobs return csums None, and the sender runs
+        its one counted scan exactly as before."""
+        with self._lock:
+            o = self._get(coll, oid)
+            data = self._read_onode(o, 0, o.size)
+            cs = None
+            if len(o.blobs) == 1 and len(o.extents) == 1 and \
+                    not o.blobs[0].compressed:
+                b = o.blobs[0]
+                e_off, e_len, _bi, b_off = o.extents[0]
+                if (e_off == 0 and b_off == 0 and e_len == o.size
+                        and b.raw_len == o.size
+                        and b.stored_len == o.size
+                        and len(b.csums) ==
+                        (o.size + self.min_alloc - 1)
+                        // self.min_alloc):
+                    cs = crcutil.Csums(self.min_alloc,
+                                       list(b.csums), o.size)
+            return data, cs
+
     def stat(self, coll: Coll, oid: str) -> Dict[str, int]:
         with self._lock:
             o = self._get(coll, oid)

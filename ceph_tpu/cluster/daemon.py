@@ -38,6 +38,7 @@ import zlib
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from ..common import auth as cx
+from ..common import crcutil
 from ..common import faults
 from ..common import tracer as _trace
 from ..common.admin import AdminServer
@@ -157,6 +158,15 @@ class WireServer:
         # files are sparse but accumulate across chaos soaks)
         from ..msg.shm_ring import sweep_stale
         sweep_stale(os.path.dirname(sock_path) or ".")
+        # daemon→client reply rings (RingReply): ONE per client
+        # request-ring path, shared by every serving connection of
+        # that client's stream pool (a reply doorbell must resolve on
+        # whichever stream it arrives; ShmRing's lock makes the
+        # cross-connection puts safe).  Refcounted by serving conns —
+        # the last close unlinks the file; a kill9'd daemon's orphans
+        # are swept by the CLIENT on reconnect (zwreply prefix).
+        self._reply_rings: Dict[str, list] = {}
+        self._reply_lock = LockdepLock("srv.reply_rings", recursive=False)
         self._sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
         self._sock.bind(sock_path)
         # deep backlog: injected-drop reconnect storms (every client
@@ -220,8 +230,109 @@ class WireServer:
             return entity, session_key
         raise cx.AuthError(f"unsupported auth frame {env.type:#x}")
 
+    def _acquire_reply_ring(self, client_path: str, size: int):
+        """Create-or-join the reply ring paired with one client
+        request ring; returns the ShmRing or None (creation failed —
+        the reply lane stays off, socket replies still work)."""
+        from ..msg.shm_ring import ShmRing
+        with self._reply_lock:
+            ent = self._reply_rings.get(client_path)
+            if ent is not None:
+                ent[1] += 1
+                return ent[0]
+            try:
+                ring = ShmRing.create(
+                    os.path.dirname(self.sock_path) or ".",
+                    self.service, int(size), prefix="zwreply")
+            except OSError:
+                return None
+            self._reply_rings[client_path] = [ring, 1]
+            return ring
+
+    def _release_reply_ring(self, client_path: str) -> None:
+        with self._reply_lock:
+            ent = self._reply_rings.get(client_path)
+            if ent is None:
+                return
+            ent[1] -= 1
+            if ent[1] > 0:
+                return
+            del self._reply_rings[client_path]
+            ring = ent[0]
+        ring.close(unlink=True)
+
+    def _reply_blobs(self, conn, rid: int, reply, key, mode: str,
+                     entity: str, reply_ring, reply_toks: dict,
+                     reply_sg: bool) -> list:
+        """Reply-direction chokepoint (RingReply): route one handler
+        reply onto the cheapest lane.  A BulkReply carries the csums
+        the store already trusts for its bytes, so in preference
+        order: (1) same-host reply ring — the payload crosses via
+        mmap and only a one-key doorbell marker rides the typed
+        reply: zero copies AND zero send scans; (2) MSG_REPLY_SG
+        socket frame — the trusted csums FOLD into the frame crc
+        (crc32_combine): zero send scans; (3) legacy typed reply
+        (client never advertised reply_sg — blocking WireClient):
+        materialized bytes, the send scan runs and is COUNTED,
+        exactly the before-lane the bench prices.  A dict carrying
+        BulkReply values (the recovery-pull shape) rides the ring
+        per-object under a ``_shm_objs`` marker.  Everything else is
+        a plain typed reply, unchanged."""
+        pc = crcutil._counters()
+        if isinstance(reply, wire.BulkReply):
+            data, csums = reply.data, reply.csums
+            combined = csums.combined if (
+                csums is not None and
+                csums.length == len(data)) else None
+            if reply_ring is not None and len(data) >= wire.SG_MIN:
+                tok = reply_ring.put(data, combined)
+                if tok is not None:
+                    reply_toks[(tok.off, tok.gen)] = tok
+                    pc.inc("shm_reply_frames")
+                    pc.inc("shm_reply_bytes", len(data))
+                    return wire.prepare_frame(
+                        conn, MSG_REPLY, rid, -1,
+                        [_dumps({"_shm_reply": tok.meta})], key,
+                        mode, self.net_entity, entity)
+            if reply_sg and len(data) >= wire.SG_MIN:
+                return wire.prepare_frame(
+                    conn, wire.MSG_REPLY_SG, rid, -1,
+                    [wire._U32.pack(0), data], key, mode,
+                    self.net_entity, entity, data_csums=csums)
+            reply = reply.to_bytes()
+        elif isinstance(reply, dict) and any(
+                isinstance(v, wire.BulkReply)
+                for v in reply.values()):
+            if reply_ring is not None:
+                out: Dict[str, Any] = {}
+                for k, v in reply.items():
+                    if isinstance(v, wire.BulkReply) and \
+                            len(v.data) >= wire.SG_MIN:
+                        comb = v.csums.combined if (
+                            v.csums is not None and
+                            v.csums.length == len(v.data)) else None
+                        tok = reply_ring.put(v.data, comb)
+                        if tok is not None:
+                            reply_toks[(tok.off, tok.gen)] = tok
+                            pc.inc("shm_reply_frames")
+                            pc.inc("shm_reply_bytes", len(v.data))
+                            out[k] = tok.meta
+                            continue
+                    out[k] = v.to_bytes() \
+                        if isinstance(v, wire.BulkReply) else v
+                reply = {"_shm_objs": out}
+            else:
+                reply = wire.unwrap_bulk(reply)
+        return wire.prepare_frame(
+            conn, MSG_REPLY, rid, -1, [_dumps(reply)], key, mode,
+            self.net_entity, entity)
+
     def _serve_conn(self, conn: socket.socket) -> None:
         shm_reader = None           # per-connection mapped client ring
+        reply_ring = None           # shared daemon→client reply ring
+        reply_key: Optional[str] = None   # registry key (client path)
+        reply_toks: dict = {}       # (off, gen) -> ShmToken awaiting free
+        reply_sg = False            # client understands MSG_REPLY_SG
         try:
             # deep kernel buffers: one pipelined client window should
             # land in as few recv syscalls as possible (syscalls are
@@ -281,10 +392,15 @@ class WireServer:
                 if env.type == wire.MSG_SET_MODE:
                     # authenticated data-mode downgrade (the ms_mode
                     # crc/secure negotiation): ack in the OLD mode —
-                    # the client switches only after reading it
-                    want = encoding.loads(env.payload).get("mode")
+                    # the client switches only after reading it.
+                    # ``reply_sg`` advertises a reader that parses
+                    # MSG_REPLY_SG bulk replies; legacy blocking
+                    # clients never set it and keep typed replies.
+                    blob = encoding.loads(env.payload)
+                    want = blob.get("mode")
                     if want not in (wire.MODE_CRC, wire.MODE_SECURE):
                         return
+                    reply_sg = bool(blob.get("reply_sg"))
                     try:
                         wire.send_frame(conn, Envelope(
                             MSG_REPLY, env.id, -1,
@@ -304,6 +420,7 @@ class WireServer:
                     # files.  Refusal is an ok=False ack: the client
                     # keeps the pure socket lane.
                     ok = False
+                    ack: Dict[str, Any] = {}
                     try:
                         blob = encoding.loads(bytes(env.payload))
                         path = os.path.realpath(str(blob["path"]))
@@ -316,6 +433,25 @@ class WireServer:
                             shm_reader = RingReader(
                                 path, int(blob["size"]))
                             ok = True
+                        if ok and blob.get("reply") and \
+                                crcutil.flag("wire_reply_ring"):
+                            # RingReply: pair the client's request
+                            # ring with a daemon-created reply ring
+                            # (same size) and name it in the ack —
+                            # same-host gets/recovery pulls go
+                            # zero-copy BOTH directions
+                            if reply_key is not None and \
+                                    reply_key != path:
+                                self._release_reply_ring(reply_key)
+                                reply_ring = reply_key = None
+                            if reply_key is None:
+                                r = self._acquire_reply_ring(
+                                    path, int(blob["size"]))
+                                if r is not None:
+                                    reply_ring, reply_key = r, path
+                            if reply_ring is not None:
+                                ack["reply_path"] = reply_ring.path
+                                ack["reply_size"] = reply_ring.size
                     except (OSError, KeyError, ValueError, TypeError):
                         # (EncodingError is a ValueError)
                         # ANY malformed attach (non-dict blob, bad
@@ -323,14 +459,32 @@ class WireServer:
                         # refusal, never a torn-down connection —
                         # the client just keeps the socket lane
                         ok = False
+                        ack = {}
+                    ack["ok"] = ok
                     try:
                         wire.send_frame(conn, Envelope(
                             MSG_REPLY, env.id, -1,
-                            _dumps({"ok": ok})),
+                            _dumps(ack)),
                             session_key=key, src=self.net_entity,
                             dst=entity, mode=mode)
                     except OSError:
                         return
+                    continue
+                if env.type == wire.MSG_SHM_FREE:
+                    # reply-ring reclaim doorbell (rid 0, no reply):
+                    # the client consumed these records — their
+                    # extents may be reused.  Forge-proof and
+                    # idempotent: only (off, gen) pairs THIS conn
+                    # allocated resolve; anything else is a no-op.
+                    try:
+                        for m in encoding.loads(bytes(env.payload)):
+                            tok = reply_toks.pop(
+                                (int(m[0]), int(m[1])), None)
+                            if tok is not None and \
+                                    reply_ring is not None:
+                                reply_ring.free(tok)
+                    except (ValueError, TypeError, IndexError):
+                        pass    # malformed free: conn-close reclaims
                     continue
                 if env.type not in (MSG_REQ, wire.MSG_REQ_SG):
                     continue
@@ -383,18 +537,24 @@ class WireServer:
                                 "shm doorbell but no ring attached "
                                 "on this connection")
                         try:
-                            data, csums = shm_reader.read(shm_meta)
+                            # receive verify through the device-crc
+                            # gate: with wire_device_crc active the
+                            # ring bytes are staged to HBM and
+                            # checked by the GF(2) matmul — zero
+                            # host scans; off/cpu = the counted
+                            # host scan, same verdict either way
+                            data, csums = shm_reader.read(
+                                shm_meta, scanner=wire.receive_csums)
                         except wire.WireError as e:
                             raise _ShmPoisoned(str(e))
                         req["data"] = data
                         req["_csums"] = csums
                     reply = self.handler(entity, req)
-                    out = Envelope(MSG_REPLY, env.id, -1, _dumps(reply))
+                    err = None
                 except _ShmPoisoned:
                     return
                 except Exception as e:
-                    out = Envelope(MSG_ERR, env.id, -1,
-                                   _dumps((type(e).__name__, str(e))))
+                    reply, err = None, (type(e).__name__, str(e))
                 try:
                     # reply direction carries its own src/dst: a
                     # oneway cut can apply the op yet lose the ack —
@@ -402,16 +562,32 @@ class WireServer:
                     # Assembled (faultpoints fired per frame) but
                     # only flushed before a blocking read or past
                     # the batch bound — pipelined requests share one
-                    # reply sendmsg
-                    out_blobs.extend(wire.prepare_frame(
-                        conn, out.type, out.id, out.shard,
-                        [out.payload], key, mode,
-                        self.net_entity, entity))
+                    # reply sendmsg.  Bulk replies route through the
+                    # RingReply chokepoint (_reply_blobs): reply
+                    # ring, MSG_REPLY_SG csum fold, or legacy typed.
+                    if err is not None:
+                        out_blobs.extend(wire.prepare_frame(
+                            conn, wire.MSG_ERR, env.id, -1,
+                            [_dumps(err)], key, mode,
+                            self.net_entity, entity))
+                    else:
+                        out_blobs.extend(self._reply_blobs(
+                            conn, env.id, reply, key, mode, entity,
+                            reply_ring, reply_toks, reply_sg))
                     if sum(len(b) for b in out_blobs) >= (4 << 20):
                         _flush()
                 except OSError:
                     return
         finally:
+            if reply_key is not None:
+                # extents whose reclaim doorbell never arrived
+                # (client died mid-get, stream killed): freed here,
+                # then this conn's ref dropped — the LAST serving
+                # conn's release unlinks the ring file
+                if reply_ring is not None:
+                    for tok in reply_toks.values():
+                        reply_ring.free(tok)
+                self._release_reply_ring(reply_key)
             if shm_reader is not None:
                 shm_reader.close()
             conn.close()
@@ -1837,8 +2013,11 @@ class OSDDaemon:
                 if pg >= 0:
                     self.heat.record(pool, pg, "wr", nbytes=nbytes)
         elif cmd in self._RD_CMDS:
-            nbytes = len(reply) if isinstance(
-                reply, (bytes, bytearray, memoryview)) else 0
+            if isinstance(reply, wire.BulkReply):
+                nbytes = len(reply.data)
+            else:
+                nbytes = len(reply) if isinstance(
+                    reply, (bytes, bytearray, memoryview)) else 0
             self._pc_io.inc("rd_ops")
             self._pc_io.inc("rd_bytes", nbytes)
             if pool >= 0:
@@ -1926,11 +2105,21 @@ class OSDDaemon:
         if cmd == "get_shard":
             coll = tuple(req["coll"])
             def read():
+                rg = req.get("ranges")
+                rwc = None if rg else getattr(
+                    self.store, "read_with_csums", None)
                 try:
+                    if rwc is not None:
+                        # full-object read with the store-trusted
+                        # blob csums alongside (RingReply): the
+                        # reply chokepoint folds them into the frame
+                        # crc / ring doorbell, so the get reply
+                        # leaves this daemon with ZERO send scans
+                        data, cs = rwc(coll, req["oid"])
+                        return wire.BulkReply(data, cs)
                     data = self.store.read(coll, req["oid"])
                 except IOError:
                     return None
-                rg = req.get("ranges")
                 if rg:
                     # sub-shard ranged read: only the requested byte
                     # ranges cross the wire (a regenerating-code
@@ -1968,13 +2157,21 @@ class OSDDaemon:
             def read_many():
                 out = {}
                 nbytes = 0
+                rwc = getattr(self.store, "read_with_csums", None)
                 for oid in req["oids"]:
                     if out and nbytes >= self._RECOVERY_CHUNK_BYTES:
                         break     # omitted: the caller re-requests
                     try:
-                        data = self.store.read(coll, oid)
+                        if rwc is not None:
+                            # trusted csums per object: same-host
+                            # recovery pulls ride the reply ring
+                            # with zero send scans (RingReply)
+                            data, cs = rwc(coll, oid)
+                        else:
+                            data, cs = self.store.read(coll, oid), \
+                                None
                         nbytes += len(data)
-                        out[oid] = data
+                        out[oid] = wire.BulkReply(data, cs)
                     except IOError:
                         out[oid] = None
                 return out

@@ -411,6 +411,16 @@ _TABLE: Tuple[Option, ...] = (
            "matmul loses to a zlib scan), 'on' forces it (bench/"
            "test), 'off' always scans on host",
            enum_values=("auto", "on", "off")),
+    Option("wire_reply_ring", TYPE_BOOL, True,
+           "RingReply same-host reply lane: the daemon answers bulk "
+           "reads (get/recovery pulls) through a daemon-created shm "
+           "reply ring (msg/shm_ring.py, 'zwreply') with only a "
+           "doorbell on the socket — zero-copy in BOTH directions, "
+           "and the store-trusted blob csums ride the doorbell so "
+           "the daemon sends with zero scans; requires the request "
+           "ring (wire_shm_ring_kib > 0), disabled under secure "
+           "mode with it; off = bulk replies ride MSG_REPLY_SG on "
+           "the socket (csums still folded, zero send scans)"),
     Option("osd_mclock_scheduler_client_res", TYPE_FLOAT, 0.2,
            "default dmClock RESERVATION for a per-tenant client "
            "class (reference osd_mclock_scheduler_client_res): the "

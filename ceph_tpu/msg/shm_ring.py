@@ -60,23 +60,27 @@ class ShmRingError(IOError):
     pass
 
 
-def sweep_stale(dir_path: str) -> int:
+def sweep_stale(dir_path: str, prefix: str = "zwring") -> int:
     """Unlink ring files whose creator process is gone.  The filename
-    embeds the creating pid (``zwring.<name>.<pid>.<hex>``) and the
+    embeds the creating pid (``<prefix>.<name>.<pid>.<hex>``) and the
     lane is same-host BY DESIGN, so pid liveness is an authoritative
-    orphan test: a kill9'd client can never reclaim its ring, and
-    nothing else will — daemons call this when they bind their
-    socket.  Live rings (creator running) and rings a serving
-    connection already mapped (mmap survives the unlink) are safe
-    either way."""
+    orphan test.  Ownership decides who sweeps what: daemons sweep
+    CLIENT-created request rings (``zwring``) when they bind their
+    socket — a kill9'd client can never reclaim its ring, and nothing
+    else will; clients sweep DAEMON-created reply rings (``zwreply``)
+    when they (re)connect — a kill9'd daemon orphans its reply rings
+    the same way, and the daemon that replaces it creates fresh ones.
+    Live rings (creator running) and rings a serving connection
+    already mapped (mmap survives the unlink) are safe either way."""
     n = 0
+    want = prefix.rstrip(".") + "."
     try:
         names = os.listdir(dir_path)
     except OSError:  # noqa: CTL603 — best-effort housekeeping: an
         # unreadable dir means nothing to sweep, not lost state
         return 0
     for fn in names:
-        if not fn.startswith("zwring."):
+        if not fn.startswith(want):
             continue
         try:
             pid = int(fn.split(".")[-2])
@@ -129,10 +133,15 @@ class ShmRing:
         self.closed = False
 
     @classmethod
-    def create(cls, shm_dir: str, name: str, size: int) -> "ShmRing":
+    def create(cls, shm_dir: str, name: str, size: int,
+               prefix: str = "zwring") -> "ShmRing":
         """Ring file next to the daemon's socket (both processes can
-        reach it there); unique per client process + pool."""
-        fname = (f"zwring.{name or 'pool'}.{os.getpid()}."
+        reach it there); unique per creator process + pool.  The
+        ``prefix`` names the OWNER: ``zwring`` = client-created
+        request ring (daemon sweeps orphans at bind), ``zwreply`` =
+        daemon-created reply ring (client sweeps orphans on
+        reconnect) — the embedded pid is the creator's either way."""
+        fname = (f"{prefix}.{name or 'pool'}.{os.getpid()}."
                  f"{secrets.token_hex(4)}")
         return cls(os.path.join(shm_dir, fname), size, create=True)
 
@@ -269,12 +278,17 @@ class RingReader:
     def _rec_hdr(self, off: int) -> Tuple[int, int, int]:
         return _REC.unpack_from(self.mm, HDR_SPACE + off)
 
-    def read(self, meta) -> Tuple[memoryview, crcutil.Csums]:
+    def read(self, meta, scanner=None
+             ) -> Tuple[memoryview, crcutil.Csums]:
         """Resolve one doorbell: seqlock-check the record header,
         ONE verify scan (sub-crcs + combine) against the doorbell's
         crc, re-check the header.  Any mismatch raises WireError —
         the serve loop drops the connection like a poisoned socket
-        frame."""
+        frame.  ``scanner`` (a ``view -> Csums`` callable, e.g.
+        ``wire.receive_csums``) replaces the host verify scan — the
+        device-crc path: same combine verdict, zero host passes over
+        the full blocks; a flipped ring byte still fails the combine
+        and kills the connection exactly like the host path."""
         from .wire import WireError
         try:
             off, ln, gen, want = (int(meta[0]), int(meta[1]),
@@ -290,8 +304,12 @@ class RingReader:
                 f"(gen {g} != {gen} or len {l} != {ln})")
         view = memoryview(self.mm)[HDR_SPACE + off + _REC.size:
                                    HDR_SPACE + off + _REC.size + ln]
-        ok, csums = crcutil.verify_blocks(view, crcutil.CSUM_BLOCK,
-                                          want, site="verify")
+        if scanner is not None:
+            csums = scanner(view)
+            ok = csums.combined == (want & 0xFFFFFFFF)
+        else:
+            ok, csums = crcutil.verify_blocks(
+                view, crcutil.CSUM_BLOCK, want, site="verify")
         if not ok:
             raise WireError("shm payload crc mismatch")
         magic, g, l = self._rec_hdr(off)      # seqlock re-check

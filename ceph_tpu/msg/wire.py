@@ -21,6 +21,7 @@ themselves sealed under entity keys.
 from __future__ import annotations
 
 import hmac
+import os
 import socket
 import struct
 import threading
@@ -80,6 +81,20 @@ MSG_SHM_ATTACH = 0x15        # same-host shared-memory ring handoff:
 #                              carry payloads out-of-band with only a
 #                              doorbell (meta + ring extent + crc)
 #                              crossing the socket (msg/shm_ring.py)
+MSG_REPLY_SG = 0x16          # scatter-gather REPLY: u32 metalen |
+#                              meta | raw bulk bytes — the reply value
+#                              IS the data segment, and the daemon
+#                              folds store-trusted blob csums into the
+#                              frame crc (crc32_combine) so the reply
+#                              leaves with ZERO send scans
+MSG_SHM_FREE = 0x17          # reply-ring reclaim doorbell (client ->
+#                              daemon, rid 0, no reply): the client
+#                              consumed the reply records named in the
+#                              payload, the daemon may reuse their
+#                              extents.  Ordering: the client
+#                              materializes the payload BEFORE sending
+#                              this, so the extent is never read after
+#                              it is freed.
 
 # per-connection data modes after the auth handshake (the reference's
 # ms_cluster_mode / ms_client_mode values, src/msg/msg_types.h):
@@ -108,6 +123,64 @@ class WireClosed(WireError):
 # flip them to price the legacy 3-pass/copying path against the same
 # daemons)
 _opt = crcutil.flag
+
+# observer-cached wire_device_crc MODE (a string enum, not a bool, so
+# crcutil.flag cannot carry it): auto / on / off, refreshed on config
+# set like the hot bool flags
+_dev_crc: dict = {}
+
+
+def _device_crc_mode() -> str:
+    v = _dev_crc.get("mode")
+    if v is None:
+        from ..common.options import config
+        cfg = config()
+
+        def _refresh(_n, val):
+            _dev_crc["mode"] = str(val)
+
+        cfg.observe("wire_device_crc", _refresh)
+        v = _dev_crc["mode"] = str(cfg.get("wire_device_crc"))
+    return v
+
+
+def _device_worthwhile() -> bool:
+    # backend probe cached for the process: "auto" consults it once
+    v = _dev_crc.get("worthwhile")
+    if v is None:
+        try:
+            from ..ops import crc32_gf2
+            v = bool(crc32_gf2.device_worthwhile())
+        except Exception:
+            v = False
+        _dev_crc["worthwhile"] = v
+    return v
+
+
+def receive_csums(buf, site: str = "verify") -> crcutil.Csums:
+    """THE receive-verify scanner — every inbound bulk payload
+    (socket SG frames, request-ring doorbells, reply-ring records)
+    funnels through here.  With ``wire_device_crc`` active the scan
+    is the batched ``[N,8B]@[8B,32]`` GF(2) matmul on the accelerator
+    slice (ops/crc32_gf2.csums_for: full 4-KiB blocks in ONE device
+    dispatch, the sub-block tail host-scanned and counted at
+    ``device_tail``) — ZERO host passes over the full blocks, with
+    device dispatches counted separately so the zero is falsifiable.
+    Off / auto-on-cpu / device failure: one counted host pass,
+    bit-identical verdict either way — a flipped bit fails the
+    combine on both paths."""
+    mode = _device_crc_mode()
+    if mode == "on" or (mode == "auto" and _device_worthwhile()):
+        try:
+            from ..ops import crc32_gf2
+            return crc32_gf2.csums_for(crcutil.as_u8(buf))
+        except Exception:
+            crcutil._counters().inc("device_crc_fallbacks")
+    # noqa: CTL131 — receive-direction counted host fallback of the
+    # device verify, not a reply send (flagged only because the serve
+    # loop hands this scanner to the ring readers)
+    return crcutil.Csums.scan(buf, block=crcutil.CSUM_BLOCK,  # noqa: CTL131
+                              site=site)
 
 
 def _recv_exact(sock: socket.socket, n: int) -> bytes:
@@ -332,22 +405,62 @@ def extract_bulk(req, site: str):
     return req, None, None
 
 
+class BulkReply:
+    """Handler-arm carrier for a bulk reply: the payload plus the
+    Csums the STORE already trusts for it (BlueStore blob csums via
+    read_with_csums, or a receive-verify product).  The serve loop's
+    reply chokepoint turns it into a reply-ring record (same-host:
+    zero copies, zero scans) or a MSG_REPLY_SG socket frame whose
+    crc the trusted csums FOLD into (crc32_combine — zero send
+    scans); in-process dispatch unwraps it to the raw value.  csums
+    None means no trusted digest exists (compressed blob, csums off)
+    — the send side scans once and COUNTS it, same as today."""
+
+    __slots__ = ("data", "csums")
+
+    def __init__(self, data, csums=None):
+        self.data = data
+        self.csums = csums
+
+    def to_bytes(self) -> bytes:
+        d = self.data
+        return d if isinstance(d, bytes) else bytes(d)
+
+
+def unwrap_bulk(val):
+    """Collapse BulkReply carriers to their raw values — the
+    in-process dispatch path (local OSD calls, tests poking
+    _handle_inner) sees exactly what the wire client would."""
+    if isinstance(val, BulkReply):
+        return val.to_bytes()
+    if isinstance(val, dict) and \
+            any(isinstance(v, BulkReply) for v in val.values()):
+        return {k: (v.to_bytes() if isinstance(v, BulkReply) else v)
+                for k, v in val.items()}
+    return val
+
+
 def _parse_frame(hdr: bytes, payload, mac: Optional[bytes],
                  session_key: Optional[bytes],
                  mode: str) -> Envelope:
     """Verify one received frame (crc / MAC / unseal) — shared by the
     raw-socket recv_frame and the buffered SockReader.
 
-    One-pass integrity (ZeroWire): for a scatter-gather request the
-    verify scan runs per 4-KiB sub-block of the data segment and the
+    One-pass integrity (ZeroWire): for a scatter-gather frame (either
+    direction — MSG_REQ_SG requests, MSG_REPLY_SG replies) the verify
+    scan runs per 4-KiB sub-block of the data segment and the
     sub-crcs are COMBINED (crc32_combine) against the header crc —
     same accept/reject verdict as a whole-payload crc32, but the
     sub-crcs survive the verify as TRUSTED values on the returned
     envelope, which the daemon hands to BlueStore as ready-made blob
-    csums: the store never scans payload bytes again."""
+    csums: the store never scans payload bytes again.  The scan
+    itself is ``receive_csums``: with ``wire_device_crc`` active it
+    is the GF(2) matmul on the accelerator slice and the host never
+    touches the full blocks at all."""
     magic, typ, mid, shard, ln, crc = _FHDR.unpack(hdr)
     csums = None
-    if crc and typ == MSG_REQ_SG and _opt("wire_one_pass"):
+    if crc and typ in (MSG_REQ_SG, MSG_REPLY_SG) and \
+            _opt("wire_one_pass"):
         mv = crcutil.as_u8(payload)
         if len(mv) < 4:
             raise WireError("payload crc mismatch")
@@ -357,9 +470,7 @@ def _parse_frame(hdr: bytes, payload, mac: Optional[bytes],
             raise WireError("payload crc mismatch")
         head_crc = zlib.crc32(mv[:dstart])
         crcutil.note_scan(dstart, "verify")
-        csums = crcutil.Csums.scan(mv[dstart:],
-                                   block=crcutil.CSUM_BLOCK,
-                                   site="verify")
+        csums = receive_csums(mv[dstart:], site="verify")
         got = crcutil.crc32_combine(head_crc, csums.combined,
                                     csums.length)
         if got != crc:
@@ -617,7 +728,8 @@ class Stream:
     """
 
     def __init__(self, conn, mode: str = MODE_SECURE,
-                 window: int = 16, ring=None):
+                 window: int = 16, ring=None,
+                 want_reply: bool = False, resolver=None):
         import queue as _queue
         from ..common.lockdep import LockdepLock
         self._conn = conn                  # owns the socket lifetime
@@ -627,6 +739,19 @@ class Stream:
         self.peer = getattr(conn, "peer", None)
         self.mode = MODE_SECURE
         self.ring_ok = False
+        # daemon→client reply ring (RingReply): ``want_reply`` asks
+        # for one in the MSG_SHM_ATTACH handshake; the daemon's ack
+        # names its ring file in ``reply_info`` = (path, size).  The
+        # ``resolver`` (StreamPool.resolve_reply) turns reply-ring
+        # doorbells arriving on this stream back into bytes.
+        self._want_reply = bool(want_reply)
+        self._resolver = resolver
+        self.reply_info = None
+        # MSG_SHM_FREE doorbells that hit a full send window park
+        # here and ride the front of the next free (order preserved;
+        # frees are idempotent daemon-side so a lost one only delays
+        # extent reuse until conn close)
+        self._free_backlog: list = []
         self.dead = False
         # True while the sender thread is inside sendmsg: a full
         # window + a socket-blocked sender means the PEER is the
@@ -664,11 +789,15 @@ class Stream:
         """Authenticated downgrade to crc data mode: the request and
         its ack travel sealed+MAC'd, so a middle box cannot forge the
         downgrade; only then do frames switch to crc'd plaintext
-        under header-only HMAC."""
+        under header-only HMAC.  ``reply_sg`` advertises that this
+        reader understands MSG_REPLY_SG frames — the daemon sends
+        bulk replies scatter-gather (trusted csums folded, zero send
+        scans) only to connections that said so; legacy blocking
+        clients keep getting typed replies."""
         from . import encoding
         send_frame(self.sock, Envelope(
             MSG_SET_MODE, 0, -1,
-            encoding.dumps({"mode": MODE_CRC})),
+            encoding.dumps({"mode": MODE_CRC, "reply_sg": True})),
             session_key=self.key, src=self.entity, dst=self.peer)
         env = recv_frame(self.sock, session_key=self.key)
         if env.type != MSG_REPLY:
@@ -681,17 +810,26 @@ class Stream:
         request and ack ride the authenticated connection, so only
         the cephx-verified peer learns the path.  A daemon that
         refuses (shm disabled, foreign path) leaves the stream on the
-        pure socket lane — fallback is per-stream and silent."""
+        pure socket lane — fallback is per-stream and silent.  With
+        ``want_reply`` the request also asks for the daemon→client
+        REPLY ring; an accepting daemon's ack carries its ring file
+        as ``reply_path``/``reply_size`` (one reply ring per client
+        request ring, shared by every conn of the pool)."""
         from . import encoding
         send_frame(self.sock, Envelope(
             MSG_SHM_ATTACH, 0, -1,
-            encoding.dumps({"path": ring.path, "size": ring.size})),
+            encoding.dumps({"path": ring.path, "size": ring.size,
+                            "reply": self._want_reply})),
             session_key=self.key, src=self.entity, dst=self.peer,
             mode=self.mode)
         env = recv_frame(self.sock, session_key=self.key,
                          mode=self.mode)
-        self.ring_ok = env.type == MSG_REPLY and \
-            bool(encoding.loads(bytes(env.payload)).get("ok"))
+        ack = encoding.loads(bytes(env.payload)) \
+            if env.type == MSG_REPLY else {}
+        self.ring_ok = bool(isinstance(ack, dict) and ack.get("ok"))
+        if self.ring_ok and self._want_reply and ack.get("reply_path"):
+            self.reply_info = (str(ack["reply_path"]),
+                               int(ack.get("reply_size") or 0))
 
     # --------------------------------------------------------- submit --
     def inflight(self) -> int:
@@ -749,6 +887,27 @@ class Stream:
                 self._pending.pop(rid, None)
             return False
 
+    def queue_free(self, payload: bytes) -> None:
+        """Queue one MSG_SHM_FREE reclaim doorbell (rid 0 — no
+        pending entry, the daemon never replies).  Non-blocking from
+        the reader thread: a full send window parks the doorbell on
+        the backlog, flushed by the next call; a dead stream drops
+        it (the daemon's conn-close cleanup frees the extents)."""
+        import queue as _q
+        with self._lock:
+            if self.dead:
+                return
+            items, self._free_backlog = \
+                self._free_backlog + [payload], []
+        for i, p in enumerate(items):
+            try:
+                self._sendq.put_nowait((0, p, None, None))
+            except _q.Full:
+                with self._lock:
+                    self._free_backlog = \
+                        items[i:] + self._free_backlog
+                return
+
     # -------------------------------------------------------- threads --
     def _sender_loop(self) -> None:
         import queue as _q
@@ -775,7 +934,12 @@ class Stream:
             try:
                 blobs: list = []
                 for rid, meta, data, csums in batch:
-                    if data is None:
+                    if rid == 0:
+                        # reply-ring reclaim doorbell (queue_free):
+                        # a control frame riding the same coalesced
+                        # sendmsg as the data frames around it
+                        typ, parts = MSG_SHM_FREE, [meta]
+                    elif data is None:
                         typ, parts = MSG_REQ, [meta]
                     else:
                         typ = MSG_REQ_SG
@@ -825,10 +989,22 @@ class Stream:
             cb = ent[0]
             if cb is None:
                 continue
-            result, exc = None, None
+            result, exc, poison = None, None, None
             if env.type == MSG_ERR:
                 try:
                     raise_reply_error(env.payload)
+                except Exception as e:
+                    exc = e
+            elif env.type == MSG_REPLY_SG:
+                # bulk reply: the data segment IS the reply value,
+                # already one-pass verified by _parse_frame (device
+                # crc when armed).  Materialized once here — the
+                # ownership copy out of the reader's frame buffer,
+                # same convention as the typed decoder's output —
+                # then the buffer retires.
+                try:
+                    _meta, data = split_sg(env.payload)
+                    result = bytes(data)  # noqa: CTL130 — ownership copy out of the retiring frame buffer, not an avoidable dup
                 except Exception as e:
                     exc = e
             else:
@@ -837,10 +1013,30 @@ class Stream:
                     result = encoding.loads(env.payload)
                 except Exception as e:
                     exc = e
+                if exc is None and self._resolver is not None and \
+                        isinstance(result, dict) and \
+                        len(result) == 1 and \
+                        ("_shm_reply" in result or
+                         "_shm_objs" in result):
+                    # reply-ring doorbell: resolve the ring extents
+                    # to bytes (verify scan via receive_csums) and
+                    # queue the reclaim doorbell.  A poisoned record
+                    # gets connection-drop parity with a flipped
+                    # socket frame: deliver the error, then kill the
+                    # stream so the caller's retry machinery re-asks.
+                    try:
+                        result = self._resolver(result, self)
+                    except WireError as e:
+                        result, poison = None, e
+                    except Exception as e:
+                        exc = e
             try:
-                cb(result, exc)
+                cb(result, exc if poison is None else poison)
             except Exception:
                 pass                       # callbacks must not kill IO
+            if poison is not None:
+                self._fail_all(poison)
+                return
 
     def _fail_all(self, exc: Exception) -> None:
         with self._lock:
@@ -849,6 +1045,9 @@ class Stream:
             else:
                 self.dead = True
                 pending, self._pending = self._pending, {}
+            # parked reclaim doorbells die with the conn — the
+            # daemon's conn-close cleanup frees the extents
+            self._free_backlog = []
         try:
             self.sock.close()
         except OSError:
@@ -908,6 +1107,14 @@ class StreamPool:
         self._shm_bytes = int(shm_bytes)
         self._ring_obj = None
         self._ring_dead = shm_bytes <= 0 or shm_dir is None
+        # daemon→client reply ring (RingReply): the daemon creates
+        # and bump-allocates it, this pool only MAPS it (RingReader)
+        # and reclaims consumed records via MSG_SHM_FREE doorbells.
+        # One reply ring per client request ring — a reply doorbell
+        # resolved on any stream of the pool finds the same extents.
+        self._reply_reader = None
+        self._want_reply = not self._ring_dead and \
+            crcutil.flag("wire_reply_ring")
         # True only after a stream's MSG_SHM_ATTACH was ACCEPTED: a
         # doorbell baked into a frame before the verdict is known
         # would turn an attach refusal into a hard op failure (the
@@ -981,9 +1188,22 @@ class StreamPool:
             return list(self._streams)
 
     def _grow(self) -> Stream:
+        # client-side orphan sweep on every (re)connect: a kill9'd
+        # daemon can never unlink the reply rings IT created, and
+        # the daemon that replaces it makes fresh ones — same
+        # creator-pid liveness rule as the daemon's zwring sweep at
+        # bind, mirrored (the satellite-4 ownership bugfix)
+        if self._shm_dir is not None and not self._ring_dead:
+            try:
+                from .shm_ring import sweep_stale
+                sweep_stale(self._shm_dir, prefix="zwreply")
+            except OSError:
+                pass
         # build outside the pool lock: the factory does wire RTTs
         st = Stream(self._factory(), mode=self.mode,
-                    window=self.window, ring=self._ring())
+                    window=self.window, ring=self._ring(),
+                    want_reply=self._want_reply,
+                    resolver=self.resolve_reply)
         if self._ring() is not None:
             with self._lock:
                 if st.ring_ok:
@@ -994,9 +1214,92 @@ class StreamPool:
                     # doorbell routed to a ring-less connection
                     # would error)
                     self._ring_dead = True
+        if st.reply_info is not None:
+            self._open_reply_reader(*st.reply_info)
         with self._lock:
             self._streams.append(st)
         return st
+
+    def _open_reply_reader(self, path: str, size: int) -> None:
+        """Map the daemon's reply ring named in an accepted attach
+        ack.  Mirrors the daemon's own path check: the ring file must
+        live in this pool's shm dir (next to the daemon socket) — an
+        ack naming a foreign path leaves the reply lane off.  The
+        ring PATH keys the daemon generation (creator pid + random
+        token in the filename): an ack naming a different path means
+        the daemon restarted and made a fresh ring, so the stale
+        mapping is replaced — resolving a new doorbell against the
+        dead generation's mmap would fail every retry forever."""
+        with self._lock:
+            cur = self._reply_reader
+            if self._ring_dead or \
+                    (cur is not None and cur.path == path):
+                return
+        if self._shm_dir is None or os.path.dirname(
+                os.path.realpath(path)) != os.path.realpath(
+                    self._shm_dir):
+            return
+        try:
+            from .shm_ring import RingReader
+            rd = RingReader(path, size)
+        except (OSError, IOError):  # noqa: CTL603 — the reply ring
+            # is an OPTIMIZATION lane: a map failure here must not
+            # poison the pool (the daemon falls back to MSG_REPLY_SG
+            # socket frames for every reply it cannot ring), so
+            # "absent reader" is the correct, fully-served state.
+            return
+        stale = None
+        with self._lock:
+            cur = self._reply_reader
+            if cur is not None and cur.path == path:
+                rd.close()            # raced with another _grow
+                return
+            stale, self._reply_reader = cur, rd
+        if stale is not None:
+            stale.close()
+
+    def resolve_reply(self, result: dict, stream: Stream):
+        """Resolve a reply-ring doorbell (called from a stream reader
+        thread): read each named extent through ``receive_csums``
+        (device crc when armed — zero host passes), materialize the
+        bytes, THEN queue the MSG_SHM_FREE reclaim doorbell — the
+        daemon never reuses an extent before its free arrives, so the
+        read is race-free by construction.  ``_shm_reply`` marks a
+        whole-reply bulk value; ``_shm_objs`` a recovery-pull dict
+        whose values may each be a ring extent.  WireError (torn or
+        poisoned record) propagates — the caller kills the stream,
+        connection-drop parity with a flipped socket frame."""
+        rd = self._reply_reader
+        if rd is None:
+            raise WireError("reply doorbell without a mapped "
+                            "reply ring")
+        pc = crcutil._counters()
+        frees: list = []
+        try:
+            if "_shm_reply" in result:
+                meta = result["_shm_reply"]
+                view, _cs = rd.read(meta, scanner=receive_csums)
+                out = bytes(view)
+                frees.append([int(meta[0]), int(meta[2])])
+                pc.inc("shm_reply_frames_served")
+                pc.inc("shm_reply_bytes_served", len(out))
+                return out
+            objs = result["_shm_objs"]
+            out_d: dict = {}
+            for oid, m in objs.items():
+                if isinstance(m, (list, tuple)):
+                    view, _cs = rd.read(m, scanner=receive_csums)
+                    out_d[oid] = bytes(view)
+                    frees.append([int(m[0]), int(m[2])])
+                    pc.inc("shm_reply_frames_served")
+                    pc.inc("shm_reply_bytes_served", len(out_d[oid]))
+                else:
+                    out_d[oid] = m    # inline bytes / None
+            return out_d
+        finally:
+            if frees:
+                from . import encoding
+                stream.queue_free(encoding.dumps(frees))
 
     def submit(self, req_meta: bytes, data=None, cb=None,
                csums=None) -> None:
@@ -1050,8 +1353,11 @@ class StreamPool:
         with self._lock:
             streams, self._streams = self._streams, []
             ring, self._ring_obj = self._ring_obj, None
+            reply_rd, self._reply_reader = self._reply_reader, None
             self._ring_dead = True
         for s in streams:
             s.close()
         if ring is not None:
             ring.close(unlink=True)
+        if reply_rd is not None:
+            reply_rd.close()          # the DAEMON owns the unlink

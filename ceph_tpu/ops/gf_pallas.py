@@ -80,6 +80,88 @@ def _bitplane_matmul_pallas(bitmat, data):
         )(bitmat, data)
 
 
+def _fused_kernel(bitmat_ref, crcA_ref, data_ref,
+                  par_ref, dcrc_ref, pcrc_ref):
+    """One ragged block: data [1, k, T] u8 -> parity [1, m, T] u8 plus
+    the crc32 BIT accumulators of every data and parity row ([1, k, 32]
+    and [1, m, 32] i32, packed to u32 values by the caller — the bit
+    packing needs u32 shifts Mosaic's vector path dislikes, and at 32
+    lanes per row it is free outside).
+
+    Fusion shape: ONE bit unpack feeds the GF(2^8) MXU matmul and the
+    crc GF(2) contraction, and the parity rows' crcs are contracted
+    straight from the parity bit planes before byte packing.  The crc
+    matrix arrives pre-sliced per bit plane (crcA [8, T, 32] i8 with
+    crcA[b, t] = A[8t+b] of crc32_gf2.crc_matrix), so each plane is a
+    plain [*, T] x [T, 32] dot — no in-kernel transposes, which this
+    Mosaic will not legalize (same constraint family as the i32-only
+    bit twiddling in _kernel above)."""
+    d = data_ref[0]                              # [k, T] uint8
+    k, T = d.shape
+    shifts = jax.lax.broadcasted_iota(jnp.int32, (k, 8, T), 1)
+    bits3 = ((d[:, None, :].astype(jnp.int32) >> shifts) & 1)
+    gf_bits = bits3.reshape(8 * k, T).astype(jnp.int8)
+    acc = jax.lax.dot_general(
+        bitmat_ref[:], gf_bits, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32)        # [8m, T]
+    m = acc.shape[0] // 8
+    bit_p = (acc & 1).reshape(m, 8, T)
+    out = bit_p[:, 0, :]
+    for b in range(1, 8):
+        out = out | (bit_p[:, b, :] << b)
+    par_ref[0] = out.astype(jnp.uint8)
+    dacc = jnp.zeros((k, 32), jnp.int32)
+    pacc = jnp.zeros((m, 32), jnp.int32)
+    for b in range(8):
+        Ab = crcA_ref[b]                         # [T, 32] int8
+        dacc = dacc + jax.lax.dot_general(
+            bits3[:, b, :].astype(jnp.int8), Ab,
+            (((1,), (0,)), ((), ())), preferred_element_type=jnp.int32)
+        pacc = pacc + jax.lax.dot_general(
+            bit_p[:, b, :].astype(jnp.int8), Ab,
+            (((1,), (0,)), ((), ())), preferred_element_type=jnp.int32)
+    dcrc_ref[0] = dacc & 1
+    pcrc_ref[0] = pacc & 1
+
+
+def fused_ragged_matmul(bitmat, crcA8, pool):
+    """TPU dispatch of the fused ragged traversal: bitmat [8m, 8k]
+    int8, crcA8 [8, T, 32] int8 (ragged_fused._crc_a8), pool
+    [G, k, T] uint8 -> (parity [G, m, T] u8, data crc bits
+    [G, k, 32] i32, parity crc bits [G, m, 32] i32).  One grid program
+    per staged block; the matrices stay VMEM-resident across the
+    grid.  Bit-identical to ragged_fused.fused_block_math (asserted
+    on TPU by tests/test_ragged_fused.py; gated by :func:`available`).
+    """
+    from jax.experimental import pallas as pl
+    bitmat = jnp.asarray(bitmat, jnp.int8)
+    crcA8 = jnp.asarray(crcA8, jnp.int8)
+    pool = jnp.asarray(pool, jnp.uint8)
+    G, k, T = pool.shape
+    m = bitmat.shape[0] // 8
+    with jax.enable_x64(False):
+        return pl.pallas_call(
+            _fused_kernel,
+            out_shape=(
+                jax.ShapeDtypeStruct((G, m, T), jnp.uint8),
+                jax.ShapeDtypeStruct((G, k, 32), jnp.int32),
+                jax.ShapeDtypeStruct((G, m, 32), jnp.int32),
+            ),
+            grid=(G,),
+            in_specs=[
+                pl.BlockSpec((bitmat.shape[0], bitmat.shape[1]),
+                             lambda g: (0, 0)),
+                pl.BlockSpec((8, T, 32), lambda g: (0, 0, 0)),
+                pl.BlockSpec((1, k, T), lambda g: (g, 0, 0)),
+            ],
+            out_specs=(
+                pl.BlockSpec((1, m, T), lambda g: (g, 0, 0)),
+                pl.BlockSpec((1, k, 32), lambda g: (g, 0, 0)),
+                pl.BlockSpec((1, m, 32), lambda g: (g, 0, 0)),
+            ),
+        )(bitmat, crcA8, pool)
+
+
 def available() -> bool:
     """Pallas path only on real TPU backends."""
     try:

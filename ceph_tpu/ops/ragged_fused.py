@@ -1,0 +1,305 @@
+"""Fused ragged GF(2^8) encode + per-block crc32 — one traversal.
+
+Mixed-size serving batches (S3Serve's zipf object profile) are RAGGED:
+padding every object to the batch max before the EC matmul moves and
+multiplies bytes that exist only to squarify the rectangle.  This
+module stages a ragged batch the way Ragged Paged Attention stages
+ragged sequences (PAPERS 2604.15464): a flat pool of fixed 4 KiB
+blocks plus row-offset/length DESCRIPTORS, so the kernel's unit of
+work is a block that really exists, not a rectangle row.
+
+The fusion: the GF(2^8) bit-plane matmul (ops/gf_jax.py) and the crc32
+GF(2) matmul (ops/crc32_gf2.py) both consume the SAME bit-unpacked
+view of the staged bytes, so one dispatch computes parity AND the
+per-4 KiB crc sub-words of every data row in a single traversal — and
+the parity rows' sub-crcs come straight off the parity BIT planes
+before they are even packed to bytes, a pass no unfused pipeline can
+skip.  Those sub-crcs are exactly the `Csums` the wire tier folds via
+crc32_combine and BlueStore adopts as blob csums, so a fused encode
+leaves nothing for the host to scan but sub-block tails.
+
+Correctness shape: GF(2^8) matmul is LANE-WISE over byte positions
+(out[i, l] depends only on column l of the inputs), so per-block
+staging with zero-padded tails yields parity bit-identical to the
+padded-rectangle path after cropping — asserted against
+:func:`encode_padded` by tests/test_ragged_fused.py, including 1-byte
+and tail-block objects.  Device block crcs are used for FULL blocks
+only; a tail's crc is a host scan of the valid prefix (counted at
+``device_tail``, same convention as crc32_gf2.csums_many).
+
+Dispatch: the 2-D data plane (parallel/data_plane.py) shards the block
+pool over mesh rows when enabled; otherwise a single-device jit.  On
+TPU the Pallas kernel (ops/gf_pallas.fused_ragged_matmul) keeps the 8x
+bit expansion in VMEM; XLA everywhere else (and it is the bit-identity
+path of record on CPU CI).
+"""
+from __future__ import annotations
+
+import functools
+import zlib
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..common import crcutil
+from . import crc32_gf2, gf
+
+TILE = crcutil.CSUM_BLOCK        # 4096: crc sub-word == staging block
+
+
+class RaggedBatch:
+    """A packed ragged batch: ``pool`` [G, k, TILE] uint8 (zero-padded
+    tails) plus per-block descriptors ``desc`` [G, 2] int32 of
+    (object index, valid byte count) — an object's blocks are
+    contiguous in pool order, so the descriptor table is the whole
+    page-table analogy: the kernel sees dense blocks, the unpack walks
+    the table."""
+
+    __slots__ = ("pool", "desc", "lengths", "k", "tile")
+
+    def __init__(self, pool: np.ndarray, desc: np.ndarray,
+                 lengths: List[int], k: int, tile: int):
+        self.pool = pool
+        self.desc = desc
+        self.lengths = lengths
+        self.k = k
+        self.tile = tile
+
+    def rect_bytes(self, m: int) -> int:
+        """Bytes the padded-rectangle path moves for this batch:
+        every object padded to the batch max, k data + m parity."""
+        if not self.lengths:
+            return 0
+        return len(self.lengths) * (self.k + m) * max(self.lengths)
+
+    def fused_bytes(self, m: int) -> int:
+        """Bytes the fused path moves: only blocks that exist."""
+        return int(self.pool.shape[0]) * (self.k + m) * self.tile
+
+    def padding_avoided(self, m: int) -> int:
+        """The headline delta: rectangle padding the descriptor
+        layout never stages (>= 0 by construction — a block pool pads
+        each object to a TILE multiple, never to the batch max)."""
+        return max(0, self.rect_bytes(m) - self.fused_bytes(m))
+
+
+def pack(shards: Sequence[np.ndarray], tile: int = TILE) -> RaggedBatch:
+    """Stage ragged shard groups into the block pool.  ``shards`` is a
+    sequence of [k, L_i] uint8 arrays with a common k and ragged L_i
+    (>= 1 — even a 1-byte object owns one zero-padded block, because
+    its parity still has to come out of the matmul)."""
+    if not shards:
+        raise ValueError("empty ragged batch")
+    k = int(shards[0].shape[0])
+    lengths: List[int] = []
+    blocks: List[np.ndarray] = []
+    desc: List[Tuple[int, int]] = []
+    for i, s in enumerate(shards):
+        a = np.ascontiguousarray(s, dtype=np.uint8)
+        if a.ndim != 2 or a.shape[0] != k:
+            raise ValueError(f"shard group {i}: want [k={k}, L] rows")
+        L = int(a.shape[1])
+        if L <= 0:
+            raise ValueError(f"shard group {i}: empty object")
+        lengths.append(L)
+        n_blk = -(-L // tile)
+        pad = n_blk * tile - L
+        if pad:
+            a = np.pad(a, ((0, 0), (0, pad)))
+        for b in range(n_blk):
+            blocks.append(a[:, b * tile:(b + 1) * tile])
+            desc.append((i, min(tile, L - b * tile)))
+    pool = np.stack(blocks, axis=0)
+    return RaggedBatch(pool, np.asarray(desc, dtype=np.int32),
+                       lengths, k, tile)
+
+
+class RaggedResult:
+    """Per-object outputs of one fused (or comparator) encode:
+    ``parity[i]`` [m, L_i] uint8; ``data_csums[i]`` / ``parity_csums[i]``
+    are the k (resp. m) per-row :class:`crcutil.Csums` — the trusted
+    sub-crcs the wire/store tiers consume without rescanning."""
+
+    __slots__ = ("parity", "data_csums", "parity_csums")
+
+    def __init__(self, parity, data_csums, parity_csums):
+        self.parity = parity
+        self.data_csums = data_csums
+        self.parity_csums = parity_csums
+
+
+def _crc_a8(tile: int) -> Tuple[np.ndarray, int]:
+    """crc32_gf2.crc_matrix reshaped for per-bit-plane contraction:
+    A8 [8, tile, 32] int8 with A8[b, t] = A[8t+b] — the layout that
+    lets a kernel contract bit plane b of a block row against one
+    [tile, 32] slab (no in-kernel transposes)."""
+    A, const = crc32_gf2.crc_matrix(tile)
+    A8 = np.ascontiguousarray(
+        A.reshape(tile, 8, 32).transpose(1, 0, 2).astype(np.int8))
+    return A8, const
+
+
+def fused_block_math(bitmat, crcA8, const: int, pool):
+    """The one-traversal math, traceable (shared by the single-device
+    jit, the data-plane shard_map body, and — in spirit — the Pallas
+    kernel): pool [G, k, T] uint8 -> (parity [G, m, T] uint8,
+    data block crcs [G, k] uint32, parity block crcs [G, m] uint32).
+
+    One bit-unpack feeds BOTH contractions, and the parity crcs are
+    contracted from the parity BIT planes before packing — the
+    traversal the unfused pipeline pays twice (encode pass + crc
+    scan) happens once."""
+    import jax.numpy as jnp
+    G, k, T = pool.shape
+    shifts = jnp.arange(8, dtype=jnp.uint8)
+    bits = ((pool[..., None] >> shifts) & jnp.uint8(1))   # [G, k, T, 8]
+    # GF(2^8) leg: bit b of symbol row j at plane row 8j+b
+    gf_bits = bits.transpose(0, 1, 3, 2).reshape(
+        G, 8 * k, T).astype(jnp.int8)
+    acc = jnp.einsum("rc,gct->grt", bitmat.astype(jnp.int8), gf_bits,
+                     preferred_element_type=jnp.int32) & 1
+    m = acc.shape[1] // 8
+    pbits = acc.reshape(G, m, 8, T).astype(jnp.uint8)     # [G, m, 8, T]
+    parity = (pbits << shifts[None, None, :, None]).sum(
+        2, dtype=jnp.uint8)                               # [G, m, T]
+    # crc leg: contract each row's bit plane b against A8[b] and
+    # accumulate — data rows from the staged bits, parity rows from
+    # the matmul's own bit planes (never re-unpacked)
+    crcA8 = crcA8.astype(jnp.int8)
+    dacc = jnp.einsum("gkbt,btc->gkc",
+                      bits.transpose(0, 1, 3, 2).astype(jnp.int8),
+                      crcA8, preferred_element_type=jnp.int32) & 1
+    pacc = jnp.einsum("gjbt,btc->gjc", pbits.astype(jnp.int8),
+                      crcA8, preferred_element_type=jnp.int32) & 1
+    weights = (jnp.uint32(1) << jnp.arange(32, dtype=jnp.uint32))
+    dcrc = jnp.sum(dacc.astype(jnp.uint32) * weights, axis=-1,
+                   dtype=jnp.uint32) ^ jnp.uint32(const)
+    pcrc = jnp.sum(pacc.astype(jnp.uint32) * weights, axis=-1,
+                   dtype=jnp.uint32) ^ jnp.uint32(const)
+    return parity, dcrc, pcrc
+
+
+@functools.lru_cache(maxsize=64)
+def _jit_fused(tile: int):
+    import jax
+    import jax.numpy as jnp
+    A8, const = _crc_a8(tile)
+    A8_dev = jnp.asarray(A8)
+
+    @jax.jit
+    def fn(bitmat, pool):
+        return fused_block_math(bitmat, A8_dev, const, pool)
+
+    return fn
+
+
+def _dispatch(bitmat_np: np.ndarray, batch: RaggedBatch,
+              impl: str) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Route one pool through the best available engine: 2-D data
+    plane when enabled, the Pallas VMEM kernel on real TPUs, the XLA
+    jit otherwise.  All three are bit-identical (lane-wise math)."""
+    import jax.numpy as jnp
+    from ..parallel import data_plane
+    pl = data_plane.plane() if impl in ("auto", "plane") else None
+    if pl is not None:
+        parity, dcrc, pcrc = pl.fused_ragged(bitmat_np, batch.pool,
+                                             batch.tile)
+    else:
+        from . import gf_pallas
+        if impl in ("auto", "pallas") and gf_pallas.available():
+            A8, const = _crc_a8(batch.tile)
+            parity, dbits, pbits_ = gf_pallas.fused_ragged_matmul(
+                bitmat_np, A8, batch.pool)
+            w = np.uint64(1) << np.arange(32, dtype=np.uint64)
+            dcrc = ((np.asarray(dbits).astype(np.uint64) * w).sum(-1)
+                    .astype(np.uint32) ^ np.uint32(const))
+            pcrc = ((np.asarray(pbits_).astype(np.uint64) * w).sum(-1)
+                    .astype(np.uint32) ^ np.uint32(const))
+            return np.asarray(parity), dcrc, pcrc
+        parity, dcrc, pcrc = _jit_fused(batch.tile)(
+            jnp.asarray(bitmat_np, jnp.int8),
+            jnp.asarray(batch.pool, jnp.uint8))
+    return (np.asarray(parity), np.asarray(dcrc).astype(np.uint32),
+            np.asarray(pcrc).astype(np.uint32))
+
+
+def encode(A: np.ndarray, shards: Sequence[np.ndarray],
+           impl: str = "auto") -> RaggedResult:
+    """Fused ragged encode: parity AND trusted per-4 KiB sub-crcs for
+    every data/parity row of every ragged object, one traversal.
+
+    ``A`` [m, k] GF(2^8) parity matrix; ``shards[i]`` [k, L_i] uint8.
+    Device crcs cover FULL blocks; tail prefixes are host-scanned
+    (counted, ``device_tail``).  The staged pool bytes ride the
+    ``device_crc_bytes``-style accounting via the returned Csums'
+    consumers; the padding win is :meth:`RaggedBatch.padding_avoided`.
+    """
+    A = np.ascontiguousarray(A, dtype=np.uint8)
+    m = int(A.shape[0])
+    batch = pack(shards)
+    bitmat = gf.gf8_bitmatrix(A)
+    parity_pool, dcrc, pcrc = _dispatch(bitmat, batch, impl)
+    tile = batch.tile
+    # unpack the descriptor table back into per-object rows
+    parities: List[np.ndarray] = []
+    data_csums: List[List[crcutil.Csums]] = []
+    parity_csums: List[List[crcutil.Csums]] = []
+    g = 0
+    for i, L in enumerate(batch.lengths):
+        n_blk = -(-L // tile)
+        blocks = slice(g, g + n_blk)
+        par = parity_pool[blocks].transpose(1, 0, 2).reshape(
+            m, n_blk * tile)[:, :L]
+        parities.append(np.ascontiguousarray(par))
+        n_full = L // tile
+        tail = L - n_full * tile
+        drows: List[crcutil.Csums] = []
+        for j in range(batch.k):
+            subs = [int(c) for c in dcrc[g:g + n_full, j]]
+            if tail:
+                subs.append(zlib.crc32(
+                    shards[i][j, n_full * tile:L].tobytes()))
+                crcutil.note_scan(tail, "device_tail")
+            drows.append(crcutil.Csums(tile, subs, L))
+        data_csums.append(drows)
+        prows: List[crcutil.Csums] = []
+        for j in range(m):
+            subs = [int(c) for c in pcrc[g:g + n_full, j]]
+            if tail:
+                subs.append(zlib.crc32(par[j, n_full * tile:].tobytes()))
+                crcutil.note_scan(tail, "device_tail")
+            prows.append(crcutil.Csums(tile, subs, L))
+        parity_csums.append(prows)
+        g += n_blk
+    return RaggedResult(parities, data_csums, parity_csums)
+
+
+def encode_padded(A: np.ndarray, shards: Sequence[np.ndarray]
+                  ) -> RaggedResult:
+    """The unfused padded-rectangle comparator (and bit-identity
+    oracle of record): pad every object to the batch max, run the
+    plain gf_jax bit-plane matmul, then pay the SEPARATE host crc
+    scan over every data and parity row (counted at ``unfused`` —
+    exactly the double traversal the fused path deletes)."""
+    import jax.numpy as jnp
+    from . import gf_jax
+    A = np.ascontiguousarray(A, dtype=np.uint8)
+    m = int(A.shape[0])
+    lens = [int(s.shape[1]) for s in shards]
+    Lmax = max(lens)
+    k = int(shards[0].shape[0])
+    rect = np.zeros((len(shards), k, Lmax), dtype=np.uint8)
+    for i, s in enumerate(shards):
+        rect[i, :, :lens[i]] = s
+    out = np.asarray(gf_jax.bitplane_matmul(
+        jnp.asarray(gf.gf8_bitmatrix(A), jnp.int8),
+        jnp.asarray(rect, jnp.uint8)))
+    parities = [np.ascontiguousarray(out[i][:, :lens[i]])
+                for i in range(len(shards))]
+    data_csums = [[crcutil.Csums.scan(np.ascontiguousarray(s[j]),
+                                      block=TILE, site="unfused")
+                   for j in range(k)] for s in shards]
+    parity_csums = [[crcutil.Csums.scan(p[j], block=TILE,
+                                        site="unfused")
+                     for j in range(m)] for p in parities]
+    return RaggedResult(parities, data_csums, parity_csums)

@@ -516,6 +516,66 @@ class ShardedDataPlane:
         return out.reshape(lead + (R, W)) if lead else \
             out.reshape(R, W)
 
+    def fused_ragged(self, bitmat_np: np.ndarray, pool: np.ndarray,
+                     tile: int):
+        """Sharded dispatch of the fused ragged encode+crc traversal
+        (ops/ragged_fused.fused_block_math): the block pool [G, k, T]
+        batch-shards over STRIPE rows (2-D) or the shard axis (1-D)
+        while the GF bit-matrix and the crc matrix replicate — the
+        block-granular analogue of xor_matmul_w32's stripe split.
+        Zero pad blocks in, zero parity + crc-of-zero-block out,
+        sliced off before return, so the result is bit-identical to
+        the single-device jit on any mesh layout (the contraction is
+        lane-wise — an axis split changes layout, never values).
+        Returns (parity [G, m, T] u8, data crcs [G, k] u32, parity
+        crcs [G, m] u32)."""
+        import jax.numpy as jnp
+        from .mesh import SHARD_AXIS, STRIPE_AXIS, mesh_cache_key
+        from ..ops import ragged_fused
+        G, k, T = (int(pool.shape[0]), int(pool.shape[1]),
+                   int(pool.shape[2]))
+        m = int(bitmat_np.shape[0]) // 8
+        key = ("ragged", m, k, T, int(tile)) + mesh_cache_key(self.mesh)
+        step = self._steps.get(key)
+        if step is None:
+            import jax
+            from jax.experimental.shard_map import shard_map
+            from jax.sharding import PartitionSpec as P
+            from ..common.jit_profile import wrap as _jit_wrap
+            A8, const = ragged_fused._crc_a8(int(tile))
+            A8_dev = jnp.asarray(A8)
+            axis = STRIPE_AXIS if self.is_2d else SHARD_AXIS
+
+            def local(bm, pl):
+                par, dcrc, pcrc = ragged_fused.fused_block_math(
+                    bm, A8_dev, const, pl)
+                return par, dcrc, pcrc
+
+            spec = P(axis)
+            step = self._steps[key] = _jit_wrap(
+                jax.jit(shard_map(
+                    local, mesh=self.mesh,
+                    in_specs=(P(), spec),
+                    out_specs=(spec, spec, spec),
+                    check_rep=False)),
+                "data_plane.ragged", f"k={k} m={m}")
+        rows = self.n_rows if self.is_2d else self.n_shards
+        gpad = (-G) % rows
+        p3 = jnp.asarray(pool, jnp.uint8)
+        if gpad:
+            p3 = jnp.pad(p3, ((0, gpad), (0, 0), (0, 0)))
+        from jax.sharding import PartitionSpec as P
+        axis = STRIPE_AXIS if self.is_2d else SHARD_AXIS
+        p3 = self._commit(p3, P(axis))
+        parity, dcrc, pcrc = step(jnp.asarray(bitmat_np, jnp.int8), p3)
+        self.account("ragged", G, (k + m) * T, padded_rows=G + gpad)
+        parity, dcrc, pcrc = parity[:G], dcrc[:G], pcrc[:G]
+        if self.is_2d:
+            parity = self._canonical(parity)
+            dcrc = self._canonical(dcrc)
+            pcrc = self._canonical(pcrc)
+        return parity, dcrc, pcrc
+
     def psum_probe(self) -> Optional[int]:
         """Read back the latest dispatch's cross-shard psum (ONE
         host sync, on demand — tests/smokes verify the collective;

@@ -6,8 +6,12 @@ where ``parsed`` is the headline JSON line bench.py prints
 (``{"metric", "unit", "value", "vs_baseline", "extras": {...}}``).
 This script diffs the named headline metrics between the newest two
 snapshots and exits nonzero when any of them regressed by more than
-the threshold (default 30%).  Higher is better for every metric in
-the headline set (they are all throughput/rate numbers).
+the threshold (default 30%).  Higher is better for throughput/rate
+metrics; the LOWER_BETTER set (per-byte cost counters: daemon crc
+passes/MiB, reply-lane copies/MiB) regresses when it RISES — and a
+zero-to-nonzero move on those is always a regression, threshold or
+not (the whole point of a counter-backed zero is that it cannot
+quietly stop being zero).
 
 Usage:
     python scripts/bench_compare.py [--dir REPO] [--threshold 0.30]
@@ -33,7 +37,19 @@ HEADLINE = (
     "extras.crush_mappings_per_s",
     "extras.cluster_system.put_gbps",
     "extras.cluster_system.degraded_get_gbps",
+    # RingReply per-byte cost counters (lower is better): the
+    # device-resident daemon's host crc passes and the reply lane's
+    # send passes / copies — all 0 after ISSUE 20; a rise fails the
+    # smoke gate
+    "extras.wire_zero.after_device.crc_passes_per_mib",
+    "extras.wire_zero.reply.after.send_passes_per_mib",
+    "extras.wire_zero.reply.after.copies_per_mib",
 )
+
+# metrics where a RISE is the regression (per-byte costs, not rates)
+LOWER_BETTER = frozenset(
+    n for n in HEADLINE
+    if n.endswith("_per_mib"))
 
 
 def _load_parsed(path: str):
@@ -70,16 +86,33 @@ def _lookup(parsed: dict, name: str):
 
 
 def compare(old: dict, new: dict, threshold: float):
-    """Return (rows, regressions) comparing headline metrics."""
+    """Return (rows, regressions) comparing headline metrics.
+    Rate metrics regress on a drop past the threshold; LOWER_BETTER
+    cost counters regress on a rise — including any move off an
+    exact 0 (no threshold shelters breaking a counter-backed zero)."""
     rows, regressions = [], []
     for name in HEADLINE:
         a, b = _lookup(old, name), _lookup(new, name)
-        if a is None or b is None or not a:
+        if a is None or b is None:
             continue
-        delta = (b - a) / abs(a)
-        rows.append((name, a, b, delta))
-        if delta < -threshold:
-            regressions.append((name, a, b, delta))
+        if name in LOWER_BETTER:
+            if a == 0:
+                if b == 0:
+                    rows.append((name, a, b, 0.0))
+                    continue
+                delta = float("inf")
+            else:
+                delta = (b - a) / abs(a)
+            rows.append((name, a, b, delta))
+            if delta > threshold or (a == 0 and b > 0):
+                regressions.append((name, a, b, delta))
+        else:
+            if not a:
+                continue
+            delta = (b - a) / abs(a)
+            rows.append((name, a, b, delta))
+            if delta < -threshold:
+                regressions.append((name, a, b, delta))
     return rows, regressions
 
 
@@ -111,10 +144,12 @@ def main(argv=None) -> int:
     print(f"bench_compare: {os.path.basename(old_p)} -> "
           f"{os.path.basename(new_p)}  (threshold "
           f"{ns.threshold:.0%})")
+    bad = {name for name, *_ in regressions}
     for name, a, b, delta in rows:
-        flag = "  REGRESSED" if delta < -ns.threshold else ""
+        flag = "  REGRESSED" if name in bad else ""
+        arrow = " (lower is better)" if name in LOWER_BETTER else ""
         print(f"  {name:44s} {a:12.3f} -> {b:12.3f}  "
-              f"{delta:+7.1%}{flag}")
+              f"{delta:+7.1%}{flag}{arrow}")
     if not rows:
         print("  (no shared headline metrics)")
     if regressions:

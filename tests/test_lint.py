@@ -1595,6 +1595,73 @@ def test_ctl130_objecter_fanout_and_helper_and_noqa(tmp_path):
     assert "reached from 'fanout'" in res.findings[0].msg
 
 
+# ------------------------------ CTL131: reply-direction rescans ---
+
+def test_ctl131_reply_rescan_and_chokepoint(tmp_path):
+    """Positive: a reply sender that rescans payload bytes; negative:
+    the combine chokepoint (calls crc32_combine) and a non-reply
+    sender stay clean."""
+    write(tmp_path, "cluster/srv.py", """\
+        import zlib
+
+        def send_reply(conn, rid, data):
+            crc = zlib.crc32(data)
+            return prepare_frame(conn, MSG_REPLY, rid, [data], crc)
+
+        def send_reply_folded(conn, rid, data, csums):
+            crc = crc32_combine(0, csums.combined, len(data))
+            return prepare_frame(conn, MSG_REPLY_SG, rid, [data], crc)
+
+        def send_request(conn, rid, data):
+            crc = zlib.crc32(data)        # request lane: CTL130 turf
+            return prepare_frame(conn, MSG_REQ, rid, [data], crc)
+        """)
+    res = lint(tmp_path, select=["CTL131"])
+    assert [(f.path, f.line) for f in res.findings] == \
+        [("cluster/srv.py", 4)], res.findings
+    assert "fold" in res.findings[0].msg
+
+
+def test_ctl131_reaches_helper_scope_and_noqa(tmp_path):
+    """Interprocedural: a scan inside a helper the reply sender
+    reaches over the program graph; # noqa suppresses; msg/-external
+    modules are out of scope."""
+    write(tmp_path, "msg/srv.py", """\
+        def _digest(data):
+            return crcutil.Csums.scan(data)
+
+        def push_reply(ring, rid, data):
+            ring.put(data, _digest(data).combined)
+            return MSG_REPLY_SG
+
+        def push_reply_counted(ring, rid, data):
+            cs = crcutil.Csums.scan(data)  # noqa: CTL131 — counted fallback
+            return ring.put(data, cs.combined)
+        """)
+    write(tmp_path, "rgw/gw.py", """\
+        import zlib
+
+        def send_reply(conn, data):
+            crc = zlib.crc32(data)        # rgw/: out of scope
+            return prepare_frame(conn, MSG_REPLY, 0, [data], crc)
+        """)
+    res = lint(tmp_path, select=["CTL131"])
+    assert [(f.path, f.line) for f in res.findings] == \
+        [("msg/srv.py", 2)], res.findings
+    assert "reached from 'push_reply'" in res.findings[0].msg
+
+
+def test_ctl131_real_tree_reply_lane_is_scan_clean():
+    """The RingReply reply lane itself: zero un-noqa'd rescans in
+    msg/ + cluster/ — the fold chokepoint is the only sender-side
+    crc source."""
+    res = runner.run(str(REPO),
+                     paths=["ceph_tpu/msg", "ceph_tpu/cluster"],
+                     select=["CTL131"])
+    assert not res.findings, "\n".join(
+        f.render() for f in res.findings)
+
+
 def test_ctl130_real_tree_hot_path_is_view_clean():
     """The refactored wire spine itself: zero un-noqa'd copy
     patterns in msg/ + the async objecter (the tree gate covers

@@ -1,0 +1,336 @@
+"""RingReply (ISSUE 20) — daemon→client shm reply ring.
+
+PR-15 proved the REQUEST direction (client-created ``zwring``); this
+file is the mirror suite for the REPLY direction the daemon owns
+(``zwreply``): same seqlock + doorbell-on-socket safety model, with
+the ownership roles swapped — the daemon bump-allocates, the client
+maps/reclaims, and orphan sweeping crosses over (clients sweep dead
+daemons' reply rings, daemons sweep dead clients' request rings).
+
+What this file proves, falsifiably:
+
+  * sweep ownership — ``sweep_stale(prefix="zwreply")`` reaps ONLY
+    dead-creator reply rings and never touches request rings (and
+    vice versa), so neither side can reap the other's live lane;
+  * a bit flipped in a reply-ring record is REJECTED with the same
+    verdict by the host verify scan and the device-crc scanner
+    (``wire.receive_csums`` under ``wire_device_crc=on``) — the
+    fallback-parity contract at the ring layer;
+  * a full reply ring returns None from ``put`` (the daemon's
+    MSG_REPLY_SG socket fallback trigger), never a clobbered extent;
+  * secure mode / no-shm pools never even ASK for a reply ring
+    (``_want_reply`` stays off), and the ``wire_reply_ring`` option
+    kills it independently;
+  * over live daemons: same-host gets ride the reply ring (client
+    ``shm_reply_*_served`` counters move) and MSG_SHM_FREE reclaim
+    keeps a small ring serving an unbounded get stream; with the
+    ring disabled, bulk replies ride MSG_REPLY_SG with the trusted
+    blob csums FOLDED into the frame crc — the daemon's send path
+    re-scans nothing;
+  * a ``wire.flip_bit`` armed INSIDE the daemon (asok
+    ``fault_injection``, site ``shm_ring``) poisons the reply record:
+    the client's resolve drops the connection exactly like a flipped
+    socket frame, and the retried get completes with correct bytes;
+  * kill9 of a daemon orphans its reply rings; the retried get
+    completes (socket / surviving replica), and a reconnecting client
+    SWEEPS the orphans — ring files do not accumulate (the
+    ISSUE 20 sweep-ownership bugfix's regression test).
+"""
+import os
+import tempfile
+import time
+
+import pytest
+
+from ceph_tpu.common import crcutil
+from ceph_tpu.common.admin import admin_request
+from ceph_tpu.common.options import config
+from ceph_tpu.common.perf_counters import perf
+from ceph_tpu.msg import shm_ring, wire
+
+N_OSDS = 2
+
+
+# ------------------------------------------------------ sweep ownership ---
+
+def test_sweep_prefix_separates_request_and_reply_ownership(tmp_path):
+    import subprocess
+    d = str(tmp_path)
+    p = subprocess.Popen(["true"])
+    p.wait()                              # reaped: pid provably dead
+    dead_req = os.path.join(d, f"zwring.osd.0.{p.pid}.aa00")
+    dead_rep = os.path.join(d, f"zwreply.osd.0.{p.pid}.bb11")
+    live_rep = os.path.join(d, f"zwreply.osd.1.{os.getpid()}.cc22")
+    for f in (dead_req, dead_rep, live_rep):
+        open(f, "wb").close()
+    # client-side sweep (reconnect): reply rings only
+    assert shm_ring.sweep_stale(d, prefix="zwreply") == 1
+    assert not os.path.exists(dead_rep)
+    assert os.path.exists(dead_req), \
+        "client swept a REQUEST ring it does not own"
+    assert os.path.exists(live_rep), "live reply ring reaped"
+    # daemon-side sweep (bind): request rings only
+    assert shm_ring.sweep_stale(d) == 1
+    assert not os.path.exists(dead_req)
+    assert os.path.exists(live_rep)
+
+
+# -------------------------------------------- ring-layer verdict parity ---
+
+def _poisoned_ring(data: bytes):
+    d = tempfile.mkdtemp()
+    ring = shm_ring.ShmRing.create(d, "osd.9", 1 << 20,
+                                   prefix="zwreply")
+    assert os.path.basename(ring.path).startswith("zwreply.")
+    import zlib
+    tok = ring.put(data, zlib.crc32(data))
+    assert tok is not None
+    # daemon-side corruption AFTER the doorbell crc was taken — the
+    # exact failure wire.flip_bit injects at site "shm_ring"
+    base = shm_ring.HDR_SPACE + tok.off + shm_ring._REC.size
+    ring.mm[base + len(data) // 2] ^= 0x01
+    return ring, tok
+
+
+def test_reply_ring_flip_verdict_parity_host_vs_device():
+    """The poisoned record must die with the SAME verdict whether the
+    reader verifies on the host or through the device-crc scanner —
+    and a clean record must produce identical Csums on both paths."""
+    data = os.urandom(200 * 1024 + 77)
+    for mode in ("off", "on"):
+        config().set("wire_device_crc", mode)
+        ring, tok = _poisoned_ring(data)
+        try:
+            rdr = shm_ring.RingReader(ring.path, ring.size)
+            with pytest.raises(wire.WireError):
+                rdr.read(tok.meta, scanner=wire.receive_csums)
+            rdr.close()
+        finally:
+            ring.close(unlink=True)
+            config().clear("wire_device_crc")
+    import zlib
+    clean = os.urandom(100 * 1024)
+    got = {}
+    for mode in ("off", "on"):
+        config().set("wire_device_crc", mode)
+        try:
+            d = tempfile.mkdtemp()
+            ring = shm_ring.ShmRing.create(d, "x", 1 << 20,
+                                           prefix="zwreply")
+            tok = ring.put(clean, zlib.crc32(clean))
+            rdr = shm_ring.RingReader(ring.path, ring.size)
+            view, cs = rdr.read(tok.meta, scanner=wire.receive_csums)
+            assert bytes(view) == clean
+            got[mode] = (cs.block, cs.subs, cs.length, cs.combined)
+            rdr.close()
+            ring.close(unlink=True)
+        finally:
+            config().clear("wire_device_crc")
+    assert got["off"] == got["on"], \
+        "device and host verify produced different csums"
+
+
+def test_reply_ring_full_returns_none_for_socket_fallback():
+    """The daemon's _reply_blobs treats put()->None as 'ride
+    MSG_REPLY_SG on the socket' — a full reply ring must refuse,
+    never hand out a live extent."""
+    d = tempfile.mkdtemp()
+    ring = shm_ring.ShmRing.create(d, "osd.9", 256 << 10,
+                                   prefix="zwreply")
+    toks = []
+    while True:
+        tok = ring.put(b"R" * 60_000, 0)
+        if tok is None:
+            break
+        toks.append(tok)
+    assert len(toks) >= 3
+    # reclaim (the MSG_SHM_FREE doorbell's effect) reopens space
+    ring.free(toks[0])
+    assert ring.put(b"S" * 50_000, 0) is not None
+    ring.close(unlink=True)
+
+
+# --------------------------------------------------- negotiation gates ---
+
+def test_want_reply_requires_shm_and_option(tmp_path):
+    """A pool with no shm lane (secure mode zeroes shm_bytes — see
+    test_secure_mode_disables_shm_lane) must never ask for a reply
+    ring; with the lane up, wire_reply_ring=False kills it alone."""
+    factory = lambda: (_ for _ in ()).throw(IOError("unused"))
+    pool = wire.StreamPool(factory, size=1, name="t",
+                           shm_dir=None, shm_bytes=0)
+    assert pool._want_reply is False
+    pool = wire.StreamPool(factory, size=1, name="t",
+                           shm_dir=str(tmp_path), shm_bytes=1 << 20)
+    assert pool._want_reply is True
+    config().set("wire_reply_ring", False)
+    try:
+        pool = wire.StreamPool(factory, size=1, name="t",
+                               shm_dir=str(tmp_path),
+                               shm_bytes=1 << 20)
+        assert pool._want_reply is False
+    finally:
+        config().clear("wire_reply_ring")
+
+
+# ------------------------------------------------------- live daemons ---
+
+@pytest.fixture(scope="module")
+def live_cluster(tmp_path_factory):
+    from ceph_tpu.client.remote import RemoteCluster
+    from ceph_tpu.tools.vstart import Vstart, build_cluster_dir
+    d = str(tmp_path_factory.mktemp("rr") / "cluster")
+    build_cluster_dir(d, n_osds=N_OSDS, osds_per_host=1, fsync=False)
+    v = Vstart(d)
+    v.start(N_OSDS, hb_interval=0.5)
+    rc = RemoteCluster(d)
+    yield d, v, rc
+    rc.close()
+    v.stop()
+
+
+def _get_retry(rc, pool, name, polls=40, tick=0.5):
+    last = None
+    for _ in range(polls):
+        try:
+            return rc.get(pool, name)
+        except (OSError, IOError) as e:
+            last = e
+            time.sleep(tick)
+    raise AssertionError(f"get kept failing: {last}")
+
+
+def _reply_files(d):
+    return [fn for fn in os.listdir(d) if fn.startswith("zwreply.")]
+
+
+def test_reply_ring_serves_gets_and_reclaims(live_cluster):
+    """Bulk replies ride the mmap ring (client ``*_served`` counters
+    move by the payload size), and MSG_SHM_FREE reclaim keeps the
+    ring serving an open-ended stream of gets."""
+    d, v, rc = live_cluster
+    data = os.urandom(2 << 20)
+    rc.put(1, "rrmove", data)
+    c0 = perf("wire.zero").dump()
+    assert rc.get(1, "rrmove") == data
+    c1 = perf("wire.zero").dump()
+    served = c1.get("shm_reply_bytes_served", 0) - \
+        c0.get("shm_reply_bytes_served", 0)
+    frames = c1.get("shm_reply_frames_served", 0) - \
+        c0.get("shm_reply_frames_served", 0)
+    assert served >= len(data), (c0, c1)
+    assert frames >= 1
+    assert _reply_files(d), "no zwreply ring file next to the socket"
+    # reclaim: many sequential bulk gets through the SAME ring
+    for i in range(10):
+        assert rc.get(1, "rrmove") == data, f"get {i} failed"
+    c2 = perf("wire.zero").dump()
+    assert c2.get("shm_reply_bytes_served", 0) - \
+        c1.get("shm_reply_bytes_served", 0) >= 10 * len(data), \
+        "reply ring stopped serving (reclaim leak?)"
+
+
+def test_reply_sg_socket_fold_when_ring_disabled(live_cluster):
+    """wire_reply_ring=False: bulk replies ride MSG_REPLY_SG on the
+    socket with the store's TRUSTED csums folded into the frame crc —
+    byte-identical data, zero ring traffic, and the daemons' send
+    path scans at most protocol noise (the fold is the whole point)."""
+    from ceph_tpu.client.remote import RemoteCluster
+    d, v, rc = live_cluster
+    data = os.urandom(2 << 20)
+    rc.put(1, "rrsg", data)
+    config().set("wire_reply_ring", False)
+    rc2 = RemoteCluster(d)
+    try:
+        c0 = perf("wire.zero").dump()
+        d0 = crcutil.wire_zero_counters(d, N_OSDS,
+                                        include_local=False)
+        assert rc2.get(1, "rrsg") == data
+        c1 = perf("wire.zero").dump()
+        d1 = crcutil.wire_zero_counters(d, N_OSDS,
+                                        include_local=False)
+        assert c1.get("shm_reply_bytes_served", 0) == \
+            c0.get("shm_reply_bytes_served", 0), \
+            "ring served bytes with the option off"
+        sent = d1.get("scan_send_bytes", 0) - \
+            d0.get("scan_send_bytes", 0)
+        assert sent < 65536, \
+            f"daemon re-scanned {sent} reply bytes despite the fold"
+    finally:
+        rc2.close()
+        config().clear("wire_reply_ring")
+
+
+def _asok(d, osd, req):
+    return admin_request(os.path.join(d, f"osd.{osd}.asok"), req)
+
+
+def test_daemon_flip_bit_in_reply_ring_drops_connection(live_cluster):
+    """Chaos leg, reply direction: wire.flip_bit armed INSIDE each
+    daemon (site shm_ring — the ring WRITE path, which for replies
+    runs daemon-side) poisons the next reply record.  The client's
+    resolve must reject it (connection drop, like a flipped socket
+    frame) and the retry must return correct bytes."""
+    d, v, rc = live_cluster
+    data = os.urandom(1 << 20)
+    rc.put(1, "rrflip", data)
+    for osd in range(N_OSDS):
+        r = _asok(d, osd, {
+            "prefix": "fault_injection", "action": "arm",
+            "name": "wire.flip_bit", "mode": "always", "count": 1,
+            "match": {"site": "shm_ring"}})
+        assert r["result"]["armed"] == "wire.flip_bit"
+    try:
+        assert _get_retry(rc, 1, "rrflip") == data
+        fired = 0
+        for osd in range(N_OSDS):
+            st = _asok(d, osd,
+                       {"prefix": "fault_injection"})["result"]
+            fired += int(st["fire_counts"].get("wire.flip_bit", 0))
+        assert fired >= 1, "daemon-side flip never fired"
+    finally:
+        for osd in range(N_OSDS):
+            _asok(d, osd, {"prefix": "fault_injection",
+                           "action": "disarm",
+                           "name": "wire.flip_bit"})
+
+
+def test_kill9_reply_rings_swept_on_reconnect(live_cluster):
+    """The sweep-ownership bugfix's regression: kill9 a daemon mid-
+    lane — its reply rings are unreclaimable by their creator.  The
+    retried get completes (surviving replica / socket), and a client
+    (re)connecting afterwards sweeps the orphans: NO ring-file
+    accumulation across daemon generations."""
+    from ceph_tpu.client.remote import RemoteCluster
+    d, v, rc = live_cluster
+    data = os.urandom(1 << 20)
+    rc.put(1, "rrk9", data)
+    assert rc.get(1, "rrk9") == data          # lane warm on both ends
+    victim = 0
+    v.kill9(f"osd.{victim}")
+    assert _get_retry(rc, 1, "rrk9") == data  # completes without osd.0
+    v.start_osd(victim)
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        try:
+            rc.refresh_map()
+            if rc.status()["n_up"] == N_OSDS:
+                break
+        except (OSError, IOError):
+            pass
+        time.sleep(0.5)
+    # a fresh client's pool-build sweeps dead-creator reply rings
+    rc2 = RemoteCluster(d)
+    try:
+        assert rc2.get(1, "rrk9") == data
+    finally:
+        rc2.close()
+    for fn in _reply_files(d):
+        pid = int(fn.split(".")[-2])
+        try:
+            os.kill(pid, 0)
+        except ProcessLookupError:
+            raise AssertionError(
+                f"orphan reply ring {fn} survived the reconnect sweep")
+        except OSError:
+            pass

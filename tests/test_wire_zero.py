@@ -305,6 +305,9 @@ def test_secure_mode_disables_shm_lane():
         ao = AsyncObjecter(object())
         try:
             assert ao.shm_bytes == 0
+            # the reply direction inherits the same promise: no
+            # plaintext mmap lane in secure mode, either way
+            assert ao.reply_wanted is False
         finally:
             ao.close()
     finally:
@@ -623,6 +626,99 @@ def test_ring_disabled_pure_socket_fallback(live_cluster):
     finally:
         rc2.close()
         config().clear("wire_shm_ring_kib")
+
+
+def test_device_crc_zero_host_scans_end_to_end(tmp_path):
+    """RingReply (ISSUE 20) acceptance over live daemons: a cluster
+    booted with ``wire_device_crc=on`` (option layering: the env var
+    reaches each forked daemon) serves a REPLICATED PUT and a
+    DEGRADED GET with ZERO host passes over the bulk bytes — every
+    verify rides the GF(2) matmul (``device_crc_bytes`` moves, the
+    counter that BACKS the zero), the stores adopt the device-verified
+    sub-crcs, and the reply lane folds them into the frame crc.
+    Falsifiable: a ``wire.flip_bit`` in the ring still kills the
+    connection under the device scanner — same verdict as the host
+    path, and the retried op lands intact."""
+    from ceph_tpu.client.remote import RemoteCluster
+    from ceph_tpu.common.options import config
+    from ceph_tpu.tools.vstart import Vstart, build_cluster_dir
+    d = str(tmp_path / "cluster")
+    build_cluster_dir(d, n_osds=N_OSDS, osds_per_host=1, fsync=False)
+    os.environ["CEPH_TPU_WIRE_DEVICE_CRC"] = "on"
+    config().set("wire_device_crc", "on")
+    v = Vstart(d)
+    try:
+        v.start(N_OSDS, hb_interval=0.5)
+        rc = RemoteCluster(d)
+        n = 2 << 20                     # block-aligned: no tail scans
+        data = os.urandom(n)
+        d0 = _daemon_counters(d)
+        c0 = perf("wire.zero").dump()
+        # the staged-in-HBM shape: client csums from the device
+        # kernel, put() threads them to the wire layer (_csums on the
+        # put_object request), the primary replicates with its
+        # verify-trusted csums forwarded — nobody host-scans
+        from ceph_tpu.ops import crc32_gf2
+        cs = crc32_gf2.csums_for(crcutil.as_u8(data))
+        assert rc.put(1, "dz", data, csums=cs) == N_OSDS
+        time.sleep(0.3)
+        d1 = _daemon_counters(d)
+        c1 = perf("wire.zero").dump()
+
+        def delta(a, b, k):
+            return b.get(k, 0) - a.get(k, 0)
+
+        # replicated put: primary + replica each device-verify once;
+        # no daemon host-scans anything, both stores adopt
+        assert delta(d0, d1, "device_crc_bytes") >= 2 * n, (d0, d1)
+        assert delta(d0, d1, "scan_verify_bytes") < 65536, \
+            "a daemon verify fell back to a host scan"
+        assert delta(d0, d1, "scan_store_bytes") == 0
+        assert delta(d0, d1, "trusted_csum_bytes") >= 2 * n
+        # client staged its csums on-device too: zero send scans
+        assert delta(c0, c1, "scan_send_bytes") + \
+            delta(c0, c1, "scan_shm_send_bytes") < 65536
+
+        # degraded get: kill a daemon, read from the survivor
+        v.kill9(f"osd.{N_OSDS - 1}")
+        time.sleep(1.0)
+        d2 = _daemon_counters(d)
+        c2 = perf("wire.zero").dump()
+        got = None
+        for _ in range(40):
+            try:
+                got = rc.get(1, "dz")
+                break
+            except (OSError, IOError):
+                time.sleep(0.5)
+        assert got == data
+        d3 = _daemon_counters(d)
+        c3 = perf("wire.zero").dump()
+        # survivor sends from trusted store csums (fold, no scan);
+        # the client's reply verify rides the device kernel
+        assert delta(d2, d3, "scan_send_bytes") < 65536, \
+            "degraded get re-scanned reply bytes on send"
+        assert delta(c2, c3, "scan_verify_bytes") < 65536, \
+            "client host-scanned the reply despite device mode"
+        assert delta(c2, c3, "device_crc_bytes") >= n, (c2, c3)
+
+        # falsifiability under the device scanner: a flipped ring
+        # byte is rejected (connection drop + retry), not stored
+        fired0 = faults.fire_counts().get("wire.flip_bit", 0)
+        faults.arm("wire.flip_bit", mode="always", count=1,
+                   match={"site": "shm_ring"})
+        try:
+            rc.put(1, "dzflip", data)
+        finally:
+            faults.disarm("wire.flip_bit")
+        assert faults.fire_counts().get("wire.flip_bit", 0) == \
+            fired0 + 1
+        assert rc.get(1, "dzflip") == data
+        rc.close()
+    finally:
+        del os.environ["CEPH_TPU_WIRE_DEVICE_CRC"]
+        config().clear("wire_device_crc")
+        v.stop()
 
 
 # ----------------------------------------------------------- CI smoke ---
